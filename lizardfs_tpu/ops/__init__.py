@@ -1,0 +1,1 @@
+"""Compute kernels: GF(2^8) arithmetic, Reed-Solomon, CRC32, bit-plane JAX ops."""

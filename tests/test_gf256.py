@@ -1,0 +1,156 @@
+"""GF(2^8) field + Reed-Solomon matrix tests against first principles.
+
+Mirrors the reference's reed_solomon_unittest.cc strategy: random
+data, encode parity, erase up to m parts, recover, compare byte-identical.
+The field itself is cross-checked against a bit-level carry-less multiply.
+"""
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.ops import gf256, rs
+
+
+def slow_gf_mul(a: int, b: int) -> int:
+    """Bitwise carry-less multiply mod 0x11d — independent oracle."""
+    p = 0
+    for i in range(8):
+        if (b >> i) & 1:
+            p ^= a << i
+    for i in range(15, 7, -1):
+        if (p >> i) & 1:
+            p ^= 0x11D << (i - 8)
+    return p
+
+
+def test_mul_table_against_bitwise_oracle():
+    rng = np.random.default_rng(0)
+    for _ in range(2000):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert int(gf256.gf_mul(a, b)) == slow_gf_mul(a, b)
+
+
+def test_field_axioms():
+    # generator powers cycle with period 255
+    assert int(gf256.GF_EXP[0]) == 1
+    seen = set(int(x) for x in gf256.GF_EXP[:255])
+    assert len(seen) == 255
+    for a in range(1, 256):
+        assert int(gf256.gf_mul(a, gf256.gf_inv(a))) == 1
+    assert gf256.gf_inv(0) == 0  # ISA-L convention
+
+
+def test_rs_matrix_known_values():
+    # Vandermonde rows: parity row r has entries (2^r)^j.
+    a = gf256.gen_rs_matrix(6, 4)  # k=4, 2 parity rows
+    assert (a[:4] == np.eye(4, dtype=np.uint8)).all()
+    assert list(a[4]) == [1, 1, 1, 1]  # gen = 2^0 = 1
+    assert list(a[5]) == [gf256.gf_pow(2, j) for j in range(4)]
+
+
+def test_cauchy_matrix_known_values():
+    a = gf256.gen_cauchy1_matrix(6, 4)
+    assert (a[:4] == np.eye(4, dtype=np.uint8)).all()
+    for i in (4, 5):
+        for j in range(4):
+            assert int(a[i, j]) == gf256.gf_inv(i ^ j)
+
+
+def test_generator_selection_rule():
+    # Cauchy iff m >= 5 or (m == 4 and k > 20)  (reed_solomon.h:168-172)
+    v = gf256.rs_generator_matrix(4, 2)
+    assert list(v[4]) == [1, 1, 1, 1]  # Vandermonde signature
+    c = gf256.rs_generator_matrix(4, 5)
+    assert int(c[4, 0]) == gf256.gf_inv(4 ^ 0)  # Cauchy signature
+    c2 = gf256.rs_generator_matrix(21, 4)
+    assert int(c2[21, 0]) == gf256.gf_inv(21 ^ 0)
+    v2 = gf256.rs_generator_matrix(20, 4)
+    assert list(v2[20]) == [1] * 20
+
+
+def test_matrix_inversion():
+    rng = np.random.default_rng(1)
+    for n in (2, 5, 13, 32):
+        # generator sub-matrices are invertible by construction
+        gen = gf256.rs_generator_matrix(n, n)
+        rows = sorted(rng.choice(2 * n, size=n, replace=False).tolist())
+        sub = gen[rows, :]
+        inv = gf256.gf_invert_matrix(sub)
+        assert (gf256.gf_matmul(inv, sub) == np.eye(n, dtype=np.uint8)).all()
+
+
+@pytest.mark.parametrize(
+    "k,m", [(2, 1), (3, 2), (4, 4), (8, 2), (8, 4), (21, 4), (8, 5), (32, 8), (32, 32)]
+)
+def test_encode_recover_roundtrip(k, m):
+    rng = np.random.default_rng(42)
+    size = 1024
+    data = [rng.integers(0, 256, size=size, dtype=np.uint8) for _ in range(k)]
+    parity = rs.encode(k, m, data)
+    assert len(parity) == m
+    allparts = data + parity
+
+    # erase m random parts, recover them from the remaining k
+    erased = sorted(rng.choice(k + m, size=m, replace=False).tolist())
+    avail = {i: allparts[i] for i in range(k + m) if i not in erased}
+    rec = rs.recover(k, m, avail, erased)
+    for i in erased:
+        np.testing.assert_array_equal(rec[i], allparts[i], err_msg=f"part {i}")
+
+
+def test_recover_only_data_path():
+    # all wanted parts are data parts -> decode-row selection path
+    k, m = 5, 3
+    rng = np.random.default_rng(7)
+    data = [rng.integers(0, 256, size=256, dtype=np.uint8) for _ in range(k)]
+    parity = rs.encode(k, m, data)
+    allparts = data + parity
+    avail = {i: allparts[i] for i in [1, 3, 5, 6, 7]}
+    rec = rs.recover(k, m, avail, [0, 2])
+    np.testing.assert_array_equal(rec[0], data[0])
+    np.testing.assert_array_equal(rec[2], data[2])
+
+
+def test_zero_part_elision_is_transparent():
+    # None parts (all zeros, elided) must give identical bytes to explicit zeros
+    k, m = 6, 3
+    rng = np.random.default_rng(9)
+    size = 512
+    data = [rng.integers(0, 256, size=size, dtype=np.uint8) for _ in range(k)]
+    data_with_zero = list(data)
+    data_with_zero[2] = np.zeros(size, dtype=np.uint8)
+    data_elided: list = list(data)
+    data_elided[2] = None
+    p_full = rs.encode(k, m, data_with_zero)
+    p_elided = rs.encode(k, m, data_elided)
+    for a, b in zip(p_full, p_elided):
+        np.testing.assert_array_equal(a, b)
+
+    allparts = data_with_zero + p_full
+    avail = {i: allparts[i] for i in range(1, k + m - 2)}
+    avail[2] = None  # available but elided as zero
+    rec_wanted = [0, k + m - 1]
+    rec = rs.recover(k, m, avail, rec_wanted)
+    np.testing.assert_array_equal(rec[0], data_with_zero[0])
+    np.testing.assert_array_equal(rec[k + m - 1], p_full[-1])
+
+
+def test_recover_from_parity_only_mixture():
+    # lose ALL data parts (m >= k case): recover everything from parity
+    k, m = 3, 4
+    rng = np.random.default_rng(11)
+    data = [rng.integers(0, 256, size=128, dtype=np.uint8) for _ in range(k)]
+    parity = rs.encode(k, m, data)
+    avail = {k + i: parity[i] for i in range(k)}  # first 3 parity parts
+    rec = rs.recover(k, m, avail, [0, 1, 2])
+    for i in range(k):
+        np.testing.assert_array_equal(rec[i], data[i])
+
+
+def test_xor_parity_roundtrip():
+    rng = np.random.default_rng(13)
+    parts = [rng.integers(0, 256, size=333, dtype=np.uint8) for _ in range(5)]
+    parity = rs.xor_parity(parts)
+    # recover part 2 from parity + others
+    rec = rs.xor_parity([parity] + [p for i, p in enumerate(parts) if i != 2])
+    np.testing.assert_array_equal(rec, parts[2])
