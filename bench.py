@@ -1,0 +1,101 @@
+"""Benchmark: fused ec(8,4) RS encode + CRC32 of a 64 MiB chunk on TPU.
+
+BASELINE config 3 (the primary target): ec(8,4) encode+CRC32 fused,
+batch = 128 x 64 KiB stripes (one full 64 MiB chunk: 1024 data blocks in
+8 parts, 512 parity blocks in 4 parts), single chip. Baseline = the CPU
+reference path (vectorized numpy golden codec, the stand-in for the
+reference's ISA-L `ec_encode_data` + table CRC until the native C++
+baseline lands).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "MiB/s", "vs_baseline": N}
+
+Timing methodology (axon tunnel quirks — see tests/conftest.py notes):
+dispatch+fetch pays a ~65 ms round trip and block_until_ready is
+unreliable, so the kernel is timed with an in-jit lax.fori_loop whose
+body feeds all outputs back into the carry (nothing DCE-able), measuring
+L iterations in one dispatch; the dispatch floor is measured separately
+with an L=1 loop of the same program and subtracted.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+K, M = 8, 4
+BLOCK = 64 * 1024
+NBLOCKS_PER_PART = 128  # 8 parts x 128 blocks x 64 KiB = 64 MiB data
+DATA_MIB = K * NBLOCKS_PER_PART * BLOCK / 2**20
+
+
+def tpu_throughput() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from lizardfs_tpu.ops import jax_ec
+
+    bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(K, M)))
+    data = jax.device_put(
+        np.random.default_rng(0).integers(
+            0, 256, size=(K, NBLOCKS_PER_PART * BLOCK), dtype=np.uint8
+        )
+    )
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def loop(bigm, x, n):
+        def body(i, x):
+            p, dc, pc = jax_ec.fused_encode_crc(bigm, x, BLOCK)
+            mix = (dc.sum(dtype=jnp.uint32) ^ pc.sum(dtype=jnp.uint32)) & 0xFF
+            x = x.at[:M, :].set(x[:M, :] ^ p)
+            return x.at[0, 0].set(x[0, 0] ^ mix.astype(jnp.uint8))
+
+        return jax.lax.fori_loop(0, n, body, x).sum(dtype=jnp.int32)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        float(loop(bigm, data, n))
+        return time.perf_counter() - t0
+
+    L = 16
+    timed(1)  # compile L=1
+    timed(L)  # compile L=16
+    floor = min(timed(1) for _ in range(3))
+    total = min(timed(L) for _ in range(3))
+    per_iter = max((total - floor) / (L - 1), 1e-9)
+    return DATA_MIB / per_iter
+
+
+def cpu_baseline_throughput() -> float:
+    """CPU reference: golden codec on a 1/16 slice, scaled (it is O(n))."""
+    from lizardfs_tpu.core.encoder import CpuChunkEncoder
+
+    enc = CpuChunkEncoder()
+    frac = 16
+    n = NBLOCKS_PER_PART * BLOCK // frac
+    data = np.random.default_rng(0).integers(0, 256, size=(K, n), dtype=np.uint8)
+    enc.encode_with_checksums(K, M, data, block_size=BLOCK // frac)  # warm tables
+    t0 = time.perf_counter()
+    enc.encode_with_checksums(K, M, data, block_size=BLOCK // frac)
+    dt = time.perf_counter() - t0
+    return (DATA_MIB / frac) / dt
+
+
+def main():
+    value = tpu_throughput()
+    baseline = cpu_baseline_throughput()
+    print(
+        json.dumps(
+            {
+                "metric": "ec(8,4) fused encode+CRC32, 64 MiB chunk, single chip",
+                "value": round(value, 1),
+                "unit": "MiB/s",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Core abstractions: ChunkEncoder plugin boundary, slice/goal geometry."""

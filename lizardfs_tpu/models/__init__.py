@@ -1,0 +1,1 @@
+"""Flagship end-to-end data-plane pipelines (bench + graft entry points)."""

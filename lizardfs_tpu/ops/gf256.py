@@ -202,6 +202,29 @@ def recovery_matrix(
     return gf_matmul(need_rows, decode)
 
 
+def recovery_selection(
+    k: int, m: int, available: list[int], wanted: list[int]
+) -> tuple[list[int], np.ndarray]:
+    """Choose which available parts to read and the matrix to apply.
+
+    The single source of truth for the reference's recover-dispatch rule
+    (reed_solomon.h:97-117): if all k data parts are available, wanted
+    (parity) parts are re-encoded straight from data; otherwise the first
+    k available parts feed an inverted recovery matrix. Returns
+    (used_part_indices, (len(wanted), k) GF matrix over those parts).
+    Both the CPU and TPU backends derive their kernels from this helper,
+    keeping them byte-identical by construction.
+    """
+    avail = sorted(available)
+    data_avail = [i for i in avail if i < k]
+    if len(data_avail) == k:
+        return data_avail, rs_generator_matrix(k, m)[list(wanted), :]
+    if len(avail) < k:
+        raise ValueError(f"need {k} parts to recover, have {len(avail)}")
+    used = avail[:k]
+    return used, recovery_matrix(k, m, used, list(wanted))
+
+
 def reduce_columns(matrix: np.ndarray, nonzero_inputs: list[int]) -> np.ndarray:
     """Drop columns whose inputs are known-zero (zero-part elision,
     reed_solomon.h:202-212). ``nonzero_inputs`` indexes into the matrix's

@@ -1,0 +1,180 @@
+"""JAX/XLA erasure-coding kernels: bit-plane GF matmul + batched CRC32.
+
+TPU-native data plane for the ``ChunkEncoder`` boundary. Design notes:
+
+* **GF(2^8) as MXU matmuls.** Parts are bit-sliced (8x expansion along a
+  small leading axis), the RS generator/recovery matrix is expanded to its
+  (8m, 8k) GF(2) bit-plane form (:mod:`lizardfs_tpu.ops.bitplane`), and
+  parity bits come out of one int8 matmul with int32 accumulation
+  followed by ``& 1``. No log/exp gathers, no data-dependent control
+  flow; XLA tiles the (8m, 8k) x (8k, N) product straight onto the MXU.
+  This replaces the reference's per-byte SSSE3/AVX2 nibble-shuffle loop
+  (reference: src/common/galois_field_encode.cc:50-95).
+
+* **CRC32 as matmul + log-tree combine.** CRC is GF(2)-affine in the
+  message bits; each 64-byte sub-block contributes through a constant
+  32x512 matrix and sub-block registers merge with cached 32x32 shift
+  matrices (:mod:`lizardfs_tpu.ops.crc32`). All 1024 block CRCs of a
+  chunk are one batched matmul plus 10 tiny combines — the serial
+  byte-table loop of the reference (src/common/crc.cc:113-151) disappears.
+
+* **Static shapes, jit-cached per geometry.** Each (k, m, part_size)
+  combination traces once; chunk geometry is fixed (64 KiB blocks), so in
+  steady state there are a handful of compiled programs.
+
+All functions take/return uint8 arrays with parts as equal-length byte
+streams, matching the golden codec in :mod:`lizardfs_tpu.ops.rs`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.ops import bitplane, crc32, gf256
+
+# Sub-block size for the CRC matmul stage. 64 bytes -> C matrix 32x512,
+# contraction dim 512: good MXU shape and small VMEM footprint.
+CRC_SUBBLOCK = 64
+
+
+def _unpack_bits_rows(parts: jnp.ndarray) -> jnp.ndarray:
+    """(r, N) uint8 -> (8r, N) int8 bit-planes; row j*8+b is bit b of part j."""
+    r, n = parts.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = (parts[:, None, :] >> shifts) & 1
+    return bits.astype(jnp.int8).reshape(8 * r, n)
+
+
+def _pack_bits_rows(bits: jnp.ndarray) -> jnp.ndarray:
+    """(8w, N) {0,1} -> (w, N) uint8, inverse of :func:`_unpack_bits_rows`."""
+    w8, n = bits.shape
+    w = w8 // 8
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
+    return (bits.astype(jnp.uint8).reshape(w, 8, n) * weights).sum(
+        axis=1, dtype=jnp.uint8
+    )
+
+
+def apply_gf_bitmatrix(bigm: jnp.ndarray, parts: jnp.ndarray) -> jnp.ndarray:
+    """Apply an expanded (8w, 8r) GF(2) matrix to (r, N) byte parts -> (w, N).
+
+    The core primitive behind both encode and recover.
+    """
+    bits = _unpack_bits_rows(parts)
+    acc = jax.lax.dot_general(
+        bigm,
+        bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _pack_bits_rows(acc & 1)
+
+
+def _crc_tree(partial: jnp.ndarray, level_mats_t: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    """Merge (B, n, 32) sub-block registers down to (B, 32)."""
+    b = partial.shape[0]
+    for mat_t in level_mats_t:
+        partial = partial.reshape(b, -1, 2, 32)
+        left = jax.lax.dot_general(
+            partial[:, :, 0, :],
+            mat_t,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        partial = (left & 1) ^ partial[:, :, 1, :]
+    return partial.reshape(b, 32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def block_crcs(blocks: jnp.ndarray, block_size: int = MFSBLOCKSIZE) -> jnp.ndarray:
+    """CRC32 of each row of a (B, block_size) uint8 array -> (B,) uint32.
+
+    Matmul + tree formulation of the reference's per-block ``mycrc32``.
+    """
+    c_sub, levels, k_const = crc32.block_crc_matrices(block_size, CRC_SUBBLOCK)
+    nsub = block_size // CRC_SUBBLOCK
+    b = blocks.shape[0]
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, None, :]
+    bits = ((blocks[:, :, None] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(b, nsub, 8 * CRC_SUBBLOCK)
+    partial = jax.lax.dot_general(
+        bits,
+        jnp.asarray(c_sub.T, dtype=jnp.int8),
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ) & 1
+    mats = tuple(jnp.asarray(m.T, dtype=jnp.int32) for m in levels)
+    reg = _crc_tree(partial, mats)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    crc = (reg.astype(jnp.uint32) * weights[None, :]).sum(axis=1, dtype=jnp.uint32)
+    return crc ^ jnp.uint32(k_const)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def fused_encode_crc(
+    bigm: jnp.ndarray, data: jnp.ndarray, block_size: int = MFSBLOCKSIZE
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Encode parity and checksum every block of data+parity in one program.
+
+    Args:
+      bigm: (8m, 8k) expanded encoding matrix (int8).
+      data: (k, N) uint8 data parts, N a multiple of block_size.
+    Returns:
+      (parity (m, N) uint8, data_crcs (k, N/bs) uint32,
+       parity_crcs (m, N/bs) uint32).
+
+    This is the TPU analog of the chunkserver's write pipeline: RS encode
+    + per-64KiB-block CRC update in a single fused dispatch (reference
+    call sites: src/mount/chunk_writer.cc:365-398 parity,
+    src/common/write_executor.cc:91-96 CRC).
+    """
+    k, n = data.shape
+    m = bigm.shape[0] // 8
+    nb = n // block_size
+    parity = apply_gf_bitmatrix(bigm, data)
+    data_crcs = block_crcs(data.reshape(k * nb, block_size), block_size)
+    parity_crcs = block_crcs(parity.reshape(m * nb, block_size), block_size)
+    return parity, data_crcs.reshape(k, nb), parity_crcs.reshape(m, nb)
+
+
+@jax.jit
+def apply_gf(bigm: jnp.ndarray, parts: jnp.ndarray) -> jnp.ndarray:
+    """Jitted :func:`apply_gf_bitmatrix` (encode or recover, per matrix)."""
+    return apply_gf_bitmatrix(bigm, parts)
+
+
+@jax.jit
+def xor_reduce(parts: jnp.ndarray) -> jnp.ndarray:
+    """(r, N) uint8 -> (N,) XOR parity (the xor2..xor9 goal family)."""
+    return jax.lax.reduce(parts, jnp.uint8(0), jax.lax.bitwise_xor, (0,))
+
+
+# ---------------------------------------------------------------------------
+# Host-side matrix preparation (cached per geometry, mirrors the
+# reference's gf_table_ caching keyed on (needed, erased, non_zero_input),
+# reed_solomon.h:194-198).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def encoding_bitmatrix(k: int, m: int) -> np.ndarray:
+    """Expanded (8m, 8k) encode matrix for RS(k, m)."""
+    return bitplane.expand_gf_matrix(gf256.encoding_matrix(k, m))
+
+
+@functools.lru_cache(maxsize=1024)
+def recovery_bitmatrix(
+    k: int, m: int, available: tuple[int, ...], wanted: tuple[int, ...]
+) -> np.ndarray:
+    """Expanded recovery matrix computing ``wanted`` from ``available``.
+
+    Part selection is delegated to :func:`gf256.recovery_selection` (the
+    shared dispatch rule), so CPU and TPU stay byte-identical.
+    """
+    _, mat = gf256.recovery_selection(k, m, list(available), list(wanted))
+    return bitplane.expand_gf_matrix(mat)

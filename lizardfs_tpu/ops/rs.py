@@ -81,18 +81,7 @@ def recover(
     k data parts are available this reduces to (re-)encoding parity
     (reed_solomon.h:113-117).
     """
-    avail = sorted(parts.keys())
-    data_avail = [i for i in avail if i < k]
-    if len(data_avail) == k:
-        # Encoding path: compute wanted (parity) parts straight from data.
-        gen = gf256.rs_generator_matrix(k, m)
-        mat = gen[wanted, :]
-        used = data_avail
-    else:
-        if len(avail) < k:
-            raise ValueError(f"need {k} parts to recover, have {len(avail)}")
-        used = avail[:k]
-        mat = gf256.recovery_matrix(k, m, used, wanted)
+    used, mat = gf256.recovery_selection(k, m, list(parts.keys()), wanted)
     nonzero_pos = [j for j, i in enumerate(used) if parts[i] is not None]
     if not nonzero_pos:
         raise ValueError("at least one available part must be non-None")

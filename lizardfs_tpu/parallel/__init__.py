@@ -1,0 +1,1 @@
+"""Multi-chip parallel encode: mesh shardings + collectives over ICI/DCN."""

@@ -1,15 +1,26 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Real TPU hardware (one chip) is reserved for bench.py; tests validate
-numerics and multi-chip sharding on host CPU devices. Must run before any
-jax import, hence here in the root conftest.
+Real TPU hardware (one chip behind the axon tunnel) is reserved for
+bench.py; tests validate numerics and multi-chip sharding on host CPU
+devices.
+
+The axon sitecustomize imports jax and registers the TPU backend at
+interpreter startup — before this conftest runs — so env vars alone don't
+stick under pytest. Setting XLA_FLAGS still works (the CPU client is not
+created yet), and ``jax.config.update("jax_platforms", ...)`` overrides
+the platform as long as no backend has been initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # pre-sitecustomize runs, belt+braces
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

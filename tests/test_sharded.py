@@ -1,0 +1,33 @@
+"""Wide-stripe sharded encode over the 8-device mesh vs golden bytes."""
+
+import jax
+import numpy as np
+import pytest
+
+from lizardfs_tpu.core.encoder import CpuChunkEncoder
+from lizardfs_tpu.parallel.sharded import make_mesh, sharded_encode_with_crcs
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return make_mesh()
+
+
+@pytest.mark.parametrize("k,m", [(32, 8), (16, 4), (8, 8)])
+def test_sharded_encode_byte_identical(mesh, k, m):
+    bs, nb = 512, 16
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    run = sharded_encode_with_crcs(mesh, k, m, bs)
+    parity, dcrc, pcrc = run(data)
+    cpu = CpuChunkEncoder()
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(parity).reshape(m, -1), wp)
+    np.testing.assert_array_equal(np.asarray(dcrc), wd)
+    np.testing.assert_array_equal(np.asarray(pcrc), wpc)
+
+
+def test_sharded_rejects_bad_divisibility(mesh):
+    with pytest.raises(ValueError):
+        sharded_encode_with_crcs(mesh, 12, 4, 512)
