@@ -1,0 +1,1 @@
+"""Shared helpers: striping math, deterministic data generation."""

@@ -1,0 +1,107 @@
+"""Chunk <-> part striping math.
+
+How chunk bytes map onto slice parts (the layout contract shared by the
+client write path, the chunkserver replicator, and the read plans):
+
+  * blocks of 64 KiB are striped round-robin over the d data parts
+    (block i of the chunk lands in data part i % d at block i // d),
+  * xorN slices store data in parts 1..N and the per-stripe XOR parity
+    in part 0; ec(k,m) stores data in parts 0..k-1, RS parity in parts
+    k..k+m-1,
+  * parity is computed over zero-padded 64 KiB blocks; part byte lengths
+    follow geometry.chunk_length_to_part_length.
+
+Reference behavior: src/mount/chunk_writer.cc:365-398 (parity from
+stripes), src/common/slice_traits.h:311-349 (lengths).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.core.encoder import ChunkEncoder, get_encoder
+
+
+def _padded_data_parts(
+    data: np.ndarray, d: int
+) -> tuple[list[np.ndarray], int]:
+    """Split chunk bytes into d zero-padded equal part streams.
+
+    Returns (parts, part_len) where part_len covers ceil(blocks/d) blocks.
+    """
+    nbytes = data.shape[0]
+    nblocks = (nbytes + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
+    blocks_per_part = (nblocks + d - 1) // d
+    part_len = blocks_per_part * MFSBLOCKSIZE
+    # scatter: block i -> part i%d, slot i//d
+    full = np.zeros(d * blocks_per_part * MFSBLOCKSIZE, dtype=np.uint8)
+    full[:nbytes] = data
+    blocks = full.reshape(blocks_per_part * d, MFSBLOCKSIZE)[: nblocks]
+    parts = [np.zeros(part_len, dtype=np.uint8) for _ in range(d)]
+    for i in range(nblocks):
+        p, slot = i % d, i // d
+        parts[p][slot * MFSBLOCKSIZE : (slot + 1) * MFSBLOCKSIZE] = blocks[i]
+    return parts, part_len
+
+
+def split_chunk(
+    data: np.ndarray,
+    slice_type: geometry.SliceType,
+    encoder: ChunkEncoder | None = None,
+) -> dict[int, np.ndarray]:
+    """Split chunk bytes into all parts of a slice (padded streams).
+
+    Returned arrays are zero-padded to whole blocks; callers truncate to
+    geometry.chunk_length_to_part_length for the on-wire/on-disk length.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    enc = encoder or get_encoder("cpu")
+    if slice_type.is_standard or slice_type.is_tape:
+        return {0: data.copy()}
+    d = slice_type.data_parts
+    parts, _ = _padded_data_parts(data, d)
+    if slice_type.is_xor:
+        parity = enc.xor_parity(parts)
+        out = {0: parity}
+        for i, p in enumerate(parts):
+            out[i + 1] = p
+        return out
+    assert slice_type.is_ec
+    m = slice_type.parity_parts
+    parity = enc.encode(d, m, parts)
+    out = {i: p for i, p in enumerate(parts)}
+    for j, p in enumerate(parity):
+        out[d + j] = p
+    return out
+
+
+def part_length(
+    slice_type: geometry.SliceType, part: int, chunk_length: int
+) -> int:
+    return geometry.chunk_length_to_part_length(
+        geometry.ChunkPartType(slice_type, part), chunk_length
+    )
+
+
+def assemble_chunk(
+    data_parts: dict[int, np.ndarray],
+    slice_type: geometry.SliceType,
+    chunk_length: int,
+) -> np.ndarray:
+    """Reassemble chunk bytes from *data* part streams (inverse of
+    split_chunk for the data portion)."""
+    if slice_type.is_standard or slice_type.is_tape:
+        return np.asarray(data_parts[0][:chunk_length])
+    d = slice_type.data_parts
+    first_data = 1 if slice_type.is_xor else 0
+    nblocks = (chunk_length + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
+    out = np.zeros(nblocks * MFSBLOCKSIZE, dtype=np.uint8)
+    for i in range(nblocks):
+        p, slot = i % d, i // d
+        src = data_parts[first_data + p]
+        out[i * MFSBLOCKSIZE : (i + 1) * MFSBLOCKSIZE] = src[
+            slot * MFSBLOCKSIZE : (slot + 1) * MFSBLOCKSIZE
+        ]
+    return out[:chunk_length]

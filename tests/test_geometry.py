@@ -1,0 +1,129 @@
+"""Slice/goal geometry tests — ids and part math must match the reference."""
+
+import pytest
+
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.core import geometry as g
+
+
+def test_slice_type_ids():
+    # goal.h:108-120
+    assert g.SliceType(0).is_standard
+    assert g.SliceType(1).is_tape
+    assert g.xor_type(2) == 2 and g.xor_type(9) == 9
+    assert g.ec_type(2, 1) == 10  # kECFirst
+    assert g.ec_type(32, 32) == 10 + 31 * 32 - 1  # kECLast
+    assert g.ec_type(3, 2) == 10 + 32 * 1 + 1
+    t = g.ec_type(8, 4)
+    assert (t.data_parts, t.parity_parts, t.expected_parts) == (8, 4, 12)
+    x = g.xor_type(5)
+    assert (x.data_parts, x.parity_parts, x.expected_parts) == (5, 1, 6)
+    assert g.SliceType(0).expected_parts == 1
+
+
+def test_part_type_packing():
+    cpt = g.ChunkPartType(g.ec_type(3, 2), 4)
+    assert cpt.id == int(g.ec_type(3, 2)) * 64 + 4
+    assert g.ChunkPartType.from_id(cpt.id) == cpt
+    assert cpt.is_parity and cpt.parity_part_index == 1
+    assert g.ChunkPartType(g.ec_type(3, 2), 2).is_data
+    # xor: part 0 is parity, data parts 1..N with 0-based stripe index
+    xp = g.ChunkPartType(g.xor_type(3), 0)
+    assert xp.is_parity
+    xd = g.ChunkPartType(g.xor_type(3), 2)
+    assert xd.is_data and xd.data_part_index == 1
+    assert cpt.to_string() == "ec(3,2):4"
+
+
+@pytest.mark.parametrize(
+    "k,blocks,per_part",
+    [
+        (3, 1024, [342, 341, 341]),
+        (2, 1024, [512, 512]),
+        (8, 1000, [125] * 8),
+        (3, 1, [1, 0, 0]),
+    ],
+)
+def test_number_of_blocks(k, blocks, per_part):
+    t = g.ec_type(k, 2)
+    for i, want in enumerate(per_part):
+        cpt = g.ChunkPartType(t, i)
+        assert g.number_of_blocks_in_part(cpt, blocks) == want
+    # parity parts are as long as part 0
+    p = g.ChunkPartType(t, k)
+    assert g.number_of_blocks_in_part(p, blocks) == per_part[0]
+
+
+def test_chunk_length_to_part_length():
+    t = g.ec_type(3, 2)
+    bs = MFSBLOCKSIZE
+    # exactly 2 full stripes
+    L = 2 * 3 * bs
+    for part in range(3):
+        assert g.chunk_length_to_part_length(g.ChunkPartType(t, part), L) == 2 * bs
+    # partial stripe: 2 stripes + 1.5 blocks
+    L = 2 * 3 * bs + bs + bs // 2
+    assert g.chunk_length_to_part_length(g.ChunkPartType(t, 0), L) == 3 * bs
+    assert g.chunk_length_to_part_length(g.ChunkPartType(t, 1), L) == 2 * bs + bs // 2
+    assert g.chunk_length_to_part_length(g.ChunkPartType(t, 2), L) == 2 * bs
+    # parity follows part 0
+    assert g.chunk_length_to_part_length(g.ChunkPartType(t, 3), L) == 3 * bs
+    # std slice gets everything
+    assert g.chunk_length_to_part_length(g.standard_part(), 12345) == 12345
+
+
+def test_goal_parsing_examples():
+    # examples straight from doc/mfsgoals.cfg.5.txt:88-98
+    cases = {
+        "3 3 : _ _ _": ("3", g.STANDARD, 3),
+        "8 not_important_file : _": ("not_important_file", g.STANDARD, 1),
+        "12 local_copy_on_mars : mars _": ("local_copy_on_mars", g.STANDARD, 2),
+        "15 default_xor3 : $xor3": ("default_xor3", g.xor_type(3), 4),
+        "16 fast_read : $xor2 { ssd ssd hdd }": ("fast_read", g.xor_type(2), 3),
+        "18 first_ec : $ec(3,1)": ("first_ec", g.ec_type(3, 1), 4),
+        "20 ec53_mixed : $ec(5,3) { hdd ssd hdd _ _ _ _ _ }": (
+            "ec53_mixed",
+            g.ec_type(5, 3),
+            8,
+        ),
+    }
+    for line, (name, type_, copies) in cases.items():
+        gid, goal = g.parse_goal_line(line)
+        assert goal.name == name
+        assert int(goal.slices[0].type) == int(type_)
+        assert goal.expected_copies() == copies
+
+    # label placement for the mixed ec goal
+    _, goal = g.parse_goal_line("20 ec53_mixed : $ec(5,3) { hdd ssd hdd _ _ _ _ _ }")
+    s = goal.slices[0]
+    assert s.labels_of_part(0) == {"hdd": 1}
+    assert s.labels_of_part(1) == {"ssd": 1}
+    assert s.labels_of_part(3) == {"_": 1}
+
+
+def test_goal_parsing_errors():
+    for bad in [
+        "0 zero : _",  # id out of range
+        "41 hi : _",
+        "3 bad name : _",
+        "3 x : $xor1",
+        "3 x : $xor10",
+        "3 x : $ec(1,1)",
+        "3 x : $ec(33,1)",
+        "3 x : $ec",
+        "3 x : $wat",
+        "3 x : $xor2 ssd ssd",  # typed labels must be braced
+        "nonsense",
+    ]:
+        with pytest.raises(g.GoalConfigError):
+            g.parse_goal_line(bad)
+    assert g.parse_goal_line("  # comment only") is None
+    assert g.parse_goal_line("") is None
+
+
+def test_load_config_keeps_defaults():
+    goals = g.load_goal_config("15 x3 : $xor3\n")
+    assert goals[1].expected_copies() == 1
+    assert goals[3].expected_copies() == 3
+    assert int(goals[15].slices[0].type) == int(g.xor_type(3))
+    assert goals[40].expected_copies() == 1

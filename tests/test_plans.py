@@ -1,0 +1,171 @@
+"""In-memory wave-by-wave plan simulation (reference plan_tester pattern:
+src/unittests/plan_tester.h — no sockets, deterministic data, simulated
+failures)."""
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.core import geometry, plans
+from lizardfs_tpu.utils import data_generator, striping
+
+
+class PlanSimulator:
+    """Executes a plan wave by wave against in-memory parts."""
+
+    def __init__(self, chunk_length: int, slice_type: geometry.SliceType):
+        self.chunk = data_generator.generate(0, chunk_length)
+        self.slice_type = slice_type
+        self.parts = striping.split_chunk(self.chunk, slice_type)
+        self.part_sizes = {
+            p: striping.part_length(slice_type, p, chunk_length)
+            for p in self.parts
+        }
+
+    def planner(self, available=None, scores=None) -> plans.SliceReadPlanner:
+        avail = available if available is not None else sorted(self.parts)
+        return plans.SliceReadPlanner(self.slice_type, avail, scores)
+
+    def execute(self, plan: plans.SliceReadPlan, failing=()):
+        buffer = np.zeros(plan.buffer_size, dtype=np.uint8)
+        available: list[int] = []
+        unreadable: list[int] = []
+        max_wave = max((op.wave for op in plan.read_operations), default=0)
+        for wave in range(max_wave + 1):
+            for op in plan.read_operations:
+                if op.wave != wave:
+                    continue
+                if op.part in failing:
+                    unreadable.append(op.part)
+                    if not plan.is_finishing_possible(unreadable):
+                        raise IOError("plan cannot finish")
+                    continue
+                src = self.parts[op.part][: self.part_sizes[op.part]]
+                chunk = src[op.request_offset : op.request_offset + op.request_size]
+                buffer[op.buffer_offset : op.buffer_offset + len(chunk)] = chunk
+                available.append(op.part)
+            if plan.is_reading_finished(available):
+                break
+        else:
+            raise IOError("waves exhausted without enough parts")
+        return plan.postprocess(buffer, available)
+
+
+def expected_result(sim, wanted_parts, first_block, block_count):
+    bps = block_count * MFSBLOCKSIZE
+    out = np.zeros(len(wanted_parts) * bps, dtype=np.uint8)
+    off = first_block * MFSBLOCKSIZE
+    for i, p in enumerate(wanted_parts):
+        src = sim.parts[p][off : off + bps]
+        out[i * bps : i * bps + len(src)] = src
+    return out
+
+
+CHUNK_LEN = 7 * MFSBLOCKSIZE + 12345  # 7.2 blocks: exercises padding
+
+
+@pytest.mark.parametrize("slice_type", [geometry.ec_type(3, 2), geometry.xor_type(3)])
+def test_read_all_available(slice_type):
+    sim = PlanSimulator(CHUNK_LEN, slice_type)
+    wanted = (
+        list(range(3)) if slice_type.is_ec else [1, 2, 3]
+    )  # data parts
+    plan = sim.planner().build_plan(wanted, 0, 3, sim.part_sizes)
+    result = sim.execute(plan)
+    np.testing.assert_array_equal(result, expected_result(sim, wanted, 0, 3))
+    # wave 0 must contain exactly the wanted parts
+    assert sorted(op.part for op in plan.read_operations if op.wave == 0) == sorted(wanted)
+
+
+def test_ec_recovery_on_runtime_failure():
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    wanted = [0, 1, 2]
+    plan = sim.planner().build_plan(wanted, 0, 3, sim.part_sizes)
+    # two data parts die at runtime -> fallback waves deliver both parities
+    result = sim.execute(plan, failing={0, 1})
+    np.testing.assert_array_equal(result, expected_result(sim, wanted, 0, 3))
+
+
+def test_ec_recovery_with_known_missing_parts():
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    # part 1 known-unavailable at planning time
+    planner = sim.planner(available=[0, 2, 3, 4])
+    plan = planner.build_plan([0, 1, 2], 0, 3, sim.part_sizes)
+    # wave 0 must already include a recovery source
+    wave0 = [op.part for op in plan.read_operations if op.wave == 0]
+    assert len(wave0) >= 3
+    result = sim.execute(plan)
+    np.testing.assert_array_equal(result, expected_result(sim, [0, 1, 2], 0, 3))
+
+
+def test_xor_recovery():
+    t = geometry.xor_type(3)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    wanted = [1, 2, 3]
+    plan = sim.planner().build_plan(wanted, 0, 3, sim.part_sizes)
+    result = sim.execute(plan, failing={2})  # parity (part 0) recovers it
+    np.testing.assert_array_equal(result, expected_result(sim, wanted, 0, 3))
+
+
+def test_xor_two_failures_is_fatal():
+    t = geometry.xor_type(3)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    plan = sim.planner().build_plan([1, 2, 3], 0, 3, sim.part_sizes)
+    with pytest.raises(IOError):
+        sim.execute(plan, failing={1, 2})
+
+
+def test_ec_too_many_failures_is_fatal():
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    plan = sim.planner().build_plan([0, 1, 2], 0, 3, sim.part_sizes)
+    with pytest.raises(IOError):
+        sim.execute(plan, failing={0, 1, 2})
+
+
+def test_parity_part_read_and_recovery():
+    # chunkserver replication reads parity parts too (RecoverParity analog)
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    plan = sim.planner().build_plan([3, 4], 0, 3, sim.part_sizes)
+    result = sim.execute(plan)
+    np.testing.assert_array_equal(result, expected_result(sim, [3, 4], 0, 3))
+    # and with the parity parts dead: recompute them from data
+    plan2 = sim.planner(available=[0, 1, 2]).build_plan([3, 4], 0, 3, sim.part_sizes)
+    result2 = sim.execute(plan2)
+    np.testing.assert_array_equal(result2, expected_result(sim, [3, 4], 0, 3))
+
+
+def test_partial_block_zero_padding():
+    # trailing partial block: requested size < buffer_part_size
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    nb = geometry.number_of_blocks_in_part(geometry.ChunkPartType(t, 2), 8)
+    plan = sim.planner().build_plan([2], 0, 3, sim.part_sizes)
+    info = plan.requested_parts[0]
+    assert info.size < plan.buffer_part_size  # part 2 is short
+    result = sim.execute(plan)
+    np.testing.assert_array_equal(result, expected_result(sim, [2], 0, 3))
+    assert (result[info.size :] == 0).all()
+
+
+def test_unreadable_plan_rejected():
+    t = geometry.ec_type(3, 2)
+    sim = PlanSimulator(CHUNK_LEN, t)
+    planner = sim.planner(available=[0, 1])  # only 2 of 5 parts
+    with pytest.raises(ValueError):
+        planner.build_plan([0, 1, 2], 0, 3, sim.part_sizes)
+
+
+def test_assemble_roundtrip():
+    for t in (geometry.ec_type(4, 2), geometry.xor_type(2), geometry.SliceType(0)):
+        sim = PlanSimulator(CHUNK_LEN, t)
+        data_parts = {
+            p: arr
+            for p, arr in sim.parts.items()
+            if geometry.ChunkPartType(t, p).is_data
+        }
+        back = striping.assemble_chunk(data_parts, t, CHUNK_LEN)
+        np.testing.assert_array_equal(back, sim.chunk)
