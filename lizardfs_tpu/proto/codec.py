@@ -1,0 +1,162 @@
+"""Declarative binary message codec.
+
+The reference generates typed big-endian serializers for every message
+with macro magic (reference: src/common/serialization.h,
+serialization_macros.h:82-140). Here the same idea is a dataclass-like
+metaclass: a message declares ``FIELDS`` as (name, type) pairs and gets
+``pack``/``unpack`` plus equality for free.
+
+Field type language:
+  u8 u16 u32 u64 i32 i64      big-endian scalars
+  bool                        one byte
+  bytes                       u32 length-prefixed byte string
+  str                         u32 length-prefixed utf-8 string
+  list:<type>                 u32 count-prefixed homogeneous list
+  msg:<ClassName>             nested message (class must be registered)
+
+Messages are versioned at the framing layer (see framing.py), matching
+the reference's LIZ packet version field (src/protocol/packet.h:29-43).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+_SCALARS = {
+    "u8": ">B",
+    "u16": ">H",
+    "u32": ">I",
+    "u64": ">Q",
+    "i32": ">i",
+    "i64": ">q",
+    "bool": ">?",
+}
+
+_MESSAGE_CLASSES: dict[str, type] = {}
+_TYPE_REGISTRY: dict[int, type] = {}
+
+
+def _pack_value(ftype: str, value: Any, out: bytearray) -> None:
+    if ftype in _SCALARS:
+        out += struct.pack(_SCALARS[ftype], value)
+    elif ftype == "bytes":
+        b = bytes(value)
+        out += struct.pack(">I", len(b))
+        out += b
+    elif ftype == "str":
+        b = str(value).encode("utf-8")
+        out += struct.pack(">I", len(b))
+        out += b
+    elif ftype.startswith("list:"):
+        inner = ftype[5:]
+        out += struct.pack(">I", len(value))
+        for item in value:
+            _pack_value(inner, item, out)
+    elif ftype.startswith("msg:"):
+        cls = _MESSAGE_CLASSES[ftype[4:]]
+        out += value.pack_body()
+    else:
+        raise TypeError(f"unknown field type {ftype!r}")
+
+
+def _unpack_value(ftype: str, buf: memoryview, off: int) -> tuple[Any, int]:
+    if ftype in _SCALARS:
+        fmt = _SCALARS[ftype]
+        size = struct.calcsize(fmt)
+        return struct.unpack_from(fmt, buf, off)[0], off + size
+    if ftype == "bytes":
+        (n,) = struct.unpack_from(">I", buf, off)
+        off += 4
+        return bytes(buf[off : off + n]), off + n
+    if ftype == "str":
+        (n,) = struct.unpack_from(">I", buf, off)
+        off += 4
+        return bytes(buf[off : off + n]).decode("utf-8"), off + n
+    if ftype.startswith("list:"):
+        inner = ftype[5:]
+        (n,) = struct.unpack_from(">I", buf, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            item, off = _unpack_value(inner, buf, off)
+            items.append(item)
+        return items, off
+    if ftype.startswith("msg:"):
+        cls = _MESSAGE_CLASSES[ftype[4:]]
+        return cls.unpack_body(buf, off)
+    raise TypeError(f"unknown field type {ftype!r}")
+
+
+class Message:
+    """Base class; subclasses define MSG_TYPE (int or None) and FIELDS."""
+
+    MSG_TYPE: int | None = None
+    FIELDS: tuple[tuple[str, str], ...] = ()
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _MESSAGE_CLASSES[cls.__name__] = cls
+        if cls.MSG_TYPE is not None:
+            existing = _TYPE_REGISTRY.get(cls.MSG_TYPE)
+            if existing is not None and existing.__name__ != cls.__name__:
+                raise TypeError(
+                    f"duplicate MSG_TYPE {cls.MSG_TYPE}: "
+                    f"{existing.__name__} vs {cls.__name__}"
+                )
+            _TYPE_REGISTRY[cls.MSG_TYPE] = cls
+
+    def __init__(self, **kwargs):
+        for name, _ in self.FIELDS:
+            if name not in kwargs:
+                raise TypeError(f"{type(self).__name__} missing field {name!r}")
+            setattr(self, name, kwargs.pop(name))
+        if kwargs:
+            raise TypeError(f"{type(self).__name__} unknown fields {sorted(kwargs)}")
+
+    def pack_body(self) -> bytes:
+        out = bytearray()
+        for name, ftype in self.FIELDS:
+            _pack_value(ftype, getattr(self, name), out)
+        return bytes(out)
+
+    @classmethod
+    def unpack_body(cls, buf: memoryview | bytes, off: int = 0):
+        buf = memoryview(buf)
+        values = {}
+        for name, ftype in cls.FIELDS:
+            values[name], off = _unpack_value(ftype, buf, off)
+        return cls(**values), off
+
+    @classmethod
+    def parse(cls, payload: bytes):
+        msg, off = cls.unpack_body(payload)
+        if off != len(payload):
+            raise ValueError(
+                f"{cls.__name__}: trailing {len(payload) - off} bytes"
+            )
+        return msg
+
+    def __eq__(self, other):
+        return type(self) is type(other) and all(
+            getattr(self, n) == getattr(other, n) for n, _ in self.FIELDS
+        )
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{n}={_short(getattr(self, n))!r}" for n, _ in self.FIELDS
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+def _short(v):
+    if isinstance(v, (bytes, bytearray)) and len(v) > 16:
+        return v[:16] + b"..."
+    return v
+
+
+def message_class_for(msg_type: int) -> type[Message]:
+    try:
+        return _TYPE_REGISTRY[msg_type]
+    except KeyError:
+        raise KeyError(f"unknown message type {msg_type}") from None

@@ -1,0 +1,55 @@
+"""Packet framing over asyncio streams.
+
+Same shape as the reference's framing (reference: src/protocol/packet.h:
+29-57): an 8-byte header — type:u32, length:u32 big-endian — followed by
+``length`` payload bytes, with a protocol version byte leading the
+payload (the LIZ packet version field).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from lizardfs_tpu.proto.codec import Message, message_class_for
+
+HEADER = struct.Struct(">II")
+PROTO_VERSION = 1
+MAX_PACKET_SIZE = 128 * 1024 * 1024  # sanity bound
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def encode(msg: Message) -> bytes:
+    if msg.MSG_TYPE is None:
+        raise ProtocolError(f"{type(msg).__name__} is not a top-level message")
+    body = msg.pack_body()
+    return HEADER.pack(msg.MSG_TYPE, len(body) + 1) + bytes([PROTO_VERSION]) + body
+
+
+def decode(msg_type: int, payload: bytes) -> Message:
+    if not payload:
+        raise ProtocolError("empty payload")
+    if payload[0] != PROTO_VERSION:
+        raise ProtocolError(f"unsupported protocol version {payload[0]}")
+    return message_class_for(msg_type).parse(payload[1:])
+
+
+async def read_message(reader: asyncio.StreamReader) -> Message:
+    header = await reader.readexactly(HEADER.size)
+    msg_type, length = HEADER.unpack(header)
+    if length > MAX_PACKET_SIZE:
+        raise ProtocolError(f"packet too large: {length}")
+    payload = await reader.readexactly(length)
+    return decode(msg_type, payload)
+
+
+def write_message(writer: asyncio.StreamWriter, msg: Message) -> None:
+    writer.write(encode(msg))
+
+
+async def send_message(writer: asyncio.StreamWriter, msg: Message) -> None:
+    write_message(writer, msg)
+    await writer.drain()

@@ -1,0 +1,525 @@
+"""Message catalog for every link in the system.
+
+Semantic mirror of the reference's per-link packet headers (reference:
+src/protocol/{cltoma,matocl,cltocs,cstocl,cstoma,matocs,cstocs}.h and the
+id catalog in MFSCommunication.h) with a fresh, uniform encoding via
+:mod:`lizardfs_tpu.proto.codec`. Type id ranges by link:
+
+  1000-1099  client -> master (CLTOMA) / master -> client (MATOCL)
+  1100-1199  chunkserver <-> master (CSTOMA / MATOCS)
+  1200-1299  client/peer <-> chunkserver data plane (CLTOCS / CSTOCL / CSTOCS)
+  1300-1399  metalogger/shadow <-> master (MLTOMA / MATOML)
+  1400-1499  admin
+
+Requests carry a ``req_id`` echoed by the response so links can pipeline
+(the reference pairs messages by message id fields similarly).
+"""
+
+from __future__ import annotations
+
+from lizardfs_tpu.proto.codec import Message
+
+# --------------------------------------------------------------------------
+# shared sub-structures
+# --------------------------------------------------------------------------
+
+
+class Addr(Message):
+    """Network address of a daemon."""
+
+    FIELDS = (("host", "str"), ("port", "u16"))
+
+    def key(self):
+        return (self.host, self.port)
+
+
+class Attr(Message):
+    """File attributes (subset of the reference's 35-byte attr blob)."""
+
+    FIELDS = (
+        ("inode", "u32"),
+        ("ftype", "u8"),  # 1=file, 2=directory, 3=symlink
+        ("mode", "u16"),
+        ("uid", "u32"),
+        ("gid", "u32"),
+        ("atime", "u32"),
+        ("mtime", "u32"),
+        ("ctime", "u32"),
+        ("nlink", "u32"),
+        ("length", "u64"),
+        ("goal", "u8"),
+        ("trash_time", "u32"),
+    )
+
+
+FTYPE_FILE = 1
+FTYPE_DIR = 2
+FTYPE_SYMLINK = 3
+
+
+class PartLocation(Message):
+    """Where one chunk part lives."""
+
+    FIELDS = (("addr", "msg:Addr"), ("part_id", "u32"))  # part_id = ChunkPartType.id
+
+
+class DirEntry(Message):
+    FIELDS = (("name", "str"), ("inode", "u32"), ("ftype", "u8"))
+
+
+class ChunkPartInfo(Message):
+    """A chunk part held by a chunkserver (registration / reports)."""
+
+    FIELDS = (("chunk_id", "u64"), ("version", "u32"), ("part_id", "u32"))
+
+
+# --------------------------------------------------------------------------
+# client <-> master
+# --------------------------------------------------------------------------
+
+
+class CltomaRegister(Message):
+    MSG_TYPE = 1000
+    FIELDS = (("req_id", "u32"), ("session_id", "u64"), ("info", "str"))
+
+
+class MatoclRegister(Message):
+    MSG_TYPE = 1001
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("session_id", "u64"))
+
+
+class CltomaLookup(Message):
+    MSG_TYPE = 1002
+    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+
+
+class MatoclAttrReply(Message):
+    """Shared reply for lookup/getattr/mkdir/create/setattr."""
+
+    MSG_TYPE = 1003
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("attr", "msg:Attr"))
+
+
+class CltomaGetattr(Message):
+    MSG_TYPE = 1004
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class CltomaMkdir(Message):
+    MSG_TYPE = 1006
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("mode", "u16"),
+        ("uid", "u32"),
+        ("gid", "u32"),
+    )
+
+
+class CltomaCreate(Message):
+    MSG_TYPE = 1008
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("mode", "u16"),
+        ("uid", "u32"),
+        ("gid", "u32"),
+    )
+
+
+class CltomaReaddir(Message):
+    MSG_TYPE = 1010
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclReaddir(Message):
+    MSG_TYPE = 1011
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("entries", "list:msg:DirEntry"),
+    )
+
+
+class CltomaUnlink(Message):
+    MSG_TYPE = 1012
+    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+
+
+class MatoclStatusReply(Message):
+    """Generic status-only reply."""
+
+    MSG_TYPE = 1013
+    FIELDS = (("req_id", "u32"), ("status", "u8"))
+
+
+class CltomaRmdir(Message):
+    MSG_TYPE = 1014
+    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+
+
+class CltomaRename(Message):
+    MSG_TYPE = 1016
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent_src", "u32"),
+        ("name_src", "str"),
+        ("parent_dst", "u32"),
+        ("name_dst", "str"),
+    )
+
+
+class CltomaSetGoal(Message):
+    MSG_TYPE = 1018
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("goal", "u8"))
+
+
+class CltomaReadChunk(Message):
+    MSG_TYPE = 1020
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("chunk_index", "u32"))
+
+
+class MatoclReadChunk(Message):
+    MSG_TYPE = 1021
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("file_length", "u64"),
+        ("locations", "list:msg:PartLocation"),
+    )
+
+
+class CltomaWriteChunk(Message):
+    MSG_TYPE = 1022
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("chunk_index", "u32"))
+
+
+class MatoclWriteChunk(Message):
+    MSG_TYPE = 1023
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("file_length", "u64"),
+        ("locations", "list:msg:PartLocation"),
+    )
+
+
+class CltomaWriteChunkEnd(Message):
+    MSG_TYPE = 1024
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("inode", "u32"),
+        ("chunk_index", "u32"),
+        ("file_length", "u64"),
+        ("status", "u8"),
+    )
+
+
+class CltomaTruncate(Message):
+    MSG_TYPE = 1026
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("length", "u64"))
+
+
+class CltomaSetattr(Message):
+    MSG_TYPE = 1028
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("set_mask", "u8"),  # 1=mode, 2=uid, 4=gid, 8=atime, 16=mtime
+        ("mode", "u16"),
+        ("uid", "u32"),
+        ("gid", "u32"),
+        ("atime", "u32"),
+        ("mtime", "u32"),
+    )
+
+
+class CltomaSymlink(Message):
+    MSG_TYPE = 1030
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("target", "str"),
+        ("uid", "u32"),
+        ("gid", "u32"),
+    )
+
+
+class CltomaReadlink(Message):
+    MSG_TYPE = 1032
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclReadlink(Message):
+    MSG_TYPE = 1033
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("target", "str"))
+
+
+class CltomaLink(Message):
+    MSG_TYPE = 1034
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+    )
+
+
+# --------------------------------------------------------------------------
+# chunkserver <-> master
+# --------------------------------------------------------------------------
+
+
+class CstomaRegister(Message):
+    MSG_TYPE = 1100
+    FIELDS = (
+        ("req_id", "u32"),
+        ("addr", "msg:Addr"),
+        ("label", "str"),
+        ("chunks", "list:msg:ChunkPartInfo"),
+        ("total_space", "u64"),
+        ("used_space", "u64"),
+    )
+
+
+class MatocsRegisterReply(Message):
+    MSG_TYPE = 1101
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("cs_id", "u32"))
+
+
+class CstomaHeartbeat(Message):
+    MSG_TYPE = 1102
+    FIELDS = (
+        ("req_id", "u32"),
+        ("cs_id", "u32"),
+        ("total_space", "u64"),
+        ("used_space", "u64"),
+    )
+
+
+class CstomaChunkDamaged(Message):
+    MSG_TYPE = 1104
+    FIELDS = (("cs_id", "u32"), ("chunks", "list:msg:ChunkPartInfo"))
+
+
+class CstomaChunkLost(Message):
+    MSG_TYPE = 1105
+    FIELDS = (("cs_id", "u32"), ("chunks", "list:msg:ChunkPartInfo"))
+
+
+class CstomaChunkNew(Message):
+    """Report parts gained (e.g. after replication)."""
+
+    MSG_TYPE = 1106
+    FIELDS = (("cs_id", "u32"), ("chunks", "list:msg:ChunkPartInfo"))
+
+
+class MatocsCreateChunk(Message):
+    MSG_TYPE = 1110
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+    )
+
+
+class MatocsDeleteChunk(Message):
+    MSG_TYPE = 1112
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+    )
+
+
+class MatocsSetVersion(Message):
+    MSG_TYPE = 1114
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("old_version", "u32"),
+        ("new_version", "u32"),
+        ("part_id", "u32"),
+    )
+
+
+class MatocsReplicate(Message):
+    """Recover/copy a part from source parts (EC recovery engine)."""
+
+    MSG_TYPE = 1116
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+        ("sources", "list:msg:PartLocation"),
+    )
+
+
+class MatocsTruncateChunk(Message):
+    MSG_TYPE = 1118
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("old_version", "u32"),
+        ("new_version", "u32"),
+        ("part_id", "u32"),
+        ("chunk_length", "u32"),  # length of the whole chunk, not the part
+    )
+
+
+class CstomaChunkOpStatus(Message):
+    """Ack for any master->CS chunk command."""
+
+    MSG_TYPE = 1120
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("chunk_id", "u64"),
+        ("part_id", "u32"),
+    )
+
+
+# --------------------------------------------------------------------------
+# data plane: client/peer <-> chunkserver
+# --------------------------------------------------------------------------
+
+
+class CltocsRead(Message):
+    MSG_TYPE = 1200
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+        ("offset", "u32"),
+        ("size", "u32"),
+    )
+
+
+class CstoclReadData(Message):
+    """One 64 KiB-aligned piece with its CRC (cstocl READ_DATA)."""
+
+    MSG_TYPE = 1201
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("offset", "u32"),
+        ("crc", "u32"),
+        ("data", "bytes"),
+    )
+
+
+class CstoclReadStatus(Message):
+    MSG_TYPE = 1202
+    FIELDS = (("req_id", "u32"), ("chunk_id", "u64"), ("status", "u8"))
+
+
+class CltocsWriteInit(Message):
+    """Open a write chain: this CS stores the part and forwards to the
+    rest of the chain (cltocs WRITE_INIT, network_worker_thread.cc:574)."""
+
+    MSG_TYPE = 1210
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+        ("chain", "list:msg:PartLocation"),  # remaining chain after this CS
+        ("create", "bool"),  # create part if absent (first write)
+    )
+
+
+class CltocsWriteData(Message):
+    MSG_TYPE = 1211
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("write_id", "u32"),
+        ("block", "u32"),  # block index within the part
+        ("offset", "u32"),  # offset within the block
+        ("crc", "u32"),  # CRC of this piece
+        ("data", "bytes"),
+    )
+
+
+class CstoclWriteStatus(Message):
+    """Per-write ack, flows back up the chain."""
+
+    MSG_TYPE = 1212
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("write_id", "u32"),
+        ("status", "u8"),
+    )
+
+
+class CltocsWriteEnd(Message):
+    MSG_TYPE = 1213
+    FIELDS = (("req_id", "u32"), ("chunk_id", "u64"))
+
+
+# --------------------------------------------------------------------------
+# metalogger / shadow <-> master
+# --------------------------------------------------------------------------
+
+
+class MltomaRegister(Message):
+    MSG_TYPE = 1300
+    FIELDS = (("req_id", "u32"), ("version_known", "u64"))
+
+
+class MatomlChangelogLine(Message):
+    """Streamed changelog entry (matoml broadcast_logstring analog)."""
+
+    MSG_TYPE = 1301
+    FIELDS = (("version", "u64"), ("line", "str"))
+
+
+class MltomaDownloadImage(Message):
+    MSG_TYPE = 1302
+    FIELDS = (("req_id", "u32"),)
+
+
+class MatomlImage(Message):
+    MSG_TYPE = 1303
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("version", "u64"), ("image", "bytes"))
+
+
+# --------------------------------------------------------------------------
+# admin
+# --------------------------------------------------------------------------
+
+
+class AdminInfo(Message):
+    MSG_TYPE = 1400
+    FIELDS = (("req_id", "u32"),)
+
+
+class AdminInfoReply(Message):
+    MSG_TYPE = 1401
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+class AdminCommand(Message):
+    """Generic admin command with JSON payload (list-chunkservers,
+    chunks-health, save-metadata, promote-shadow, ...)."""
+
+    MSG_TYPE = 1402
+    FIELDS = (("req_id", "u32"), ("command", "str"), ("json", "str"))
+
+
+class AdminReply(Message):
+    MSG_TYPE = 1403
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))

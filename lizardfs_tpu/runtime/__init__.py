@@ -1,0 +1,1 @@
+"""Daemon runtime: event-loop harness, config system, RPC connections."""

@@ -1,0 +1,128 @@
+"""Asyncio daemon harness: serve, timers, reload/terminate hooks.
+
+The analog of the reference's event loop + main harness (reference:
+src/common/event_loop.h:47-77 poll loop with timers and reload/exit
+hooks; src/main/main.cc daemon scaffolding). One asyncio loop per
+daemon; connection handlers and periodic tasks are coroutines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+
+def setup_logging(name: str, level: str = "INFO") -> logging.Logger:
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s [" + name + "] %(message)s",
+        stream=sys.stderr,
+    )
+    return logging.getLogger(name)
+
+
+class Daemon:
+    """Base daemon: TCP server + named periodic timers + signal hooks."""
+
+    name = "daemon"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.log = logging.getLogger(self.name)
+        self._server: asyncio.Server | None = None
+        self._timers: list[tuple[float, object]] = []
+        self._tasks: set[asyncio.Task] = set()
+        self._stopping = asyncio.Event()
+
+    # --- lifecycle ---------------------------------------------------------
+
+    async def setup(self) -> None:
+        """Subclass hook: run before serving."""
+
+    async def teardown(self) -> None:
+        """Subclass hook: run on shutdown."""
+
+    def reload(self) -> None:
+        """Subclass hook: SIGHUP / admin reload-config."""
+
+    async def handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        raise NotImplementedError
+
+    def add_timer(self, interval: float, coro_fn) -> None:
+        """Register a periodic coroutine (event_loop.h timer hook analog)."""
+        self._timers.append((interval, coro_fn))
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Track a background task; it is cancelled on shutdown."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def _run_timer(self, interval: float, coro_fn) -> None:
+        while not self._stopping.is_set():
+            try:
+                await asyncio.wait_for(self._stopping.wait(), timeout=interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                await coro_fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.log.exception("timer %s failed", getattr(coro_fn, "__name__", "?"))
+
+    async def _guarded_connection(self, reader, writer) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            await self.handle_connection(reader, writer)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # peer went away
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.log.exception("connection from %s crashed", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+    async def start(self) -> None:
+        await self.setup()
+        self._server = await asyncio.start_server(
+            self._guarded_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        for interval, coro_fn in self._timers:
+            self.spawn(self._run_timer(interval, coro_fn))
+        self.log.info("%s listening on %s:%d", self.name, self.host, self.port)
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for task in list(self._tasks):
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.teardown()
+
+    async def run_forever(self) -> None:
+        """Start, install signal handlers, run until SIGTERM/SIGINT."""
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(signal.SIGHUP, self.reload)
+        await self.start()
+        await stop.wait()
+        self.log.info("shutting down")
+        await self.stop()

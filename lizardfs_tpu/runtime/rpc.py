@@ -1,0 +1,103 @@
+"""Client-side RPC connection: pipelined request/response over one stream.
+
+The analog of the reference's mastercomm packet pump (reference:
+src/mount/mastercomm.cc): one persistent connection, concurrent in-flight
+requests matched to responses by ``req_id``, push messages (e.g. the
+changelog stream) dispatched to registered handlers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto.codec import Message
+from lizardfs_tpu.proto.status import StatusError
+
+
+class RpcConnection:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._req_ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._push_handlers: dict[type, object] = {}
+        self._pump_task: asyncio.Task | None = None
+        self._closed = asyncio.Event()
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "RpcConnection":
+        reader, writer = await asyncio.open_connection(host, port)
+        conn = cls(reader, writer)
+        conn.start()
+        return conn
+
+    def start(self) -> None:
+        self._pump_task = asyncio.get_running_loop().create_task(self._pump())
+
+    def on_push(self, msg_cls: type, handler) -> None:
+        """Register an async handler for unsolicited messages of a type."""
+        self._push_handlers[msg_cls] = handler
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                msg = await framing.read_message(self.reader)
+                req_id = getattr(msg, "req_id", None)
+                fut = self._pending.pop(req_id, None) if req_id is not None else None
+                if fut is not None:
+                    if not fut.done():
+                        fut.set_result(msg)
+                    continue
+                handler = self._push_handlers.get(type(msg))
+                if handler is not None:
+                    await handler(msg)
+                # unsolicited + unhandled messages are dropped
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("connection lost"))
+            self._pending.clear()
+
+    async def call(
+        self, msg_cls, *, timeout: float = 30.0, **fields
+    ) -> Message:
+        """Send a request (auto req_id) and await its response."""
+        req_id = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            await framing.send_message(self.writer, msg_cls(req_id=req_id, **fields))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(req_id, None)
+
+    async def call_ok(self, msg_cls, *, timeout: float = 30.0, **fields) -> Message:
+        """``call`` that raises StatusError on non-OK status replies."""
+        reply = await self.call(msg_cls, timeout=timeout, **fields)
+        st = getattr(reply, "status", 0)
+        if st != 0:
+            raise StatusError(st, msg_cls.__name__)
+        return reply
+
+    async def send(self, msg: Message) -> None:
+        """Fire-and-forget (reports, acks)."""
+        await framing.send_message(self.writer, msg)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    async def close(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        self.writer.close()
+        try:
+            await self.writer.wait_closed()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        self._closed.set()
