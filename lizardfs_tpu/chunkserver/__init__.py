@@ -1,0 +1,1 @@
+"""Chunkserver: disk store, serving state machine, replicator."""

@@ -1,0 +1,34 @@
+"""Run a chunkserver: python -m lizardfs_tpu.chunkserver [config]
+
+Config keys (mfschunkserver.cfg analog): DATA_PATH, LISTEN_HOST,
+LISTEN_PORT, MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|tpu|auto),
+LOG_LEVEL.
+"""
+
+import asyncio
+import sys
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.runtime.config import Config
+from lizardfs_tpu.runtime.daemon import setup_logging
+
+
+def main() -> None:
+    cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
+    setup_logging("chunkserver", cfg.get_str("LOG_LEVEL", "INFO"))
+    server = ChunkServer(
+        data_folder=cfg.get_str("DATA_PATH", "./cs-data"),
+        master_addr=(
+            cfg.get_str("MASTER_HOST", "127.0.0.1"),
+            cfg.get_int("MASTER_PORT", 9420),
+        ),
+        host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
+        port=cfg.get_int("LISTEN_PORT", 0),
+        label=cfg.get_str("LABEL", "_"),
+        encoder_name=cfg.get_str("ENCODER", "cpu"),
+    )
+    asyncio.run(server.run_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,529 @@
+"""Chunkserver daemon: serving, write chains, master link, replicator.
+
+The analog of the reference's chunkserver (reference:
+src/chunkserver/network_worker_thread.cc serving state machine,
+masterconn.cc master link, chunk_replicator.cc EC recovery). Disk work
+runs in worker threads via ``asyncio.to_thread`` (the bgjobs pool
+analog); the event loop stays non-blocking.
+
+Data-plane flows:
+  * read: CltocsRead -> stream of CstoclReadData (per-block CRC) +
+    CstoclReadStatus
+  * write: CltocsWriteInit opens a chain — this server stores the part
+    and pipelines every CltocsWriteData to the next server in the chain;
+    a write is acked upstream (CstoclWriteStatus) only when the local
+    write AND the downstream ack both landed
+  * replicate: master sends MatocsReplicate with source part locations;
+    the replicator builds a recovery plan (copy same part / recover
+    data / recover parity — slice_recovery_planner.h:29-38 modes all
+    reduce to a SliceReadPlanner plan + ChunkEncoder recovery), executes
+    it over the network, writes the part with fresh CRCs, reports
+    CstomaChunkNew.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from lizardfs_tpu.chunkserver.chunk_store import (
+    ChunkStore,
+    ChunkStoreError,
+)
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.core import geometry, plans
+from lizardfs_tpu.core import read_executor
+from lizardfs_tpu.core.encoder import get_encoder
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime.daemon import Daemon
+from lizardfs_tpu.runtime.rpc import RpcConnection
+
+
+class _WriteSession:
+    """State for one open write chain on one connection.
+
+    One session == one (chunk, part): clients and forwarding peers open
+    a dedicated connection per chain head (csserventry analog).
+    """
+
+    def __init__(self, chunk_id: int, version: int, part_id: int):
+        self.chunk_id = chunk_id
+        self.version = version
+        self.part_id = part_id
+        self.downstream: tuple[asyncio.StreamReader, asyncio.StreamWriter] | None = None
+        self.down_status: dict[int, int] = {}  # write_id -> status
+        self.down_event: dict[int, asyncio.Event] = {}
+        self.relay_task: asyncio.Task | None = None
+
+    async def close(self):
+        if self.relay_task is not None:
+            self.relay_task.cancel()
+        if self.downstream is not None:
+            _, w = self.downstream
+            w.close()
+            try:
+                await w.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+
+class ChunkServer(Daemon):
+    name = "chunkserver"
+
+    def __init__(
+        self,
+        data_folder: str,
+        master_addr: tuple[str, int],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        label: str = "_",
+        encoder_name: str | None = "cpu",
+        wave_timeout: float = 0.3,
+    ):
+        super().__init__(host, port)
+        self.store = ChunkStore(data_folder)
+        self.master_addr = master_addr
+        self.label = label
+        self.cs_id = 0
+        self.master: RpcConnection | None = None
+        self.encoder = get_encoder(encoder_name)
+        self.wave_timeout = wave_timeout
+        self.log = logging.getLogger("chunkserver")
+
+    # --- lifecycle -----------------------------------------------------------
+
+    async def setup(self) -> None:
+        await asyncio.to_thread(self.store.scan)
+        self.add_timer(5.0, self._heartbeat)
+        self.add_timer(60.0, self._test_chunks)
+
+    async def start(self) -> None:
+        await super().start()
+        if self.master_addr is not None:  # None = standalone (tests)
+            await self._connect_master()
+
+    async def teardown(self) -> None:
+        if self.master is not None:
+            await self.master.close()
+
+    async def _connect_master(self) -> None:
+        self.master = await RpcConnection.connect(*self.master_addr)
+        for cls, handler in (
+            (m.MatocsCreateChunk, self._cmd_create),
+            (m.MatocsDeleteChunk, self._cmd_delete),
+            (m.MatocsSetVersion, self._cmd_set_version),
+            (m.MatocsTruncateChunk, self._cmd_truncate),
+            (m.MatocsReplicate, self._cmd_replicate),
+        ):
+            self.master.on_push(cls, handler)
+        total, used = self.store.space()
+        reply = await self.master.call_ok(
+            m.CstomaRegister,
+            addr=m.Addr(host=self.host, port=self.port),
+            label=self.label,
+            chunks=[
+                m.ChunkPartInfo(
+                    chunk_id=cf.chunk_id, version=cf.version, part_id=cf.part_id
+                )
+                for cf in self.store.all_parts()
+            ],
+            total_space=total,
+            used_space=used,
+        )
+        self.cs_id = reply.cs_id
+        self.log.info("registered with master as cs %d", self.cs_id)
+
+    async def _heartbeat(self) -> None:
+        if self.master_addr is None:
+            return
+        if self.master is None or self.master.closed:
+            try:
+                await self._connect_master()
+            except OSError:
+                return
+        total, used = self.store.space()
+        try:
+            await self.master.call(
+                m.CstomaHeartbeat,
+                cs_id=self.cs_id,
+                total_space=total,
+                used_space=used,
+                timeout=5.0,
+            )
+        except (ConnectionError, asyncio.TimeoutError):
+            pass
+
+    async def _test_chunks(self) -> None:
+        """Chunk tester (hdd_test_chunk analog): verify a few parts/round."""
+        parts = self.store.all_parts()[:8]
+        damaged = []
+        for cf in parts:
+            ok = await asyncio.to_thread(self.store.test_part, cf)
+            if not ok:
+                damaged.append(
+                    m.ChunkPartInfo(
+                        chunk_id=cf.chunk_id, version=cf.version, part_id=cf.part_id
+                    )
+                )
+        if damaged and self.master is not None and not self.master.closed:
+            await self.master.send(
+                m.CstomaChunkDamaged(cs_id=self.cs_id, chunks=damaged)
+            )
+
+    # --- master commands -------------------------------------------------------
+
+    async def _ack(self, req_id: int, chunk_id: int, part_id: int, code: int):
+        if self.master is not None and not self.master.closed:
+            await self.master.send(
+                m.CstomaChunkOpStatus(
+                    req_id=req_id, status=code, chunk_id=chunk_id, part_id=part_id
+                )
+            )
+
+    async def _run_job(self, msg, fn, *args):
+        try:
+            await asyncio.to_thread(fn, *args)
+            code = st.OK
+        except ChunkStoreError as e:
+            code = e.code
+        except Exception:
+            self.log.exception("chunk op failed")
+            code = st.EIO
+        await self._ack(msg.req_id, msg.chunk_id, msg.part_id, code)
+
+    async def _cmd_create(self, msg: m.MatocsCreateChunk):
+        await self._run_job(
+            msg, self.store.create, msg.chunk_id, msg.version, msg.part_id
+        )
+
+    async def _cmd_delete(self, msg: m.MatocsDeleteChunk):
+        await self._run_job(
+            msg, self.store.delete, msg.chunk_id, msg.version, msg.part_id
+        )
+
+    async def _cmd_set_version(self, msg: m.MatocsSetVersion):
+        await self._run_job(
+            msg,
+            self.store.set_version,
+            msg.chunk_id,
+            msg.old_version,
+            msg.new_version,
+            msg.part_id,
+        )
+
+    async def _cmd_truncate(self, msg: m.MatocsTruncateChunk):
+        def job():
+            cpt = geometry.ChunkPartType.from_id(msg.part_id)
+            part_len = geometry.chunk_length_to_part_length(cpt, msg.chunk_length)
+            self.store.set_version(
+                msg.chunk_id, msg.old_version, msg.new_version, msg.part_id
+            )
+            self.store.truncate_part(
+                msg.chunk_id, msg.new_version, msg.part_id, part_len
+            )
+
+        await self._run_job(msg, job)
+
+    # --- replication (chunk_replicator.cc analog) -------------------------------
+
+    async def _cmd_replicate(self, msg: m.MatocsReplicate):
+        try:
+            await self._replicate(msg)
+            code = st.OK
+        except (ChunkStoreError,) as e:
+            code = e.code
+        except Exception as e:
+            self.log.warning("replication failed: %s", e)
+            code = st.EIO
+        await self._ack(msg.req_id, msg.chunk_id, msg.part_id, code)
+        if code == st.OK and self.master is not None:
+            cf = self.store.get(msg.chunk_id, msg.part_id)
+            if cf is not None:
+                await self.master.send(
+                    m.CstomaChunkNew(
+                        cs_id=self.cs_id,
+                        chunks=[
+                            m.ChunkPartInfo(
+                                chunk_id=cf.chunk_id,
+                                version=cf.version,
+                                part_id=cf.part_id,
+                            )
+                        ],
+                    )
+                )
+
+    async def _replicate(self, msg: m.MatocsReplicate) -> None:
+        target = geometry.ChunkPartType.from_id(msg.part_id)
+        slice_type = target.type
+        # source availability: slice part index -> (addr, wire part id)
+        locations: dict[int, tuple[tuple[str, int], int]] = {}
+        for loc in msg.sources:
+            cpt = geometry.ChunkPartType.from_id(loc.part_id)
+            if int(cpt.type) == int(slice_type):
+                locations.setdefault(
+                    cpt.part, ((loc.addr.host, loc.addr.port), loc.part_id)
+                )
+        nblocks = geometry.number_of_blocks_in_part(target)
+        if int(slice_type) == geometry.STANDARD:
+            # plain copy of the same part (mode 1 of slice_recovery_planner)
+            if 0 not in locations:
+                raise ChunkStoreError(st.NO_CHUNK, "no source for copy")
+            plan = plans.plan_for_standard(nblocks * MFSBLOCKSIZE)
+        else:
+            planner = plans.SliceReadPlanner(
+                slice_type, list(locations.keys()), encoder=self.encoder
+            )
+            if not planner.is_readable([target.part]):
+                raise ChunkStoreError(st.NO_CHUNK, "not enough source parts")
+            # per-part geometry lengths: trailing data parts hold one block
+            # fewer than part 0 when the chunk doesn't stripe evenly
+            part_sizes = {
+                p: geometry.number_of_blocks_in_part(
+                    geometry.ChunkPartType(slice_type, p)
+                )
+                * MFSBLOCKSIZE
+                for p in range(slice_type.expected_parts)
+            }
+            plan = planner.build_plan([target.part], 0, nblocks, part_sizes)
+        data = await read_executor.execute_plan(
+            plan,
+            msg.chunk_id,
+            msg.version,
+            locations,
+            wave_timeout=self.wave_timeout,
+        )
+
+        def write_part():
+            if self.store.get(msg.chunk_id, msg.part_id) is None:
+                self.store.create(msg.chunk_id, msg.version, msg.part_id)
+            arr = np.asarray(data[: nblocks * MFSBLOCKSIZE])
+            blocks = arr.reshape(nblocks, MFSBLOCKSIZE)
+            crcs = self.encoder.checksum(blocks)
+            for b in range(nblocks):
+                self.store.write(
+                    msg.chunk_id,
+                    msg.version,
+                    msg.part_id,
+                    b,
+                    0,
+                    blocks[b].tobytes(),
+                    int(crcs[b]),
+                )
+
+        await asyncio.to_thread(write_part)
+
+    # --- serving ---------------------------------------------------------------
+
+    async def handle_connection(self, reader, writer) -> None:
+        sessions: dict[int, _WriteSession] = {}
+        try:
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if isinstance(msg, m.CltocsRead):
+                    await self._serve_read(writer, msg)
+                elif isinstance(msg, m.CltocsWriteInit):
+                    await self._serve_write_init(writer, msg, sessions)
+                elif isinstance(msg, m.CltocsWriteData):
+                    await self._serve_write_data(writer, msg, sessions)
+                elif isinstance(msg, m.CltocsWriteEnd):
+                    session = sessions.pop(msg.chunk_id, None)
+                    if session is not None:
+                        if session.downstream is not None:
+                            _, dw = session.downstream
+                            await framing.send_message(dw, msg)
+                        await session.close()
+                    await framing.send_message(
+                        writer,
+                        m.CstoclWriteStatus(
+                            req_id=msg.req_id,
+                            chunk_id=msg.chunk_id,
+                            write_id=0,
+                            status=st.OK,
+                        ),
+                    )
+                else:
+                    self.log.warning("unexpected %s", type(msg).__name__)
+                    break
+        finally:
+            for session in sessions.values():
+                await session.close()
+
+    async def _serve_read(self, writer, msg: m.CltocsRead) -> None:
+        try:
+            pieces = await asyncio.to_thread(
+                self.store.read,
+                msg.chunk_id,
+                msg.version,
+                msg.part_id,
+                msg.offset,
+                msg.size,
+            )
+        except ChunkStoreError as e:
+            await framing.send_message(
+                writer,
+                m.CstoclReadStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id, status=e.code
+                ),
+            )
+            return
+        for off, data, crc in pieces:
+            await framing.send_message(
+                writer,
+                m.CstoclReadData(
+                    req_id=msg.req_id,
+                    chunk_id=msg.chunk_id,
+                    offset=off,
+                    crc=crc,
+                    data=bytes(data),
+                ),
+            )
+        await framing.send_message(
+            writer,
+            m.CstoclReadStatus(
+                req_id=msg.req_id, chunk_id=msg.chunk_id, status=st.OK
+            ),
+        )
+
+    async def _serve_write_init(self, writer, msg: m.CltocsWriteInit, sessions):
+        session = _WriteSession(msg.chunk_id, msg.version, msg.part_id)
+        code = st.OK
+        try:
+            if msg.create and self.store.get(msg.chunk_id, msg.part_id) is None:
+                await asyncio.to_thread(
+                    self.store.create, msg.chunk_id, msg.version, msg.part_id
+                )
+            else:
+                self.store.require(msg.chunk_id, msg.version, msg.part_id)
+        except ChunkStoreError as e:
+            code = e.code
+        if code == st.OK and msg.chain:
+            # connect to the next server and forward the init with the
+            # rest of the chain (WRITEFWD state analog)
+            nxt = msg.chain[0]
+            try:
+                dr, dw = await asyncio.open_connection(nxt.addr.host, nxt.addr.port)
+                session.downstream = (dr, dw)
+                await framing.send_message(
+                    dw,
+                    m.CltocsWriteInit(
+                        req_id=msg.req_id,
+                        chunk_id=msg.chunk_id,
+                        version=msg.version,
+                        part_id=nxt.part_id,
+                        chain=msg.chain[1:],
+                        create=msg.create,
+                    ),
+                )
+                reply = await framing.read_message(dr)
+                if (
+                    not isinstance(reply, m.CstoclWriteStatus)
+                    or reply.status != st.OK
+                ):
+                    code = getattr(reply, "status", st.EIO)
+                else:
+                    session.relay_task = self.spawn(
+                        self._relay_down_statuses(session)
+                    )
+            except OSError:
+                code = st.DISCONNECTED
+        if code == st.OK:
+            sessions[msg.chunk_id] = session
+        else:
+            await session.close()
+        await framing.send_message(
+            writer,
+            m.CstoclWriteStatus(
+                req_id=msg.req_id, chunk_id=msg.chunk_id, write_id=0, status=code
+            ),
+        )
+
+    async def _relay_down_statuses(self, session: _WriteSession) -> None:
+        dr, _ = session.downstream
+        try:
+            while True:
+                msg = await framing.read_message(dr)
+                if isinstance(msg, m.CstoclWriteStatus):
+                    session.down_status[msg.write_id] = msg.status
+                    ev = session.down_event.get(msg.write_id)
+                    if ev is not None:
+                        ev.set()
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            # downstream died: fail all waiting writes
+            for wid, ev in session.down_event.items():
+                session.down_status.setdefault(wid, st.DISCONNECTED)
+                ev.set()
+
+    async def _serve_write_data(self, writer, msg: m.CltocsWriteData, sessions):
+        """Forward downstream in-order, then complete the local write and
+        the upstream ack in a background task — the connection loop keeps
+        reading, so blocks pipeline through the chain instead of paying
+        one chain round trip each (WRITEFWD pipelining)."""
+        session = sessions.get(msg.chunk_id)
+        if session is None:
+            await framing.send_message(
+                writer,
+                m.CstoclWriteStatus(
+                    req_id=msg.req_id,
+                    chunk_id=msg.chunk_id,
+                    write_id=msg.write_id,
+                    status=st.EINVAL,
+                ),
+            )
+            return
+        down_ev = None
+        if session.downstream is not None:
+            down_ev = asyncio.Event()
+            session.down_event[msg.write_id] = down_ev
+            _, dw = session.downstream
+            try:
+                await framing.send_message(dw, msg)
+            except (ConnectionError, OSError):
+                session.down_status[msg.write_id] = st.DISCONNECTED
+                down_ev.set()
+        self.spawn(self._finish_write(writer, session, msg, down_ev))
+
+    async def _finish_write(self, writer, session, msg, down_ev) -> None:
+        code = st.OK
+        try:
+            await asyncio.to_thread(self._local_write, session, msg)
+        except ChunkStoreError as e:
+            code = e.code
+        except Exception:
+            self.log.exception("local write failed")
+            code = st.EIO
+        if down_ev is not None:
+            await down_ev.wait()
+            down_code = session.down_status.pop(msg.write_id, st.DISCONNECTED)
+            session.down_event.pop(msg.write_id, None)
+            if code == st.OK:
+                code = down_code
+        try:
+            await framing.send_message(
+                writer,
+                m.CstoclWriteStatus(
+                    req_id=msg.req_id,
+                    chunk_id=msg.chunk_id,
+                    write_id=msg.write_id,
+                    status=code,
+                ),
+            )
+        except (ConnectionError, OSError):
+            pass
+
+    def _local_write(self, session: _WriteSession, msg: m.CltocsWriteData) -> None:
+        self.store.write(
+            msg.chunk_id,
+            session.version,
+            session.part_id,
+            msg.block,
+            msg.offset,
+            msg.data,
+            msg.crc,
+        )

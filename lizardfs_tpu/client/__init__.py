@@ -1,0 +1,1 @@
+"""Client: library-first file system access (liblizardfs-client analog)."""

@@ -1,0 +1,188 @@
+"""Wave-scheduled network execution of read plans.
+
+The async analog of the reference's ReadPlanExecutor (reference:
+src/common/read_plan_executor.cc): start wave 0's reads, fire the next
+wave when a wave timeout expires or a read fails, finish as soon as the
+plan says enough parts arrived, then post-process (recovery). Used by
+the client read path and by the chunkserver replicator (both read chunk
+parts from chunkservers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from lizardfs_tpu.core.plans import SliceReadPlan
+from lizardfs_tpu.ops import crc32 as crc_mod
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+
+log = logging.getLogger("read_executor")
+
+DEFAULT_WAVE_TIMEOUT = 0.5
+DEFAULT_TOTAL_TIMEOUT = 30.0
+
+
+class ReadError(Exception):
+    pass
+
+
+async def read_part_range(
+    addr: tuple[str, int],
+    chunk_id: int,
+    version: int,
+    part_id: int,
+    offset: int,
+    size: int,
+    into: np.ndarray | None = None,
+    into_offset: int = 0,
+) -> np.ndarray:
+    """Read one range of one part from one chunkserver, verifying piece
+    CRCs (ReadOperationExecutor analog)."""
+    out = into if into is not None else np.zeros(size, dtype=np.uint8)
+    if size == 0:
+        return out[into_offset:into_offset]
+    reader, writer = await asyncio.open_connection(*addr)
+    try:
+        await framing.send_message(
+            writer,
+            m.CltocsRead(
+                req_id=1,
+                chunk_id=chunk_id,
+                version=version,
+                part_id=part_id,
+                offset=offset,
+                size=size,
+            ),
+        )
+        received = 0
+        while True:
+            msg = await framing.read_message(reader)
+            if isinstance(msg, m.CstoclReadData):
+                data = np.frombuffer(msg.data, dtype=np.uint8)
+                if crc_mod.crc32(msg.data) != msg.crc:
+                    raise ReadError("piece CRC mismatch from chunkserver")
+                rel = msg.offset - offset
+                if rel < 0 or rel + len(data) > size:
+                    raise ReadError("piece outside requested range")
+                out[into_offset + rel : into_offset + rel + len(data)] = data
+                received += len(data)
+            elif isinstance(msg, m.CstoclReadStatus):
+                if msg.status != st.OK:
+                    raise ReadError(f"read failed: {st.name(msg.status)}")
+                if received < size:
+                    raise ReadError(
+                        f"short read: {received} of {size} bytes"
+                    )
+                return out
+            else:
+                raise ReadError(f"unexpected message {type(msg).__name__}")
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+
+async def execute_plan(
+    plan: SliceReadPlan,
+    chunk_id: int,
+    version: int,
+    locations: dict[int, tuple[tuple[str, int], int]],
+    wave_timeout: float = DEFAULT_WAVE_TIMEOUT,
+    total_timeout: float = DEFAULT_TOTAL_TIMEOUT,
+) -> np.ndarray:
+    """Execute a plan; returns the post-processed result bytes.
+
+    locations: slice part index -> ((host, port), wire part_id).
+    """
+    buffer = np.zeros(plan.buffer_size, dtype=np.uint8)
+    available: list[int] = []
+    unreadable: list[int] = []
+    pending: dict[asyncio.Task, int] = {}
+    max_wave = max((op.wave for op in plan.read_operations), default=0)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + total_timeout
+    current_wave = -1
+
+    def start_wave(w: int):
+        for op in plan.read_operations:
+            if op.wave != w:
+                continue
+            if op.part not in locations:
+                unreadable.append(op.part)
+                continue
+            addr, wire_part_id = locations[op.part]
+            task = asyncio.ensure_future(
+                read_part_range(
+                    addr,
+                    chunk_id,
+                    version,
+                    wire_part_id,
+                    op.request_offset,
+                    op.request_size,
+                    into=buffer,
+                    into_offset=op.buffer_offset,
+                )
+            )
+            pending[task] = op.part
+
+    current_wave = 0
+    start_wave(0)
+    wave_start = loop.time()
+    try:
+        while not plan.is_reading_finished(available):
+            if not pending:
+                # everything in flight resolved; fire the next wave now
+                if current_wave >= max_wave:
+                    raise ReadError(
+                        f"no more parts to try (available={available}, "
+                        f"unreadable={unreadable})"
+                    )
+                current_wave += 1
+                start_wave(current_wave)
+                wave_start = loop.time()
+                continue
+            now = loop.time()
+            if now >= deadline:
+                raise ReadError("read plan timed out")
+            if current_wave < max_wave:
+                timeout = min(wave_start + wave_timeout - now, deadline - now)
+            else:
+                timeout = deadline - now
+            done, _ = await asyncio.wait(
+                pending.keys(),
+                timeout=max(timeout, 0.001),
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for task in done:
+                part = pending.pop(task)
+                exc = task.exception()
+                if exc is None:
+                    available.append(part)
+                else:
+                    log.debug("part %d failed: %s", part, exc)
+                    unreadable.append(part)
+                    if not plan.is_finishing_possible(unreadable):
+                        raise ReadError(f"too many failed parts: {unreadable}")
+            # wave timeout: stragglers trigger the next wave (reference
+            # startReadsForWave, read_plan_executor.cc:162-176)
+            if (
+                current_wave < max_wave
+                and loop.time() - wave_start >= wave_timeout
+            ):
+                current_wave += 1
+                start_wave(current_wave)
+                wave_start = loop.time()
+    finally:
+        for task in pending:
+            task.cancel()
+        if pending:
+            await asyncio.gather(*pending.keys(), return_exceptions=True)
+
+    return plan.postprocess(buffer, available)

@@ -1,0 +1,1 @@
+"""Master: metadata server — FS tree, chunk registry, changelog, health."""

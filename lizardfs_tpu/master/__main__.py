@@ -1,0 +1,37 @@
+"""Run the master daemon: python -m lizardfs_tpu.master [config]
+
+Config keys (KEY = VALUE, mfsmaster.cfg analog): DATA_PATH, LISTEN_HOST,
+LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), LOG_LEVEL,
+HEALTH_INTERVAL, IMAGE_INTERVAL.
+"""
+
+import asyncio
+import sys
+
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.runtime.config import Config
+from lizardfs_tpu.runtime.daemon import setup_logging
+
+
+def main() -> None:
+    cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
+    setup_logging("master", cfg.get_str("LOG_LEVEL", "INFO"))
+    goals = geometry.default_goals()
+    goals_path = cfg.get_str("GOALS_CFG", "")
+    if goals_path:
+        with open(goals_path) as f:
+            goals = geometry.load_goal_config(f.read())
+    server = MasterServer(
+        data_dir=cfg.get_str("DATA_PATH", "./master-data"),
+        host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
+        port=cfg.get_int("LISTEN_PORT", 9420),
+        goals=goals,
+        health_interval=cfg.get_float("HEALTH_INTERVAL", 1.0),
+        image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0),
+    )
+    asyncio.run(server.run_forever())
+
+
+if __name__ == "__main__":
+    main()

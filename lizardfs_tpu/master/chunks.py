@@ -1,0 +1,256 @@
+"""Chunk registry + chunkserver database + health engine.
+
+The analog of the reference's chunk metadata engine (reference:
+src/master/chunks.{h,cc}): per-chunk version and slice type, live part
+locations (volatile — rebuilt from chunkserver registrations, never
+persisted), redundancy evaluation (ChunkCopiesCalculator analog,
+src/common/chunk_copies_calculator.h:41-95), an **endangered-first
+priority queue** (chunks.cc:256-259), and the periodic health walk that
+issues replicate/delete commands (chunks.cc:1807-2200).
+
+Server selection is label-aware weighted-by-free-space choice
+(get_servers_for_new_chunk.h:68-100 analog).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.proto import status as st
+
+
+@dataclass
+class ChunkServerInfo:
+    cs_id: int
+    host: str
+    port: int
+    label: str
+    total_space: int = 0
+    used_space: int = 0
+    connected: bool = True
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    @property
+    def free_space(self) -> int:
+        return max(self.total_space - self.used_space, 0)
+
+
+@dataclass
+class ChunkInfo:
+    chunk_id: int
+    version: int
+    slice_type: int  # geometry slice type id
+    copies: int = 1  # wanted copies per part (std goals: N-copy replication)
+    locked_until: float = 0.0
+    # live locations: (cs_id, slice part index) set; volatile
+    parts: set[tuple[int, int]] = field(default_factory=set)
+
+    def parts_by_index(self) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        for cs_id, part in self.parts:
+            out.setdefault(part, []).append(cs_id)
+        return out
+
+
+class RedundancyState:
+    """ChunkCopiesCalculator verdict for one chunk."""
+
+    def __init__(self, missing: list[int], redundant: list[tuple[int, int]],
+                 safe: bool, readable: bool):
+        self.missing_parts = missing  # slice part indices with no copy
+        self.redundant = redundant  # (cs_id, part) copies beyond 1
+        self.is_safe = safe  # can lose any single server w/o data loss
+        self.is_readable = readable
+
+    @property
+    def is_endangered(self) -> bool:
+        return self.is_readable and not self.is_safe
+
+    @property
+    def needs_work(self) -> bool:
+        return bool(self.missing_parts or self.redundant)
+
+
+class ChunkRegistry:
+    def __init__(self):
+        self.chunks: dict[int, ChunkInfo] = {}
+        self.servers: dict[int, ChunkServerInfo] = {}
+        self.next_chunk_id = 1
+        self.next_cs_id = 1
+        # endangered queue served before routine work (chunks.cc:2562)
+        self.endangered: list[int] = []
+        # chunks released from metadata whose on-disk parts still need
+        # deleting on chunkservers (drained by the master's health tick;
+        # bounded so an idle shadow doesn't grow it forever)
+        self.pending_deletes: list[ChunkInfo] = []
+        self._rng = random.Random(0xEC)
+
+    # --- chunkserver db -------------------------------------------------------
+
+    def register_server(
+        self, host: str, port: int, label: str, total: int, used: int
+    ) -> ChunkServerInfo:
+        # reconnection of the same host:port replaces the old entry
+        for srv in self.servers.values():
+            if (srv.host, srv.port) == (host, port):
+                srv.connected = True
+                srv.label = label
+                srv.total_space = total
+                srv.used_space = used
+                return srv
+        cs = ChunkServerInfo(self.next_cs_id, host, port, label, total, used)
+        self.next_cs_id += 1
+        self.servers[cs.cs_id] = cs
+        return cs
+
+    def server_disconnected(self, cs_id: int) -> list[int]:
+        """Mark server down, drop its parts; returns affected chunk ids
+        (chunks.h:80 chunk_server_disconnected analog)."""
+        srv = self.servers.get(cs_id)
+        if srv is not None:
+            srv.connected = False
+        affected = []
+        for chunk in self.chunks.values():
+            before = len(chunk.parts)
+            chunk.parts = {(c, p) for (c, p) in chunk.parts if c != cs_id}
+            if len(chunk.parts) != before:
+                affected.append(chunk.chunk_id)
+        return affected
+
+    def connected_servers(self) -> list[ChunkServerInfo]:
+        return [s for s in self.servers.values() if s.connected]
+
+    # --- chunk lifecycle --------------------------------------------------------
+
+    def create_chunk(self, slice_type: int, chunk_id: int | None = None,
+                     version: int = 1, copies: int = 1) -> ChunkInfo:
+        if chunk_id is None:
+            chunk_id = self.next_chunk_id
+        self.next_chunk_id = max(self.next_chunk_id, chunk_id + 1)
+        chunk = ChunkInfo(chunk_id, version, slice_type, copies=copies)
+        self.chunks[chunk_id] = chunk
+        return chunk
+
+    def chunk(self, chunk_id: int) -> ChunkInfo:
+        c = self.chunks.get(chunk_id)
+        if c is None:
+            raise KeyError(f"chunk {chunk_id}")
+        return c
+
+    def add_part(self, chunk_id: int, cs_id: int, part_id: int, version: int) -> bool:
+        """Record a part reported by a chunkserver; False = stale/unknown
+        (caller schedules deletion)."""
+        chunk = self.chunks.get(chunk_id)
+        if chunk is None or version != chunk.version:
+            return False
+        cpt = geometry.ChunkPartType.from_id(part_id)
+        if int(cpt.type) != chunk.slice_type:
+            return False
+        chunk.parts.add((cs_id, cpt.part))
+        return True
+
+    def drop_part(self, chunk_id: int, cs_id: int, part_id: int) -> None:
+        chunk = self.chunks.get(chunk_id)
+        if chunk is None:
+            return
+        cpt = geometry.ChunkPartType.from_id(part_id)
+        chunk.parts.discard((cs_id, cpt.part))
+
+    def delete_chunk(self, chunk_id: int) -> ChunkInfo | None:
+        chunk = self.chunks.pop(chunk_id, None)
+        if chunk is not None and chunk.parts:
+            self.pending_deletes.append(chunk)
+            if len(self.pending_deletes) > 100_000:
+                del self.pending_deletes[:-100_000]
+        return chunk
+
+    # --- redundancy evaluation ----------------------------------------------------
+
+    def evaluate(self, chunk: ChunkInfo) -> RedundancyState:
+        t = geometry.SliceType(chunk.slice_type)
+        expected = t.expected_parts
+        by_index = chunk.parts_by_index()
+        live = {
+            p: [c for c in cs_list if self.servers.get(c) and self.servers[c].connected]
+            for p, cs_list in by_index.items()
+        }
+        live = {p: cs for p, cs in live.items() if cs}
+        if t.is_standard:
+            ncopies = len(live.get(0, []))
+            # under goal: each missing copy is a 'missing part 0' work item
+            missing = [0] * max(chunk.copies - ncopies, 0)
+            redundant = [
+                (c, 0) for c in live.get(0, [])[chunk.copies :]
+            ]
+            readable = ncopies >= 1
+            safe = ncopies >= min(2, chunk.copies)
+            return RedundancyState(missing, redundant, safe, readable)
+        missing = [p for p in range(expected) if p not in live]
+        redundant = []
+        for p, cs_list in live.items():
+            for c in cs_list[1:]:
+                redundant.append((c, p))
+        k = geometry.required_parts_to_recover(t)
+        readable = len(live) >= k
+        # safe: even after losing any one more part, still >= k
+        safe = (expected - len(missing)) >= k + 1
+        return RedundancyState(missing, redundant, safe, readable)
+
+    def mark_endangered(self, chunk_id: int) -> None:
+        if chunk_id not in self.endangered:
+            self.endangered.append(chunk_id)
+
+    # --- server selection (get_servers_for_new_chunk analog) ----------------------
+
+    def choose_servers(self, count: int, exclude: set[int] = frozenset(),
+                       min_free: int = 0) -> list[ChunkServerInfo]:
+        """Weighted-by-free-space distinct-server choice. Servers may
+        repeat only if there are fewer servers than parts (degenerate
+        test clusters), mirroring wildcard-label behavior."""
+        candidates = [
+            s
+            for s in self.connected_servers()
+            if s.cs_id not in exclude and s.free_space >= min_free
+        ]
+        if not candidates:
+            raise ValueError("no chunkservers available")
+        chosen: list[ChunkServerInfo] = []
+        pool = list(candidates)
+        for _ in range(count):
+            if not pool:
+                pool = list(candidates)  # wrap: fewer servers than parts
+            weights = [max(s.free_space, 1) for s in pool]
+            pick = self._rng.choices(range(len(pool)), weights=weights)[0]
+            chosen.append(pool.pop(pick))
+        return chosen
+
+    # --- health walk (ChunkWorker coroutine analog) --------------------------------
+
+    def health_work(self, limit: int = 64):
+        """Yield up to ``limit`` work items: ('replicate', chunk, part) or
+        ('delete', chunk, cs_id, part). Endangered chunks first."""
+        out = []
+        priority = set(self.endangered)
+        queue = list(self.endangered)
+        self.endangered.clear()
+        queue.extend(cid for cid in self.chunks if cid not in priority)
+        for i, cid in enumerate(queue):
+            if len(out) >= limit:
+                # leave the unprocessed tail for the next round
+                for c in queue[i:]:
+                    self.mark_endangered(c)
+                break
+            chunk = self.chunks.get(cid)
+            if chunk is None:
+                continue
+            state = self.evaluate(chunk)
+            for p in state.missing_parts:
+                out.append(("replicate", chunk, p))
+            for cs_id, p in state.redundant:
+                out.append(("delete", chunk, cs_id, p))
+        return out

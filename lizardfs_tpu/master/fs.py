@@ -1,0 +1,341 @@
+"""In-memory file system tree with deterministic, replayable mutations.
+
+The analog of the reference's FSNode tree + filesystem_operations
+(reference: src/master/filesystem_node_types.h:88-320,
+filesystem_operations.cc). The key architectural property carried over:
+**every mutation is expressed as a deterministic operation record** —
+all non-deterministic inputs (allocated inode numbers, timestamps) are
+chosen once by the live master, serialized into the changelog, and the
+same ``apply_*`` code path replays them on shadows/restore
+(src/master/restore.h:28 pattern). The changelog is therefore exact by
+construction.
+
+Operation records are JSON objects with an ``op`` field; see OPS at the
+bottom. File content geometry: a file's data is a list of chunk ids
+indexed by chunk position (64 MiB each).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from lizardfs_tpu.constants import MFSCHUNKSIZE
+from lizardfs_tpu.proto import status as st
+
+ROOT_INODE = 1
+
+TYPE_FILE = 1
+TYPE_DIR = 2
+TYPE_SYMLINK = 3
+
+
+class FsError(Exception):
+    def __init__(self, code: int, msg: str = ""):
+        self.code = code
+        super().__init__(f"{st.name(code)}{(': ' + msg) if msg else ''}")
+
+
+@dataclass
+class Node:
+    inode: int
+    ftype: int
+    mode: int = 0o644
+    uid: int = 0
+    gid: int = 0
+    atime: int = 0
+    mtime: int = 0
+    ctime: int = 0
+    goal: int = 1
+    trash_time: int = 86400
+    # files
+    length: int = 0
+    chunks: list[int] = field(default_factory=list)  # chunk ids by index, 0 = hole
+    # directories
+    children: dict[str, int] = field(default_factory=dict)
+    # symlinks
+    symlink_target: str = ""
+    # link count (parents holding an edge to this node)
+    nlink: int = 0
+
+    def to_dict(self) -> dict:
+        d = {
+            "inode": self.inode,
+            "ftype": self.ftype,
+            "mode": self.mode,
+            "uid": self.uid,
+            "gid": self.gid,
+            "atime": self.atime,
+            "mtime": self.mtime,
+            "ctime": self.ctime,
+            "goal": self.goal,
+            "trash_time": self.trash_time,
+            "nlink": self.nlink,
+        }
+        if self.ftype == TYPE_FILE:
+            d["length"] = self.length
+            d["chunks"] = self.chunks
+        elif self.ftype == TYPE_DIR:
+            d["children"] = self.children
+        elif self.ftype == TYPE_SYMLINK:
+            d["symlink_target"] = self.symlink_target
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        n = cls(inode=d["inode"], ftype=d["ftype"])
+        for k, v in d.items():
+            if k == "children":
+                n.children = {str(name): int(i) for name, i in v.items()}
+            elif hasattr(n, k):
+                setattr(n, k, v)
+        return n
+
+
+class FsTree:
+    """The namespace + attributes. No I/O here; pure data structure."""
+
+    def __init__(self):
+        self.nodes: dict[int, Node] = {}
+        self.next_inode = ROOT_INODE + 1
+        self.trash: dict[int, tuple[str, int]] = {}  # inode -> (name, del_ts)
+        root = Node(inode=ROOT_INODE, ftype=TYPE_DIR, mode=0o755, nlink=1)
+        self.nodes[ROOT_INODE] = root
+
+    # --- helpers -------------------------------------------------------------
+
+    def node(self, inode: int) -> Node:
+        n = self.nodes.get(inode)
+        if n is None:
+            raise FsError(st.ENOENT, f"inode {inode}")
+        return n
+
+    def dir_node(self, inode: int) -> Node:
+        n = self.node(inode)
+        if n.ftype != TYPE_DIR:
+            raise FsError(st.ENOTDIR, f"inode {inode}")
+        return n
+
+    def file_node(self, inode: int) -> Node:
+        n = self.node(inode)
+        if n.ftype != TYPE_FILE:
+            raise FsError(st.EISDIR if n.ftype == TYPE_DIR else st.EINVAL)
+        return n
+
+    def alloc_inode(self) -> int:
+        inode = self.next_inode
+        self.next_inode += 1
+        return inode
+
+    def lookup(self, parent: int, name: str) -> Node:
+        p = self.dir_node(parent)
+        inode = p.children.get(name)
+        if inode is None:
+            raise FsError(st.ENOENT, name)
+        return self.node(inode)
+
+    # --- deterministic mutations (replayed verbatim from the changelog) ------
+
+    def apply_mknode(
+        self,
+        parent: int,
+        name: str,
+        inode: int,
+        ftype: int,
+        mode: int,
+        uid: int,
+        gid: int,
+        ts: int,
+        goal: int,
+        trash_time: int,
+        symlink_target: str = "",
+    ) -> Node:
+        p = self.dir_node(parent)
+        if name in p.children:
+            raise FsError(st.EEXIST, name)
+        if not name or "/" in name or name in (".", ".."):
+            raise FsError(st.EINVAL, repr(name))
+        if len(name) > 255:
+            raise FsError(st.NAME_TOO_LONG, name)
+        n = Node(
+            inode=inode,
+            ftype=ftype,
+            mode=mode,
+            uid=uid,
+            gid=gid,
+            atime=ts,
+            mtime=ts,
+            ctime=ts,
+            goal=goal,
+            trash_time=trash_time,
+            symlink_target=symlink_target,
+            nlink=1,
+        )
+        self.nodes[inode] = n
+        p.children[name] = inode
+        p.mtime = p.ctime = ts
+        self.next_inode = max(self.next_inode, inode + 1)
+        return n
+
+    def apply_unlink(self, parent: int, name: str, ts: int, to_trash: bool) -> Node:
+        p = self.dir_node(parent)
+        inode = p.children.get(name)
+        if inode is None:
+            raise FsError(st.ENOENT, name)
+        n = self.node(inode)
+        if n.ftype == TYPE_DIR:
+            raise FsError(st.EPERM, "unlink of directory")
+        del p.children[name]
+        p.mtime = p.ctime = ts
+        n.nlink -= 1
+        n.ctime = ts
+        if n.nlink <= 0:
+            if to_trash and n.ftype == TYPE_FILE and n.trash_time > 0:
+                self.trash[inode] = (name, ts + n.trash_time)
+            else:
+                del self.nodes[inode]
+        return n
+
+    def apply_rmdir(self, parent: int, name: str, ts: int) -> None:
+        p = self.dir_node(parent)
+        inode = p.children.get(name)
+        if inode is None:
+            raise FsError(st.ENOENT, name)
+        n = self.node(inode)
+        if n.ftype != TYPE_DIR:
+            raise FsError(st.ENOTDIR, name)
+        if n.children:
+            raise FsError(st.ENOTEMPTY, name)
+        del p.children[name]
+        del self.nodes[inode]
+        p.mtime = p.ctime = ts
+
+    def apply_rename(
+        self, parent_src: int, name_src: str, parent_dst: int, name_dst: str, ts: int
+    ) -> None:
+        ps = self.dir_node(parent_src)
+        pd = self.dir_node(parent_dst)
+        inode = ps.children.get(name_src)
+        if inode is None:
+            raise FsError(st.ENOENT, name_src)
+        moving = self.node(inode)
+        # validate EVERYTHING before mutating: a raise after a partial
+        # mutation would diverge the live tree from the changelog
+        if moving.ftype == TYPE_DIR:
+            # cycle check: cannot move a directory under itself
+            cur = parent_dst
+            while cur != ROOT_INODE:
+                if cur == inode:
+                    raise FsError(st.EINVAL, "rename cycle")
+                cur = self._parent_of_dir(cur)
+        existing = pd.children.get(name_dst)
+        if existing is not None:
+            ex = self.node(existing)
+            if ex.ftype == TYPE_DIR:
+                if ex.children:
+                    raise FsError(st.ENOTEMPTY, name_dst)
+                del self.nodes[existing]
+                del pd.children[name_dst]
+            else:
+                self.apply_unlink(parent_dst, name_dst, ts, to_trash=True)
+        del ps.children[name_src]
+        pd.children[name_dst] = inode
+        ps.mtime = ps.ctime = ts
+        pd.mtime = pd.ctime = ts
+        moving.ctime = ts
+
+    def _parent_of_dir(self, inode: int) -> int:
+        # directories have exactly one parent; linear scan is fine for the
+        # rare rename-cycle check (the reference stores parent pointers)
+        for i, n in self.nodes.items():
+            if n.ftype == TYPE_DIR and inode in n.children.values():
+                return i
+        return ROOT_INODE
+
+    def apply_link(self, inode: int, parent: int, name: str, ts: int) -> Node:
+        n = self.file_node(inode)
+        p = self.dir_node(parent)
+        if name in p.children:
+            raise FsError(st.EEXIST, name)
+        p.children[name] = inode
+        n.nlink += 1
+        n.ctime = ts
+        p.mtime = p.ctime = ts
+        return n
+
+    def apply_setattr(
+        self, inode: int, set_mask: int, mode: int, uid: int, gid: int,
+        atime: int, mtime: int, ts: int,
+    ) -> Node:
+        n = self.node(inode)
+        if set_mask & 1:
+            n.mode = mode
+        if set_mask & 2:
+            n.uid = uid
+        if set_mask & 4:
+            n.gid = gid
+        if set_mask & 8:
+            n.atime = atime
+        if set_mask & 16:
+            n.mtime = mtime
+        n.ctime = ts
+        return n
+
+    def apply_setgoal(self, inode: int, goal: int, ts: int) -> Node:
+        n = self.node(inode)
+        n.goal = goal
+        n.ctime = ts
+        return n
+
+    def apply_set_chunk(self, inode: int, chunk_index: int, chunk_id: int) -> Node:
+        """Attach a chunk id at a file position (write path)."""
+        n = self.file_node(inode)
+        while len(n.chunks) <= chunk_index:
+            n.chunks.append(0)
+        n.chunks[chunk_index] = chunk_id
+        return n
+
+    def apply_set_length(self, inode: int, length: int, ts: int) -> list[int]:
+        """Set file length; returns chunk ids dropped past the new end
+        (the caller releases them in the chunk registry)."""
+        n = self.file_node(inode)
+        n.length = length
+        n.mtime = n.ctime = ts
+        nchunks = (length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE if length else 0
+        removed = [c for c in n.chunks[nchunks:] if c]
+        del n.chunks[nchunks:]
+        return removed
+
+    def apply_purge_trash(self, inode: int) -> None:
+        self.trash.pop(inode, None)
+        self.nodes.pop(inode, None)
+
+    # --- persistence -----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "next_inode": self.next_inode,
+            "nodes": [n.to_dict() for n in self.nodes.values()],
+            "trash": {str(i): list(v) for i, v in self.trash.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FsTree":
+        fs = cls.__new__(cls)
+        fs.nodes = {}
+        fs.next_inode = d["next_inode"]
+        fs.trash = {int(i): (v[0], int(v[1])) for i, v in d.get("trash", {}).items()}
+        for nd in d["nodes"]:
+            node = Node.from_dict(nd)
+            fs.nodes[node.inode] = node
+        if ROOT_INODE not in fs.nodes:
+            raise ValueError("image missing root inode")
+        return fs
+
+    def checksum_data(self) -> str:
+        """Stable digest of the whole tree — master/shadow divergence
+        detection (filesystem_checksum analog)."""
+        import hashlib
+
+        blob = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()

@@ -1,0 +1,125 @@
+"""MetadataStore: the replayable state machine behind the master.
+
+Owns the FS tree + the *persistent* half of the chunk registry and
+applies operation records. The live master builds an op, applies it,
+and appends it to the changelog; shadows and crash recovery apply the
+same records through the same code path — the restore.cc pattern, with
+one implementation instead of two.
+"""
+
+from __future__ import annotations
+
+from lizardfs_tpu.master.chunks import ChunkRegistry
+from lizardfs_tpu.master.fs import FsError, FsTree
+
+
+class MetadataStore:
+    def __init__(self):
+        self.fs = FsTree()
+        self.registry = ChunkRegistry()
+
+    # --- op application (the one true mutation path) -------------------------
+
+    def apply(self, op: dict) -> None:
+        fn = getattr(self, "_op_" + op["op"], None)
+        if fn is None:
+            raise ValueError(f"unknown op {op['op']!r}")
+        fn(op)
+
+    def _op_mknode(self, op):
+        self.fs.apply_mknode(
+            op["parent"], op["name"], op["inode"], op["ftype"], op["mode"],
+            op["uid"], op["gid"], op["ts"], op["goal"], op["trash_time"],
+            op.get("symlink_target", ""),
+        )
+
+    def _op_unlink(self, op):
+        node = self.fs.apply_unlink(op["parent"], op["name"], op["ts"], op["to_trash"])
+        if node.nlink <= 0 and node.inode not in self.fs.trash:
+            for cid in node.chunks:
+                if cid:
+                    self.registry.delete_chunk(cid)
+
+    def _op_rmdir(self, op):
+        self.fs.apply_rmdir(op["parent"], op["name"], op["ts"])
+
+    def _op_rename(self, op):
+        self.fs.apply_rename(
+            op["parent_src"], op["name_src"], op["parent_dst"], op["name_dst"],
+            op["ts"],
+        )
+
+    def _op_link(self, op):
+        self.fs.apply_link(op["inode"], op["parent"], op["name"], op["ts"])
+
+    def _op_setattr(self, op):
+        self.fs.apply_setattr(
+            op["inode"], op["set_mask"], op["mode"], op["uid"], op["gid"],
+            op["atime"], op["mtime"], op["ts"],
+        )
+
+    def _op_setgoal(self, op):
+        self.fs.apply_setgoal(op["inode"], op["goal"], op["ts"])
+
+    def _op_set_length(self, op):
+        removed = self.fs.apply_set_length(op["inode"], op["length"], op["ts"])
+        for cid in removed:
+            self.registry.delete_chunk(cid)
+
+    def _op_create_chunk(self, op):
+        self.registry.create_chunk(
+            op["slice_type"], chunk_id=op["chunk_id"], version=op["version"],
+            copies=op.get("copies", 1),
+        )
+
+    def _op_set_chunk(self, op):
+        self.fs.apply_set_chunk(op["inode"], op["chunk_index"], op["chunk_id"])
+
+    def _op_bump_chunk_version(self, op):
+        self.registry.chunk(op["chunk_id"]).version = op["version"]
+
+    def _op_delete_chunk(self, op):
+        self.registry.delete_chunk(op["chunk_id"])
+
+    def _op_purge_trash(self, op):
+        node = self.fs.nodes.get(op["inode"])
+        if node is not None:
+            for cid in node.chunks:
+                if cid:
+                    self.registry.delete_chunk(cid)
+        self.fs.apply_purge_trash(op["inode"])
+
+    # --- persistence sections --------------------------------------------------
+
+    def to_sections(self) -> dict:
+        return {
+            "fs": self.fs.to_dict(),
+            "chunks": {
+                "next_chunk_id": self.registry.next_chunk_id,
+                "table": [
+                    {"id": c.chunk_id, "version": c.version,
+                     "slice_type": c.slice_type, "copies": c.copies}
+                    for c in self.registry.chunks.values()
+                ],
+            },
+        }
+
+    def load_sections(self, doc: dict) -> None:
+        self.fs = FsTree.from_dict(doc["fs"])
+        self.registry = ChunkRegistry()
+        ch = doc["chunks"]
+        self.registry.next_chunk_id = ch["next_chunk_id"]
+        for row in ch["table"]:
+            self.registry.create_chunk(
+                row["slice_type"], chunk_id=row["id"], version=row["version"],
+                copies=row.get("copies", 1),
+            )
+        self.registry.next_chunk_id = ch["next_chunk_id"]
+
+    def checksum(self) -> str:
+        """Divergence-detection digest over FS + persistent chunk state."""
+        import hashlib
+        import json
+
+        blob = json.dumps(self.to_sections(), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()

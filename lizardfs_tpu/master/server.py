@@ -1,0 +1,791 @@
+"""Master daemon: client service, chunkserver service, shadow stream,
+health loop, persistence.
+
+One asyncio daemon hosting all the reference's master-side network
+modules (reference: src/master/matoclserv.cc client service,
+matocsserv.cc chunkserver service, matomlserv.cc shadow/metalogger
+stream) over the MetadataStore state machine. Connections self-identify
+with their first message (register), then stay in a per-role loop.
+
+Write-path protocol (fuse_write_chunk analog, matoclserv.cc:2938):
+  WriteChunk -> create chunk (choose servers per part, command creates)
+                or bump version on existing parts; lock; reply locations
+  WriteChunkEnd -> set file length, unlock, changelog.
+
+Health loop (ChunkWorker analog, chunks.cc:1807): every tick, serve the
+endangered queue first, then walk chunks; replicate missing parts
+(MatocsReplicate to a chosen server with source locations) and delete
+redundant ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from lizardfs_tpu.constants import MFSCHUNKSIZE
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master import fs as fsmod
+from lizardfs_tpu.master.changelog import Changelog, load_image, save_image
+from lizardfs_tpu.master.chunks import ChunkServerInfo
+from lizardfs_tpu.master.metadata import MetadataStore
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime.daemon import Daemon
+
+CHUNK_LOCK_SECONDS = 30.0
+
+
+class _CsLink:
+    """Server-side link to one registered chunkserver: lets the master
+    send commands and await acks while reports flow in."""
+
+    def __init__(self, master: "MasterServer", reader, writer):
+        self.master = master
+        self.reader = reader
+        self.writer = writer
+        self.cs_id = 0
+        # disjoint from the chunkserver's own call ids (they start at 1):
+        # both directions share one connection (see rpc.RpcConnection._pump)
+        self._req_ids = iter(range(1 << 30, 1 << 62))
+        self._pending: dict[int, asyncio.Future] = {}
+
+    async def command(self, msg_cls, *, timeout: float = 20.0, **fields):
+        req_id = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            await framing.send_message(self.writer, msg_cls(req_id=req_id, **fields))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(req_id, None)
+
+    def dispatch_ack(self, msg) -> bool:
+        fut = self._pending.get(msg.req_id)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+            return True
+        return False
+
+    def fail_all(self):
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("chunkserver disconnected"))
+        self._pending.clear()
+
+
+class MasterServer(Daemon):
+    name = "master"
+
+    def __init__(
+        self,
+        data_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        goals: dict[int, geometry.Goal] | None = None,
+        health_interval: float = 1.0,
+        image_interval: float = 300.0,
+    ):
+        super().__init__(host, port)
+        self.data_dir = data_dir
+        self.meta = MetadataStore()
+        self.changelog = Changelog(data_dir)
+        self.goals = goals or geometry.default_goals()
+        self.cs_links: dict[int, _CsLink] = {}
+        self.shadow_writers: list[asyncio.StreamWriter] = []
+        self.sessions: dict[int, dict] = {}
+        self.next_session = 1
+        self.health_interval = health_interval
+        self.image_interval = image_interval
+        self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
+        self.log = logging.getLogger("master")
+
+    # --- lifecycle -----------------------------------------------------------
+
+    async def setup(self) -> None:
+        loaded = load_image(self.data_dir)
+        start_version = 0
+        if loaded is not None:
+            start_version, doc = loaded
+            self.meta.load_sections(doc)
+        self.changelog.version = start_version
+        replayed = 0
+        for version, op in self.changelog.iter_entries(start_version):
+            self.meta.apply(op)
+            self.changelog.version = version
+            replayed += 1
+        if replayed:
+            self.log.info("replayed %d changelog entries", replayed)
+        self.changelog.open()
+        self.add_timer(self.health_interval, self._health_tick)
+        self.add_timer(self.image_interval, self._dump_image)
+        self.add_timer(10.0, self._purge_trash)
+
+    async def teardown(self) -> None:
+        await self._dump_image()
+        self.changelog.close()
+
+    # --- mutation helper --------------------------------------------------------
+
+    def commit(self, op: dict) -> int:
+        """Apply + changelog + broadcast to shadows. The one write path."""
+        self.meta.apply(op)
+        version = self.changelog.append(op)
+        if self.shadow_writers:
+            line = m.MatomlChangelogLine(version=version, line=json.dumps(op, sort_keys=True))
+            dead = []
+            for w in self.shadow_writers:
+                try:
+                    framing.write_message(w, line)
+                except (ConnectionError, RuntimeError):
+                    dead.append(w)
+            for w in dead:
+                self.shadow_writers.remove(w)
+        return version
+
+    async def _dump_image(self) -> None:
+        version = self.changelog.version
+        sections = self.meta.to_sections()
+        # serialization + fsync off the event loop (MetadataDumper analog)
+        await asyncio.to_thread(save_image, self.data_dir, version, sections)
+        self.changelog.rotate()
+        self.changelog.open()
+
+    async def _purge_trash(self) -> None:
+        now = int(time.time())
+        expired = [i for i, (_, ts) in self.meta.fs.trash.items() if ts <= now]
+        for inode in expired:
+            self.commit({"op": "purge_trash", "inode": inode})
+
+    # --- connection dispatch ------------------------------------------------------
+
+    async def handle_connection(self, reader, writer) -> None:
+        try:
+            first = await framing.read_message(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        if isinstance(first, m.CltomaRegister):
+            await self._client_loop(reader, writer, first)
+        elif isinstance(first, m.CstomaRegister):
+            await self._cs_loop(reader, writer, first)
+        elif isinstance(first, m.MltomaRegister):
+            await self._shadow_loop(reader, writer, first)
+        elif isinstance(first, (m.AdminInfo, m.AdminCommand)):
+            await self._admin_message(writer, first)
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                await self._admin_message(writer, msg)
+        else:
+            self.log.warning("unexpected first message %s", type(first).__name__)
+
+    # --- client service (matoclserv analog) -----------------------------------------
+
+    async def _client_loop(self, reader, writer, first: m.CltomaRegister) -> None:
+        session_id = first.session_id or self.next_session
+        if first.session_id == 0:
+            self.next_session += 1
+        self.sessions[session_id] = {"info": first.info, "connected": True}
+        await framing.send_message(
+            writer,
+            m.MatoclRegister(req_id=first.req_id, status=st.OK, session_id=session_id),
+        )
+        while True:
+            try:
+                msg = await framing.read_message(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                break
+            try:
+                reply = await self._handle_client(msg)
+            except fsmod.FsError as e:
+                reply = self._error_reply(msg, e.code)
+            except Exception:
+                self.log.exception("client op %s failed", type(msg).__name__)
+                reply = self._error_reply(msg, st.EIO)
+            if reply is not None:
+                await framing.send_message(writer, reply)
+        self.sessions.get(session_id, {})["connected"] = False
+
+    def _error_reply(self, msg, code: int):
+        if isinstance(msg, (m.CltomaReadChunk,)):
+            return m.MatoclReadChunk(
+                req_id=msg.req_id, status=code, chunk_id=0, version=0,
+                file_length=0, locations=[],
+            )
+        if isinstance(msg, (m.CltomaWriteChunk,)):
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=code, chunk_id=0, version=0,
+                file_length=0, locations=[],
+            )
+        if isinstance(msg, m.CltomaReaddir):
+            return m.MatoclReaddir(req_id=msg.req_id, status=code, entries=[])
+        if isinstance(msg, m.CltomaReadlink):
+            return m.MatoclReadlink(req_id=msg.req_id, status=code, target="")
+        if isinstance(
+            msg,
+            (m.CltomaLookup, m.CltomaGetattr, m.CltomaMkdir, m.CltomaCreate,
+             m.CltomaSetattr, m.CltomaSymlink, m.CltomaLink),
+        ):
+            return m.MatoclAttrReply(
+                req_id=msg.req_id, status=code, attr=_null_attr()
+            )
+        return m.MatoclStatusReply(req_id=msg.req_id, status=code)
+
+    async def _handle_client(self, msg):
+        fs = self.meta.fs
+        now = int(time.time())
+        if isinstance(msg, m.CltomaLookup):
+            node = fs.lookup(msg.parent, msg.name)
+            return self._attr_reply(msg.req_id, node)
+        if isinstance(msg, m.CltomaGetattr):
+            return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaMkdir):
+            inode = fs.alloc_inode()
+            self.commit({
+                "op": "mknode", "parent": msg.parent, "name": msg.name,
+                "inode": inode, "ftype": fsmod.TYPE_DIR, "mode": msg.mode,
+                "uid": msg.uid, "gid": msg.gid, "ts": now, "goal": 1,
+                "trash_time": 86400,
+            })
+            return self._attr_reply(msg.req_id, fs.node(inode))
+        if isinstance(msg, m.CltomaCreate):
+            parent_goal = fs.dir_node(msg.parent).goal
+            inode = fs.alloc_inode()
+            self.commit({
+                "op": "mknode", "parent": msg.parent, "name": msg.name,
+                "inode": inode, "ftype": fsmod.TYPE_FILE, "mode": msg.mode,
+                "uid": msg.uid, "gid": msg.gid, "ts": now, "goal": parent_goal,
+                "trash_time": 86400,
+            })
+            return self._attr_reply(msg.req_id, fs.node(inode))
+        if isinstance(msg, m.CltomaSymlink):
+            inode = fs.alloc_inode()
+            self.commit({
+                "op": "mknode", "parent": msg.parent, "name": msg.name,
+                "inode": inode, "ftype": fsmod.TYPE_SYMLINK, "mode": 0o777,
+                "uid": msg.uid, "gid": msg.gid, "ts": now, "goal": 1,
+                "trash_time": 0, "symlink_target": msg.target,
+            })
+            return self._attr_reply(msg.req_id, fs.node(inode))
+        if isinstance(msg, m.CltomaReadlink):
+            node = fs.node(msg.inode)
+            if node.ftype != fsmod.TYPE_SYMLINK:
+                return m.MatoclReadlink(req_id=msg.req_id, status=st.EINVAL, target="")
+            return m.MatoclReadlink(
+                req_id=msg.req_id, status=st.OK, target=node.symlink_target
+            )
+        if isinstance(msg, m.CltomaLink):
+            self.commit({
+                "op": "link", "inode": msg.inode, "parent": msg.parent,
+                "name": msg.name, "ts": now,
+            })
+            return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaReaddir):
+            node = fs.dir_node(msg.inode)
+            entries = [
+                m.DirEntry(name=name, inode=i, ftype=fs.node(i).ftype)
+                for name, i in sorted(node.children.items())
+            ]
+            return m.MatoclReaddir(req_id=msg.req_id, status=st.OK, entries=entries)
+        if isinstance(msg, m.CltomaUnlink):
+            self.commit({
+                "op": "unlink", "parent": msg.parent, "name": msg.name,
+                "ts": now, "to_trash": True,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaRmdir):
+            self.commit({"op": "rmdir", "parent": msg.parent, "name": msg.name, "ts": now})
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaRename):
+            self.commit({
+                "op": "rename", "parent_src": msg.parent_src,
+                "name_src": msg.name_src, "parent_dst": msg.parent_dst,
+                "name_dst": msg.name_dst, "ts": now,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaSetGoal):
+            if msg.goal not in self.goals:
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
+            self.commit({"op": "setgoal", "inode": msg.inode, "goal": msg.goal, "ts": now})
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaSetattr):
+            self.commit({
+                "op": "setattr", "inode": msg.inode, "set_mask": msg.set_mask,
+                "mode": msg.mode, "uid": msg.uid, "gid": msg.gid,
+                "atime": msg.atime, "mtime": msg.mtime, "ts": now,
+            })
+            return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaTruncate):
+            self.commit({"op": "set_length", "inode": msg.inode,
+                         "length": msg.length, "ts": now})
+            return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaReadChunk):
+            return await self._read_chunk(msg)
+        if isinstance(msg, m.CltomaWriteChunk):
+            return await self._write_chunk(msg)
+        if isinstance(msg, m.CltomaWriteChunkEnd):
+            return await self._write_chunk_end(msg)
+        return m.MatoclStatusReply(req_id=getattr(msg, "req_id", 0), status=st.EINVAL)
+
+    def _attr_reply(self, req_id: int, node) -> m.MatoclAttrReply:
+        return m.MatoclAttrReply(req_id=req_id, status=st.OK, attr=_attr_of(node))
+
+    def _locations_of(self, chunk) -> list[m.PartLocation]:
+        t = geometry.SliceType(chunk.slice_type)
+        out = []
+        for cs_id, part in sorted(chunk.parts):
+            srv = self.meta.registry.servers.get(cs_id)
+            if srv is None or not srv.connected:
+                continue
+            out.append(
+                m.PartLocation(
+                    addr=m.Addr(host=srv.host, port=srv.port),
+                    part_id=geometry.ChunkPartType(t, part).id,
+                )
+            )
+        return out
+
+    async def _read_chunk(self, msg: m.CltomaReadChunk):
+        node = self.meta.fs.file_node(msg.inode)
+        chunk_id = (
+            node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
+        )
+        if chunk_id == 0:
+            # hole: no chunk — client reads zeros
+            return m.MatoclReadChunk(
+                req_id=msg.req_id, status=st.OK, chunk_id=0, version=0,
+                file_length=node.length, locations=[],
+            )
+        chunk = self.meta.registry.chunk(chunk_id)
+        return m.MatoclReadChunk(
+            req_id=msg.req_id, status=st.OK, chunk_id=chunk_id,
+            version=chunk.version, file_length=node.length,
+            locations=self._locations_of(chunk),
+        )
+
+    async def _write_chunk(self, msg: m.CltomaWriteChunk):
+        node = self.meta.fs.file_node(msg.inode)
+        chunk_id = (
+            node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
+        )
+        if chunk_id == 0:
+            return await self._create_new_chunk(msg, node)
+        chunk = self.meta.registry.chunk(chunk_id)
+        if chunk.locked_until > time.monotonic():
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.CHUNK_BUSY, chunk_id=0, version=0,
+                file_length=0, locations=[],
+            )
+        # version bump so stale copies are detectable (chunk lock + bump,
+        # matoclserv.cc fuse_write_chunk semantics)
+        new_version = chunk.version + 1
+        holders = sorted(chunk.parts)
+        t = geometry.SliceType(chunk.slice_type)
+        acks = []
+        for cs_id, part in holders:
+            link = self.cs_links.get(cs_id)
+            if link is None:
+                acks.append((cs_id, part, None))
+                continue
+            acks.append((
+                cs_id, part,
+                link.command(
+                    m.MatocsSetVersion,
+                    chunk_id=chunk_id,
+                    old_version=chunk.version,
+                    new_version=new_version,
+                    part_id=geometry.ChunkPartType(t, part).id,
+                ),
+            ))
+        ok_holders: list[tuple[int, int]] = []
+        for cs_id, part, coro in acks:
+            if coro is None:
+                continue
+            try:
+                reply = await coro
+                if reply.status == st.OK:
+                    ok_holders.append((cs_id, part))
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+        if not ok_holders:
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
+                version=0, file_length=0, locations=[],
+            )
+        # copies that missed the bump are stale: unregister them so the
+        # reply's locations are all at new_version, and queue re-repair
+        stale = chunk.parts - set(ok_holders)
+        if stale:
+            chunk.parts -= stale
+            self.meta.registry.mark_endangered(chunk_id)
+        self.commit({
+            "op": "bump_chunk_version", "chunk_id": chunk_id, "version": new_version,
+        })
+        chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
+        return m.MatoclWriteChunk(
+            req_id=msg.req_id, status=st.OK, chunk_id=chunk_id,
+            version=new_version, file_length=node.length,
+            locations=self._locations_of(chunk),
+        )
+
+    def _slice_type_for_goal(self, goal_id: int) -> geometry.SliceType:
+        goal = self.goals.get(goal_id)
+        if goal is None or not goal.slices:
+            return geometry.SliceType(geometry.STANDARD)
+        return goal.slices[0].type
+
+    async def _create_new_chunk(self, msg: m.CltomaWriteChunk, node):
+        t = self._slice_type_for_goal(node.goal)
+        goal = self.goals.get(node.goal)
+        copies = goal.expected_copies() if (goal and t.is_standard) else 1
+        # std goals: N copies of part 0; xor/ec: one copy of each part
+        part_list = [0] * copies if t.is_standard else list(range(t.expected_parts))
+        nparts = len(part_list)
+        try:
+            servers = self.meta.registry.choose_servers(nparts)
+        except ValueError:
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
+                version=0, file_length=0, locations=[],
+            )
+        # reserve the id immediately — the awaits below suspend this
+        # coroutine and a concurrent create must not reuse it
+        chunk_id = self.meta.registry.next_chunk_id
+        self.meta.registry.next_chunk_id = chunk_id + 1
+        version = 1
+        # command part creation on each server first; registry mutation is
+        # committed only after at least the data parts exist
+        acks = []
+        for part, srv in zip(part_list, servers):
+            link = self.cs_links.get(srv.cs_id)
+            if link is None:
+                continue
+            acks.append((
+                part, srv,
+                link.command(
+                    m.MatocsCreateChunk,
+                    chunk_id=chunk_id, version=version,
+                    part_id=geometry.ChunkPartType(t, part).id,
+                ),
+            ))
+        created: list[tuple[int, ChunkServerInfo]] = []
+        for part, srv, coro in acks:
+            try:
+                reply = await coro
+                if reply.status == st.OK:
+                    created.append((part, srv))
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+        if len(created) < nparts:
+            # roll back whatever was created
+            for part, srv in created:
+                link = self.cs_links.get(srv.cs_id)
+                if link is not None:
+                    try:
+                        await link.command(
+                            m.MatocsDeleteChunk, chunk_id=chunk_id,
+                            version=version,
+                            part_id=geometry.ChunkPartType(t, part).id,
+                        )
+                    except (ConnectionError, asyncio.TimeoutError):
+                        pass
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
+                version=0, file_length=0, locations=[],
+            )
+        self.commit({
+            "op": "create_chunk", "chunk_id": chunk_id,
+            "slice_type": int(t), "version": version, "copies": copies,
+        })
+        self.commit({
+            "op": "set_chunk", "inode": msg.inode,
+            "chunk_index": msg.chunk_index, "chunk_id": chunk_id,
+        })
+        chunk = self.meta.registry.chunk(chunk_id)
+        for part, srv in created:
+            chunk.parts.add((srv.cs_id, part))
+        chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
+        return m.MatoclWriteChunk(
+            req_id=msg.req_id, status=st.OK, chunk_id=chunk_id, version=version,
+            file_length=node.length, locations=self._locations_of(chunk),
+        )
+
+    async def _write_chunk_end(self, msg: m.CltomaWriteChunkEnd):
+        chunk = self.meta.registry.chunks.get(msg.chunk_id)
+        if chunk is not None:
+            chunk.locked_until = 0.0
+            state = self.meta.registry.evaluate(chunk)
+            if state.needs_work:
+                self.meta.registry.mark_endangered(msg.chunk_id)
+        if msg.status == st.OK:
+            node = self.meta.fs.file_node(msg.inode)
+            if msg.file_length > node.length:
+                self.commit({
+                    "op": "set_length", "inode": msg.inode,
+                    "length": msg.file_length, "ts": int(time.time()),
+                })
+        return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+
+    # --- chunkserver service (matocsserv analog) --------------------------------------
+
+    async def _cs_loop(self, reader, writer, first: m.CstomaRegister) -> None:
+        link = _CsLink(self, reader, writer)
+        srv = self.meta.registry.register_server(
+            first.addr.host, first.addr.port, first.label,
+            first.total_space, first.used_space,
+        )
+        link.cs_id = srv.cs_id
+        self.cs_links[srv.cs_id] = link
+        stale: list[m.ChunkPartInfo] = []
+        for info in first.chunks:
+            if not self.meta.registry.add_part(
+                info.chunk_id, srv.cs_id, info.part_id, info.version
+            ):
+                stale.append(info)
+        await framing.send_message(
+            writer,
+            m.MatocsRegisterReply(req_id=first.req_id, status=st.OK, cs_id=srv.cs_id),
+        )
+        self.log.info(
+            "chunkserver %d registered (%s:%d, %d parts, %d stale)",
+            srv.cs_id, srv.host, srv.port, len(first.chunks), len(stale),
+        )
+        for info in stale:
+            self.spawn(self._delete_stale(link, info))
+        try:
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if isinstance(msg, m.CstomaChunkOpStatus):
+                    link.dispatch_ack(msg)
+                elif isinstance(msg, m.CstomaHeartbeat):
+                    srv.total_space = msg.total_space
+                    srv.used_space = msg.used_space
+                    await framing.send_message(
+                        writer, m.MatocsRegisterReply(
+                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                        )
+                    )
+                elif isinstance(msg, (m.CstomaChunkDamaged, m.CstomaChunkLost)):
+                    for info in msg.chunks:
+                        self.meta.registry.drop_part(
+                            info.chunk_id, srv.cs_id, info.part_id
+                        )
+                        self.meta.registry.mark_endangered(info.chunk_id)
+                elif isinstance(msg, m.CstomaChunkNew):
+                    for info in msg.chunks:
+                        self.meta.registry.add_part(
+                            info.chunk_id, srv.cs_id, info.part_id, info.version
+                        )
+        finally:
+            self.cs_links.pop(srv.cs_id, None)
+            link.fail_all()
+            affected = self.meta.registry.server_disconnected(srv.cs_id)
+            for cid in affected:
+                self.meta.registry.mark_endangered(cid)
+            self.log.info(
+                "chunkserver %d disconnected (%d chunks affected)",
+                srv.cs_id, len(affected),
+            )
+
+    async def _delete_stale(self, link: _CsLink, info: m.ChunkPartInfo) -> None:
+        try:
+            await link.command(
+                m.MatocsDeleteChunk, chunk_id=info.chunk_id,
+                version=info.version, part_id=info.part_id,
+            )
+        except (ConnectionError, asyncio.TimeoutError):
+            pass
+
+    # --- health loop (ChunkWorker analog) ----------------------------------------------
+
+    async def _health_tick(self) -> None:
+        # released chunks: delete their on-disk parts
+        drained = self.meta.registry.pending_deletes[:16]
+        del self.meta.registry.pending_deletes[:16]
+        for dead in drained:
+            t = geometry.SliceType(dead.slice_type)
+            for cs_id, part in dead.parts:
+                link = self.cs_links.get(cs_id)
+                if link is None:
+                    continue
+                self.spawn(self._delete_orphan(link, dead, t, part))
+        work = self.meta.registry.health_work(limit=16)
+        for item in work:
+            if item[0] == "replicate":
+                _, chunk, part = item
+                key = (chunk.chunk_id, part)
+                if key in self._replicating or chunk.locked_until > time.monotonic():
+                    continue
+                self._replicating.add(key)
+                self.spawn(self._replicate_part(chunk, part))
+            elif item[0] == "delete":
+                _, chunk, cs_id, part = item
+                self.spawn(self._delete_redundant(chunk, cs_id, part))
+
+    async def _delete_orphan(self, link, dead, t, part: int) -> None:
+        try:
+            await link.command(
+                m.MatocsDeleteChunk, chunk_id=dead.chunk_id,
+                version=dead.version, part_id=geometry.ChunkPartType(t, part).id,
+            )
+        except (ConnectionError, asyncio.TimeoutError):
+            pass
+
+    async def _replicate_part(self, chunk, part: int) -> None:
+        try:
+            t = geometry.SliceType(chunk.slice_type)
+            holders = {cs for cs, _ in chunk.parts}
+            try:
+                target = self.meta.registry.choose_servers(1, exclude=holders)[0]
+            except ValueError:
+                return
+            link = self.cs_links.get(target.cs_id)
+            if link is None:
+                return
+            sources = self._locations_of(chunk)
+            try:
+                reply = await link.command(
+                    m.MatocsReplicate,
+                    chunk_id=chunk.chunk_id, version=chunk.version,
+                    part_id=geometry.ChunkPartType(t, part).id,
+                    sources=sources, timeout=60.0,
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                return
+            if reply.status != st.OK:
+                self.log.warning(
+                    "replication of chunk %d part %d to cs %d failed: %s",
+                    chunk.chunk_id, part, target.cs_id, st.name(reply.status),
+                )
+        finally:
+            self._replicating.discard((chunk.chunk_id, part))
+            # re-evaluate on the next tick until healthy
+            state = self.meta.registry.evaluate(chunk)
+            if state.needs_work:
+                self.meta.registry.mark_endangered(chunk.chunk_id)
+
+    async def _delete_redundant(self, chunk, cs_id: int, part: int) -> None:
+        link = self.cs_links.get(cs_id)
+        if link is None:
+            return
+        t = geometry.SliceType(chunk.slice_type)
+        part_id = geometry.ChunkPartType(t, part).id
+        try:
+            reply = await link.command(
+                m.MatocsDeleteChunk, chunk_id=chunk.chunk_id,
+                version=chunk.version, part_id=part_id,
+            )
+            if reply.status == st.OK:
+                self.meta.registry.drop_part(chunk.chunk_id, cs_id, part_id)
+        except (ConnectionError, asyncio.TimeoutError):
+            pass
+
+    # --- shadow / metalogger stream (matomlserv analog) ---------------------------------
+
+    async def _shadow_loop(self, reader, writer, first: m.MltomaRegister) -> None:
+        self.shadow_writers.append(writer)
+        try:
+            # serve image download requests; changelog lines are pushed by
+            # commit()
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if isinstance(msg, m.MltomaDownloadImage):
+                    doc = {
+                        "format": "inline",
+                        **self.meta.to_sections(),
+                    }
+                    await framing.send_message(
+                        writer,
+                        m.MatomlImage(
+                            req_id=msg.req_id, status=st.OK,
+                            version=self.changelog.version,
+                            image=json.dumps(doc, sort_keys=True).encode(),
+                        ),
+                    )
+        finally:
+            if writer in self.shadow_writers:
+                self.shadow_writers.remove(writer)
+
+    # --- admin ----------------------------------------------------------------------------
+
+    async def _admin_message(self, writer, msg) -> None:
+        if isinstance(msg, m.AdminInfo):
+            info = {
+                "version": self.changelog.version,
+                "inodes": len(self.meta.fs.nodes),
+                "chunks": len(self.meta.registry.chunks),
+                "chunkservers": [
+                    {
+                        "cs_id": s.cs_id, "host": s.host, "port": s.port,
+                        "label": s.label, "connected": s.connected,
+                        "total_space": s.total_space, "used_space": s.used_space,
+                    }
+                    for s in self.meta.registry.servers.values()
+                ],
+                "sessions": len(self.sessions),
+            }
+            await framing.send_message(
+                writer,
+                m.AdminInfoReply(req_id=msg.req_id, status=st.OK, json=json.dumps(info)),
+            )
+            return
+        if isinstance(msg, m.AdminCommand):
+            reply = await self._admin_command(msg)
+            await framing.send_message(writer, reply)
+
+    async def _admin_command(self, msg: m.AdminCommand) -> m.AdminReply:
+        if msg.command == "save-metadata":
+            await self._dump_image()
+            return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")
+        if msg.command == "chunks-health":
+            healthy = endangered = lost = 0
+            for chunk in self.meta.registry.chunks.values():
+                state = self.meta.registry.evaluate(chunk)
+                if not state.is_readable:
+                    lost += 1
+                elif state.is_endangered or state.missing_parts:
+                    endangered += 1
+                else:
+                    healthy += 1
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({
+                    "healthy": healthy, "endangered": endangered, "lost": lost,
+                }),
+            )
+        if msg.command == "metadata-checksum":
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({
+                    "version": self.changelog.version,
+                    "checksum": self.meta.checksum(),
+                }),
+            )
+        return m.AdminReply(req_id=msg.req_id, status=st.EINVAL, json="{}")
+
+
+def _attr_of(node) -> m.Attr:
+    return m.Attr(
+        inode=node.inode, ftype=node.ftype, mode=node.mode, uid=node.uid,
+        gid=node.gid, atime=node.atime, mtime=node.mtime, ctime=node.ctime,
+        nlink=node.nlink, length=node.length, goal=node.goal,
+        trash_time=node.trash_time,
+    )
+
+
+def _null_attr() -> m.Attr:
+    return m.Attr(
+        inode=0, ftype=0, mode=0, uid=0, gid=0, atime=0, mtime=0, ctime=0,
+        nlink=0, length=0, goal=0, trash_time=0,
+    )

@@ -23,6 +23,7 @@ class RpcConnection:
         self._req_ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._push_handlers: dict[type, object] = {}
+        self._handler_tasks: set[asyncio.Task] = set()
         self._pump_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
 
@@ -44,15 +45,21 @@ class RpcConnection:
         try:
             while True:
                 msg = await framing.read_message(self.reader)
-                req_id = getattr(msg, "req_id", None)
-                fut = self._pending.pop(req_id, None) if req_id is not None else None
-                if fut is not None:
-                    if not fut.done():
-                        fut.set_result(msg)
-                    continue
+                # push types FIRST: peer-initiated requests (e.g. master
+                # commands) carry their own req_id space which would
+                # otherwise collide with our call ids on a bidirectional
+                # link. Push handlers run as tasks so a slow handler
+                # (e.g. a replication) never stalls the pump.
                 handler = self._push_handlers.get(type(msg))
                 if handler is not None:
-                    await handler(msg)
+                    task = asyncio.get_running_loop().create_task(handler(msg))
+                    self._handler_tasks.add(task)
+                    task.add_done_callback(self._handler_tasks.discard)
+                    continue
+                req_id = getattr(msg, "req_id", None)
+                fut = self._pending.pop(req_id, None) if req_id is not None else None
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
                 # unsolicited + unhandled messages are dropped
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
@@ -95,6 +102,8 @@ class RpcConnection:
     async def close(self) -> None:
         if self._pump_task is not None:
             self._pump_task.cancel()
+        for task in list(self._handler_tasks):
+            task.cancel()
         self.writer.close()
         try:
             await self.writer.wait_closed()

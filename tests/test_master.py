@@ -1,0 +1,148 @@
+"""Master state machine tests: fs ops, changelog replay, registry health."""
+
+import pytest
+
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master import fs as fsmod
+from lizardfs_tpu.master.changelog import Changelog, load_image, save_image
+from lizardfs_tpu.master.chunks import ChunkRegistry
+from lizardfs_tpu.master.fs import FsError, FsTree, ROOT_INODE
+from lizardfs_tpu.master.metadata import MetadataStore
+from lizardfs_tpu.proto import status as st
+
+
+def test_fs_basic_tree():
+    fs = FsTree()
+    d = fs.apply_mknode(ROOT_INODE, "dir", 2, fsmod.TYPE_DIR, 0o755, 0, 0, 100, 1, 0)
+    f = fs.apply_mknode(2, "file", 3, fsmod.TYPE_FILE, 0o644, 1, 1, 101, 2, 0)
+    assert fs.lookup(ROOT_INODE, "dir").inode == 2
+    assert fs.lookup(2, "file").inode == 3
+    with pytest.raises(FsError) as e:
+        fs.apply_mknode(ROOT_INODE, "dir", 4, fsmod.TYPE_DIR, 0, 0, 0, 0, 1, 0)
+    assert e.value.code == st.EEXIST
+    with pytest.raises(FsError):
+        fs.lookup(ROOT_INODE, "nope")
+    with pytest.raises(FsError) as e:
+        fs.apply_rmdir(ROOT_INODE, "dir", 102)
+    assert e.value.code == st.ENOTEMPTY
+    fs.apply_unlink(2, "file", 103, to_trash=False)
+    fs.apply_rmdir(ROOT_INODE, "dir", 104)
+    assert len(fs.nodes) == 1
+
+
+def test_fs_rename_and_link():
+    fs = FsTree()
+    fs.apply_mknode(ROOT_INODE, "a", 2, fsmod.TYPE_DIR, 0o755, 0, 0, 1, 1, 0)
+    fs.apply_mknode(ROOT_INODE, "f", 3, fsmod.TYPE_FILE, 0o644, 0, 0, 1, 1, 0)
+    fs.apply_rename(ROOT_INODE, "f", 2, "g", 2)
+    assert fs.lookup(2, "g").inode == 3
+    fs.apply_link(3, ROOT_INODE, "hard", 3)
+    assert fs.node(3).nlink == 2
+    # rename a directory under itself must fail
+    fs.apply_mknode(2, "b", 4, fsmod.TYPE_DIR, 0o755, 0, 0, 1, 1, 0)
+    with pytest.raises(FsError):
+        fs.apply_rename(ROOT_INODE, "a", 4, "loop", 5)
+
+
+def test_fs_trash_flow():
+    fs = FsTree()
+    fs.apply_mknode(ROOT_INODE, "f", 2, fsmod.TYPE_FILE, 0o644, 0, 0, 1, 1, 3600)
+    fs.apply_unlink(ROOT_INODE, "f", 10, to_trash=True)
+    assert 2 in fs.trash and 2 in fs.nodes  # kept until purge
+    fs.apply_purge_trash(2)
+    assert 2 not in fs.nodes
+
+
+def test_metadata_image_and_replay(tmp_path):
+    """Changelog + image: rebuild state through the same apply path."""
+    data_dir = str(tmp_path)
+    store = MetadataStore()
+    log = Changelog(data_dir)
+
+    def commit(op):
+        store.apply(op)
+        log.append(op)
+
+    commit({"op": "mknode", "parent": 1, "name": "d", "inode": 2,
+            "ftype": fsmod.TYPE_DIR, "mode": 0o755, "uid": 0, "gid": 0,
+            "ts": 1, "goal": 1, "trash_time": 0})
+    commit({"op": "mknode", "parent": 2, "name": "f", "inode": 3,
+            "ftype": fsmod.TYPE_FILE, "mode": 0o644, "uid": 0, "gid": 0,
+            "ts": 2, "goal": 3, "trash_time": 0})
+    commit({"op": "create_chunk", "chunk_id": 1,
+            "slice_type": int(geometry.ec_type(3, 2)), "version": 1, "copies": 1})
+    commit({"op": "set_chunk", "inode": 3, "chunk_index": 0, "chunk_id": 1})
+    commit({"op": "set_length", "inode": 3, "length": 12345, "ts": 3})
+
+    # image at version 3, then 2 more entries replayed on top
+    mid_sections_version = 3
+    # write image as if dumped after the 3rd entry: rebuild a mid-state
+    mid = MetadataStore()
+    for i, (version, op) in enumerate(Changelog(data_dir).iter_entries(0)):
+        if version <= mid_sections_version:
+            mid.apply(op)
+    save_image(data_dir, mid_sections_version, mid.to_sections())
+
+    # restart: load image + replay tail
+    reloaded = MetadataStore()
+    version, doc = load_image(data_dir)
+    reloaded.load_sections(doc)
+    for v, op in Changelog(data_dir).iter_entries(version):
+        reloaded.apply(op)
+    assert reloaded.checksum() == store.checksum()
+    assert reloaded.fs.node(3).length == 12345
+    assert reloaded.registry.chunk(1).version == 1
+
+
+def test_registry_health_ec():
+    reg = ChunkRegistry()
+    for i in range(6):
+        reg.register_server(f"h{i}", 9000 + i, "_", 10**12, 0)
+    t = geometry.ec_type(3, 2)
+    chunk = reg.create_chunk(int(t))
+    for part in range(5):
+        chunk.parts.add((part + 1, part))
+    state = reg.evaluate(chunk)
+    assert state.is_safe and state.is_readable and not state.needs_work
+
+    # lose two servers: endangered but readable, two parts missing
+    reg.server_disconnected(1)
+    reg.server_disconnected(2)
+    state = reg.evaluate(chunk)
+    assert state.is_readable and not state.is_safe
+    assert sorted(state.missing_parts) == [0, 1]
+    work = reg.health_work()
+    kinds = [(w[0], w[2]) for w in work]
+    assert ("replicate", 0) in kinds and ("replicate", 1) in kinds
+
+    # lose one more: unreadable (data loss for ec(3,2))
+    reg.server_disconnected(3)
+    assert not reg.evaluate(chunk).is_readable
+
+
+def test_registry_health_std_copies():
+    reg = ChunkRegistry()
+    for i in range(4):
+        reg.register_server(f"h{i}", 9100 + i, "_", 10**12, 0)
+    chunk = reg.create_chunk(geometry.STANDARD, copies=3)
+    chunk.parts.add((1, 0))
+    state = reg.evaluate(chunk)
+    assert state.missing_parts == [0, 0] and state.is_readable
+    chunk.parts.add((2, 0))
+    chunk.parts.add((3, 0))
+    chunk.parts.add((4, 0))
+    state = reg.evaluate(chunk)
+    assert not state.missing_parts
+    assert len(state.redundant) == 1  # 4 copies, want 3
+
+
+def test_choose_servers_distinct_and_weighted():
+    reg = ChunkRegistry()
+    for i in range(5):
+        reg.register_server(f"h{i}", 9200 + i, "_", 10**12, 0)
+    picked = reg.choose_servers(5)
+    assert len({s.cs_id for s in picked}) == 5  # distinct while possible
+    picked = reg.choose_servers(8)  # more parts than servers: wraps
+    assert len(picked) == 8
+    with pytest.raises(ValueError):
+        ChunkRegistry().choose_servers(1)
