@@ -188,14 +188,19 @@ def get_encoder(name: str | None = None) -> ChunkEncoder:
     if name is None:
         name = os.environ.get("LIZARDFS_TPU_ENCODER", "auto")
     if name == "auto":
-        try:
-            get_encoder("tpu")
-            name = "tpu"
-        except Exception:
-            name = "cpu"
+        for candidate in ("tpu", "cpp", "cpu"):
+            try:
+                return get_encoder(candidate)
+            except Exception:
+                continue
+        name = "cpu"
     if name not in _ENCODERS:
         if name == "cpu":
             _ENCODERS[name] = CpuChunkEncoder()
+        elif name == "cpp":
+            from lizardfs_tpu.core.native import CppChunkEncoder
+
+            _ENCODERS[name] = CppChunkEncoder()
         elif name == "tpu":
             _ENCODERS[name] = TpuChunkEncoder()
         else:
