@@ -34,8 +34,13 @@ def tpu_throughput() -> float:
     import jax
     import jax.numpy as jnp
 
-    from lizardfs_tpu.ops import jax_ec
+    from lizardfs_tpu.ops import jax_ec, pallas_ec
 
+    fused = (
+        pallas_ec.fused_encode_crc
+        if pallas_ec.supported()
+        else jax_ec.fused_encode_crc
+    )
     bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(K, M)))
     data = jax.device_put(
         np.random.default_rng(0).integers(
@@ -46,7 +51,7 @@ def tpu_throughput() -> float:
     @functools.partial(jax.jit, static_argnums=(2,))
     def loop(bigm, x, n):
         def body(i, x):
-            p, dc, pc = jax_ec.fused_encode_crc(bigm, x, BLOCK)
+            p, dc, pc = fused(bigm, x, BLOCK)
             mix = (dc.sum(dtype=jnp.uint32) ^ pc.sum(dtype=jnp.uint32)) & 0xFF
             x = x.at[:M, :].set(x[:M, :] ^ p)
             return x.at[0, 0].set(x[0, 0] ^ mix.astype(jnp.uint8))

@@ -153,10 +153,17 @@ class TpuChunkEncoder(ChunkEncoder):
         out = np.asarray(self._ops.apply_gf(self._put(bigm), self._put(stacked)))
         return {w: out[i] for i, w in enumerate(wanted)}
 
+    def _pallas(self):
+        from lizardfs_tpu.ops import pallas_ec
+
+        return pallas_ec if pallas_ec.supported() else None
+
     def checksum(self, blocks):
         blocks = np.ascontiguousarray(blocks)
+        pe = self._pallas()
+        ops = pe if pe is not None else self._ops
         return np.asarray(
-            self._ops.block_crcs(self._put(blocks), blocks.shape[1])
+            ops.block_crcs(self._put(blocks), blocks.shape[1])
         ).astype(np.uint32)
 
     def xor_parity(self, parts):
@@ -165,9 +172,9 @@ class TpuChunkEncoder(ChunkEncoder):
 
     def encode_with_checksums(self, k, m, data, block_size=MFSBLOCKSIZE):
         bigm = self._ops.encoding_bitmatrix(k, m)
-        parity, dcrc, pcrc = self._ops.fused_encode_crc(
-            self._put(bigm), self._put(data), block_size
-        )
+        pe = self._pallas()
+        fused = pe.fused_encode_crc if pe is not None else self._ops.fused_encode_crc
+        parity, dcrc, pcrc = fused(self._put(bigm), self._put(data), block_size)
         return (
             np.asarray(parity),
             np.asarray(dcrc).astype(np.uint32),

@@ -1,0 +1,61 @@
+"""Pallas kernels in interpret mode (CPU): byte parity with the golden
+path. The same kernels run compiled on the real chip (validated by
+bench.py / graft entry)."""
+
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+
+import lizardfs_tpu.ops.pallas_ec as pe
+from lizardfs_tpu.core.encoder import CpuChunkEncoder
+from lizardfs_tpu.ops import jax_ec
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    orig = pl.pallas_call
+
+    def patched(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(pl, "pallas_call", patched)
+
+
+cpu = CpuChunkEncoder()
+
+
+def test_supported_is_false_on_cpu():
+    assert pe.supported() is False
+
+
+@pytest.mark.parametrize("k,m", [(3, 2), (8, 4)])
+def test_pallas_encode_byte_identical(k, m):
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, 2 * 16384), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    parity = np.asarray(pe.encode(bigm, data))
+    want = np.stack(cpu.encode(k, m, list(data)))
+    np.testing.assert_array_equal(parity, want)
+
+
+def test_pallas_crcs_byte_identical():
+    rng = np.random.default_rng(1)
+    # 18 blocks: not a multiple of the per-step group (16) -> padding path
+    blocks = rng.integers(0, 256, size=(18, 4096), dtype=np.uint8)
+    got = np.asarray(pe.block_crcs(blocks, 4096))
+    from lizardfs_tpu.ops import crc32
+
+    np.testing.assert_array_equal(got, crc32.block_crcs_golden(blocks))
+
+
+def test_pallas_fused_byte_identical():
+    rng = np.random.default_rng(2)
+    k, m, bs, nb = 8, 4, 8192, 4
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    p, dc, pc = pe.fused_encode_crc(bigm, data, bs)
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(p), wp)
+    np.testing.assert_array_equal(np.asarray(dc), wd)
+    np.testing.assert_array_equal(np.asarray(pc), wpc)
