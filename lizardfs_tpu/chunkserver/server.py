@@ -77,28 +77,35 @@ class ChunkServer(Daemon):
     def __init__(
         self,
         data_folder: str,
-        master_addr: tuple[str, int],
+        master_addr: tuple[str, int] | list[tuple[str, int]] | None,
         host: str = "127.0.0.1",
         port: int = 0,
         label: str = "_",
         encoder_name: str | None = "cpu",
         wave_timeout: float = 0.3,
+        heartbeat_interval: float = 5.0,
     ):
         super().__init__(host, port)
         self.store = ChunkStore(data_folder)
-        self.master_addr = master_addr
+        # one or more master addresses (active + shadows); registration
+        # cycles until the active master accepts
+        if isinstance(master_addr, tuple):
+            master_addr = [master_addr]
+        self.master_addrs: list[tuple[str, int]] | None = master_addr
+        self.master_addr = master_addr[0] if master_addr else None
         self.label = label
         self.cs_id = 0
         self.master: RpcConnection | None = None
         self.encoder = get_encoder(encoder_name)
         self.wave_timeout = wave_timeout
+        self.heartbeat_interval = heartbeat_interval
         self.log = logging.getLogger("chunkserver")
 
     # --- lifecycle -----------------------------------------------------------
 
     async def setup(self) -> None:
         await asyncio.to_thread(self.store.scan)
-        self.add_timer(5.0, self._heartbeat)
+        self.add_timer(self.heartbeat_interval, self._heartbeat)
         self.add_timer(60.0, self._test_chunks)
 
     async def start(self) -> None:
@@ -111,7 +118,23 @@ class ChunkServer(Daemon):
             await self.master.close()
 
     async def _connect_master(self) -> None:
-        self.master = await RpcConnection.connect(*self.master_addr)
+        from lizardfs_tpu.proto.status import StatusError
+
+        last: Exception | None = None
+        for addr in self.master_addrs:
+            try:
+                await self._connect_master_at(addr)
+                self.master_addr = addr
+                return
+            except (OSError, ConnectionError, StatusError, asyncio.TimeoutError) as e:
+                last = e
+                if self.master is not None:
+                    await self.master.close()
+                    self.master = None
+        raise ConnectionError(f"no active master reachable: {last}")
+
+    async def _connect_master_at(self, addr: tuple[str, int]) -> None:
+        self.master = await RpcConnection.connect(*addr)
         for cls, handler in (
             (m.MatocsCreateChunk, self._cmd_create),
             (m.MatocsDeleteChunk, self._cmd_delete),

@@ -46,22 +46,44 @@ class Client:
         encoder: ChunkEncoder | None = None,
         wave_timeout: float = 0.3,
         retries: int = 5,
+        master_addrs: list[tuple[str, int]] | None = None,
     ):
-        self.master_addr = (master_host, master_port)
+        # master_addrs: full list of master addresses (active + shadows);
+        # the client cycles until the active one accepts its session
+        self.master_addrs = master_addrs or [(master_host, master_port)]
         self.master: RpcConnection | None = None
         self.session_id = 0
         self.encoder = encoder or get_encoder("cpu")
         self.wave_timeout = wave_timeout
         self.retries = retries
+        self._info = "pyclient"
 
     # --- session -----------------------------------------------------------------
 
     async def connect(self, info: str = "pyclient") -> None:
-        self.master = await RpcConnection.connect(*self.master_addr)
-        reply = await self.master.call_ok(
-            m.CltomaRegister, session_id=self.session_id, info=info
-        )
-        self.session_id = reply.session_id
+        self._info = info
+        last: Exception | None = None
+        for addr in self.master_addrs:
+            try:
+                conn = await RpcConnection.connect(*addr)
+                reply = await conn.call_ok(
+                    m.CltomaRegister, session_id=self.session_id, info=info
+                )
+                self.master = conn
+                self.session_id = reply.session_id
+                return
+            except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
+                last = e
+        raise ConnectionError(f"no active master reachable: {last}")
+
+    async def _call(self, msg_cls, **fields):
+        """Master RPC with one transparent reconnect+retry on a lost or
+        demoted master (failover support)."""
+        try:
+            return await self.master.call_ok(msg_cls, **fields)
+        except (ConnectionError, asyncio.TimeoutError):
+            await self.connect(self._info)
+            return await self.master.call_ok(msg_cls, **fields)
 
     async def close(self) -> None:
         if self.master is not None:
@@ -70,17 +92,17 @@ class Client:
     # --- metadata ops ---------------------------------------------------------------
 
     async def lookup(self, parent: int, name: str) -> m.Attr:
-        r = await self.master.call_ok(m.CltomaLookup, parent=parent, name=name)
+        r = await self._call(m.CltomaLookup, parent=parent, name=name)
         return r.attr
 
     async def getattr(self, inode: int) -> m.Attr:
-        r = await self.master.call_ok(m.CltomaGetattr, inode=inode)
+        r = await self._call(m.CltomaGetattr, inode=inode)
         return r.attr
 
     async def mkdir(
         self, parent: int, name: str, mode: int = 0o755, uid: int = 0, gid: int = 0
     ) -> m.Attr:
-        r = await self.master.call_ok(
+        r = await self._call(
             m.CltomaMkdir, parent=parent, name=name, mode=mode, uid=uid, gid=gid
         )
         return r.attr
@@ -88,48 +110,48 @@ class Client:
     async def create(
         self, parent: int, name: str, mode: int = 0o644, uid: int = 0, gid: int = 0
     ) -> m.Attr:
-        r = await self.master.call_ok(
+        r = await self._call(
             m.CltomaCreate, parent=parent, name=name, mode=mode, uid=uid, gid=gid
         )
         return r.attr
 
     async def readdir(self, inode: int) -> list[m.DirEntry]:
-        r = await self.master.call_ok(m.CltomaReaddir, inode=inode)
+        r = await self._call(m.CltomaReaddir, inode=inode)
         return r.entries
 
     async def unlink(self, parent: int, name: str) -> None:
-        await self.master.call_ok(m.CltomaUnlink, parent=parent, name=name)
+        await self._call(m.CltomaUnlink, parent=parent, name=name)
 
     async def rmdir(self, parent: int, name: str) -> None:
-        await self.master.call_ok(m.CltomaRmdir, parent=parent, name=name)
+        await self._call(m.CltomaRmdir, parent=parent, name=name)
 
     async def rename(self, psrc: int, nsrc: str, pdst: int, ndst: str) -> None:
-        await self.master.call_ok(
+        await self._call(
             m.CltomaRename,
             parent_src=psrc, name_src=nsrc, parent_dst=pdst, name_dst=ndst,
         )
 
     async def symlink(self, parent: int, name: str, target: str) -> m.Attr:
-        r = await self.master.call_ok(
+        r = await self._call(
             m.CltomaSymlink, parent=parent, name=name, target=target, uid=0, gid=0
         )
         return r.attr
 
     async def readlink(self, inode: int) -> str:
-        r = await self.master.call_ok(m.CltomaReadlink, inode=inode)
+        r = await self._call(m.CltomaReadlink, inode=inode)
         return r.target
 
     async def link(self, inode: int, parent: int, name: str) -> m.Attr:
-        r = await self.master.call_ok(
+        r = await self._call(
             m.CltomaLink, inode=inode, parent=parent, name=name
         )
         return r.attr
 
     async def setgoal(self, inode: int, goal: int) -> None:
-        await self.master.call_ok(m.CltomaSetGoal, inode=inode, goal=goal)
+        await self._call(m.CltomaSetGoal, inode=inode, goal=goal)
 
     async def truncate(self, inode: int, length: int) -> m.Attr:
-        r = await self.master.call_ok(m.CltomaTruncate, inode=inode, length=length)
+        r = await self._call(m.CltomaTruncate, inode=inode, length=length)
         return r.attr
 
     # --- write path -------------------------------------------------------------------
@@ -156,7 +178,7 @@ class Client:
     async def _write_chunk(
         self, inode: int, chunk_index: int, chunk_data: np.ndarray, file_length: int
     ) -> None:
-        grant = await self.master.call_ok(
+        grant = await self._call(
             m.CltomaWriteChunk, inode=inode, chunk_index=chunk_index
         )
         status_code = st.EIO
@@ -164,7 +186,7 @@ class Client:
             await self._push_chunk_parts(grant, chunk_data)
             status_code = st.OK
         finally:
-            await self.master.call_ok(
+            await self._call(
                 m.CltomaWriteChunkEnd,
                 chunk_id=grant.chunk_id,
                 inode=inode,
@@ -303,7 +325,7 @@ class Client:
         for attempt in range(self.retries):
             if attempt:
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
-            loc = await self.master.call_ok(
+            loc = await self._call(
                 m.CltomaReadChunk, inode=inode, chunk_index=chunk_index
             )
             if loc.chunk_id == 0:

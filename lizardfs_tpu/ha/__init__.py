@@ -1,0 +1,1 @@
+"""High availability: raft-style leader election + failover controller."""

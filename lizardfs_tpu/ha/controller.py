@@ -1,0 +1,57 @@
+"""Failover controller: binds an ElectionNode to a MasterServer.
+
+The uRaftController analog (reference: src/uraft/uraftcontroller.cc:78-98
+runs promote/demote helper scripts): on winning an election, a shadow
+master is promoted in-process; on losing leadership while active, the
+daemon logs and keeps serving reads only (full demotion = restart, same
+operational rule as the reference).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from lizardfs_tpu.ha.election import ElectionNode
+
+
+class FailoverController:
+    def __init__(
+        self,
+        master,  # MasterServer
+        node_id: str,
+        listen: tuple[str, int],
+        peers: dict[str, tuple[str, int]],
+        **election_kwargs,
+    ):
+        self.master = master
+        self.log = logging.getLogger(f"failover[{node_id}]")
+        self.node = ElectionNode(
+            node_id,
+            listen,
+            peers,
+            get_version=lambda: master.changelog.version,
+            on_leader=self._on_leader,
+            on_follower=self._on_follower,
+            **election_kwargs,
+        )
+
+    async def start(self) -> None:
+        await self.node.start()
+
+    async def stop(self) -> None:
+        await self.node.stop()
+
+    async def _on_leader(self) -> None:
+        if self.master.personality != "master":
+            self.log.info("election won — promoting shadow")
+            self.master.promote()
+
+    async def _on_follower(self, leader_id: str) -> None:
+        if self.master.personality == "master":
+            # split-brain guard: an active master that lost leadership
+            # stops accepting work; operators restart it as a shadow
+            self.log.warning(
+                "lost leadership to %s — demoting to shadow (read-only)",
+                leader_id,
+            )
+            self.master.personality = "shadow"

@@ -2,7 +2,10 @@
 
 Config keys (KEY = VALUE, mfsmaster.cfg analog): DATA_PATH, LISTEN_HOST,
 LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), LOG_LEVEL,
-HEALTH_INTERVAL, IMAGE_INTERVAL.
+HEALTH_INTERVAL, IMAGE_INTERVAL, PERSONALITY (master|shadow),
+ACTIVE_MASTER (host:port, required for shadow), and optional election:
+ELECTION_ID, ELECTION_LISTEN (host:port), ELECTION_PEERS
+(id=host:port,id=host:port,...).
 """
 
 import asyncio
@@ -14,14 +17,19 @@ from lizardfs_tpu.runtime.config import Config
 from lizardfs_tpu.runtime.daemon import setup_logging
 
 
-def main() -> None:
-    cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
-    setup_logging("master", cfg.get_str("LOG_LEVEL", "INFO"))
+def _hostport(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host, int(port)
+
+
+async def _run(cfg: Config) -> None:
     goals = geometry.default_goals()
     goals_path = cfg.get_str("GOALS_CFG", "")
     if goals_path:
         with open(goals_path) as f:
             goals = geometry.load_goal_config(f.read())
+    personality = cfg.get_str("PERSONALITY", "master")
+    active = cfg.get_str("ACTIVE_MASTER", "")
     server = MasterServer(
         data_dir=cfg.get_str("DATA_PATH", "./master-data"),
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
@@ -29,8 +37,37 @@ def main() -> None:
         goals=goals,
         health_interval=cfg.get_float("HEALTH_INTERVAL", 1.0),
         image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0),
+        personality=personality,
+        active_addr=_hostport(active) if active else None,
     )
-    asyncio.run(server.run_forever())
+    controller = None
+    if cfg.get_str("ELECTION_ID", ""):
+        from lizardfs_tpu.ha.controller import FailoverController
+
+        peers = {}
+        for item in cfg.get_str("ELECTION_PEERS", "").split(","):
+            if item.strip():
+                pid, _, addr = item.strip().partition("=")
+                peers[pid] = _hostport(addr)
+        controller = FailoverController(
+            server,
+            cfg.get_str("ELECTION_ID"),
+            _hostport(cfg.get_str("ELECTION_LISTEN", "127.0.0.1:0")),
+            peers,
+        )
+    if controller is not None:
+        await controller.start()
+    try:
+        await server.run_forever()
+    finally:
+        if controller is not None:
+            await controller.stop()
+
+
+def main() -> None:
+    cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
+    setup_logging("master", cfg.get_str("LOG_LEVEL", "INFO"))
+    asyncio.run(_run(cfg))
 
 
 if __name__ == "__main__":

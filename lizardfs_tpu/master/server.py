@@ -88,6 +88,8 @@ class MasterServer(Daemon):
         goals: dict[int, geometry.Goal] | None = None,
         health_interval: float = 1.0,
         image_interval: float = 300.0,
+        personality: str = "master",
+        active_addr: tuple[str, int] | None = None,
     ):
         super().__init__(host, port)
         self.data_dir = data_dir
@@ -101,6 +103,12 @@ class MasterServer(Daemon):
         self.health_interval = health_interval
         self.image_interval = image_interval
         self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
+        # personality: "master" (active) or "shadow" (applies the
+        # changelog stream from active_addr; promotable at runtime)
+        # (src/master/personality.h:25-69 analog)
+        self.personality = personality
+        self.active_addr = active_addr
+        self._shadow_task: asyncio.Task | None = None
         self.log = logging.getLogger("master")
 
     # --- lifecycle -----------------------------------------------------------
@@ -123,6 +131,17 @@ class MasterServer(Daemon):
         self.add_timer(self.health_interval, self._health_tick)
         self.add_timer(self.image_interval, self._dump_image)
         self.add_timer(10.0, self._purge_trash)
+
+    async def start(self) -> None:
+        await super().start()
+        if self.personality == "shadow":
+            if self.active_addr is None:
+                raise ValueError("shadow personality needs active_addr")
+            self._shadow_task = self.spawn(self._shadow_follow())
+
+    @property
+    def is_active(self) -> bool:
+        return self.personality == "master"
 
     async def teardown(self) -> None:
         await self._dump_image()
@@ -155,6 +174,8 @@ class MasterServer(Daemon):
         self.changelog.open()
 
     async def _purge_trash(self) -> None:
+        if not self.is_active:
+            return
         now = int(time.time())
         expired = [i for i, (_, ts) in self.meta.fs.trash.items() if ts <= now]
         for inode in expired:
@@ -187,6 +208,16 @@ class MasterServer(Daemon):
     # --- client service (matoclserv analog) -----------------------------------------
 
     async def _client_loop(self, reader, writer, first: m.CltomaRegister) -> None:
+        if not self.is_active:
+            # clients cycle through master addresses until they find the
+            # active one (modern replacement for the floating-IP dance)
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, session_id=0
+                ),
+            )
+            return
         session_id = first.session_id or self.next_session
         if first.session_id == 0:
             self.next_session += 1
@@ -534,6 +565,14 @@ class MasterServer(Daemon):
     # --- chunkserver service (matocsserv analog) --------------------------------------
 
     async def _cs_loop(self, reader, writer, first: m.CstomaRegister) -> None:
+        if not self.is_active:
+            await framing.send_message(
+                writer,
+                m.MatocsRegisterReply(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0
+                ),
+            )
+            return
         link = _CsLink(self, reader, writer)
         srv = self.meta.registry.register_server(
             first.addr.host, first.addr.port, first.label,
@@ -607,6 +646,8 @@ class MasterServer(Daemon):
     # --- health loop (ChunkWorker analog) ----------------------------------------------
 
     async def _health_tick(self) -> None:
+        if not self.is_active:
+            return
         # released chunks: delete their on-disk parts
         drained = self.meta.registry.pending_deletes[:16]
         del self.meta.registry.pending_deletes[:16]
@@ -692,6 +733,12 @@ class MasterServer(Daemon):
 
     async def _shadow_loop(self, reader, writer, first: m.MltomaRegister) -> None:
         self.shadow_writers.append(writer)
+        await framing.send_message(
+            writer,
+            m.MatomlRegisterReply(
+                req_id=first.req_id, status=st.OK, version=self.changelog.version
+            ),
+        )
         try:
             # serve image download requests; changelog lines are pushed by
             # commit()
@@ -717,11 +764,93 @@ class MasterServer(Daemon):
             if writer in self.shadow_writers:
                 self.shadow_writers.remove(writer)
 
+    # --- shadow personality: follow the active master -------------------------------------
+
+    async def _shadow_follow(self) -> None:
+        """masterconn analog (src/master/masterconn.cc:401-483): stream
+        the changelog from the active master, applying through the same
+        MetadataStore.apply path; download the image when behind."""
+        while self.personality == "shadow":
+            try:
+                await self._shadow_follow_once()
+            except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                self.log.info("shadow link lost (%s); retrying", e)
+            except asyncio.CancelledError:
+                return
+            await asyncio.sleep(1.0)
+
+    async def _shadow_follow_once(self) -> None:
+        reader, writer = await asyncio.open_connection(*self.active_addr)
+        try:
+            await framing.send_message(
+                writer,
+                m.MltomaRegister(req_id=1, version_known=self.changelog.version),
+            )
+            hello = await framing.read_message(reader)
+            if not isinstance(hello, m.MatomlRegisterReply) or hello.status != st.OK:
+                raise ConnectionError("active master rejected shadow registration")
+            if hello.version > self.changelog.version:
+                await self._shadow_download_image(reader, writer)
+            while self.personality == "shadow":
+                msg = await framing.read_message(reader)
+                if isinstance(msg, m.MatomlChangelogLine):
+                    await self._shadow_apply(msg, reader, writer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+    async def _shadow_download_image(self, reader, writer) -> None:
+        await framing.send_message(writer, m.MltomaDownloadImage(req_id=2))
+        while True:
+            msg = await framing.read_message(reader)
+            if isinstance(msg, m.MatomlImage):
+                break
+            # changelog lines racing the download are superseded by it
+        if msg.status != st.OK:
+            raise ConnectionError("image download failed")
+        doc = json.loads(msg.image.decode())
+        self.meta.load_sections(doc)
+        self.changelog.close()
+        self.changelog.version = msg.version
+        self.changelog.open()
+        save_image(self.data_dir, msg.version, self.meta.to_sections())
+        self.log.info("shadow: downloaded metadata image at v%d", msg.version)
+
+    async def _shadow_apply(self, line: m.MatomlChangelogLine, reader, writer) -> None:
+        if line.version <= self.changelog.version:
+            return  # duplicate during catch-up
+        if line.version != self.changelog.version + 1:
+            self.log.warning(
+                "shadow: changelog gap (have v%d, got v%d) — re-downloading",
+                self.changelog.version, line.version,
+            )
+            await self._shadow_download_image(reader, writer)
+            return
+        op = json.loads(line.line)
+        self.meta.apply(op)
+        self.changelog.append(op)  # assigns the same version, persists
+
+    def promote(self) -> None:
+        """Shadow -> active master (promoteAutoToMaster analog,
+        personality.h:69). Chunkservers and clients find us by cycling
+        their configured master address lists."""
+        if self.personality == "master":
+            return
+        self.personality = "master"
+        if self._shadow_task is not None:
+            self._shadow_task.cancel()
+            self._shadow_task = None
+        self.log.info("promoted to active master at v%d", self.changelog.version)
+
     # --- admin ----------------------------------------------------------------------------
 
     async def _admin_message(self, writer, msg) -> None:
         if isinstance(msg, m.AdminInfo):
             info = {
+                "personality": self.personality,
                 "version": self.changelog.version,
                 "inodes": len(self.meta.fs.nodes),
                 "chunks": len(self.meta.registry.chunks),
@@ -764,6 +893,14 @@ class MasterServer(Daemon):
                     "healthy": healthy, "endangered": endangered, "lost": lost,
                 }),
             )
+        if msg.command == "promote-shadow":
+            if self.personality == "master":
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json='{"error": "already active"}',
+                )
+            self.promote()
+            return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")
         if msg.command == "metadata-checksum":
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,

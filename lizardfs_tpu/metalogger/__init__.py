@@ -1,0 +1,1 @@
+"""Metalogger: changelog archiver daemon (metadata disaster recovery)."""

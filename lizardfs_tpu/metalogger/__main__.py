@@ -1,0 +1,42 @@
+"""Run a metalogger: python -m lizardfs_tpu.metalogger [config]
+
+Config keys: DATA_PATH, MASTER_ADDRS (host:port,host:port,...),
+IMAGE_INTERVAL, LOG_LEVEL.
+"""
+
+import asyncio
+import signal
+import sys
+
+from lizardfs_tpu.metalogger.server import Metalogger
+from lizardfs_tpu.runtime.config import Config
+from lizardfs_tpu.runtime.daemon import setup_logging
+
+
+async def _run(cfg: Config) -> None:
+    addrs = []
+    for item in cfg.get_str("MASTER_ADDRS", "127.0.0.1:9420").split(","):
+        host, _, port = item.strip().rpartition(":")
+        addrs.append((host, int(port)))
+    ml = Metalogger(
+        cfg.get_str("DATA_PATH", "./metalogger-data"),
+        addrs,
+        image_interval=cfg.get_float("IMAGE_INTERVAL", 3600.0),
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await ml.start()
+    await stop.wait()
+    await ml.stop()
+
+
+def main() -> None:
+    cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
+    setup_logging("metalogger", cfg.get_str("LOG_LEVEL", "INFO"))
+    asyncio.run(_run(cfg))
+
+
+if __name__ == "__main__":
+    main()

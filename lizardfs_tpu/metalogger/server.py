@@ -1,0 +1,118 @@
+"""Metalogger daemon: archives the master's changelog + metadata images.
+
+The reference's metalogger is the master's changelog-subscriber module
+running standalone (reference: src/metalogger/init.h:35-42 — just the
+masterconn module). Same here: subscribe to the changelog stream, append
+lines to ``changelog_ml.0.log``, periodically snapshot a downloaded
+metadata image. Restoring a lost master = metarestore over these files
+(tools/metarestore analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+
+from lizardfs_tpu.master.changelog import Changelog, save_image
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+
+
+class Metalogger:
+    def __init__(
+        self,
+        data_dir: str,
+        master_addrs: list[tuple[str, int]],
+        image_interval: float = 3600.0,
+    ):
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.master_addrs = master_addrs
+        self.image_interval = image_interval
+        self.version = 0
+        self._log_file = None
+        self._task: asyncio.Task | None = None
+        self._stopping = asyncio.Event()
+        self.log = logging.getLogger("metalogger")
+        self._load_state()
+
+    def _load_state(self) -> None:
+        """Resume from the last archived line's version."""
+        path = os.path.join(self.data_dir, "changelog_ml.0.log")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    parsed = Changelog.parse_line(line)
+                    if parsed:
+                        self.version = max(self.version, parsed[0])
+
+    def _append(self, version: int, line: str) -> None:
+        if self._log_file is None:
+            self._log_file = open(
+                os.path.join(self.data_dir, "changelog_ml.0.log"),
+                "a",
+                encoding="utf-8",
+            )
+        self._log_file.write(f"{version}: {line}\n")
+        self._log_file.flush()
+        self.version = version
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+
+    async def _run(self) -> None:
+        while not self._stopping.is_set():
+            for addr in self.master_addrs:
+                try:
+                    await self._follow(addr)
+                except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                    continue
+                except asyncio.CancelledError:
+                    return
+            await asyncio.sleep(1.0)
+
+    async def _follow(self, addr: tuple[str, int]) -> None:
+        reader, writer = await asyncio.open_connection(*addr)
+        try:
+            await framing.send_message(
+                writer, m.MltomaRegister(req_id=1, version_known=self.version)
+            )
+            hello = await framing.read_message(reader)
+            if not isinstance(hello, m.MatomlRegisterReply) or hello.status != st.OK:
+                raise ConnectionError("not the active master")
+            self.log.info("following master at %s:%d (v%d)", *addr, hello.version)
+            last_image = 0.0
+            loop = asyncio.get_running_loop()
+            while True:
+                if loop.time() - last_image > self.image_interval:
+                    await framing.send_message(
+                        writer, m.MltomaDownloadImage(req_id=2)
+                    )
+                    last_image = loop.time()
+                msg = await framing.read_message(reader)
+                if isinstance(msg, m.MatomlChangelogLine):
+                    if msg.version > self.version:
+                        self._append(msg.version, msg.line)
+                elif isinstance(msg, m.MatomlImage) and msg.status == st.OK:
+                    doc = json.loads(msg.image.decode())
+                    doc.pop("format", None)  # save_image stamps its own
+                    save_image(self.data_dir, msg.version, doc)
+                    self.log.info("archived metadata image v%d", msg.version)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, asyncio.CancelledError):
+                pass

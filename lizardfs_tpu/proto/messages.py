@@ -480,6 +480,11 @@ class MltomaRegister(Message):
     FIELDS = (("req_id", "u32"), ("version_known", "u64"))
 
 
+class MatomlRegisterReply(Message):
+    MSG_TYPE = 1304
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("version", "u64"))
+
+
 class MatomlChangelogLine(Message):
     """Streamed changelog entry (matoml broadcast_logstring analog)."""
 

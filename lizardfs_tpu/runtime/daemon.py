@@ -35,6 +35,7 @@ class Daemon:
         self._server: asyncio.Server | None = None
         self._timers: list[tuple[float, object]] = []
         self._tasks: set[asyncio.Task] = set()
+        self._conn_writers: set[asyncio.StreamWriter] = set()
         self._stopping = asyncio.Event()
 
     # --- lifecycle ---------------------------------------------------------
@@ -80,6 +81,7 @@ class Daemon:
 
     async def _guarded_connection(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")
+        self._conn_writers.add(writer)
         try:
             await self.handle_connection(reader, writer)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -89,6 +91,7 @@ class Daemon:
         except Exception:
             self.log.exception("connection from %s crashed", peer)
         finally:
+            self._conn_writers.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -109,7 +112,14 @@ class Daemon:
         self._stopping.set()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # drop live connections: python 3.12's wait_closed() blocks
+            # until every handler's transport is gone
+            for w in list(self._conn_writers):
+                w.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                self.log.warning("server close timed out with handlers alive")
         for task in list(self._tasks):
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

@@ -1,0 +1,240 @@
+"""HA tests: shadow replication, promotion failover, metalogger, election."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.ha.election import ElectionNode, LEADER
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.metalogger.server import Metalogger
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import make_goals
+
+
+async def admin(port, command):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    await framing.send_message(
+        w, m.AdminCommand(req_id=1, command=command, json="{}")
+    )
+    reply = await framing.read_message(r)
+    w.close()
+    return reply
+
+
+@pytest.mark.asyncio
+async def test_shadow_follows_and_promotes(tmp_path):
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    try:
+        c = Client("127.0.0.1", active.port)
+        await c.connect()
+        d = await c.mkdir(1, "dir")
+        f = await c.create(d.inode, "f")
+        await c.close()
+
+        # shadow catches up and checksums match
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            if shadow.changelog.version == active.changelog.version:
+                break
+        assert shadow.changelog.version == active.changelog.version
+        assert shadow.meta.checksum() == active.meta.checksum()
+
+        # shadow rejects clients and mutations pre-promotion
+        c2 = Client("127.0.0.1", shadow.port)
+        with pytest.raises(ConnectionError):
+            await c2.connect()
+
+        # promote via admin; now a client can use it
+        reply = await admin(shadow.port, "promote-shadow")
+        assert reply.status == 0
+        c3 = Client("127.0.0.1", shadow.port)
+        await c3.connect()
+        assert (await c3.lookup(1, "dir")).inode == d.inode
+        await c3.close()
+    finally:
+        await shadow.stop()
+        await active.stop()
+
+
+@pytest.mark.asyncio
+async def test_shadow_catches_up_from_image(tmp_path):
+    """Shadow started late (behind) must download the image first."""
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    try:
+        c = Client("127.0.0.1", active.port)
+        await c.connect()
+        for i in range(5):
+            await c.mkdir(1, f"d{i}")
+        await c.close()
+        shadow = MasterServer(
+            str(tmp_path / "m2"), goals=make_goals(),
+            personality="shadow", active_addr=("127.0.0.1", active.port),
+        )
+        await shadow.start()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if shadow.changelog.version == active.changelog.version:
+                break
+        assert shadow.meta.checksum() == active.meta.checksum()
+        await shadow.stop()
+    finally:
+        await active.stop()
+
+
+@pytest.mark.asyncio
+async def test_full_failover_with_chunkservers(tmp_path):
+    """Kill the active master; promote the shadow; chunkservers and the
+    client fail over via their address lists; data remains readable."""
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    addrs = [("127.0.0.1", active.port), ("127.0.0.1", shadow.port)]
+    servers = [
+        ChunkServer(
+            str(tmp_path / f"cs{i}"), master_addr=addrs,
+            heartbeat_interval=0.2, wave_timeout=0.2,
+        )
+        for i in range(5)
+    ]
+    for cs in servers:
+        await cs.start()
+    c = Client("", 0, master_addrs=addrs, wave_timeout=0.2)
+    await c.connect()
+    try:
+        f = await c.create(1, "ha.bin")
+        await c.setgoal(f.inode, 10)  # ec(3,2)
+        payload = data_generator.generate(3, 4 * 65536 + 99).tobytes()
+        await c.write_file(f.inode, payload)
+        await asyncio.sleep(0.2)  # let the shadow apply the tail
+
+        await active.stop()  # the active master dies
+        shadow.promote()
+        # chunkservers re-register with the new active on heartbeat
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            if len(shadow.cs_links) == 5:
+                break
+        assert len(shadow.cs_links) == 5
+
+        back = await c.read_file(f.inode)  # client reconnects transparently
+        assert back == payload
+    finally:
+        await c.close()
+        for cs in servers:
+            await cs.stop()
+        await shadow.stop()
+
+
+@pytest.mark.asyncio
+async def test_metalogger_archives(tmp_path):
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    ml = Metalogger(
+        str(tmp_path / "ml"), [("127.0.0.1", active.port)], image_interval=0.2
+    )
+    await ml.start()
+    try:
+        c = Client("127.0.0.1", active.port)
+        await c.connect()
+        for i in range(3):
+            await c.mkdir(1, f"d{i}")
+        await c.close()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if ml.version >= active.changelog.version and os.path.exists(
+                os.path.join(str(tmp_path / "ml"), "metadata.liz")
+            ):
+                break
+        assert ml.version == active.changelog.version
+        # archived lines replay into the same state
+        from lizardfs_tpu.master.changelog import Changelog, load_image
+        from lizardfs_tpu.master.metadata import MetadataStore
+
+        version, doc = load_image(str(tmp_path / "ml"))
+        rebuilt = MetadataStore()
+        rebuilt.load_sections(doc)
+        with open(os.path.join(str(tmp_path / "ml"), "changelog_ml.0.log")) as fh:
+            for line in fh:
+                v, op = Changelog.parse_line(line)
+                if v > version:
+                    rebuilt.apply(op)
+        assert rebuilt.checksum() == active.meta.checksum()
+    finally:
+        await ml.stop()
+        await active.stop()
+
+
+@pytest.mark.asyncio
+async def test_election_three_nodes(tmp_path):
+    """3-node election: one leader; kill it; a new leader emerges."""
+    import socket
+
+    def free_port():
+        s = socket.socket(socket.SOCK_DGRAM and socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ports = {f"n{i}": free_port() for i in range(3)}
+    leaders: dict[str, bool] = {}
+    nodes = {}
+
+    def make(nid):
+        async def on_leader():
+            leaders[nid] = True
+
+        async def on_follower(l):
+            leaders[nid] = False
+
+        peers = {
+            pid: ("127.0.0.1", p) for pid, p in ports.items() if pid != nid
+        }
+        return ElectionNode(
+            nid, ("127.0.0.1", ports[nid]), peers,
+            get_version=lambda: 1, on_leader=on_leader, on_follower=on_follower,
+        )
+
+    for nid in ports:
+        nodes[nid] = make(nid)
+        await nodes[nid].start()
+    try:
+        leader = None
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            current = [nid for nid, n in nodes.items() if n.state == LEADER]
+            if len(current) == 1:
+                leader = current[0]
+                break
+        assert leader is not None, "no leader elected"
+
+        await nodes[leader].stop()
+        remaining = {nid: n for nid, n in nodes.items() if nid != leader}
+        new_leader = None
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            current = [nid for nid, n in remaining.items() if n.state == LEADER]
+            if len(current) == 1:
+                new_leader = current[0]
+                break
+        assert new_leader is not None and new_leader != leader
+    finally:
+        for n in nodes.values():
+            await n.stop()
