@@ -154,6 +154,41 @@ class Client:
         r = await self._call(m.CltomaTruncate, inode=inode, length=length)
         return r.attr
 
+    async def setattr(
+        self, inode: int, set_mask: int, mode: int = 0, uid: int = 0,
+        gid: int = 0, atime: int = 0, mtime: int = 0, trash_time: int = 0,
+    ) -> m.Attr:
+        r = await self._call(
+            m.CltomaSetattr, inode=inode, set_mask=set_mask, mode=mode,
+            uid=uid, gid=gid, atime=atime, mtime=mtime, trash_time=trash_time,
+        )
+        return r.attr
+
+    async def settrashtime(self, inode: int, seconds: int) -> m.Attr:
+        return await self.setattr(inode, 32, trash_time=seconds)
+
+    async def resolve(self, path: str) -> m.Attr:
+        """Walk an absolute path from the root inode."""
+        attr = await self.getattr(1)
+        for comp in path.strip("/").split("/"):
+            if comp:
+                attr = await self.lookup(attr.inode, comp)
+        return attr
+
+    async def resolve_parent(self, path: str) -> tuple[m.Attr, str]:
+        """-> (parent dir attr, leaf name) for an absolute path."""
+        path = path.rstrip("/")
+        parent_path, _, name = path.rpartition("/")
+        if not name:
+            raise st.StatusError(st.EINVAL, "path has no leaf")
+        return await self.resolve(parent_path or "/"), name
+
+    async def chunk_info(self, inode: int, chunk_index: int) -> m.MatoclReadChunk:
+        """Chunk id/version/locations at a file position (fileinfo)."""
+        return await self._call(
+            m.CltomaReadChunk, inode=inode, chunk_index=chunk_index
+        )
+
     # --- write path -------------------------------------------------------------------
 
     async def write_file(self, inode: int, data: bytes | np.ndarray) -> None:

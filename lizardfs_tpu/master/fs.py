@@ -265,7 +265,7 @@ class FsTree:
 
     def apply_setattr(
         self, inode: int, set_mask: int, mode: int, uid: int, gid: int,
-        atime: int, mtime: int, ts: int,
+        atime: int, mtime: int, ts: int, trash_time: int = 0,
     ) -> Node:
         n = self.node(inode)
         if set_mask & 1:
@@ -278,6 +278,8 @@ class FsTree:
             n.atime = atime
         if set_mask & 16:
             n.mtime = mtime
+        if set_mask & 32:
+            n.trash_time = trash_time
         n.ctime = ts
         return n
 

@@ -55,7 +55,7 @@ class MetadataStore:
     def _op_setattr(self, op):
         self.fs.apply_setattr(
             op["inode"], op["set_mask"], op["mode"], op["uid"], op["gid"],
-            op["atime"], op["mtime"], op["ts"],
+            op["atime"], op["mtime"], op["ts"], op.get("trash_time", 0),
         )
 
     def _op_setgoal(self, op):

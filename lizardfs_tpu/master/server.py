@@ -349,6 +349,7 @@ class MasterServer(Daemon):
                 "op": "setattr", "inode": msg.inode, "set_mask": msg.set_mask,
                 "mode": msg.mode, "uid": msg.uid, "gid": msg.gid,
                 "atime": msg.atime, "mtime": msg.mtime, "ts": now,
+                "trash_time": msg.trash_time,
             })
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaTruncate):

@@ -232,12 +232,13 @@ class CltomaSetattr(Message):
     FIELDS = (
         ("req_id", "u32"),
         ("inode", "u32"),
-        ("set_mask", "u8"),  # 1=mode, 2=uid, 4=gid, 8=atime, 16=mtime
+        ("set_mask", "u8"),  # 1=mode 2=uid 4=gid 8=atime 16=mtime 32=trash_time
         ("mode", "u16"),
         ("uid", "u32"),
         ("gid", "u32"),
         ("atime", "u32"),
         ("mtime", "u32"),
+        ("trash_time", "u32"),
     )
 
 

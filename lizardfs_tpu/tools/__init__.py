@@ -1,0 +1,1 @@
+"""User-facing CLIs: the `lizardfs` file tool and `lizardfs-admin`."""

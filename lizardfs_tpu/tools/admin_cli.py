@@ -1,0 +1,81 @@
+"""`lizardfs-admin` — cluster administration CLI (reference: src/admin/).
+
+    python -m lizardfs_tpu.tools.admin_cli <host:port> <command>
+
+Commands: info, list-chunkservers, list-sessions, chunks-health,
+save-metadata, metadata-checksum, promote-shadow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+
+
+async def _admin(addr: tuple[str, int], command: str, payload: str = "{}"):
+    reader, writer = await asyncio.open_connection(*addr)
+    try:
+        if command == "info":
+            await framing.send_message(writer, m.AdminInfo(req_id=1))
+        else:
+            await framing.send_message(
+                writer, m.AdminCommand(req_id=1, command=command, json=payload)
+            )
+        return await framing.read_message(reader)
+    finally:
+        writer.close()
+
+
+async def _amain(argv) -> int:
+    p = argparse.ArgumentParser(prog="lizardfs-admin", description=__doc__)
+    p.add_argument("master", help="master host:port")
+    p.add_argument(
+        "command",
+        choices=[
+            "info", "list-chunkservers", "list-sessions", "chunks-health",
+            "save-metadata", "metadata-checksum", "promote-shadow",
+        ],
+    )
+    args = p.parse_args(argv)
+    host, _, port = args.master.rpartition(":")
+    addr = (host or "127.0.0.1", int(port))
+
+    cmd = args.command
+    if cmd in ("list-chunkservers", "list-sessions"):
+        reply = await _admin(addr, "info")
+    else:
+        reply = await _admin(addr, cmd)
+    if getattr(reply, "status", 1) != st.OK:
+        print(f"error: {st.name(reply.status)} {getattr(reply, 'json', '')}",
+              file=sys.stderr)
+        return 1
+    doc = json.loads(reply.json) if reply.json else {}
+    if cmd == "list-chunkservers":
+        for srv in doc.get("chunkservers", []):
+            state = "up" if srv["connected"] else "DOWN"
+            used = srv["used_space"] / 2**30
+            total = srv["total_space"] / 2**30
+            print(
+                f"cs{srv['cs_id']:<3d} {srv['host']}:{srv['port']:<6d} "
+                f"label={srv['label']:<8s} {state:<4s} "
+                f"{used:.1f}/{total:.1f} GiB"
+            )
+    elif cmd == "list-sessions":
+        print(f"sessions: {doc.get('sessions', 0)}")
+    else:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""`lizardfs` — busybox-style file tool (reference: src/tools/, the
+setgoal/getgoal/fileinfo/dirinfo/... multi-tool).
+
+Works daemonless against the master/chunkservers through the client
+library (no FUSE mount needed):
+
+    python -m lizardfs_tpu.tools.cli --master host:port <command> [...]
+
+Commands: ls, mkdir, rmdir, rm, mv, ln, symlink, readlink, put, get,
+cat, stat, setgoal, getgoal, settrashtime, gettrashtime, fileinfo,
+dirinfo, checkfile, rremove, truncate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import stat as stat_mod
+import sys
+
+from lizardfs_tpu.constants import MFSCHUNKSIZE
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.proto import messages as msgs
+from lizardfs_tpu.proto import status as st
+
+FTYPE_CHAR = {1: "-", 2: "d", 3: "l"}
+
+
+def _addrs(s: str) -> list[tuple[str, int]]:
+    out = []
+    for item in s.split(","):
+        host, _, port = item.strip().rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+async def _connect(args) -> Client:
+    addrs = _addrs(args.master)
+    c = Client("", 0, master_addrs=addrs)
+    await c.connect(info="lizardfs-cli")
+    return c
+
+
+def _fmt_attr(name: str, a) -> str:
+    kind = FTYPE_CHAR.get(a.ftype, "?")
+    mode = stat_mod.filemode(
+        (stat_mod.S_IFDIR if a.ftype == 2 else
+         stat_mod.S_IFLNK if a.ftype == 3 else stat_mod.S_IFREG) | a.mode
+    )[1:]
+    return (
+        f"{kind}{mode} {a.nlink:3d} {a.uid:5d} {a.gid:5d} "
+        f"{a.length:12d} goal:{a.goal:<3d} {name}"
+    )
+
+
+async def cmd_ls(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    if a.ftype != msgs.FTYPE_DIR:
+        print(_fmt_attr(args.path, a))
+        return 0
+    for e in await c.readdir(a.inode):
+        ea = await c.getattr(e.inode)
+        print(_fmt_attr(e.name, ea))
+    return 0
+
+
+async def cmd_mkdir(c: Client, args) -> int:
+    parent, name = await c.resolve_parent(args.path)
+    await c.mkdir(parent.inode, name)
+    return 0
+
+
+async def cmd_rmdir(c: Client, args) -> int:
+    parent, name = await c.resolve_parent(args.path)
+    await c.rmdir(parent.inode, name)
+    return 0
+
+
+async def cmd_rm(c: Client, args) -> int:
+    parent, name = await c.resolve_parent(args.path)
+    await c.unlink(parent.inode, name)
+    return 0
+
+
+async def cmd_mv(c: Client, args) -> int:
+    psrc, nsrc = await c.resolve_parent(args.src)
+    pdst, ndst = await c.resolve_parent(args.dst)
+    await c.rename(psrc.inode, nsrc, pdst.inode, ndst)
+    return 0
+
+
+async def cmd_ln(c: Client, args) -> int:
+    target = await c.resolve(args.target)
+    parent, name = await c.resolve_parent(args.link)
+    await c.link(target.inode, parent.inode, name)
+    return 0
+
+
+async def cmd_symlink(c: Client, args) -> int:
+    parent, name = await c.resolve_parent(args.link)
+    await c.symlink(parent.inode, name, args.target)
+    return 0
+
+
+async def cmd_readlink(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    print(await c.readlink(a.inode))
+    return 0
+
+
+async def cmd_put(c: Client, args) -> int:
+    with open(args.local, "rb") as f:
+        data = f.read()
+    try:
+        a = await c.resolve(args.remote)
+    except st.StatusError:
+        parent, name = await c.resolve_parent(args.remote)
+        a = await c.create(parent.inode, name)
+    if args.goal:
+        await c.setgoal(a.inode, args.goal)
+    await c.write_file(a.inode, data)
+    print(f"wrote {len(data)} bytes to {args.remote}")
+    return 0
+
+
+async def cmd_get(c: Client, args) -> int:
+    a = await c.resolve(args.remote)
+    data = await c.read_file(a.inode)
+    with open(args.local, "wb") as f:
+        f.write(data)
+    print(f"read {len(data)} bytes from {args.remote}")
+    return 0
+
+
+async def cmd_cat(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    sys.stdout.buffer.write(await c.read_file(a.inode))
+    return 0
+
+
+async def cmd_stat(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    print(json.dumps({
+        "inode": a.inode, "type": a.ftype, "mode": oct(a.mode),
+        "uid": a.uid, "gid": a.gid, "nlink": a.nlink, "length": a.length,
+        "goal": a.goal, "trash_time": a.trash_time,
+        "atime": a.atime, "mtime": a.mtime, "ctime": a.ctime,
+    }, indent=2))
+    return 0
+
+
+async def cmd_setgoal(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    await c.setgoal(a.inode, args.goal)
+    return 0
+
+
+async def cmd_getgoal(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    print(f"{args.path}: goal {a.goal}")
+    return 0
+
+
+async def cmd_settrashtime(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    await c.settrashtime(a.inode, args.seconds)
+    return 0
+
+
+async def cmd_gettrashtime(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    print(f"{args.path}: trash time {a.trash_time}s")
+    return 0
+
+
+async def cmd_truncate(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    await c.truncate(a.inode, args.size)
+    return 0
+
+
+async def cmd_fileinfo(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    nchunks = (a.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE
+    print(f"{args.path}: {a.length} bytes, {nchunks} chunk(s)")
+    for i in range(nchunks):
+        info = await c.chunk_info(a.inode, i)
+        if info.chunk_id == 0:
+            print(f"  chunk {i}: hole")
+            continue
+        print(f"  chunk {i}: id {info.chunk_id:016X} version {info.version}")
+        for loc in info.locations:
+            cpt = geometry.ChunkPartType.from_id(loc.part_id)
+            print(
+                f"    part {cpt.to_string():>12s} on "
+                f"{loc.addr.host}:{loc.addr.port}"
+            )
+    return 0
+
+
+async def cmd_checkfile(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    nchunks = (a.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE
+    problems = 0
+    for i in range(nchunks):
+        info = await c.chunk_info(a.inode, i)
+        if info.chunk_id == 0:
+            continue
+        parts = {geometry.ChunkPartType.from_id(l.part_id).part for l in info.locations}
+        if not info.locations:
+            print(f"  chunk {i}: NO COPIES (lost)")
+            problems += 1
+            continue
+        t = geometry.ChunkPartType.from_id(info.locations[0].part_id).type
+        missing = t.expected_parts - len(parts)
+        if t.is_standard:
+            print(f"  chunk {i}: {len(info.locations)} cop(ies)")
+        elif missing > 0:
+            k = t.data_parts
+            state = "ENDANGERED" if len(parts) >= k else "UNREADABLE"
+            print(f"  chunk {i}: {len(parts)}/{t.expected_parts} parts — {state}")
+            problems += 1
+    print(f"{args.path}: {'OK' if problems == 0 else f'{problems} problem chunk(s)'}")
+    return 0 if problems == 0 else 1
+
+
+async def _walk_size(c: Client, inode: int) -> tuple[int, int, int]:
+    """(files, dirs, bytes) under a directory (dirinfo analog)."""
+    files = dirs = total = 0
+    for e in await c.readdir(inode):
+        if e.ftype == msgs.FTYPE_DIR:
+            dirs += 1
+            f2, d2, t2 = await _walk_size(c, e.inode)
+            files, dirs, total = files + f2, dirs + d2, total + t2
+        else:
+            files += 1
+            total += (await c.getattr(e.inode)).length
+    return files, dirs, total
+
+
+async def cmd_dirinfo(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    files, dirs, total = await _walk_size(c, a.inode)
+    print(f"{args.path}: {files} files, {dirs} dirs, {total} bytes")
+    return 0
+
+
+async def _rremove(c: Client, parent_inode: int, name: str, inode: int, ftype: int) -> None:
+    if ftype == msgs.FTYPE_DIR:
+        for e in await c.readdir(inode):
+            await _rremove(c, inode, e.name, e.inode, e.ftype)
+        await c.rmdir(parent_inode, name)
+    else:
+        await c.unlink(parent_inode, name)
+
+
+async def cmd_rremove(c: Client, args) -> int:
+    parent, name = await c.resolve_parent(args.path)
+    a = await c.lookup(parent.inode, name)
+    await _rremove(c, parent.inode, name, a.inode, a.ftype)
+    return 0
+
+
+COMMANDS = {
+    "ls": (cmd_ls, [("path", {})]),
+    "mkdir": (cmd_mkdir, [("path", {})]),
+    "rmdir": (cmd_rmdir, [("path", {})]),
+    "rm": (cmd_rm, [("path", {})]),
+    "mv": (cmd_mv, [("src", {}), ("dst", {})]),
+    "ln": (cmd_ln, [("target", {}), ("link", {})]),
+    "symlink": (cmd_symlink, [("target", {}), ("link", {})]),
+    "readlink": (cmd_readlink, [("path", {})]),
+    "put": (cmd_put, [("local", {}), ("remote", {}),
+                      ("--goal", {"type": int, "default": 0})]),
+    "get": (cmd_get, [("remote", {}), ("local", {})]),
+    "cat": (cmd_cat, [("path", {})]),
+    "stat": (cmd_stat, [("path", {})]),
+    "setgoal": (cmd_setgoal, [("goal", {"type": int}), ("path", {})]),
+    "getgoal": (cmd_getgoal, [("path", {})]),
+    "settrashtime": (cmd_settrashtime, [("seconds", {"type": int}), ("path", {})]),
+    "gettrashtime": (cmd_gettrashtime, [("path", {})]),
+    "truncate": (cmd_truncate, [("size", {"type": int}), ("path", {})]),
+    "fileinfo": (cmd_fileinfo, [("path", {})]),
+    "checkfile": (cmd_checkfile, [("path", {})]),
+    "dirinfo": (cmd_dirinfo, [("path", {})]),
+    "rremove": (cmd_rremove, [("path", {})]),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="lizardfs", description=__doc__)
+    p.add_argument(
+        "--master", default="127.0.0.1:9420",
+        help="master address(es), host:port[,host:port...]",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    for name, (_, params) in COMMANDS.items():
+        sp = sub.add_parser(name)
+        for pname, kw in params:
+            sp.add_argument(pname, **kw)
+    return p
+
+
+async def _amain(argv) -> int:
+    args = build_parser().parse_args(argv)
+    fn = COMMANDS[args.command][0]
+    c = await _connect(args)
+    try:
+        return await fn(c, args)
+    except st.StatusError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await c.close()
+
+
+def main(argv=None) -> int:
+    return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""CLI tools driven against an in-process cluster."""
+
+import asyncio
+import json
+
+import pytest
+
+from lizardfs_tpu.tools import admin_cli, cli
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster
+
+
+@pytest.mark.asyncio
+async def test_cli_end_to_end(tmp_path, capsys):
+    cluster = Cluster(tmp_path, n_cs=5)
+    await cluster.start()
+    master = f"127.0.0.1:{cluster.master.port}"
+
+    async def run(*argv):
+        return await cli._amain(["--master", master, *argv])
+
+    try:
+        assert await run("mkdir", "/docs") == 0
+        local = tmp_path / "payload.bin"
+        payload = data_generator.generate(0, 200_000).tobytes()
+        local.write_bytes(payload)
+
+        assert await run("put", str(local), "/docs/a.bin", "--goal", "10") == 0
+        out = tmp_path / "out.bin"
+        assert await run("get", "/docs/a.bin", str(out)) == 0
+        assert out.read_bytes() == payload
+
+        capsys.readouterr()
+        assert await run("ls", "/docs") == 0
+        assert "a.bin" in capsys.readouterr().out
+
+        assert await run("getgoal", "/docs/a.bin") == 0
+        assert "goal 10" in capsys.readouterr().out
+
+        assert await run("fileinfo", "/docs/a.bin") == 0
+        info = capsys.readouterr().out
+        assert "chunk 0" in info and "ec(3,2)" in info
+
+        assert await run("checkfile", "/docs/a.bin") == 0
+        assert "OK" in capsys.readouterr().out
+
+        assert await run("settrashtime", "3600", "/docs/a.bin") == 0
+        await run("gettrashtime", "/docs/a.bin")
+        assert "3600" in capsys.readouterr().out
+
+        assert await run("dirinfo", "/") == 0
+        assert "1 files" in capsys.readouterr().out
+
+        assert await run("mv", "/docs/a.bin", "/b.bin") == 0
+        assert await run("stat", "/b.bin") == 0
+        st_doc = json.loads(capsys.readouterr().out)
+        assert st_doc["length"] == 200_000
+
+        # degraded checkfile: kill a chunkserver holding a part
+        victim = cluster.chunkservers[0]
+        await victim.stop()
+        await asyncio.sleep(0.1)
+        # may or may not hold a part; just verify the command runs
+        await run("checkfile", "/b.bin")
+        capsys.readouterr()
+
+        assert await run("rremove", "/docs") == 0
+        assert await run("ls", "/") == 0
+        assert "docs" not in capsys.readouterr().out
+
+        # error surface: missing path
+        assert await run("stat", "/nope") == 1
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_admin_cli(tmp_path, capsys):
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    master = f"127.0.0.1:{cluster.master.port}"
+    try:
+        assert await admin_cli._amain([master, "info"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["personality"] == "master"
+        assert len(doc["chunkservers"]) == 2
+
+        assert await admin_cli._amain([master, "list-chunkservers"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("up") == 2
+
+        assert await admin_cli._amain([master, "chunks-health"]) == 0
+        json.loads(capsys.readouterr().out)
+
+        assert await admin_cli._amain([master, "save-metadata"]) == 0
+        capsys.readouterr()
+        assert await admin_cli._amain([master, "metadata-checksum"]) == 0
+        assert "checksum" in capsys.readouterr().out
+
+        # promote on an active master is an error
+        assert await admin_cli._amain([master, "promote-shadow"]) == 1
+    finally:
+        await cluster.stop()
