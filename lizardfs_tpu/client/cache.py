@@ -1,0 +1,83 @@
+"""Client-side read cache + readahead adviser.
+
+Analog of the reference's per-inode read machinery (reference:
+src/mount/readdata_cache.h block-aligned ReadCache,
+src/mount/readahead_adviser.h window sizing): a block-granular LRU
+shared across inodes with byte budget, and a per-inode sequentiality
+detector that grows the readahead window on streaming reads and resets
+it on seeks.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+
+
+class BlockCache:
+    """LRU of 64 KiB chunk blocks keyed (inode, chunk_index, block)."""
+
+    def __init__(self, max_bytes: int = 64 * 2**20):
+        self.max_bytes = max_bytes
+        self._used = 0
+        self._entries: OrderedDict[tuple[int, int, int], bytes] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, inode: int, ci: int, block: int) -> bytes | None:
+        key = (inode, ci, block)
+        data = self._entries.get(key)
+        if data is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return data
+
+    def put(self, inode: int, ci: int, block: int, data: bytes) -> None:
+        key = (inode, ci, block)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._used -= len(old)
+        self._entries[key] = data
+        self._used += len(data)
+        while self._used > self.max_bytes and self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self._used -= len(evicted)
+
+    def invalidate(self, inode: int, ci: int | None = None) -> None:
+        """Drop an inode's blocks (optionally just one chunk's)."""
+        keys = [
+            k for k in self._entries
+            if k[0] == inode and (ci is None or k[1] == ci)
+        ]
+        for k in keys:
+            self._used -= len(self._entries.pop(k))
+
+
+class ReadaheadAdviser:
+    """Grows a readahead window while access stays sequential."""
+
+    def __init__(
+        self,
+        min_window: int = 0,
+        max_window: int = 16 * MFSBLOCKSIZE,
+    ):
+        self.min_window = min_window
+        self.max_window = max_window
+        self._expected_next = -1
+        self._window = min_window
+
+    def advise(self, offset: int, size: int) -> int:
+        """Returns extra bytes to read past the request."""
+        if offset == self._expected_next:
+            self._window = min(
+                max(self._window * 2, 2 * MFSBLOCKSIZE), self.max_window
+            )
+        else:
+            self._window = self.min_window
+        self._expected_next = offset + size
+        return self._window

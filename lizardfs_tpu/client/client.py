@@ -32,6 +32,7 @@ from lizardfs_tpu.core.read_executor import ReadError, execute_plan
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
 from lizardfs_tpu.runtime.rpc import RpcConnection
 from lizardfs_tpu.utils import striping
 
@@ -57,6 +58,8 @@ class Client:
         self.wave_timeout = wave_timeout
         self.retries = retries
         self._info = "pyclient"
+        self.cache = BlockCache()
+        self._readahead: dict[int, ReadaheadAdviser] = {}
 
     # --- session -----------------------------------------------------------------
 
@@ -152,6 +155,7 @@ class Client:
 
     async def truncate(self, inode: int, length: int) -> m.Attr:
         r = await self._call(m.CltomaTruncate, inode=inode, length=length)
+        self.cache.invalidate(inode)
         return r.attr
 
     async def setattr(
@@ -210,12 +214,134 @@ class Client:
         if old_length > total:
             await self.truncate(inode, total)
 
+    async def pwrite(self, inode: int, offset: int, data: bytes | np.ndarray) -> None:
+        """Positional write at an arbitrary offset (POSIX pwrite).
+
+        Partial stripes are handled with read-modify-write: the affected
+        stripes' current data is read back (with recovery if parts are
+        down), patched, parity recomputed client-side, and all affected
+        blocks rewritten — the chunk_writer.cc:471-533 pattern.
+        """
+        data = np.frombuffer(bytes(data), dtype=np.uint8)
+        if len(data) == 0:
+            return
+        old_length = (await self.getattr(inode)).length
+        end = offset + len(data)
+        pos = offset
+        while pos < end:
+            ci = pos // MFSCHUNKSIZE
+            coff = pos % MFSCHUNKSIZE
+            take = min(MFSCHUNKSIZE - coff, end - pos)
+            await self._pwrite_chunk(
+                inode, ci, coff, data[pos - offset : pos - offset + take],
+                old_length, max(old_length, end),
+            )
+            pos += take
+
+    async def _pwrite_chunk(
+        self, inode: int, ci: int, coff: int, piece: np.ndarray,
+        old_length: int, new_length: int,
+    ) -> None:
+        grant = await self._call(m.CltomaWriteChunk, inode=inode, chunk_index=ci)
+        self.cache.invalidate(inode, ci)
+        status_code = st.EIO
+        try:
+            copies: dict[int, list[m.PartLocation]] = {}
+            slice_type = None
+            for loc in grant.locations:
+                cpt = geometry.ChunkPartType.from_id(loc.part_id)
+                slice_type = cpt.type if slice_type is None else slice_type
+                copies.setdefault(cpt.part, []).append(loc)
+            if slice_type is None:
+                raise st.StatusError(st.NO_CHUNK_SERVERS, "no locations granted")
+            if slice_type.is_standard:
+                # plain copies: patch the byte range in every replica chain
+                await self._write_part(
+                    grant.chunk_id, grant.version, copies[0], piece,
+                    len(piece), part_offset=coff,
+                )
+            else:
+                await self._rmw_striped(grant, slice_type, copies, ci, coff,
+                                        piece, old_length)
+            status_code = st.OK
+        finally:
+            await self._call(
+                m.CltomaWriteChunkEnd,
+                chunk_id=grant.chunk_id, inode=inode, chunk_index=ci,
+                file_length=new_length, status=status_code,
+            )
+
+    async def _rmw_striped(
+        self, grant, slice_type, copies, ci: int, coff: int,
+        piece: np.ndarray, old_length: int,
+    ) -> None:
+        d = slice_type.data_parts
+        first_data = 1 if slice_type.is_xor else 0
+        stripe_bytes = d * MFSBLOCKSIZE
+        lo_s = coff // stripe_bytes
+        hi_s = (coff + len(piece) - 1) // stripe_bytes
+        nstripes = hi_s - lo_s + 1
+        region_start = lo_s * stripe_bytes
+        region = np.zeros(nstripes * stripe_bytes, dtype=np.uint8)
+
+        chunk_len_old = min(max(old_length - ci * MFSCHUNKSIZE, 0), MFSCHUNKSIZE)
+        overlap_end = min(chunk_len_old, region_start + len(region))
+        fully_covered = (
+            coff == region_start and coff + len(piece) >= overlap_end
+        )
+        if overlap_end > region_start and not fully_covered:
+            # read back the stripes being partially overwritten
+            by_part = {p: (
+                (locs[0].addr.host, locs[0].addr.port), locs[0].part_id
+            ) for p, locs in copies.items()}
+            part_sizes = {
+                p: striping.part_length(slice_type, p, chunk_len_old)
+                for p in range(slice_type.expected_parts)
+            }
+            wanted = [first_data + i for i in range(d)]
+            planner = plans.SliceReadPlanner(
+                slice_type, list(by_part.keys()), encoder=self.encoder
+            )
+            if not planner.is_readable(wanted):
+                raise ReadError("not enough parts for read-modify-write")
+            plan = planner.build_plan(wanted, lo_s, nstripes, part_sizes)
+            buf = await execute_plan(
+                plan, grant.chunk_id, grant.version, by_part,
+                wave_timeout=self.wave_timeout,
+            )
+            bps = nstripes * MFSBLOCKSIZE
+            data_parts = {
+                wanted[i]: buf[i * bps : (i + 1) * bps] for i in range(d)
+            }
+            region[:] = striping.assemble_chunk(
+                data_parts, slice_type, len(region)
+            )
+        region[coff - region_start : coff - region_start + len(piece)] = piece
+
+        # recompute the affected stripes' parity and rewrite all parts
+        parts = striping.split_chunk(region, slice_type, self.encoder)
+        sends = []
+        for part_idx, locs in copies.items():
+            stream = parts.get(part_idx)
+            if stream is None:
+                continue
+            sends.append(
+                self._write_part(
+                    grant.chunk_id, grant.version, locs,
+                    stream[: nstripes * MFSBLOCKSIZE],
+                    nstripes * MFSBLOCKSIZE,
+                    part_offset=lo_s * MFSBLOCKSIZE,
+                )
+            )
+        await asyncio.gather(*sends)
+
     async def _write_chunk(
         self, inode: int, chunk_index: int, chunk_data: np.ndarray, file_length: int
     ) -> None:
         grant = await self._call(
             m.CltomaWriteChunk, inode=inode, chunk_index=chunk_index
         )
+        self.cache.invalidate(inode, chunk_index)
         status_code = st.EIO
         try:
             await self._push_chunk_parts(grant, chunk_data)
@@ -264,9 +390,12 @@ class Client:
         locs: list[m.PartLocation],
         payload: np.ndarray,
         length: int,
+        part_offset: int = 0,
     ) -> None:
-        """Write one part: head of the chain + forwarding for extra copies
-        (WriteExecutor analog, write_executor.cc:66-96)."""
+        """Write ``payload[:length]`` at ``part_offset`` within one part:
+        head of the chain + forwarding for extra copies (WriteExecutor
+        analog, write_executor.cc:66-96). Pieces never cross 64 KiB block
+        boundaries; each carries its own CRC."""
         head = locs[0]
         chain = locs[1:]
         reader, writer = await asyncio.open_connection(
@@ -287,15 +416,19 @@ class Client:
             init = await framing.read_message(reader)
             if not isinstance(init, m.CstoclWriteStatus) or init.status != st.OK:
                 raise st.StatusError(getattr(init, "status", st.EIO), "write init")
-            nbytes = length if length > 0 else 0
-            nblocks = (nbytes + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
+            nbytes = max(length, 0)
             write_id = 0
             expected = set()
             from lizardfs_tpu.ops import crc32 as crc_mod
 
-            for b in range(nblocks):
-                piece = payload[b * MFSBLOCKSIZE : b * MFSBLOCKSIZE + MFSBLOCKSIZE]
-                piece = piece.tobytes()[: max(0, nbytes - b * MFSBLOCKSIZE)]
+            pos = 0
+            while pos < nbytes:
+                abs_off = part_offset + pos
+                block = abs_off // MFSBLOCKSIZE
+                block_off = abs_off % MFSBLOCKSIZE
+                take = min(MFSBLOCKSIZE - block_off, nbytes - pos)
+                piece = payload[pos : pos + take].tobytes()
+                pos += take
                 if not piece:
                     continue
                 write_id += 1
@@ -306,8 +439,8 @@ class Client:
                         req_id=write_id,
                         chunk_id=chunk_id,
                         write_id=write_id,
-                        block=b,
-                        offset=0,
+                        block=block,
+                        offset=block_off,
                         crc=crc_mod.crc32(piece),
                         data=piece,
                     ),
@@ -356,6 +489,30 @@ class Client:
     async def _read_chunk_range(
         self, inode: int, chunk_index: int, off: int, size: int, file_length: int
     ) -> np.ndarray:
+        chunk_len = min(
+            max(file_length - chunk_index * MFSCHUNKSIZE, 0), MFSCHUNKSIZE
+        )
+        # cache fast path: all covering blocks resident
+        lo_b = off // MFSBLOCKSIZE
+        hi_b = (off + size - 1) // MFSBLOCKSIZE
+        cached = [
+            self.cache.get(inode, chunk_index, b) for b in range(lo_b, hi_b + 1)
+        ]
+        if all(c is not None for c in cached):
+            joined = b"".join(cached)
+            rel = off - lo_b * MFSBLOCKSIZE
+            if len(joined) >= rel + size:
+                return np.frombuffer(joined, dtype=np.uint8)[rel : rel + size]
+
+        # block-align the request and extend by the readahead window
+        adviser = self._readahead.setdefault(inode, ReadaheadAdviser())
+        extra = adviser.advise(chunk_index * MFSCHUNKSIZE + off, size)
+        aligned_off = lo_b * MFSBLOCKSIZE
+        aligned_end = min(
+            -(-(off + size + extra) // MFSBLOCKSIZE) * MFSBLOCKSIZE, chunk_len
+        )
+        read_size = aligned_end - aligned_off
+
         last_error: Exception | None = None
         for attempt in range(self.retries):
             if attempt:
@@ -366,10 +523,20 @@ class Client:
             if loc.chunk_id == 0:
                 return np.zeros(size, dtype=np.uint8)  # hole
             try:
-                return await self._read_located(loc, chunk_index, off, size, file_length)
+                data = await self._read_located(
+                    loc, chunk_index, aligned_off, read_size, file_length
+                )
             except (ReadError, ConnectionError, OSError) as e:
                 last_error = e
                 log.info("read retry %d for chunk %d: %s", attempt + 1, loc.chunk_id, e)
+                continue
+            for b in range(lo_b, aligned_end // MFSBLOCKSIZE + 1):
+                s = b * MFSBLOCKSIZE - aligned_off
+                blk = data[s : s + MFSBLOCKSIZE]
+                if len(blk):
+                    self.cache.put(inode, chunk_index, b, blk.tobytes())
+            rel = off - aligned_off
+            return data[rel : rel + size]
         raise st.StatusError(st.EIO, f"read failed after retries: {last_error}")
 
     async def _read_located(

@@ -1,0 +1,109 @@
+"""Positional writes (read-modify-write), cache, readahead."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster, EC_GOAL, XOR_GOAL
+
+
+def test_block_cache_lru_and_invalidate():
+    c = BlockCache(max_bytes=3 * 10)
+    c.put(1, 0, 0, b"x" * 10)
+    c.put(1, 0, 1, b"y" * 10)
+    c.put(1, 1, 0, b"z" * 10)
+    assert c.get(1, 0, 0) == b"x" * 10
+    c.put(2, 0, 0, b"w" * 10)  # evicts LRU (1,0,1)
+    assert c.get(1, 0, 1) is None
+    c.invalidate(1, 1)
+    assert c.get(1, 1, 0) is None
+    assert c.get(1, 0, 0) is not None
+    c.invalidate(1)
+    assert c.get(1, 0, 0) is None
+
+
+def test_readahead_adviser_grows_and_resets():
+    a = ReadaheadAdviser()
+    assert a.advise(0, 100) == 0  # first access: no window
+    w1 = a.advise(100, 100)  # sequential: window appears
+    assert w1 > 0
+    w2 = a.advise(200, 100)
+    assert w2 >= w1
+    assert a.advise(10_000_000, 100) == 0  # seek resets
+
+
+@pytest.mark.parametrize("goal", [2, EC_GOAL, XOR_GOAL])
+@pytest.mark.asyncio
+async def test_pwrite_random_offsets(tmp_path, goal):
+    """Shadow-model test: random pwrites vs a local bytearray."""
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "rw.bin")
+        await c.setgoal(f.inode, goal)
+        size = 6 * MFSBLOCKSIZE + 1234
+        base = data_generator.generate(0, size).tobytes()
+        await c.write_file(f.inode, base)
+        model = bytearray(base)
+
+        rng = np.random.default_rng(42)
+        for i in range(8):
+            off = int(rng.integers(0, size - 1))
+            ln = int(rng.integers(1, min(size - off, 3 * MFSBLOCKSIZE)))
+            patch = data_generator.generate(10_000 + i, ln).tobytes()
+            await c.pwrite(f.inode, off, patch)
+            model[off : off + ln] = patch
+            back = await c.read_file(f.inode)
+            assert back == bytes(model), f"mismatch after patch {i} at {off}+{ln}"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_pwrite_extends_file(tmp_path):
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "ext.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        await c.write_file(f.inode, b"head")
+        # write past EOF: hole of zeros in between
+        await c.pwrite(f.inode, 2 * MFSBLOCKSIZE + 7, b"tail")
+        attr = await c.getattr(f.inode)
+        assert attr.length == 2 * MFSBLOCKSIZE + 7 + 4
+        back = await c.read_file(f.inode)
+        assert back[:4] == b"head"
+        assert back[4 : 2 * MFSBLOCKSIZE + 7] == b"\0" * (2 * MFSBLOCKSIZE + 3)
+        assert back[-4:] == b"tail"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_read_cache_serves_repeat_reads(tmp_path):
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "cache.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(1, 4 * MFSBLOCKSIZE).tobytes()
+        await c.write_file(f.inode, payload)
+        a = await c.read_file(f.inode)
+        hits0 = c.cache.hits
+        b = await c.read_file(f.inode)
+        assert b == payload == a
+        assert c.cache.hits > hits0  # second read came from cache
+        # write invalidates
+        await c.pwrite(f.inode, 0, b"XY")
+        back = await c.read_file(f.inode)
+        assert back[:2] == b"XY" and back[2:] == payload[2:]
+    finally:
+        await cluster.stop()
