@@ -187,6 +187,32 @@ class ChunkStore:
         except OSError:
             pass
 
+    def duplicate(
+        self, src_chunk_id: int, src_version: int, part_id: int,
+        new_chunk_id: int, new_version: int,
+    ) -> ChunkFile:
+        """Local copy of a part under a new chunk id (COW duplicate,
+        hdd duplicate op analog)."""
+        src = self.require(src_chunk_id, src_version, part_id)
+        key = (new_chunk_id, part_id)
+        with self._lock:
+            if key in self._chunks:
+                raise ChunkStoreError(st.EEXIST, f"chunk {new_chunk_id:016X}")
+        new_path = self._path_for(new_chunk_id, new_version)
+        with src.lock, open(src.path, "rb") as fin, open(new_path, "wb") as fout:
+            fin.seek(SIGNATURE_SIZE)
+            fout.write(_SIG.pack(MAGIC, new_chunk_id, new_version, part_id))
+            fout.write(b"\0" * (SIGNATURE_SIZE - _SIG.size))
+            while True:
+                buf = fin.read(1 << 20)
+                if not buf:
+                    break
+                fout.write(buf)
+        cf = ChunkFile(new_chunk_id, new_version, part_id, new_path)
+        with self._lock:
+            self._chunks[key] = cf
+        return cf
+
     def set_version(self, chunk_id: int, old_version: int, new_version: int,
                     part_id: int) -> ChunkFile:
         cf = self.require(chunk_id, old_version, part_id)

@@ -141,6 +141,7 @@ class ChunkServer(Daemon):
             (m.MatocsSetVersion, self._cmd_set_version),
             (m.MatocsTruncateChunk, self._cmd_truncate),
             (m.MatocsReplicate, self._cmd_replicate),
+            (m.MatocsDuplicateChunk, self._cmd_duplicate),
         ):
             self.master.on_push(cls, handler)
         total, used = self.store.space()
@@ -236,6 +237,17 @@ class ChunkServer(Daemon):
             msg.old_version,
             msg.new_version,
             msg.part_id,
+        )
+
+    async def _cmd_duplicate(self, msg: m.MatocsDuplicateChunk):
+        await self._run_job(
+            msg,
+            self.store.duplicate,
+            msg.src_chunk_id,
+            msg.src_version,
+            msg.part_id,
+            msg.chunk_id,
+            msg.version,
         )
 
     async def _cmd_truncate(self, msg: m.MatocsTruncateChunk):

@@ -193,6 +193,103 @@ class Client:
             m.CltomaReadChunk, inode=inode, chunk_index=chunk_index
         )
 
+    async def snapshot(self, src_inode: int, dst_parent: int, dst_name: str) -> m.Attr:
+        """COW snapshot of a file or subtree (makesnapshot analog)."""
+        r = await self._call(
+            m.CltomaSnapshot, src_inode=src_inode, dst_parent=dst_parent,
+            dst_name=dst_name,
+        )
+        return r.attr
+
+    async def set_xattr(self, inode: int, name: str, value: bytes) -> None:
+        await self._call(m.CltomaSetXattr, inode=inode, name=name, value=value)
+
+    async def get_xattr(self, inode: int, name: str) -> bytes:
+        r = await self._call(m.CltomaGetXattr, inode=inode, name=name)
+        return r.value
+
+    async def remove_xattr(self, inode: int, name: str) -> None:
+        await self._call(m.CltomaSetXattr, inode=inode, name=name, value=b"")
+
+    async def list_xattr(self, inode: int) -> list[str]:
+        r = await self._call(m.CltomaListXattr, inode=inode)
+        return r.names
+
+    async def set_quota(
+        self, kind: str, owner_id: int, *, soft_inodes: int = 0,
+        hard_inodes: int = 0, soft_bytes: int = 0, hard_bytes: int = 0,
+        remove: bool = False,
+    ) -> None:
+        await self._call(
+            m.CltomaSetQuota, kind=kind, owner_id=owner_id,
+            soft_inodes=soft_inodes, hard_inodes=hard_inodes,
+            soft_bytes=soft_bytes, hard_bytes=hard_bytes, remove=remove,
+        )
+
+    async def get_quota(self) -> list[dict]:
+        import json
+
+        r = await self._call(m.CltomaGetQuota)
+        return json.loads(r.json)
+
+    async def trash_list(self) -> list[dict]:
+        import json
+
+        r = await self._call(m.CltomaTrashList)
+        return json.loads(r.json)
+
+    async def undelete(self, inode: int) -> None:
+        await self._call(m.CltomaUndelete, inode=inode)
+
+    # --- locking -----------------------------------------------------------
+
+    async def flock(
+        self, inode: int, ltype: int, token: int = 0, wait: bool = False,
+        timeout: float = 30.0,
+    ) -> bool:
+        """BSD flock (1=shared 2=exclusive 0=unlock). wait=True blocks
+        until granted (the master pushes the grant). False = refused."""
+        return await self._lock(inode, 1, token, 0, 0, ltype, wait, timeout)
+
+    async def posix_lock(
+        self, inode: int, start: int, end: int, ltype: int, token: int = 0,
+        wait: bool = False, timeout: float = 30.0,
+    ) -> bool:
+        return await self._lock(inode, 0, token, start, end, ltype, wait, timeout)
+
+    async def test_lock(self, inode: int, start: int, end: int, ltype: int,
+                        token: int = 0) -> bool:
+        """True iff the lock would be grantable (F_GETLK)."""
+        r = await self.master.call(
+            m.CltomaLockOp, op=2, inode=inode, token=token, start=start,
+            end=end, ltype=ltype, wait=False,
+        )
+        return r.status == st.OK
+
+    async def _lock(self, inode, op, token, start, end, ltype, wait, timeout):
+        grant_q: asyncio.Queue = asyncio.Queue()
+
+        async def on_grant(push: m.MatoclLockGranted):
+            if push.inode == inode and push.token == token:
+                grant_q.put_nowait(True)
+
+        if wait:
+            self.master.on_push(m.MatoclLockGranted, on_grant)
+        try:
+            r = await self.master.call(
+                m.CltomaLockOp, op=op, inode=inode, token=token, start=start,
+                end=end, ltype=ltype, wait=wait,
+            )
+            if r.status == st.OK:
+                return True
+            if r.status == st.LOCKED and wait:
+                await asyncio.wait_for(grant_q.get(), timeout)
+                return True
+            return False
+        finally:
+            if wait:
+                self.master._push_handlers.pop(m.MatoclLockGranted, None)
+
     # --- write path -------------------------------------------------------------------
 
     async def write_file(self, inode: int, data: bytes | np.ndarray) -> None:

@@ -46,6 +46,8 @@ class ChunkInfo:
     version: int
     slice_type: int  # geometry slice type id
     copies: int = 1  # wanted copies per part (std goals: N-copy replication)
+    refcount: int = 1  # files referencing this chunk (snapshots share; COW
+    #                    on write — chunk_goal_counters analog)
     locked_until: float = 0.0
     # live locations: (cs_id, slice part index) set; volatile
     parts: set[tuple[int, int]] = field(default_factory=set)
@@ -168,6 +170,15 @@ class ChunkRegistry:
             if len(self.pending_deletes) > 100_000:
                 del self.pending_deletes[:-100_000]
         return chunk
+
+    def release_chunk(self, chunk_id: int) -> None:
+        """Drop one file reference; physical deletion only at zero."""
+        chunk = self.chunks.get(chunk_id)
+        if chunk is None:
+            return
+        chunk.refcount -= 1
+        if chunk.refcount <= 0:
+            self.delete_chunk(chunk_id)
 
     # --- redundancy evaluation ----------------------------------------------------
 

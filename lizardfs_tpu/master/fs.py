@@ -57,8 +57,20 @@ class Node:
     symlink_target: str = ""
     # link count (parents holding an edge to this node)
     nlink: int = 0
+    # parent directory inodes holding edges to this node (one entry per
+    # edge; duplicates allowed for hardlinks in one dir). Directories
+    # always have exactly one.
+    parents: list[int] = field(default_factory=list)
+    # extended attributes
+    xattrs: dict[str, bytes] = field(default_factory=dict)
+    # directories: recursive subtree statistics (fsnodes statistics
+    # analog) — counts include the directory itself
+    stat_inodes: int = 1
+    stat_bytes: int = 0
 
     def to_dict(self) -> dict:
+        import base64
+
         d = {
             "inode": self.inode,
             "ftype": self.ftype,
@@ -71,22 +83,35 @@ class Node:
             "goal": self.goal,
             "trash_time": self.trash_time,
             "nlink": self.nlink,
+            "parents": self.parents,
         }
+        if self.xattrs:
+            d["xattrs"] = {
+                k: base64.b64encode(v).decode() for k, v in self.xattrs.items()
+            }
         if self.ftype == TYPE_FILE:
             d["length"] = self.length
             d["chunks"] = self.chunks
         elif self.ftype == TYPE_DIR:
             d["children"] = self.children
+            d["stat_inodes"] = self.stat_inodes
+            d["stat_bytes"] = self.stat_bytes
         elif self.ftype == TYPE_SYMLINK:
             d["symlink_target"] = self.symlink_target
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Node":
+        import base64
+
         n = cls(inode=d["inode"], ftype=d["ftype"])
         for k, v in d.items():
             if k == "children":
                 n.children = {str(name): int(i) for name, i in v.items()}
+            elif k == "xattrs":
+                n.xattrs = {
+                    key: base64.b64decode(val) for key, val in v.items()
+                }
             elif hasattr(n, k):
                 setattr(n, k, v)
         return n
@@ -126,6 +151,32 @@ class FsTree:
         inode = self.next_inode
         self.next_inode += 1
         return inode
+
+    def _add_stats(self, dir_inode: int, d_inodes: int, d_bytes: int) -> None:
+        """Propagate subtree statistic deltas up the directory chain
+        (fsnodes_add_stats analog). Each edge counts once."""
+        seen = 0
+        cur = dir_inode
+        while True:
+            n = self.nodes.get(cur)
+            if n is None or n.ftype != TYPE_DIR:
+                return
+            n.stat_inodes += d_inodes
+            n.stat_bytes += d_bytes
+            if cur == ROOT_INODE or not n.parents:
+                return
+            cur = n.parents[0]
+            seen += 1
+            if seen > 4096:  # corrupt parent chain guard
+                return
+
+    def _node_weight(self, n: Node) -> tuple[int, int]:
+        """(inodes, bytes) a single edge to this node contributes."""
+        if n.ftype == TYPE_DIR:
+            return n.stat_inodes, n.stat_bytes
+        if n.ftype == TYPE_FILE:
+            return 1, n.length
+        return 1, 0
 
     def lookup(self, parent: int, name: str) -> Node:
         p = self.dir_node(parent)
@@ -170,11 +221,13 @@ class FsTree:
             trash_time=trash_time,
             symlink_target=symlink_target,
             nlink=1,
+            parents=[parent],
         )
         self.nodes[inode] = n
         p.children[name] = inode
         p.mtime = p.ctime = ts
         self.next_inode = max(self.next_inode, inode + 1)
+        self._add_stats(parent, 1, 0)
         return n
 
     def apply_unlink(self, parent: int, name: str, ts: int, to_trash: bool) -> Node:
@@ -187,11 +240,16 @@ class FsTree:
             raise FsError(st.EPERM, "unlink of directory")
         del p.children[name]
         p.mtime = p.ctime = ts
+        wi, wb = self._node_weight(n)
+        self._add_stats(parent, -wi, -wb)
+        if parent in n.parents:
+            n.parents.remove(parent)
         n.nlink -= 1
         n.ctime = ts
         if n.nlink <= 0:
             if to_trash and n.ftype == TYPE_FILE and n.trash_time > 0:
-                self.trash[inode] = (name, ts + n.trash_time)
+                # keep the last parent+name so undelete can restore
+                self.trash[inode] = (name, ts + n.trash_time, parent)
             else:
                 del self.nodes[inode]
         return n
@@ -209,6 +267,7 @@ class FsTree:
         del p.children[name]
         del self.nodes[inode]
         p.mtime = p.ctime = ts
+        self._add_stats(parent, -1, 0)
 
     def apply_rename(
         self, parent_src: int, name_src: str, parent_dst: int, name_dst: str, ts: int
@@ -236,20 +295,25 @@ class FsTree:
                     raise FsError(st.ENOTEMPTY, name_dst)
                 del self.nodes[existing]
                 del pd.children[name_dst]
+                self._add_stats(parent_dst, -1, 0)
             else:
                 self.apply_unlink(parent_dst, name_dst, ts, to_trash=True)
+        wi, wb = self._node_weight(moving)
         del ps.children[name_src]
+        self._add_stats(parent_src, -wi, -wb)
+        if parent_src in moving.parents:
+            moving.parents.remove(parent_src)
         pd.children[name_dst] = inode
+        moving.parents.append(parent_dst)
+        self._add_stats(parent_dst, wi, wb)
         ps.mtime = ps.ctime = ts
         pd.mtime = pd.ctime = ts
         moving.ctime = ts
 
     def _parent_of_dir(self, inode: int) -> int:
-        # directories have exactly one parent; linear scan is fine for the
-        # rare rename-cycle check (the reference stores parent pointers)
-        for i, n in self.nodes.items():
-            if n.ftype == TYPE_DIR and inode in n.children.values():
-                return i
+        n = self.nodes.get(inode)
+        if n is not None and n.parents:
+            return n.parents[0]
         return ROOT_INODE
 
     def apply_link(self, inode: int, parent: int, name: str, ts: int) -> Node:
@@ -259,8 +323,10 @@ class FsTree:
             raise FsError(st.EEXIST, name)
         p.children[name] = inode
         n.nlink += 1
+        n.parents.append(parent)
         n.ctime = ts
         p.mtime = p.ctime = ts
+        self._add_stats(parent, 1, n.length)
         return n
 
     def apply_setattr(
@@ -301,6 +367,9 @@ class FsTree:
         """Set file length; returns chunk ids dropped past the new end
         (the caller releases them in the chunk registry)."""
         n = self.file_node(inode)
+        delta = length - n.length
+        for parent in n.parents:
+            self._add_stats(parent, 0, delta)
         n.length = length
         n.mtime = n.ctime = ts
         nchunks = (length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE if length else 0
@@ -311,6 +380,90 @@ class FsTree:
     def apply_purge_trash(self, inode: int) -> None:
         self.trash.pop(inode, None)
         self.nodes.pop(inode, None)
+
+    def apply_undelete(self, inode: int, ts: int) -> Node:
+        """Restore a trashed file to its original directory (or the root
+        if that directory is gone), resolving name collisions with a
+        suffix (trash-restore analog)."""
+        entry = self.trash.get(inode)
+        if entry is None:
+            raise FsError(st.ENOENT, f"inode {inode} not in trash")
+        name, _, parent = entry
+        p = self.nodes.get(parent)
+        if p is None or p.ftype != TYPE_DIR:
+            parent = ROOT_INODE
+            p = self.dir_node(parent)
+        final = name
+        i = 1
+        while final in p.children:
+            final = f"{name}.restored.{i}"
+            i += 1
+        n = self.node(inode)
+        p.children[final] = inode
+        n.nlink = 1
+        n.parents = [parent]
+        n.ctime = ts
+        p.mtime = p.ctime = ts
+        del self.trash[inode]
+        self._add_stats(parent, 1, n.length)
+        return n
+
+    def apply_set_xattr(self, inode: int, name: str, value_b64: str, ts: int) -> None:
+        import base64
+
+        n = self.node(inode)
+        if value_b64 == "":
+            if name not in n.xattrs:
+                raise FsError(st.ENOATTR, name)
+            del n.xattrs[name]
+        else:
+            if len(name) > 255:
+                raise FsError(st.NAME_TOO_LONG, name)
+            n.xattrs[name] = base64.b64decode(value_b64)
+        n.ctime = ts
+
+    def apply_snapshot(
+        self, src_inode: int, dst_parent: int, dst_name: str,
+        inode_map: dict[str, int], ts: int,
+    ) -> list[tuple[int, int]]:
+        """Clone a subtree; files share chunk ids (COW happens at write
+        time via chunk refcounts). ``inode_map`` assigns the new inode
+        for every cloned source inode (chosen by the live master so
+        replay is deterministic). Returns [(chunk_id, +1 refcount)]
+        deltas for the registry."""
+        src = self.node(src_inode)
+        p = self.dir_node(dst_parent)
+        if dst_name in p.children:
+            raise FsError(st.EEXIST, dst_name)
+        shared: list[tuple[int, int]] = []
+
+        def clone(node: Node, parent_inode: int, name: str) -> None:
+            new_inode = inode_map[str(node.inode)]
+            new = Node(
+                inode=new_inode, ftype=node.ftype, mode=node.mode,
+                uid=node.uid, gid=node.gid, atime=ts, mtime=node.mtime,
+                ctime=ts, goal=node.goal, trash_time=node.trash_time,
+                length=node.length, chunks=list(node.chunks),
+                symlink_target=node.symlink_target, nlink=1,
+                parents=[parent_inode], xattrs=dict(node.xattrs),
+            )
+            self.nodes[new_inode] = new
+            self.nodes[parent_inode].children[name] = new_inode
+            self.next_inode = max(self.next_inode, new_inode + 1)
+            for cid in new.chunks:
+                if cid:
+                    shared.append((cid, 1))
+            if node.ftype == TYPE_DIR:
+                for child_name, child_inode in sorted(node.children.items()):
+                    clone(self.node(child_inode), new_inode, child_name)
+                new.stat_inodes = node.stat_inodes
+                new.stat_bytes = node.stat_bytes
+
+        clone(src, dst_parent, dst_name)
+        wi, wb = self._node_weight(self.node(inode_map[str(src_inode)]))
+        self._add_stats(dst_parent, wi, wb)
+        p.mtime = p.ctime = ts
+        return shared
 
     # --- persistence -----------------------------------------------------------
 
@@ -326,7 +479,10 @@ class FsTree:
         fs = cls.__new__(cls)
         fs.nodes = {}
         fs.next_inode = d["next_inode"]
-        fs.trash = {int(i): (v[0], int(v[1])) for i, v in d.get("trash", {}).items()}
+        fs.trash = {
+            int(i): (v[0], int(v[1]), int(v[2]) if len(v) > 2 else ROOT_INODE)
+            for i, v in d.get("trash", {}).items()
+        }
         for nd in d["nodes"]:
             node = Node.from_dict(nd)
             fs.nodes[node.inode] = node

@@ -11,12 +11,14 @@ from __future__ import annotations
 
 from lizardfs_tpu.master.chunks import ChunkRegistry
 from lizardfs_tpu.master.fs import FsError, FsTree
+from lizardfs_tpu.master.quotas import QuotaDatabase
 
 
 class MetadataStore:
     def __init__(self):
         self.fs = FsTree()
         self.registry = ChunkRegistry()
+        self.quotas = QuotaDatabase()
 
     # --- op application (the one true mutation path) -------------------------
 
@@ -32,13 +34,15 @@ class MetadataStore:
             op["uid"], op["gid"], op["ts"], op["goal"], op["trash_time"],
             op.get("symlink_target", ""),
         )
+        self.quotas.charge(op["uid"], op["gid"], 1, 0)
 
     def _op_unlink(self, op):
         node = self.fs.apply_unlink(op["parent"], op["name"], op["ts"], op["to_trash"])
         if node.nlink <= 0 and node.inode not in self.fs.trash:
+            self.quotas.charge(node.uid, node.gid, -1, -node.length)
             for cid in node.chunks:
                 if cid:
-                    self.registry.delete_chunk(cid)
+                    self.registry.release_chunk(cid)
 
     def _op_rmdir(self, op):
         self.fs.apply_rmdir(op["parent"], op["name"], op["ts"])
@@ -62,9 +66,12 @@ class MetadataStore:
         self.fs.apply_setgoal(op["inode"], op["goal"], op["ts"])
 
     def _op_set_length(self, op):
+        node = self.fs.file_node(op["inode"])
+        delta = op["length"] - node.length
         removed = self.fs.apply_set_length(op["inode"], op["length"], op["ts"])
+        self.quotas.charge(node.uid, node.gid, 0, delta)
         for cid in removed:
-            self.registry.delete_chunk(cid)
+            self.registry.release_chunk(cid)
 
     def _op_create_chunk(self, op):
         self.registry.create_chunk(
@@ -84,10 +91,52 @@ class MetadataStore:
     def _op_purge_trash(self, op):
         node = self.fs.nodes.get(op["inode"])
         if node is not None:
+            self.quotas.charge(node.uid, node.gid, -1, -node.length)
             for cid in node.chunks:
                 if cid:
-                    self.registry.delete_chunk(cid)
+                    self.registry.release_chunk(cid)
         self.fs.apply_purge_trash(op["inode"])
+
+    def _op_undelete(self, op):
+        self.fs.apply_undelete(op["inode"], op["ts"])
+
+    def _op_set_xattr(self, op):
+        self.fs.apply_set_xattr(op["inode"], op["name"], op["value"], op["ts"])
+
+    def _op_set_quota(self, op):
+        if op.get("remove"):
+            self.quotas.remove(op["kind"], op["owner_id"])
+        else:
+            self.quotas.set_limits(
+                op["kind"], op["owner_id"], op["soft_inodes"],
+                op["hard_inodes"], op["soft_bytes"], op["hard_bytes"],
+            )
+
+    def _op_snapshot(self, op):
+        shared = self.fs.apply_snapshot(
+            op["src_inode"], op["dst_parent"], op["dst_name"],
+            op["inode_map"], op["ts"],
+        )
+        for cid, delta in shared:
+            chunk = self.registry.chunks.get(cid)
+            if chunk is not None:
+                chunk.refcount += delta
+        # cloned nodes charge their owners
+        src = self.fs.node(op["inode_map"][str(op["src_inode"])])
+        wi, wb = self.fs._node_weight(src)
+        self.quotas.charge(src.uid, src.gid, wi, wb)
+
+    def _op_cow_chunk(self, op):
+        """Copy-on-write: a file's shared chunk was duplicated; point the
+        file at the private copy."""
+        old = self.registry.chunks.get(op["old_chunk_id"])
+        self.registry.create_chunk(
+            op["slice_type"], chunk_id=op["new_chunk_id"],
+            version=op["version"], copies=op.get("copies", 1),
+        )
+        if old is not None:
+            old.refcount -= 1
+        self.fs.apply_set_chunk(op["inode"], op["chunk_index"], op["new_chunk_id"])
 
     # --- persistence sections --------------------------------------------------
 
@@ -98,10 +147,12 @@ class MetadataStore:
                 "next_chunk_id": self.registry.next_chunk_id,
                 "table": [
                     {"id": c.chunk_id, "version": c.version,
-                     "slice_type": c.slice_type, "copies": c.copies}
+                     "slice_type": c.slice_type, "copies": c.copies,
+                     "refcount": c.refcount}
                     for c in self.registry.chunks.values()
                 ],
             },
+            "quotas": self.quotas.to_dict(),
         }
 
     def load_sections(self, doc: dict) -> None:
@@ -110,11 +161,13 @@ class MetadataStore:
         ch = doc["chunks"]
         self.registry.next_chunk_id = ch["next_chunk_id"]
         for row in ch["table"]:
-            self.registry.create_chunk(
+            c = self.registry.create_chunk(
                 row["slice_type"], chunk_id=row["id"], version=row["version"],
                 copies=row.get("copies", 1),
             )
+            c.refcount = row.get("refcount", 1)
         self.registry.next_chunk_id = ch["next_chunk_id"]
+        self.quotas = QuotaDatabase.from_dict(doc.get("quotas", {}))
 
     def checksum(self) -> str:
         """Divergence-detection digest over FS + persistent chunk state."""

@@ -30,7 +30,9 @@ from lizardfs_tpu.core import geometry
 from lizardfs_tpu.master import fs as fsmod
 from lizardfs_tpu.master.changelog import Changelog, load_image, save_image
 from lizardfs_tpu.master.chunks import ChunkServerInfo
+from lizardfs_tpu.master.locks import LOCK_UNLOCK, LockManager
 from lizardfs_tpu.master.metadata import MetadataStore
+from lizardfs_tpu.master.quotas import KIND_DIR
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
@@ -100,6 +102,8 @@ class MasterServer(Daemon):
         self.shadow_writers: list[asyncio.StreamWriter] = []
         self.sessions: dict[int, dict] = {}
         self.next_session = 1
+        self.locks = LockManager()
+        self._session_writers: dict[int, asyncio.StreamWriter] = {}
         self.health_interval = health_interval
         self.image_interval = image_interval
         self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
@@ -222,25 +226,32 @@ class MasterServer(Daemon):
         if first.session_id == 0:
             self.next_session += 1
         self.sessions[session_id] = {"info": first.info, "connected": True}
+        self._session_writers[session_id] = writer
         await framing.send_message(
             writer,
             m.MatoclRegister(req_id=first.req_id, status=st.OK, session_id=session_id),
         )
-        while True:
-            try:
-                msg = await framing.read_message(reader)
-            except (asyncio.IncompleteReadError, ConnectionError):
-                break
-            try:
-                reply = await self._handle_client(msg)
-            except fsmod.FsError as e:
-                reply = self._error_reply(msg, e.code)
-            except Exception:
-                self.log.exception("client op %s failed", type(msg).__name__)
-                reply = self._error_reply(msg, st.EIO)
-            if reply is not None:
-                await framing.send_message(writer, reply)
-        self.sessions.get(session_id, {})["connected"] = False
+        try:
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                try:
+                    reply = await self._handle_client(msg, session_id)
+                except fsmod.FsError as e:
+                    reply = self._error_reply(msg, e.code)
+                except Exception:
+                    self.log.exception("client op %s failed", type(msg).__name__)
+                    reply = self._error_reply(msg, st.EIO)
+                if reply is not None:
+                    await framing.send_message(writer, reply)
+        finally:
+            self.sessions.get(session_id, {})["connected"] = False
+            self._session_writers.pop(session_id, None)
+            # a dying session releases its locks; queued waiters may wake
+            for inode in self.locks.release_session(session_id):
+                self._grant_pending_locks(inode)
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -257,17 +268,64 @@ class MasterServer(Daemon):
             return m.MatoclReaddir(req_id=msg.req_id, status=code, entries=[])
         if isinstance(msg, m.CltomaReadlink):
             return m.MatoclReadlink(req_id=msg.req_id, status=code, target="")
+        if isinstance(msg, m.CltomaGetXattr):
+            return m.MatoclXattrReply(req_id=msg.req_id, status=code, value=b"")
+        if isinstance(msg, m.CltomaListXattr):
+            return m.MatoclListXattr(req_id=msg.req_id, status=code, names=[])
+        if isinstance(msg, m.CltomaGetQuota):
+            return m.MatoclQuotaReply(req_id=msg.req_id, status=code, json="[]")
+        if isinstance(msg, m.CltomaLockOp):
+            return m.MatoclLockReply(req_id=msg.req_id, status=code)
+        if isinstance(msg, m.CltomaTrashList):
+            return m.MatoclTrashList(req_id=msg.req_id, status=code, json="[]")
         if isinstance(
             msg,
             (m.CltomaLookup, m.CltomaGetattr, m.CltomaMkdir, m.CltomaCreate,
-             m.CltomaSetattr, m.CltomaSymlink, m.CltomaLink),
+             m.CltomaSetattr, m.CltomaSymlink, m.CltomaLink, m.CltomaSnapshot),
         ):
             return m.MatoclAttrReply(
                 req_id=msg.req_id, status=code, attr=_null_attr()
             )
         return m.MatoclStatusReply(req_id=msg.req_id, status=code)
 
-    async def _handle_client(self, msg):
+    def _check_quota(self, dir_inode: int, uid: int, gid: int,
+                     d_inodes: int, d_bytes: int) -> None:
+        """Raise QUOTA_EXCEEDED if hard limits forbid the addition."""
+        if not self.meta.quotas.check(uid, gid, d_inodes, d_bytes):
+            raise fsmod.FsError(st.QUOTA_EXCEEDED, f"uid {uid}/gid {gid}")
+        # directory quotas along the ancestor chain
+        fs = self.meta.fs
+        cur = dir_inode
+        hops = 0
+        while cur and hops < 4096:
+            entry = self.meta.quotas.entry(KIND_DIR, cur)
+            node = fs.nodes.get(cur)
+            if node is None:
+                break
+            if entry is not None and not self.meta.quotas.check_dir(
+                (node.stat_inodes, node.stat_bytes), entry, d_inodes, d_bytes
+            ):
+                raise fsmod.FsError(st.QUOTA_EXCEEDED, f"dir {cur}")
+            if cur == fsmod.ROOT_INODE or not node.parents:
+                break
+            cur = node.parents[0]
+            hops += 1
+
+    def _grant_pending_locks(self, inode: int) -> None:
+        for granted in self.locks.retry_pending(inode):
+            w = self._session_writers.get(granted.owner.session_id)
+            if w is not None:
+                try:
+                    framing.write_message(
+                        w,
+                        m.MatoclLockGranted(
+                            inode=inode, token=granted.owner.token
+                        ),
+                    )
+                except (ConnectionError, RuntimeError):
+                    pass
+
+    async def _handle_client(self, msg, session_id: int = 0):
         fs = self.meta.fs
         now = int(time.time())
         if isinstance(msg, m.CltomaLookup):
@@ -276,6 +334,7 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaGetattr):
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaMkdir):
+            self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             inode = fs.alloc_inode()
             self.commit({
                 "op": "mknode", "parent": msg.parent, "name": msg.name,
@@ -285,6 +344,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(inode))
         if isinstance(msg, m.CltomaCreate):
+            self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             parent_goal = fs.dir_node(msg.parent).goal
             inode = fs.alloc_inode()
             self.commit({
@@ -295,6 +355,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(inode))
         if isinstance(msg, m.CltomaSymlink):
+            self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             inode = fs.alloc_inode()
             self.commit({
                 "op": "mknode", "parent": msg.parent, "name": msg.name,
@@ -311,6 +372,8 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK, target=node.symlink_target
             )
         if isinstance(msg, m.CltomaLink):
+            target = fs.file_node(msg.inode)
+            self._check_quota(msg.parent, target.uid, target.gid, 1, target.length)
             self.commit({
                 "op": "link", "inode": msg.inode, "parent": msg.parent,
                 "name": msg.name, "ts": now,
@@ -362,7 +425,114 @@ class MasterServer(Daemon):
             return await self._write_chunk(msg)
         if isinstance(msg, m.CltomaWriteChunkEnd):
             return await self._write_chunk_end(msg)
+        if isinstance(msg, m.CltomaSnapshot):
+            return await self._snapshot(msg, now)
+        if isinstance(msg, m.CltomaSetXattr):
+            import base64
+
+            self.commit({
+                "op": "set_xattr", "inode": msg.inode, "name": msg.name,
+                "value": base64.b64encode(msg.value).decode(), "ts": now,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaGetXattr):
+            node = fs.node(msg.inode)
+            if msg.name not in node.xattrs:
+                return m.MatoclXattrReply(
+                    req_id=msg.req_id, status=st.ENOATTR, value=b""
+                )
+            return m.MatoclXattrReply(
+                req_id=msg.req_id, status=st.OK, value=node.xattrs[msg.name]
+            )
+        if isinstance(msg, m.CltomaListXattr):
+            node = fs.node(msg.inode)
+            return m.MatoclListXattr(
+                req_id=msg.req_id, status=st.OK, names=sorted(node.xattrs)
+            )
+        if isinstance(msg, m.CltomaSetQuota):
+            self.commit({
+                "op": "set_quota", "kind": msg.kind, "owner_id": msg.owner_id,
+                "soft_inodes": msg.soft_inodes, "hard_inodes": msg.hard_inodes,
+                "soft_bytes": msg.soft_bytes, "hard_bytes": msg.hard_bytes,
+                "remove": msg.remove,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaGetQuota):
+            rows = []
+            for (kind, oid), e in sorted(self.meta.quotas.entries.items()):
+                row = {"kind": kind, "id": oid, **e.to_dict()}
+                if kind == KIND_DIR:
+                    node = fs.nodes.get(oid)
+                    if node is not None:
+                        row["used_inodes"] = node.stat_inodes
+                        row["used_bytes"] = node.stat_bytes
+                rows.append(row)
+            return m.MatoclQuotaReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(rows)
+            )
+        if isinstance(msg, m.CltomaLockOp):
+            return self._lock_op(msg, session_id)
+        if isinstance(msg, m.CltomaTrashList):
+            rows = [
+                {"inode": inode, "name": name, "expires": exp, "parent": parent}
+                for inode, (name, exp, parent) in sorted(fs.trash.items())
+            ]
+            return m.MatoclTrashList(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(rows)
+            )
+        if isinstance(msg, m.CltomaUndelete):
+            if msg.inode not in fs.trash:
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.ENOENT)
+            self.commit({"op": "undelete", "inode": msg.inode, "ts": now})
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         return m.MatoclStatusReply(req_id=getattr(msg, "req_id", 0), status=st.EINVAL)
+
+    def _lock_op(self, msg: m.CltomaLockOp, session_id: int):
+        inode, token = msg.inode, msg.token
+        self.meta.fs.file_node(inode)  # must exist and be a file
+        if msg.op == 2:  # test (F_GETLK); checks both spaces
+            conflict = self.locks.test(
+                inode, session_id, token, msg.start, msg.end, msg.ltype
+            ) or self.locks.test_flock(inode, session_id, token, msg.ltype)
+            return m.MatoclLockReply(
+                req_id=msg.req_id,
+                status=st.OK if conflict is None else st.LOCKED,
+            )
+        if msg.op == 1:  # flock
+            ok = self.locks.flock(inode, session_id, token, msg.ltype, msg.wait)
+        else:  # posix range
+            ok = self.locks.posix(
+                inode, session_id, token, msg.start, msg.end, msg.ltype, msg.wait
+            )
+        if ok and msg.ltype == LOCK_UNLOCK:
+            self._grant_pending_locks(inode)
+        return m.MatoclLockReply(
+            req_id=msg.req_id, status=st.OK if ok else st.LOCKED
+        )
+
+    async def _snapshot(self, msg: m.CltomaSnapshot, now: int):
+        fs = self.meta.fs
+        src = fs.node(msg.src_inode)
+        wi, wb = fs._node_weight(src)
+        self._check_quota(msg.dst_parent, src.uid, src.gid, wi, wb)
+        # pre-assign all clone inodes so replay is deterministic
+        inode_map: dict[str, int] = {}
+
+        def assign(node):
+            inode_map[str(node.inode)] = fs.alloc_inode()
+            if node.ftype == fsmod.TYPE_DIR:
+                for child in sorted(node.children.values()):
+                    assign(fs.node(child))
+
+        assign(src)
+        self.commit({
+            "op": "snapshot", "src_inode": msg.src_inode,
+            "dst_parent": msg.dst_parent, "dst_name": msg.dst_name,
+            "inode_map": inode_map, "ts": now,
+        })
+        return self._attr_reply(
+            msg.req_id, fs.node(inode_map[str(msg.src_inode)])
+        )
 
     def _attr_reply(self, req_id: int, node) -> m.MatoclAttrReply:
         return m.MatoclAttrReply(req_id=req_id, status=st.OK, attr=_attr_of(node))
@@ -413,6 +583,9 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.CHUNK_BUSY, chunk_id=0, version=0,
                 file_length=0, locations=[],
             )
+        if chunk.refcount > 1:
+            # snapshot-shared chunk: copy-on-write before mutating
+            return await self._cow_chunk(msg, node, chunk)
         # version bump so stale copies are detectable (chunk lock + bump,
         # matoclserv.cc fuse_write_chunk semantics)
         new_version = chunk.version + 1
@@ -463,6 +636,69 @@ class MasterServer(Daemon):
             req_id=msg.req_id, status=st.OK, chunk_id=chunk_id,
             version=new_version, file_length=node.length,
             locations=self._locations_of(chunk),
+        )
+
+    async def _cow_chunk(self, msg: m.CltomaWriteChunk, node, chunk):
+        """Duplicate a snapshot-shared chunk on its part holders, point
+        the file at the private copy, then grant the write on it."""
+        new_id = self.meta.registry.next_chunk_id
+        self.meta.registry.next_chunk_id = new_id + 1
+        t = geometry.SliceType(chunk.slice_type)
+        version = 1
+        acks = []
+        for cs_id, part in sorted(chunk.parts):
+            link = self.cs_links.get(cs_id)
+            if link is None:
+                continue
+            acks.append((
+                cs_id, part,
+                link.command(
+                    m.MatocsDuplicateChunk,
+                    chunk_id=new_id, version=version,
+                    part_id=geometry.ChunkPartType(t, part).id,
+                    src_chunk_id=chunk.chunk_id, src_version=chunk.version,
+                ),
+            ))
+        created = []
+        for cs_id, part, coro in acks:
+            try:
+                reply = await coro
+                if reply.status == st.OK:
+                    created.append((cs_id, part))
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+        if len(created) < len(chunk.parts):
+            for cs_id, part in created:
+                link = self.cs_links.get(cs_id)
+                if link is not None:
+                    try:
+                        await link.command(
+                            m.MatocsDeleteChunk, chunk_id=new_id,
+                            version=version,
+                            part_id=geometry.ChunkPartType(t, part).id,
+                        )
+                    except (ConnectionError, asyncio.TimeoutError):
+                        pass
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
+                version=0, file_length=0, locations=[],
+            )
+        self.commit({
+            "op": "cow_chunk", "inode": msg.inode, "chunk_index": msg.chunk_index,
+            "old_chunk_id": chunk.chunk_id, "new_chunk_id": new_id,
+            "slice_type": chunk.slice_type, "version": version,
+            "copies": chunk.copies,
+        })
+        new_chunk = self.meta.registry.chunk(new_id)
+        for cs_id, part in created:
+            new_chunk.parts.add((cs_id, part))
+        new_chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
+        self.log.info(
+            "COW: chunk %d -> %d for inode %d", chunk.chunk_id, new_id, msg.inode
+        )
+        return m.MatoclWriteChunk(
+            req_id=msg.req_id, status=st.OK, chunk_id=new_id, version=version,
+            file_length=node.length, locations=self._locations_of(new_chunk),
         )
 
     def _slice_type_for_goal(self, goal_id: int) -> geometry.SliceType:
@@ -557,6 +793,9 @@ class MasterServer(Daemon):
         if msg.status == st.OK:
             node = self.meta.fs.file_node(msg.inode)
             if msg.file_length > node.length:
+                delta = msg.file_length - node.length
+                parent = node.parents[0] if node.parents else fsmod.ROOT_INODE
+                self._check_quota(parent, node.uid, node.gid, 0, delta)
                 self.commit({
                     "op": "set_length", "inode": msg.inode,
                     "length": msg.file_length, "ts": int(time.time()),

@@ -274,6 +274,118 @@ class CltomaLink(Message):
     )
 
 
+class CltomaSnapshot(Message):
+    MSG_TYPE = 1036
+    FIELDS = (
+        ("req_id", "u32"),
+        ("src_inode", "u32"),
+        ("dst_parent", "u32"),
+        ("dst_name", "str"),
+    )
+
+
+class CltomaSetXattr(Message):
+    """Set (value non-empty) or remove (value empty) an xattr."""
+
+    MSG_TYPE = 1038
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("name", "str"),
+        ("value", "bytes"),
+    )
+
+
+class CltomaGetXattr(Message):
+    MSG_TYPE = 1040
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("name", "str"))
+
+
+class MatoclXattrReply(Message):
+    MSG_TYPE = 1041
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("value", "bytes"))
+
+
+class CltomaListXattr(Message):
+    MSG_TYPE = 1042
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclListXattr(Message):
+    MSG_TYPE = 1043
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("names", "list:str"))
+
+
+class CltomaSetQuota(Message):
+    """Set/remove quota limits (remove when all limits zero and
+    ``remove`` set)."""
+
+    MSG_TYPE = 1044
+    FIELDS = (
+        ("req_id", "u32"),
+        ("kind", "str"),  # user | group | dir
+        ("owner_id", "u32"),  # uid/gid/directory inode
+        ("soft_inodes", "u64"),
+        ("hard_inodes", "u64"),
+        ("soft_bytes", "u64"),
+        ("hard_bytes", "u64"),
+        ("remove", "bool"),
+    )
+
+
+class CltomaGetQuota(Message):
+    MSG_TYPE = 1046
+    FIELDS = (("req_id", "u32"),)
+
+
+class MatoclQuotaReply(Message):
+    MSG_TYPE = 1047
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+class CltomaLockOp(Message):
+    """POSIX byte-range lock / flock / test (op: 0=posix 1=flock 2=test)."""
+
+    MSG_TYPE = 1048
+    FIELDS = (
+        ("req_id", "u32"),
+        ("op", "u8"),
+        ("inode", "u32"),
+        ("token", "u64"),  # per-session owner discriminator (fd/pid)
+        ("start", "u64"),
+        ("end", "u64"),  # 0 = EOF/whole file
+        ("ltype", "u8"),  # 0=unlock 1=shared 2=exclusive
+        ("wait", "bool"),
+    )
+
+
+class MatoclLockReply(Message):
+    MSG_TYPE = 1049
+    FIELDS = (("req_id", "u32"), ("status", "u8"))  # LOCKED = queued/denied
+
+
+class MatoclLockGranted(Message):
+    """Push: a previously queued lock was granted."""
+
+    MSG_TYPE = 1050
+    FIELDS = (("inode", "u32"), ("token", "u64"))
+
+
+class CltomaTrashList(Message):
+    MSG_TYPE = 1052
+    FIELDS = (("req_id", "u32"),)
+
+
+class MatoclTrashList(Message):
+    MSG_TYPE = 1053
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+class CltomaUndelete(Message):
+    MSG_TYPE = 1054
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
 # --------------------------------------------------------------------------
 # chunkserver <-> master
 # --------------------------------------------------------------------------
@@ -376,6 +488,20 @@ class MatocsTruncateChunk(Message):
         ("new_version", "u32"),
         ("part_id", "u32"),
         ("chunk_length", "u32"),  # length of the whole chunk, not the part
+    )
+
+
+class MatocsDuplicateChunk(Message):
+    """Duplicate a part locally under a new chunk id (snapshot COW)."""
+
+    MSG_TYPE = 1122
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),  # new chunk id
+        ("version", "u32"),  # new version
+        ("part_id", "u32"),
+        ("src_chunk_id", "u64"),
+        ("src_version", "u32"),
     )
 
 

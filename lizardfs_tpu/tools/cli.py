@@ -263,6 +263,67 @@ async def cmd_rremove(c: Client, args) -> int:
     return 0
 
 
+async def cmd_snapshot(c: Client, args) -> int:
+    src = await c.resolve(args.src)
+    parent, name = await c.resolve_parent(args.dst)
+    await c.snapshot(src.inode, parent.inode, name)
+    print(f"snapshot {args.src} -> {args.dst}")
+    return 0
+
+
+async def cmd_getxattr(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    sys.stdout.buffer.write(await c.get_xattr(a.inode, args.name) + b"\n")
+    return 0
+
+
+async def cmd_setxattr(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    await c.set_xattr(a.inode, args.name, args.value.encode())
+    return 0
+
+
+async def cmd_listxattr(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    for name in await c.list_xattr(a.inode):
+        print(name)
+    return 0
+
+
+async def cmd_quota_set(c: Client, args) -> int:
+    owner = args.id
+    if args.kind == "dir":
+        owner = (await c.resolve(args.id)).inode
+    await c.set_quota(
+        args.kind, int(owner), soft_inodes=args.soft_inodes,
+        hard_inodes=args.hard_inodes, soft_bytes=args.soft_bytes,
+        hard_bytes=args.hard_bytes, remove=args.remove,
+    )
+    return 0
+
+
+async def cmd_quota_rep(c: Client, args) -> int:
+    rows = await c.get_quota()
+    for r in rows:
+        print(
+            f"{r['kind']:6s} {r['id']:<8d} "
+            f"inodes {r['used_inodes']}/{r['hard_inodes'] or '-'} "
+            f"bytes {r['used_bytes']}/{r['hard_bytes'] or '-'}"
+        )
+    return 0
+
+
+async def cmd_trash_list(c: Client, args) -> int:
+    for row in await c.trash_list():
+        print(f"inode {row['inode']:<8d} expires {row['expires']} {row['name']}")
+    return 0
+
+
+async def cmd_undelete(c: Client, args) -> int:
+    await c.undelete(args.inode)
+    return 0
+
+
 COMMANDS = {
     "ls": (cmd_ls, [("path", {})]),
     "mkdir": (cmd_mkdir, [("path", {})]),
@@ -286,6 +347,21 @@ COMMANDS = {
     "checkfile": (cmd_checkfile, [("path", {})]),
     "dirinfo": (cmd_dirinfo, [("path", {})]),
     "rremove": (cmd_rremove, [("path", {})]),
+    "snapshot": (cmd_snapshot, [("src", {}), ("dst", {})]),
+    "getxattr": (cmd_getxattr, [("path", {}), ("name", {})]),
+    "setxattr": (cmd_setxattr, [("path", {}), ("name", {}), ("value", {})]),
+    "listxattr": (cmd_listxattr, [("path", {})]),
+    "quota-set": (cmd_quota_set, [
+        ("kind", {"choices": ["user", "group", "dir"]}), ("id", {}),
+        ("--soft-inodes", {"type": int, "default": 0, "dest": "soft_inodes"}),
+        ("--hard-inodes", {"type": int, "default": 0, "dest": "hard_inodes"}),
+        ("--soft-bytes", {"type": int, "default": 0, "dest": "soft_bytes"}),
+        ("--hard-bytes", {"type": int, "default": 0, "dest": "hard_bytes"}),
+        ("--remove", {"action": "store_true"}),
+    ]),
+    "quota-rep": (cmd_quota_rep, []),
+    "trash-list": (cmd_trash_list, []),
+    "undelete": (cmd_undelete, [("inode", {"type": int})]),
 }
 
 

@@ -1,0 +1,207 @@
+"""Quotas, xattrs, locks, snapshots (COW), trash restore."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.master.locks import (
+    LOCK_EXCLUSIVE,
+    LOCK_SHARED,
+    LOCK_UNLOCK,
+    FileLocks,
+    Owner,
+)
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster, EC_GOAL
+
+
+def test_lock_ranges_posix_semantics():
+    fl = FileLocks()
+    a, b = Owner(1, 1), Owner(2, 1)
+    assert fl.apply(a, 0, 100, LOCK_EXCLUSIVE, False)
+    assert not fl.apply(b, 50, 150, LOCK_EXCLUSIVE, False)
+    assert fl.apply(b, 100, 200, LOCK_EXCLUSIVE, False)  # disjoint ok
+    # shared locks coexist
+    fl2 = FileLocks()
+    assert fl2.apply(a, 0, 100, LOCK_SHARED, False)
+    assert fl2.apply(b, 0, 100, LOCK_SHARED, False)
+    assert not fl2.apply(Owner(3, 1), 0, 10, LOCK_EXCLUSIVE, False)
+    # POSIX split: unlock the middle of a's range
+    assert fl.apply(a, 25, 75, LOCK_UNLOCK, False)
+    assert fl.apply(b, 30, 60, LOCK_SHARED, False)  # hole is free now
+    # same-owner upgrade replaces in place
+    assert fl.apply(a, 0, 25, LOCK_SHARED, False)
+    # pending queue: b waits for a's [75,100)
+    assert not fl.apply(b, 70, 100, LOCK_EXCLUSIVE, True)
+    assert fl.apply(a, 0, 100, LOCK_UNLOCK, False)
+    granted = fl.retry_pending()
+    assert len(granted) == 1 and granted[0].owner == b
+
+
+@pytest.mark.asyncio
+async def test_xattrs(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "x.bin")
+        await c.set_xattr(f.inode, "user.color", b"blue")
+        await c.set_xattr(f.inode, "user.size", b"42")
+        assert await c.get_xattr(f.inode, "user.color") == b"blue"
+        assert await c.list_xattr(f.inode) == ["user.color", "user.size"]
+        await c.remove_xattr(f.inode, "user.color")
+        assert await c.list_xattr(f.inode) == ["user.size"]
+        with pytest.raises(st.StatusError) as e:
+            await c.get_xattr(f.inode, "user.color")
+        assert e.value.code == st.ENOATTR
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_quota_enforcement(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "limited")
+        # directory quota: at most 3 inodes in the subtree (dir itself = 1)
+        await c.set_quota("dir", d.inode, hard_inodes=3)
+        await c.create(d.inode, "a", uid=7, gid=7)
+        await c.create(d.inode, "b", uid=7, gid=7)
+        with pytest.raises(st.StatusError) as e:
+            await c.create(d.inode, "c", uid=7, gid=7)
+        assert e.value.code == st.QUOTA_EXCEEDED
+        # byte quota on a user
+        await c.set_quota("user", 7, hard_bytes=10_000)
+        f = await c.lookup(d.inode, "a")
+        await c.write_file(f.inode, b"x" * 5_000)
+        with pytest.raises(st.StatusError) as e:
+            await c.write_file(f.inode, b"y" * 20_000)
+        assert e.value.code == st.QUOTA_EXCEEDED
+        rep = await c.get_quota()
+        kinds = {(r["kind"], r["id"]) for r in rep}
+        assert ("dir", d.inode) in kinds and ("user", 7) in kinds
+        # removing the quota unblocks
+        await c.set_quota("dir", d.inode, remove=True)
+        await c.set_quota("user", 7, remove=True)
+        await c.create(d.inode, "c", uid=7, gid=7)
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_snapshot_cow(tmp_path):
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "src")
+        f = await c.create(d.inode, "data.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(0, 3 * 65536 + 7).tobytes()
+        await c.write_file(f.inode, payload)
+
+        snap = await c.snapshot(d.inode, 1, "snap")
+        # snapshot shares chunks: still only 1 physical chunk
+        assert len(cluster.master.meta.registry.chunks) == 1
+        chunk = next(iter(cluster.master.meta.registry.chunks.values()))
+        assert chunk.refcount == 2
+
+        sf = await c.lookup(snap.inode, "data.bin")
+        assert (await c.read_file(sf.inode)) == payload
+
+        # writing to the ORIGINAL triggers COW; snapshot keeps old bytes
+        await c.pwrite(f.inode, 0, b"MUTATED!")
+        assert len(cluster.master.meta.registry.chunks) == 2
+        assert (await c.read_file(sf.inode)) == payload
+        got = await c.read_file(f.inode)
+        assert got[:8] == b"MUTATED!" and got[8:] == payload[8:]
+
+        # deleting the original keeps the snapshot readable
+        await c.unlink(d.inode, "data.bin")
+        assert (await c.read_file(sf.inode)) == payload
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_trash_restore(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "precious.txt")
+        await c.write_file(f.inode, b"do not lose me")
+        await c.unlink(1, "precious.txt")
+        with pytest.raises(st.StatusError):
+            await c.lookup(1, "precious.txt")
+        trash = await c.trash_list()
+        assert len(trash) == 1 and trash[0]["name"] == "precious.txt"
+        await c.undelete(trash[0]["inode"])
+        back = await c.lookup(1, "precious.txt")
+        assert (await c.read_file(back.inode)) == b"do not lose me"
+        assert await c.trash_list() == []
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_flock_and_posix_locks(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c1 = await cluster.client()
+        c2 = await cluster.client()
+        f = await c1.create(1, "locked.bin")
+
+        assert await c1.flock(f.inode, LOCK_EXCLUSIVE, token=1)
+        assert not await c2.flock(f.inode, LOCK_EXCLUSIVE, token=1)
+        assert not await c2.test_lock(f.inode, 0, 0, LOCK_EXCLUSIVE)
+
+        # blocking wait: grant arrives when c1 unlocks
+        waiter = asyncio.ensure_future(
+            c2.flock(f.inode, LOCK_EXCLUSIVE, token=1, wait=True, timeout=5)
+        )
+        await asyncio.sleep(0.1)
+        assert not waiter.done()
+        assert await c1.flock(f.inode, LOCK_UNLOCK, token=1)
+        assert await asyncio.wait_for(waiter, 5) is True
+
+        # posix ranges: disjoint ranges from different sessions coexist
+        assert await c1.posix_lock(f.inode, 0, 100, LOCK_EXCLUSIVE, token=2)
+        assert await c2.posix_lock(f.inode, 100, 200, LOCK_EXCLUSIVE, token=2)
+        assert not await c1.posix_lock(f.inode, 150, 160, LOCK_EXCLUSIVE, token=3)
+
+        # session death releases locks
+        await c2.close()
+        await asyncio.sleep(0.2)
+        assert await c1.posix_lock(f.inode, 150, 160, LOCK_EXCLUSIVE, token=3)
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_subtree_stats_dirinfo(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "top")
+        sub = await c.mkdir(d.inode, "sub")
+        f1 = await c.create(d.inode, "a")
+        f2 = await c.create(sub.inode, "b")
+        await c.write_file(f1.inode, b"x" * 1000)
+        await c.write_file(f2.inode, b"y" * 500)
+        node = cluster.master.meta.fs.node(d.inode)
+        assert node.stat_inodes == 4  # top, sub, a, b
+        assert node.stat_bytes == 1500
+        # rename out: stats follow
+        await c.rename(sub.inode, "b", 1, "b_moved")
+        node = cluster.master.meta.fs.node(d.inode)
+        assert node.stat_inodes == 3 and node.stat_bytes == 1000
+    finally:
+        await cluster.stop()

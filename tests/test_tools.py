@@ -102,3 +102,47 @@ async def test_admin_cli(tmp_path, capsys):
         assert await admin_cli._amain([master, "promote-shadow"]) == 1
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_cli_snapshot_xattr_quota_trash(tmp_path, capsys):
+    cluster = Cluster(tmp_path, n_cs=5)
+    await cluster.start()
+    master = f"127.0.0.1:{cluster.master.port}"
+
+    async def run(*argv):
+        return await cli._amain(["--master", master, *argv])
+
+    try:
+        local = tmp_path / "p.bin"
+        local.write_bytes(b"snapshot me")
+        assert await run("put", str(local), "/orig.bin") == 0
+        assert await run("snapshot", "/orig.bin", "/snap.bin") == 0
+        capsys.readouterr()
+        assert await run("cat", "/snap.bin") == 0
+        assert capsys.readouterr().out.endswith("snapshot me")
+
+        assert await run("setxattr", "/orig.bin", "user.k", "v1") == 0
+        capsys.readouterr()
+        assert await run("getxattr", "/orig.bin", "user.k") == 0
+        assert "v1" in capsys.readouterr().out
+        assert await run("listxattr", "/orig.bin") == 0
+        assert "user.k" in capsys.readouterr().out
+
+        assert await run("quota-set", "user", "0", "--hard-bytes", "1000000") == 0
+        capsys.readouterr()
+        assert await run("quota-rep") == 0
+        assert "user" in capsys.readouterr().out
+
+        assert await run("rm", "/orig.bin") == 0
+        capsys.readouterr()
+        assert await run("trash-list") == 0
+        out = capsys.readouterr().out
+        assert "orig.bin" in out
+        inode = int(out.split()[1])
+        assert await run("undelete", str(inode)) == 0
+        capsys.readouterr()
+        assert await run("cat", "/orig.bin") == 0
+        assert capsys.readouterr().out.endswith("snapshot me")
+    finally:
+        await cluster.stop()
