@@ -100,6 +100,12 @@ class ChunkServer(Daemon):
         self.wave_timeout = wave_timeout
         self.heartbeat_interval = heartbeat_interval
         self.log = logging.getLogger("chunkserver")
+        # replication bandwidth cap (bytes/s, 0 = unlimited) — tweakable
+        # at runtime (replication_bandwidth_limiter analog)
+        from lizardfs_tpu.runtime.limiter import TokenBucket
+
+        self._repl_bps = self.tweaks.register("replication_bps", 0)
+        self._repl_bucket = TokenBucket(0.0)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -324,6 +330,10 @@ class ChunkServer(Daemon):
                 for p in range(slice_type.expected_parts)
             }
             plan = planner.build_plan([target.part], 0, nblocks, part_sizes)
+        nbytes_needed = sum(op.request_size for op in plan.read_operations if op.wave == 0)
+        self._repl_bucket.rate = float(self._repl_bps.value)
+        self._repl_bucket.burst = max(self._repl_bucket.rate, 1.0)
+        await self._repl_bucket.acquire(nbytes_needed)
         data = await read_executor.execute_plan(
             plan,
             msg.chunk_id,
@@ -331,6 +341,8 @@ class ChunkServer(Daemon):
             locations,
             wave_timeout=self.wave_timeout,
         )
+        self.metrics.counter("replications").inc()
+        self.metrics.counter("replication_bytes").inc(float(len(data)))
 
         def write_part():
             if self.store.get(msg.chunk_id, msg.part_id) is None:
@@ -361,7 +373,9 @@ class ChunkServer(Daemon):
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
-                if isinstance(msg, m.CltocsRead):
+                if isinstance(msg, (m.AdminInfo, m.AdminCommand)):
+                    await self._serve_admin(writer, msg)
+                elif isinstance(msg, m.CltocsRead):
                     await self._serve_read(writer, msg)
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
@@ -390,6 +404,28 @@ class ChunkServer(Daemon):
             for session in sessions.values():
                 await session.close()
 
+    async def _serve_admin(self, writer, msg) -> None:
+        import json
+
+        if isinstance(msg, m.AdminInfo):
+            total, used = self.store.space()
+            await framing.send_message(
+                writer,
+                m.AdminInfoReply(
+                    req_id=msg.req_id, status=st.OK,
+                    json=json.dumps({
+                        "cs_id": self.cs_id, "label": self.label,
+                        "parts": len(self.store.all_parts()),
+                        "total_space": total, "used_space": used,
+                    }),
+                ),
+            )
+            return
+        reply = self.handle_admin_basics(msg)
+        if reply is None:
+            reply = m.AdminReply(req_id=msg.req_id, status=st.EINVAL, json="{}")
+        await framing.send_message(writer, reply)
+
     async def _serve_read(self, writer, msg: m.CltocsRead) -> None:
         try:
             pieces = await asyncio.to_thread(
@@ -409,6 +445,7 @@ class ChunkServer(Daemon):
             )
             return
         for off, data, crc in pieces:
+            self.metrics.counter("bytes_read").inc(float(len(data)))
             await framing.send_message(
                 writer,
                 m.CstoclReadData(
@@ -553,6 +590,7 @@ class ChunkServer(Daemon):
             pass
 
     def _local_write(self, session: _WriteSession, msg: m.CltocsWriteData) -> None:
+        self.metrics.counter("bytes_written").inc(float(len(msg.data)))
         self.store.write(
             msg.chunk_id,
             session.version,

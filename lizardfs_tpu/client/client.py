@@ -60,6 +60,17 @@ class Client:
         self._info = "pyclient"
         self.cache = BlockCache()
         self._readahead: dict[int, ReadaheadAdviser] = {}
+        # operation log ring + counters (.oplog / .stats analog)
+        from collections import deque
+
+        self.oplog: deque = deque(maxlen=1024)
+        self.op_counters: dict[str, int] = {}
+
+    def _record(self, op: str, **kw) -> None:
+        import time as _time
+
+        self.oplog.append((_time.time(), op, kw))
+        self.op_counters[op] = self.op_counters.get(op, 0) + 1
 
     # --- session -----------------------------------------------------------------
 
@@ -82,6 +93,7 @@ class Client:
     async def _call(self, msg_cls, **fields):
         """Master RPC with one transparent reconnect+retry on a lost or
         demoted master (failover support)."""
+        self._record(msg_cls.__name__)
         try:
             return await self.master.call_ok(msg_cls, **fields)
         except (ConnectionError, asyncio.TimeoutError):

@@ -155,6 +155,8 @@ class MasterServer(Daemon):
 
     def commit(self, op: dict) -> int:
         """Apply + changelog + broadcast to shadows. The one write path."""
+        self.metrics.counter("metadata_ops").inc()
+        self.metrics.counter(f"op.{op['op']}").inc()
         self.meta.apply(op)
         version = self.changelog.append(op)
         if self.shadow_writers:
@@ -888,6 +890,12 @@ class MasterServer(Daemon):
     async def _health_tick(self) -> None:
         if not self.is_active:
             return
+        self.metrics.gauge("chunks").set(len(self.meta.registry.chunks))
+        self.metrics.gauge("endangered_queue").set(
+            len(self.meta.registry.endangered)
+        )
+        self.metrics.gauge("chunkservers_connected").set(len(self.cs_links))
+        self.metrics.gauge("inodes").set(len(self.meta.fs.nodes))
         # released chunks: delete their on-disk parts
         drained = self.meta.registry.pending_deletes[:16]
         del self.meta.registry.pending_deletes[:16]
@@ -1114,6 +1122,9 @@ class MasterServer(Daemon):
             await framing.send_message(writer, reply)
 
     async def _admin_command(self, msg: m.AdminCommand) -> m.AdminReply:
+        basic = self.handle_admin_basics(msg)
+        if basic is not None:
+            return basic
         if msg.command == "save-metadata":
             await self._dump_image()
             return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")

@@ -13,6 +13,9 @@ import logging
 import signal
 import sys
 
+from lizardfs_tpu.runtime.metrics import Metrics
+from lizardfs_tpu.runtime.tweaks import Tweaks
+
 
 def setup_logging(name: str, level: str = "INFO") -> logging.Logger:
     logging.basicConfig(
@@ -37,6 +40,48 @@ class Daemon:
         self._tasks: set[asyncio.Task] = set()
         self._conn_writers: set[asyncio.StreamWriter] = set()
         self._stopping = asyncio.Event()
+        self.metrics = Metrics()
+        self.tweaks = Tweaks()
+        self.add_timer(1.0, self._sample_metrics)
+
+    async def _sample_metrics(self) -> None:
+        self.metrics.sample_all()
+
+    def handle_admin_basics(self, msg) -> object | None:
+        """Shared admin commands every daemon answers (metrics, tweaks).
+        Returns a reply message or None if the command is not handled."""
+        import json
+
+        from lizardfs_tpu.proto import messages as m
+        from lizardfs_tpu.proto import status as st
+
+        if getattr(msg, "command", None) == "metrics":
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+            except ValueError:
+                payload = {}
+            resolution = payload.get("resolution", "sec")
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self.metrics.to_dict(resolution)),
+            )
+        if getattr(msg, "command", None) == "tweaks":
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self.tweaks.to_dict()),
+            )
+        if getattr(msg, "command", None) == "tweaks-set":
+            try:
+                payload = json.loads(msg.json)
+                ok = self.tweaks.set(str(payload["name"]), str(payload["value"]))
+            except (ValueError, KeyError):
+                ok = False
+            return m.AdminReply(
+                req_id=msg.req_id,
+                status=st.OK if ok else st.EINVAL,
+                json=json.dumps(self.tweaks.to_dict()),
+            )
+        return None
 
     # --- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,48 @@
+"""Token-bucket rate limiting for replication / IO bandwidth.
+
+Reference: src/common/token_bucket.h (client QoS smoothing) and
+src/chunkserver/replication_bandwidth_limiter.cc (replication cap).
+Async: ``acquire`` sleeps until enough tokens accumulate; a rate of 0
+means unlimited.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float | None = None):
+        """rate: tokens (bytes) per second; burst: bucket size."""
+        self.rate = rate
+        self.burst = burst if burst is not None else max(rate, 1.0)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+
+    def try_acquire(self, n: float) -> bool:
+        if self.rate <= 0:
+            return True
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    async def acquire(self, n: float) -> None:
+        """Debt model: requests larger than the burst still pace at
+        ``rate`` instead of deadlocking — tokens go negative and the
+        caller sleeps the debt off."""
+        if self.rate <= 0:
+            return
+        self._refill()
+        self._tokens -= n
+        if self._tokens < 0:
+            await asyncio.sleep(-self._tokens / self.rate)

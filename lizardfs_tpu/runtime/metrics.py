@@ -1,0 +1,82 @@
+"""In-process time-series metrics — the charts subsystem, modernized.
+
+The reference keeps RRD-like fixed-range in-memory series per daemon and
+renders them to GIF/CSV over the admin protocol (reference:
+src/common/charts.cc, chartsdata.cc registrations). Same data model
+here — counters and gauges sampled into fixed-size rings at three
+resolutions (seconds/minutes/hours) — exported as JSON over the admin
+link instead of server-rendered images.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+
+RESOLUTIONS = (("sec", 1.0, 120), ("min", 60.0, 120), ("hour", 3600.0, 120))
+
+
+class Series:
+    def __init__(self, name: str, kind: str = "counter"):
+        self.name = name
+        self.kind = kind  # counter: rate per tick; gauge: last value
+        self.total = 0.0
+        self.value = 0.0  # gauges
+        self._rings = {
+            rname: deque(maxlen=size) for rname, _, size in RESOLUTIONS
+        }
+        self._last_total = {rname: 0.0 for rname, _, _ in RESOLUTIONS}
+        self._last_ts = {rname: 0.0 for rname, _, _ in RESOLUTIONS}
+
+    def inc(self, n: float = 1.0) -> None:
+        self.total += n
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def sample(self, now: float) -> None:
+        for rname, period, _ in RESOLUTIONS:
+            if now - self._last_ts[rname] >= period:
+                if self.kind == "counter":
+                    self._rings[rname].append(self.total - self._last_total[rname])
+                    self._last_total[rname] = self.total
+                else:
+                    self._rings[rname].append(self.value)
+                self._last_ts[rname] = now
+
+    def to_dict(self, resolution: str = "sec") -> dict:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "total": self.total if self.kind == "counter" else self.value,
+            "resolution": resolution,
+            "points": list(self._rings.get(resolution, ())),
+        }
+
+
+class Metrics:
+    def __init__(self):
+        self.series: dict[str, Series] = {}
+
+    def counter(self, name: str) -> Series:
+        s = self.series.get(name)
+        if s is None:
+            s = self.series[name] = Series(name, "counter")
+        return s
+
+    def gauge(self, name: str) -> Series:
+        s = self.series.get(name)
+        if s is None:
+            s = self.series[name] = Series(name, "gauge")
+        return s
+
+    def sample_all(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        for s in self.series.values():
+            s.sample(now)
+
+    def to_dict(self, resolution: str = "sec") -> dict:
+        return {
+            name: s.to_dict(resolution) for name, s in sorted(self.series.items())
+        }

@@ -34,14 +34,16 @@ async def _admin(addr: tuple[str, int], command: str, payload: str = "{}"):
 
 async def _amain(argv) -> int:
     p = argparse.ArgumentParser(prog="lizardfs-admin", description=__doc__)
-    p.add_argument("master", help="master host:port")
+    p.add_argument("master", help="daemon host:port (master or chunkserver)")
     p.add_argument(
         "command",
         choices=[
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
+            "metrics", "tweaks", "tweaks-set",
         ],
     )
+    p.add_argument("extra", nargs="*", help="tweaks-set: NAME VALUE; metrics: [resolution]")
     args = p.parse_args(argv)
     host, _, port = args.master.rpartition(":")
     addr = (host or "127.0.0.1", int(port))
@@ -49,6 +51,16 @@ async def _amain(argv) -> int:
     cmd = args.command
     if cmd in ("list-chunkservers", "list-sessions"):
         reply = await _admin(addr, "info")
+    elif cmd == "metrics":
+        resolution = args.extra[0] if args.extra else "sec"
+        reply = await _admin(addr, cmd, json.dumps({"resolution": resolution}))
+    elif cmd == "tweaks-set":
+        if len(args.extra) != 2:
+            print("usage: tweaks-set NAME VALUE", file=sys.stderr)
+            return 2
+        reply = await _admin(
+            addr, cmd, json.dumps({"name": args.extra[0], "value": args.extra[1]})
+        )
     else:
         reply = await _admin(addr, cmd)
     if getattr(reply, "status", 1) != st.OK:

