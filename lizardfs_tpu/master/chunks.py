@@ -46,6 +46,7 @@ class ChunkInfo:
     version: int
     slice_type: int  # geometry slice type id
     copies: int = 1  # wanted copies per part (std goals: N-copy replication)
+    goal_id: int = 0  # goal that created this chunk (label-aware repair)
     refcount: int = 1  # files referencing this chunk (snapshots share; COW
     #                    on write — chunk_goal_counters analog)
     locked_until: float = 0.0
@@ -130,11 +131,13 @@ class ChunkRegistry:
     # --- chunk lifecycle --------------------------------------------------------
 
     def create_chunk(self, slice_type: int, chunk_id: int | None = None,
-                     version: int = 1, copies: int = 1) -> ChunkInfo:
+                     version: int = 1, copies: int = 1,
+                     goal_id: int = 0) -> ChunkInfo:
         if chunk_id is None:
             chunk_id = self.next_chunk_id
         self.next_chunk_id = max(self.next_chunk_id, chunk_id + 1)
-        chunk = ChunkInfo(chunk_id, version, slice_type, copies=copies)
+        chunk = ChunkInfo(chunk_id, version, slice_type, copies=copies,
+                          goal_id=goal_id)
         self.chunks[chunk_id] = chunk
         return chunk
 
@@ -219,10 +222,19 @@ class ChunkRegistry:
     # --- server selection (get_servers_for_new_chunk analog) ----------------------
 
     def choose_servers(self, count: int, exclude: set[int] = frozenset(),
-                       min_free: int = 0) -> list[ChunkServerInfo]:
-        """Weighted-by-free-space distinct-server choice. Servers may
-        repeat only if there are fewer servers than parts (degenerate
-        test clusters), mirroring wildcard-label behavior."""
+                       min_free: int = 0,
+                       labels: list[str] | None = None) -> list[ChunkServerInfo]:
+        """Label-aware weighted-by-free-space server choice
+        (GetServersForNewChunk::chooseServersForLabels analog,
+        get_servers_for_new_chunk.h:68-100).
+
+        ``labels[i]`` constrains slot i: a concrete label must match the
+        server's label; the wildcard "_" (or None) accepts any server.
+        Distinct servers are preferred; repeats happen only when there
+        are fewer eligible servers than slots. Labeled slots fall back
+        to the wildcard pool if no labeled server exists (degraded but
+        placed beats unplaced, matching the reference's behavior of
+        preferring availability)."""
         candidates = [
             s
             for s in self.connected_servers()
@@ -230,15 +242,36 @@ class ChunkRegistry:
         ]
         if not candidates:
             raise ValueError("no chunkservers available")
-        chosen: list[ChunkServerInfo] = []
-        pool = list(candidates)
-        for _ in range(count):
+        slot_labels = list(labels) if labels else ["_"] * count
+        if len(slot_labels) < count:
+            slot_labels += ["_"] * (count - len(slot_labels))
+        # fill constrained slots first so labeled servers aren't used up
+        # by wildcard slots
+        order = sorted(range(count), key=lambda i: slot_labels[i] == "_")
+        chosen: dict[int, ChunkServerInfo] = {}
+        used: set[int] = set()
+
+        def pick_from(pool: list[ChunkServerInfo]) -> ChunkServerInfo | None:
             if not pool:
-                pool = list(candidates)  # wrap: fewer servers than parts
+                return None
             weights = [max(s.free_space, 1) for s in pool]
-            pick = self._rng.choices(range(len(pool)), weights=weights)[0]
-            chosen.append(pool.pop(pick))
-        return chosen
+            return pool[self._rng.choices(range(len(pool)), weights=weights)[0]]
+
+        for i in order:
+            want = slot_labels[i]
+            labeled = [
+                s for s in candidates
+                if (want == "_" or s.label == want) and s.cs_id not in used
+            ]
+            s = pick_from(labeled)
+            if s is None and want != "_":
+                s = pick_from([c for c in candidates if c.cs_id not in used])
+            if s is None:  # all distinct servers used: allow repeats
+                pool = [c for c in candidates if want == "_" or c.label == want]
+                s = pick_from(pool or candidates)
+            chosen[i] = s
+            used.add(s.cs_id)
+        return [chosen[i] for i in range(count)]
 
     # --- health walk (ChunkWorker coroutine analog) --------------------------------
 

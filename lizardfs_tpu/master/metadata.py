@@ -76,7 +76,7 @@ class MetadataStore:
     def _op_create_chunk(self, op):
         self.registry.create_chunk(
             op["slice_type"], chunk_id=op["chunk_id"], version=op["version"],
-            copies=op.get("copies", 1),
+            copies=op.get("copies", 1), goal_id=op.get("goal_id", 0),
         )
 
     def _op_set_chunk(self, op):
@@ -133,6 +133,7 @@ class MetadataStore:
         self.registry.create_chunk(
             op["slice_type"], chunk_id=op["new_chunk_id"],
             version=op["version"], copies=op.get("copies", 1),
+            goal_id=op.get("goal_id", 0),
         )
         if old is not None:
             old.refcount -= 1
@@ -148,7 +149,7 @@ class MetadataStore:
                 "table": [
                     {"id": c.chunk_id, "version": c.version,
                      "slice_type": c.slice_type, "copies": c.copies,
-                     "refcount": c.refcount}
+                     "refcount": c.refcount, "goal_id": c.goal_id}
                     for c in self.registry.chunks.values()
                 ],
             },
@@ -163,7 +164,7 @@ class MetadataStore:
         for row in ch["table"]:
             c = self.registry.create_chunk(
                 row["slice_type"], chunk_id=row["id"], version=row["version"],
-                copies=row.get("copies", 1),
+                copies=row.get("copies", 1), goal_id=row.get("goal_id", 0),
             )
             c.refcount = row.get("refcount", 1)
         self.registry.next_chunk_id = ch["next_chunk_id"]

@@ -689,7 +689,7 @@ class MasterServer(Daemon):
             "op": "cow_chunk", "inode": msg.inode, "chunk_index": msg.chunk_index,
             "old_chunk_id": chunk.chunk_id, "new_chunk_id": new_id,
             "slice_type": chunk.slice_type, "version": version,
-            "copies": chunk.copies,
+            "copies": chunk.copies, "goal_id": chunk.goal_id,
         })
         new_chunk = self.meta.registry.chunk(new_id)
         for cs_id, part in created:
@@ -709,6 +709,25 @@ class MasterServer(Daemon):
             return geometry.SliceType(geometry.STANDARD)
         return goal.slices[0].type
 
+    def _labels_for_goal(
+        self, goal_id: int, t: geometry.SliceType, part_list: list[int]
+    ) -> list[str]:
+        """Per-slot placement labels from the goal definition."""
+        goal = self.goals.get(goal_id)
+        if goal is None or not goal.slices:
+            return ["_"] * len(part_list)
+        s = goal.slices[0]
+        if t.is_standard:
+            out: list[str] = []
+            for label, count in sorted(s.labels_of_part(0).items()):
+                out.extend([label] * count)
+            out = out[: len(part_list)]
+            return out + ["_"] * (len(part_list) - len(out))
+        return [
+            next(iter(s.labels_of_part(p)), "_") if p < s.size else "_"
+            for p in part_list
+        ]
+
     async def _create_new_chunk(self, msg: m.CltomaWriteChunk, node):
         t = self._slice_type_for_goal(node.goal)
         goal = self.goals.get(node.goal)
@@ -717,7 +736,9 @@ class MasterServer(Daemon):
         part_list = [0] * copies if t.is_standard else list(range(t.expected_parts))
         nparts = len(part_list)
         try:
-            servers = self.meta.registry.choose_servers(nparts)
+            servers = self.meta.registry.choose_servers(
+                nparts, labels=self._labels_for_goal(node.goal, t, part_list)
+            )
         except ValueError:
             return m.MatoclWriteChunk(
                 req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
@@ -771,6 +792,7 @@ class MasterServer(Daemon):
         self.commit({
             "op": "create_chunk", "chunk_id": chunk_id,
             "slice_type": int(t), "version": version, "copies": copies,
+            "goal_id": node.goal,
         })
         self.commit({
             "op": "set_chunk", "inode": msg.inode,
@@ -932,8 +954,11 @@ class MasterServer(Daemon):
         try:
             t = geometry.SliceType(chunk.slice_type)
             holders = {cs for cs, _ in chunk.parts}
+            label = self._labels_for_goal(chunk.goal_id, t, [part])[0]
             try:
-                target = self.meta.registry.choose_servers(1, exclude=holders)[0]
+                target = self.meta.registry.choose_servers(
+                    1, exclude=holders, labels=[label]
+                )[0]
             except ValueError:
                 return
             link = self.cs_links.get(target.cs_id)

@@ -1,0 +1,89 @@
+"""Label-aware placement: goals with labels land on matching servers."""
+
+import asyncio
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master.chunks import ChunkRegistry
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.utils import data_generator
+
+
+def test_choose_servers_labels():
+    reg = ChunkRegistry()
+    for i in range(3):
+        reg.register_server(f"s{i}", 9000 + i, "ssd", 10**12, 0)
+    for i in range(3):
+        reg.register_server(f"h{i}", 9100 + i, "hdd", 10**12, 0)
+    picked = reg.choose_servers(4, labels=["ssd", "ssd", "hdd", "_"])
+    assert picked[0].label == "ssd" and picked[1].label == "ssd"
+    assert picked[2].label == "hdd"
+    assert len({s.cs_id for s in picked}) == 4
+    # label with no server falls back to wildcard rather than failing
+    picked = reg.choose_servers(2, labels=["tape", "_"])
+    assert len(picked) == 2
+
+
+@pytest.mark.asyncio
+async def test_labeled_goal_placement_e2e(tmp_path):
+    goals = geometry.default_goals()
+    goals[20] = geometry.parse_goal_line("20 fast : $ec(2,1) { ssd ssd hdd }")[1]
+    goals[21] = geometry.parse_goal_line("21 mixed : mars _")[1]
+    master = MasterServer(str(tmp_path / "m"), goals=goals)
+    await master.start()
+    servers = []
+    for i, label in enumerate(["ssd", "ssd", "hdd", "mars", "_"]):
+        cs = ChunkServer(
+            str(tmp_path / f"cs{i}"),
+            master_addr=("127.0.0.1", master.port), label=label,
+        )
+        await cs.start()
+        servers.append(cs)
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    try:
+        f = await c.create(1, "fast.bin")
+        await c.setgoal(f.inode, 20)
+        await c.write_file(f.inode, data_generator.generate(0, 100_000).tobytes())
+        chunk = next(iter(master.meta.registry.chunks.values()))
+        labels_by_part = {}
+        for cs_id, part in chunk.parts:
+            labels_by_part[part] = master.meta.registry.servers[cs_id].label
+        # ec(2,1): data parts 0,1 on ssd; parity part 2 on hdd
+        assert labels_by_part[0] == "ssd" and labels_by_part[1] == "ssd"
+        assert labels_by_part[2] == "hdd"
+
+        f2 = await c.create(1, "mars.bin")
+        await c.setgoal(f2.inode, 21)
+        await c.write_file(f2.inode, b"x" * 1000)
+        chunk2 = [
+            ch for ch in master.meta.registry.chunks.values()
+            if ch.chunk_id != chunk.chunk_id
+        ][0]
+        labels = sorted(
+            master.meta.registry.servers[cs].label for cs, _ in chunk2.parts
+        )
+        assert "mars" in labels  # one copy pinned to the mars datacenter
+
+        # label-aware repair: kill the hdd server; the parity part cannot
+        # be re-placed on a matching label (no other hdd), so it falls
+        # back to any free server — data stays safe
+        hdd = next(s for s in servers if s.label == "hdd")
+        await hdd.stop()
+        for _ in range(80):
+            await asyncio.sleep(0.1)
+            if not master.meta.registry.evaluate(chunk).missing_parts:
+                break
+        assert not master.meta.registry.evaluate(chunk).missing_parts
+    finally:
+        await c.close()
+        for cs in servers:
+            if cs is not None:
+                try:
+                    await cs.stop()
+                except Exception:
+                    pass
+        await master.stop()
