@@ -65,6 +65,11 @@ class Client:
 
         self.oplog: deque = deque(maxlen=1024)
         self.op_counters: dict[str, int] = {}
+        # serialize concurrent writes per (inode, chunk): read-modify-
+        # write on a shared stripe must not interleave (FUSE is
+        # multithreaded; the reference serializes via its per-inode
+        # write journal, writedata.cc)
+        self._chunk_write_locks: dict[tuple[int, int], asyncio.Lock] = {}
 
     def _record(self, op: str, **kw) -> None:
         import time as _time
@@ -351,6 +356,16 @@ class Client:
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
         old_length: int, new_length: int,
     ) -> None:
+        lock = self._chunk_write_locks.setdefault((inode, ci), asyncio.Lock())
+        async with lock:
+            await self._pwrite_chunk_locked(
+                inode, ci, coff, piece, old_length, new_length
+            )
+
+    async def _pwrite_chunk_locked(
+        self, inode: int, ci: int, coff: int, piece: np.ndarray,
+        old_length: int, new_length: int,
+    ) -> None:
         grant = await self._call(m.CltomaWriteChunk, inode=inode, chunk_index=ci)
         self.cache.invalidate(inode, ci)
         status_code = st.EIO
@@ -370,8 +385,10 @@ class Client:
                     len(piece), part_offset=coff,
                 )
             else:
+                # use the grant's file length, not the caller's snapshot:
+                # concurrent writers may have extended the file since
                 await self._rmw_striped(grant, slice_type, copies, ci, coff,
-                                        piece, old_length)
+                                        piece, grant.file_length)
             status_code = st.OK
         finally:
             await self._call(

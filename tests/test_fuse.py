@@ -1,0 +1,74 @@
+"""Real FUSE mount e2e (skipped when the environment can't mount)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.test_cluster import Cluster
+
+
+@pytest.mark.asyncio
+async def test_fuse_mount_end_to_end(tmp_path):
+    if not os.path.exists("/dev/fuse"):
+        pytest.skip("no /dev/fuse")
+    cluster = Cluster(tmp_path, n_cs=5)
+    await cluster.start()
+    mnt = tmp_path / "mnt"
+    mnt.mkdir()
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lizardfs_tpu.client.fuse_mount",
+         "--master", f"127.0.0.1:{cluster.master.port}", str(mnt)],
+        env=env, stderr=subprocess.PIPE,
+    )
+    try:
+        # every mountpoint syscall must run OFF the event loop: the FUSE
+        # daemon's callbacks are served by the master on this loop, so a
+        # blocking stat here would deadlock the whole stack
+        mounted = False
+        for _ in range(50):
+            await asyncio.sleep(0.2)
+            if await asyncio.to_thread(os.path.ismount, mnt):
+                mounted = True
+                break
+        if not mounted:
+            proc.terminate()
+            err = proc.stderr.read().decode()[:500]
+            pytest.skip(f"mount did not come up (no privileges?): {err}")
+
+        def work():
+            os.mkdir(mnt / "dir")
+            payload = b"hello fuse world\n" * 1000
+            with open(mnt / "dir" / "hello.txt", "wb") as f:
+                f.write(payload)
+            with open(mnt / "dir" / "hello.txt", "rb") as f:
+                assert f.read() == payload
+            os.rename(mnt / "dir" / "hello.txt", mnt / "renamed.txt")
+            assert os.stat(mnt / "renamed.txt").st_size == len(payload)
+            os.symlink("/renamed.txt", mnt / "slink")
+            assert os.readlink(mnt / "slink") == "/renamed.txt"
+            os.setxattr(mnt / "renamed.txt", b"user.k", b"v")
+            assert os.getxattr(mnt / "renamed.txt", b"user.k") == b"v"
+            with open(mnt / "renamed.txt", "r+b") as f:
+                f.seek(5)
+                f.write(b"FUSE!")
+            with open(mnt / "renamed.txt", "rb") as f:
+                assert f.read(17) == b"helloFUSE! world\n"
+            os.truncate(mnt / "renamed.txt", 10)
+            assert os.stat(mnt / "renamed.txt").st_size == 10
+            assert sorted(os.listdir(mnt)) == ["dir", "renamed.txt", "slink"]
+
+        await asyncio.to_thread(work)
+    finally:
+        await asyncio.to_thread(
+            subprocess.run, ["fusermount", "-u", str(mnt)], check=False
+        )
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        await cluster.stop()
