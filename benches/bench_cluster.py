@@ -1,0 +1,111 @@
+"""Cluster throughput benchmark: dd-style write/read speed per goal.
+
+The analog of the reference's Benchmarks tier (reference:
+tests/test_suites/Benchmarks/test_disk_speed.sh — sequential dd per
+goal over a localhost cluster): spins up an in-process master + N
+chunkservers on a temp dir, writes and reads a file per goal, reports
+MB/s.
+
+    python benches/bench_cluster.py [--size-mb 64] [--cs 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import asyncio
+import json
+import tempfile
+import time
+from pathlib import Path
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.utils import data_generator
+
+GOALS = [
+    (1, "goal 1 (1 copy)"),
+    (2, "goal 2 (2 copies)"),
+    (11, "xor3"),
+    (10, "ec(3,2)"),
+    (12, "ec(8,4)"),
+]
+
+
+def bench_goals():
+    goals = geometry.default_goals()
+    goals[10] = geometry.parse_goal_line("10 ec32 : $ec(3,2)")[1]
+    goals[11] = geometry.parse_goal_line("11 x3 : $xor3")[1]
+    goals[12] = geometry.parse_goal_line("12 ec84 : $ec(8,4)")[1]
+    return goals
+
+
+async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
+    tmp = Path(tempfile.mkdtemp(prefix="lizbench"))
+    master = MasterServer(str(tmp / "master"), goals=bench_goals(),
+                          health_interval=5.0)
+    await master.start()
+    servers = []
+    for i in range(n_cs):
+        cs = ChunkServer(str(tmp / f"cs{i}"),
+                         master_addr=("127.0.0.1", master.port))
+        await cs.start()
+        servers.append(cs)
+    client = Client("127.0.0.1", master.port, encoder=None)
+    if encoder != "auto":
+        from lizardfs_tpu.core.encoder import get_encoder
+
+        client.encoder = get_encoder(encoder)
+    await client.connect()
+    payload = data_generator.generate(0, size_mb * 2**20).tobytes()
+    rows = []
+    try:
+        for goal_id, label in GOALS:
+            f = await client.create(1, f"bench_{goal_id}.bin")
+            await client.setgoal(f.inode, goal_id)
+            t0 = time.perf_counter()
+            await client.write_file(f.inode, payload)
+            wt = time.perf_counter() - t0
+            client.cache.invalidate(f.inode)  # cold read
+            t0 = time.perf_counter()
+            back = await client.read_file(f.inode)
+            rt = time.perf_counter() - t0
+            assert back == payload, f"corruption at goal {label}"
+            rows.append({
+                "goal": label,
+                "write_MBps": round(size_mb / wt, 1),
+                "read_MBps": round(size_mb / rt, 1),
+            })
+    finally:
+        await client.close()
+        for cs in servers:
+            await cs.stop()
+        await master.stop()
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size-mb", type=int, default=64)
+    p.add_argument("--cs", type=int, default=12)
+    p.add_argument("--encoder", default="auto",
+                   help="cpu | cpp | tpu | auto (client-side parity backend)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    rows = asyncio.run(run_bench(args.size_mb, args.cs, args.encoder))
+    for r in rows:
+        if args.json:
+            print(json.dumps(r))
+        else:
+            print(f"{r['goal']:>18s}:  write {r['write_MBps']:8.1f} MB/s"
+                  f"   read {r['read_MBps']:8.1f} MB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
