@@ -1,8 +1,9 @@
 """Run a chunkserver: python -m lizardfs_tpu.chunkserver [config]
 
-Config keys (mfschunkserver.cfg analog): DATA_PATH, LISTEN_HOST,
-LISTEN_PORT, MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|tpu|auto),
-LOG_LEVEL.
+Config keys (mfschunkserver.cfg analog): DATA_PATH (comma-separated
+folders allowed), HDD_CFG (file listing one data folder per line,
+mfshdd.cfg analog; overrides DATA_PATH), LISTEN_HOST, LISTEN_PORT,
+MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|cpp|tpu|auto), LOG_LEVEL.
 """
 
 import asyncio
@@ -13,11 +14,29 @@ from lizardfs_tpu.runtime.config import Config
 from lizardfs_tpu.runtime.daemon import setup_logging
 
 
+def _folders(cfg: Config) -> list[str]:
+    hdd_cfg = cfg.get_str("HDD_CFG", "")
+    if hdd_cfg:
+        out = []
+        with open(hdd_cfg) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    out.append(line)
+        if out:
+            return out
+    return [
+        p.strip()
+        for p in cfg.get_str("DATA_PATH", "./cs-data").split(",")
+        if p.strip()
+    ]
+
+
 def main() -> None:
     cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
     setup_logging("chunkserver", cfg.get_str("LOG_LEVEL", "INFO"))
     server = ChunkServer(
-        data_folder=cfg.get_str("DATA_PATH", "./cs-data"),
+        data_folder=_folders(cfg),
         master_addr=(
             cfg.get_str("MASTER_HOST", "127.0.0.1"),
             cfg.get_int("MASTER_PORT", 9420),

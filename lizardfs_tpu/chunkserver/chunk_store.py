@@ -365,3 +365,128 @@ class ChunkStore:
         total = s.f_blocks * s.f_frsize
         free = s.f_bavail * s.f_frsize
         return total, total - free
+
+
+class MultiStore:
+    """Several data folders behind the single-store API (mfshdd.cfg
+    analog: one chunkserver, many disks — reference parses a folder
+    list and scans each, hddspacemgr.cc).
+
+    New parts land on the folder with the most free space; lookups fan
+    out. A folder that fails to scan is marked damaged and its parts are
+    reported so the master re-replicates elsewhere.
+    """
+
+    def __init__(self, folders: list[str]):
+        if not folders:
+            raise ValueError("at least one data folder required")
+        self.stores = [ChunkStore(f) for f in folders]
+        self.damaged_folders: list[str] = []
+
+    # --- scan ---------------------------------------------------------------
+
+    def scan(self) -> list[ChunkFile]:
+        out: list[ChunkFile] = []
+        for store in list(self.stores):
+            try:
+                out.extend(store.scan())
+            except OSError:
+                self.damaged_folders.append(store.folder)
+                self.stores.remove(store)
+        return out
+
+    # --- lookup -------------------------------------------------------------
+
+    def _store_of(self, chunk_id: int, part_id: int) -> ChunkStore | None:
+        for store in self.stores:
+            if store.get(chunk_id, part_id) is not None:
+                return store
+        return None
+
+    def get(self, chunk_id: int, part_id: int) -> ChunkFile | None:
+        store = self._store_of(chunk_id, part_id)
+        return store.get(chunk_id, part_id) if store else None
+
+    def require(self, chunk_id: int, version: int, part_id: int) -> ChunkFile:
+        store = self._store_of(chunk_id, part_id)
+        if store is None:
+            raise ChunkStoreError(st.NO_CHUNK, f"chunk {chunk_id:016X}:{part_id}")
+        return store.require(chunk_id, version, part_id)
+
+    def all_parts(self) -> list[ChunkFile]:
+        out: list[ChunkFile] = []
+        for store in self.stores:
+            out.extend(store.all_parts())
+        return out
+
+    # --- placement ----------------------------------------------------------
+
+    def _emptiest(self) -> ChunkStore:
+        def free(s: ChunkStore) -> int:
+            total, used = s.space()
+            return total - used
+
+        return max(self.stores, key=free)
+
+    def create(self, chunk_id: int, version: int, part_id: int) -> ChunkFile:
+        if self._store_of(chunk_id, part_id) is not None:
+            raise ChunkStoreError(st.EEXIST, f"chunk {chunk_id:016X}:{part_id}")
+        return self._emptiest().create(chunk_id, version, part_id)
+
+    def duplicate(self, src_chunk_id, src_version, part_id, new_chunk_id,
+                  new_version) -> ChunkFile:
+        store = self._store_of(src_chunk_id, part_id)
+        if store is None:
+            raise ChunkStoreError(st.NO_CHUNK, f"chunk {src_chunk_id:016X}")
+        return store.duplicate(
+            src_chunk_id, src_version, part_id, new_chunk_id, new_version
+        )
+
+    # --- delegated ops ------------------------------------------------------
+
+    def _delegate(self, name, chunk_id, part_id, *args):
+        store = self._store_of(chunk_id, part_id)
+        if store is None:
+            raise ChunkStoreError(st.NO_CHUNK, f"chunk {chunk_id:016X}:{part_id}")
+        return getattr(store, name)(*args)
+
+    def delete(self, chunk_id, version, part_id):
+        return self._delegate("delete", chunk_id, part_id, chunk_id, version, part_id)
+
+    def set_version(self, chunk_id, old_version, new_version, part_id):
+        return self._delegate(
+            "set_version", chunk_id, part_id, chunk_id, old_version,
+            new_version, part_id,
+        )
+
+    def read(self, chunk_id, version, part_id, offset, size):
+        return self._delegate(
+            "read", chunk_id, part_id, chunk_id, version, part_id, offset, size
+        )
+
+    def write(self, chunk_id, version, part_id, block, offset_in_block, data,
+              data_crc):
+        return self._delegate(
+            "write", chunk_id, part_id, chunk_id, version, part_id, block,
+            offset_in_block, data, data_crc,
+        )
+
+    def truncate_part(self, chunk_id, version, part_id, part_length):
+        return self._delegate(
+            "truncate_part", chunk_id, part_id, chunk_id, version, part_id,
+            part_length,
+        )
+
+    def test_part(self, cf: ChunkFile) -> bool:
+        for store in self.stores:
+            if store.get(cf.chunk_id, cf.part_id) is cf:
+                return store.test_part(cf)
+        return ChunkStore.test_part(self.stores[0], cf)
+
+    def space(self) -> tuple[int, int]:
+        total = used = 0
+        for store in self.stores:
+            t, u = store.space()
+            total += t
+            used += u
+        return total, used

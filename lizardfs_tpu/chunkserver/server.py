@@ -31,6 +31,7 @@ import numpy as np
 from lizardfs_tpu.chunkserver.chunk_store import (
     ChunkStore,
     ChunkStoreError,
+    MultiStore,
 )
 from lizardfs_tpu.constants import MFSBLOCKSIZE
 from lizardfs_tpu.core import geometry, plans
@@ -76,7 +77,7 @@ class ChunkServer(Daemon):
 
     def __init__(
         self,
-        data_folder: str,
+        data_folder: str | list[str],
         master_addr: tuple[str, int] | list[tuple[str, int]] | None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -86,7 +87,8 @@ class ChunkServer(Daemon):
         heartbeat_interval: float = 5.0,
     ):
         super().__init__(host, port)
-        self.store = ChunkStore(data_folder)
+        folders = [data_folder] if isinstance(data_folder, str) else list(data_folder)
+        self.store = MultiStore(folders)
         # one or more master addresses (active + shadows); registration
         # cycles until the active master accepts
         if isinstance(master_addr, tuple):
@@ -111,6 +113,8 @@ class ChunkServer(Daemon):
 
     async def setup(self) -> None:
         await asyncio.to_thread(self.store.scan)
+        for folder in self.store.damaged_folders:
+            self.log.warning("data folder %s is damaged; skipping", folder)
         self.add_timer(self.heartbeat_interval, self._heartbeat)
         self.add_timer(60.0, self._test_chunks)
 

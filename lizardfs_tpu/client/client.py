@@ -79,14 +79,16 @@ class Client:
 
     # --- session -----------------------------------------------------------------
 
-    async def connect(self, info: str = "pyclient") -> None:
+    async def connect(self, info: str = "pyclient", password: str = "") -> None:
         self._info = info
+        self._password = password
         last: Exception | None = None
         for addr in self.master_addrs:
             try:
                 conn = await RpcConnection.connect(*addr)
                 reply = await conn.call_ok(
-                    m.CltomaRegister, session_id=self.session_id, info=info
+                    m.CltomaRegister, session_id=self.session_id, info=info,
+                    password=password,
                 )
                 self.master = conn
                 self.session_id = reply.session_id
@@ -102,7 +104,7 @@ class Client:
         try:
             return await self.master.call_ok(msg_cls, **fields)
         except (ConnectionError, asyncio.TimeoutError):
-            await self.connect(self._info)
+            await self.connect(self._info, getattr(self, "_password", ""))
             return await self.master.call_ok(msg_cls, **fields)
 
     async def close(self) -> None:
@@ -650,7 +652,8 @@ class Client:
                 return np.zeros(size, dtype=np.uint8)  # hole
             try:
                 data = await self._read_located(
-                    loc, chunk_index, aligned_off, read_size, file_length
+                    loc, chunk_index, aligned_off, read_size, file_length,
+                    attempt=attempt,
                 )
             except (ReadError, ConnectionError, OSError) as e:
                 last_error = e
@@ -666,7 +669,8 @@ class Client:
         raise st.StatusError(st.EIO, f"read failed after retries: {last_error}")
 
     async def _read_located(
-        self, loc, chunk_index: int, off: int, size: int, file_length: int
+        self, loc, chunk_index: int, off: int, size: int, file_length: int,
+        attempt: int = 0,
     ) -> np.ndarray:
         import random
 
@@ -681,9 +685,12 @@ class Client:
             )
         if slice_type is None:
             raise ReadError("no locations for chunk")
-        # one location per part; copy choice is randomized so the retry
-        # loop naturally rotates off a dead replica
-        by_part = {p: random.choice(locs) for p, locs in copies.items()}
+        # first attempt: the master's topology-preferred (closest) copy;
+        # retries randomize so a dead replica gets rotated off
+        by_part = {
+            p: (locs[0] if attempt == 0 else random.choice(locs))
+            for p, locs in copies.items()
+        }
         chunk_len = min(
             max(file_length - chunk_index * MFSCHUNKSIZE, 0), MFSCHUNKSIZE
         )

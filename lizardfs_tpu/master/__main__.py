@@ -30,6 +30,19 @@ async def _run(cfg: Config) -> None:
             goals = geometry.load_goal_config(f.read())
     personality = cfg.get_str("PERSONALITY", "master")
     active = cfg.get_str("ACTIVE_MASTER", "")
+    exports = topology = None
+    exports_path = cfg.get_str("EXPORTS_CFG", "")
+    if exports_path:
+        from lizardfs_tpu.master.exports import Exports
+
+        with open(exports_path) as f:
+            exports = Exports.load(f.read())
+    topology_path = cfg.get_str("TOPOLOGY_CFG", "")
+    if topology_path:
+        from lizardfs_tpu.master.exports import Topology
+
+        with open(topology_path) as f:
+            topology = Topology.load(f.read())
     server = MasterServer(
         data_dir=cfg.get_str("DATA_PATH", "./master-data"),
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
@@ -39,6 +52,8 @@ async def _run(cfg: Config) -> None:
         image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0),
         personality=personality,
         active_addr=_hostport(active) if active else None,
+        exports=exports,
+        topology=topology,
     )
     controller = None
     if cfg.get_str("ELECTION_ID", ""):

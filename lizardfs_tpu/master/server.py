@@ -92,6 +92,8 @@ class MasterServer(Daemon):
         image_interval: float = 300.0,
         personality: str = "master",
         active_addr: tuple[str, int] | None = None,
+        exports=None,
+        topology=None,
     ):
         super().__init__(host, port)
         self.data_dir = data_dir
@@ -104,6 +106,10 @@ class MasterServer(Daemon):
         self.next_session = 1
         self.locks = LockManager()
         self._session_writers: dict[int, asyncio.StreamWriter] = {}
+        from lizardfs_tpu.master.exports import Exports, Topology
+
+        self.exports = exports if exports is not None else Exports()
+        self.topology = topology if topology is not None else Topology()
         self.health_interval = health_interval
         self.image_interval = image_interval
         self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
@@ -183,7 +189,9 @@ class MasterServer(Daemon):
         if not self.is_active:
             return
         now = int(time.time())
-        expired = [i for i, (_, ts) in self.meta.fs.trash.items() if ts <= now]
+        expired = [
+            i for i, entry in self.meta.fs.trash.items() if entry[1] <= now
+        ]
         for inode in expired:
             self.commit({"op": "purge_trash", "inode": inode})
 
@@ -224,10 +232,39 @@ class MasterServer(Daemon):
                 ),
             )
             return
+        peer = writer.get_extra_info("peername") or ("127.0.0.1", 0)
+        rule = self.exports.match(peer[0], getattr(first, "password", ""))
+        if rule is None:
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.EACCES, session_id=0
+                ),
+            )
+            return
+        root_inode = fsmod.ROOT_INODE
+        if rule.path not in ("/", ""):
+            try:
+                node = self.meta.fs.node(fsmod.ROOT_INODE)
+                for comp in rule.path.strip("/").split("/"):
+                    node = self.meta.fs.lookup(node.inode, comp)
+                root_inode = node.inode
+            except fsmod.FsError:
+                await framing.send_message(
+                    writer,
+                    m.MatoclRegister(
+                        req_id=first.req_id, status=st.ENOENT, session_id=0
+                    ),
+                )
+                return
         session_id = first.session_id or self.next_session
         if first.session_id == 0:
             self.next_session += 1
-        self.sessions[session_id] = {"info": first.info, "connected": True}
+        self.sessions[session_id] = {
+            "info": first.info, "connected": True, "ip": peer[0],
+            "readonly": rule.readonly, "maproot": rule.maproot,
+            "root": root_inode,
+        }
         self._session_writers[session_id] = writer
         await framing.send_message(
             writer,
@@ -327,9 +364,37 @@ class MasterServer(Daemon):
                 except (ConnectionError, RuntimeError):
                     pass
 
+    _MUTATING = (
+        "CltomaMkdir", "CltomaCreate", "CltomaSymlink", "CltomaLink",
+        "CltomaUnlink", "CltomaRmdir", "CltomaRename", "CltomaSetGoal",
+        "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
+        "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
+        "CltomaSetQuota", "CltomaUndelete",
+    )
+
+    def _apply_session_view(self, msg, session: dict):
+        """Subtree exports + root squash: remap the client's root inode
+        to the exported directory and squash root uids to maproot."""
+        root = session.get("root", fsmod.ROOT_INODE)
+        if root != fsmod.ROOT_INODE:
+            for field in ("parent", "inode", "parent_src", "parent_dst",
+                          "dst_parent", "src_inode"):
+                if getattr(msg, field, None) == fsmod.ROOT_INODE:
+                    setattr(msg, field, root)
+        maproot = session.get("maproot")
+        if maproot is not None:
+            for field in ("uid", "gid"):
+                if getattr(msg, field, None) == 0:
+                    setattr(msg, field, maproot)
+
     async def _handle_client(self, msg, session_id: int = 0):
         fs = self.meta.fs
         now = int(time.time())
+        session = self.sessions.get(session_id, {})
+        if session:
+            if session.get("readonly") and type(msg).__name__ in self._MUTATING:
+                return self._error_reply(msg, st.EROFS)
+            self._apply_session_view(msg, session)
         if isinstance(msg, m.CltomaLookup):
             node = fs.lookup(msg.parent, msg.name)
             return self._attr_reply(msg.req_id, node)
@@ -422,7 +487,7 @@ class MasterServer(Daemon):
                          "length": msg.length, "ts": now})
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaReadChunk):
-            return await self._read_chunk(msg)
+            return await self._read_chunk(msg, session.get("ip"))
         if isinstance(msg, m.CltomaWriteChunk):
             return await self._write_chunk(msg)
         if isinstance(msg, m.CltomaWriteChunkEnd):
@@ -539,22 +604,30 @@ class MasterServer(Daemon):
     def _attr_reply(self, req_id: int, node) -> m.MatoclAttrReply:
         return m.MatoclAttrReply(req_id=req_id, status=st.OK, attr=_attr_of(node))
 
-    def _locations_of(self, chunk) -> list[m.PartLocation]:
+    def _locations_of(self, chunk, client_ip: str | None = None) -> list[m.PartLocation]:
+        """Part locations, same-rack servers first (topology read
+        locality, topology.h:25 analog)."""
         t = geometry.SliceType(chunk.slice_type)
-        out = []
+        rows = []
         for cs_id, part in sorted(chunk.parts):
             srv = self.meta.registry.servers.get(cs_id)
             if srv is None or not srv.connected:
                 continue
-            out.append(
-                m.PartLocation(
-                    addr=m.Addr(host=srv.host, port=srv.port),
-                    part_id=geometry.ChunkPartType(t, part).id,
-                )
+            dist = (
+                self.topology.distance(client_ip, srv.host)
+                if client_ip else 0
             )
-        return out
+            rows.append((part, dist, srv))
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return [
+            m.PartLocation(
+                addr=m.Addr(host=srv.host, port=srv.port),
+                part_id=geometry.ChunkPartType(t, part).id,
+            )
+            for part, _, srv in rows
+        ]
 
-    async def _read_chunk(self, msg: m.CltomaReadChunk):
+    async def _read_chunk(self, msg: m.CltomaReadChunk, client_ip: str | None = None):
         node = self.meta.fs.file_node(msg.inode)
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
@@ -569,7 +642,7 @@ class MasterServer(Daemon):
         return m.MatoclReadChunk(
             req_id=msg.req_id, status=st.OK, chunk_id=chunk_id,
             version=chunk.version, file_length=node.length,
-            locations=self._locations_of(chunk),
+            locations=self._locations_of(chunk, client_ip),
         )
 
     async def _write_chunk(self, msg: m.CltomaWriteChunk):

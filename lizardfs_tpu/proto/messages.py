@@ -80,7 +80,12 @@ class ChunkPartInfo(Message):
 
 class CltomaRegister(Message):
     MSG_TYPE = 1000
-    FIELDS = (("req_id", "u32"), ("session_id", "u64"), ("info", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("session_id", "u64"),
+        ("info", "str"),
+        ("password", "str"),
+    )
 
 
 class MatoclRegister(Message):

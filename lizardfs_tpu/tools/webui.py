@@ -1,0 +1,184 @@
+"""Web status UI — the CGI monitoring panel, modernized.
+
+The reference ships a Python CGI rendering master state tables + charts
+(reference: src/cgi/mfs.cgi.in). This is the stdlib-only equivalent: a
+small HTTP server that queries the master's admin protocol and serves a
+live HTML dashboard plus raw JSON endpoints.
+
+    python -m lizardfs_tpu.tools.webui --master 127.0.0.1:9420 --port 9425
+
+Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+
+PAGE = """<!doctype html>
+<html><head><title>lizardfs-tpu status</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #111; color: #ddd; }}
+ h1 {{ color: #7fd4a0; }} h2 {{ color: #8ab4f8; margin-top: 1.5em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #444; padding: 4px 10px; text-align: left; }}
+ th {{ background: #222; }}
+ .ok {{ color: #7fd4a0; }} .bad {{ color: #f28b82; }}
+</style></head><body>
+<h1>lizardfs-tpu &mdash; {personality} @ v{version}</h1>
+<h2>cluster</h2>
+<table>
+<tr><th>inodes</th><td>{inodes}</td></tr>
+<tr><th>chunks</th><td>{chunks}</td></tr>
+<tr><th>sessions</th><td>{sessions}</td></tr>
+<tr><th>chunks healthy / endangered / lost</th>
+    <td><span class="ok">{healthy}</span> /
+        <span class="{endangered_cls}">{endangered}</span> /
+        <span class="{lost_cls}">{lost}</span></td></tr>
+</table>
+<h2>chunkservers</h2>
+<table><tr><th>id</th><th>address</th><th>label</th><th>state</th>
+<th>used / total GiB</th></tr>{servers}</table>
+<h2>metadata ops (last 120 s)</h2>
+<pre>{ops}</pre>
+</body></html>
+"""
+
+
+async def _admin(addr, msg):
+    reader, writer = await asyncio.open_connection(*addr)
+    try:
+        await framing.send_message(writer, msg)
+        return await framing.read_message(reader)
+    finally:
+        writer.close()
+
+
+class Dashboard:
+    def __init__(self, master_addr: tuple[str, int]):
+        self.master_addr = master_addr
+        self.loop = asyncio.new_event_loop()
+        threading.Thread(target=self.loop.run_forever, daemon=True).start()
+
+    def _call(self, msg):
+        fut = asyncio.run_coroutine_threadsafe(
+            _admin(self.master_addr, msg), self.loop
+        )
+        return fut.result(10)
+
+    def info(self) -> dict:
+        return json.loads(self._call(m.AdminInfo(req_id=1)).json)
+
+    def health(self) -> dict:
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="chunks-health", json="{}")
+            ).json
+        )
+
+    def metrics(self, resolution: str = "sec") -> dict:
+        return json.loads(
+            self._call(
+                m.AdminCommand(
+                    req_id=1, command="metrics",
+                    json=json.dumps({"resolution": resolution}),
+                )
+            ).json
+        )
+
+    def render(self) -> str:
+        info = self.info()
+        health = self.health()
+        rows = []
+        for s in info.get("chunkservers", []):
+            state = (
+                '<span class="ok">up</span>' if s["connected"]
+                else '<span class="bad">DOWN</span>'
+            )
+            rows.append(
+                f"<tr><td>{s['cs_id']}</td><td>{s['host']}:{s['port']}</td>"
+                f"<td>{s['label']}</td><td>{state}</td>"
+                f"<td>{s['used_space']/2**30:.1f} / {s['total_space']/2**30:.1f}</td></tr>"
+            )
+        metrics = self.metrics()
+        ops_lines = []
+        for name, series in metrics.items():
+            if name.startswith("op.") or name == "metadata_ops":
+                pts = series["points"][-60:]
+                ops_lines.append(
+                    f"{name:<24s} total={series['total']:<10.0f} "
+                    f"last120s={sum(pts):.0f}"
+                )
+        return PAGE.format(
+            personality=info.get("personality", "?"),
+            version=info.get("version", 0),
+            inodes=info.get("inodes", 0),
+            chunks=info.get("chunks", 0),
+            sessions=info.get("sessions", 0),
+            healthy=health.get("healthy", 0),
+            endangered=health.get("endangered", 0),
+            lost=health.get("lost", 0),
+            endangered_cls="bad" if health.get("endangered") else "ok",
+            lost_cls="bad" if health.get("lost") else "ok",
+            servers="".join(rows) or "<tr><td colspan=5>none</td></tr>",
+            ops="\n".join(sorted(ops_lines)) or "(no ops yet)",
+        )
+
+
+def make_handler(dash: Dashboard):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, body: str, ctype: str = "text/html"):
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            try:
+                if self.path == "/api/info":
+                    self._send(json.dumps(dash.info()), "application/json")
+                elif self.path == "/api/health":
+                    self._send(json.dumps(dash.health()), "application/json")
+                elif self.path.startswith("/api/metrics"):
+                    res = self.path.rpartition("=")[2] if "=" in self.path else "sec"
+                    self._send(json.dumps(dash.metrics(res)), "application/json")
+                else:
+                    self._send(dash.render())
+            except Exception as e:  # noqa: BLE001
+                self.send_error(502, f"master unreachable: {e}")
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="lizardfs-webui", description=__doc__)
+    p.add_argument("--master", default="127.0.0.1:9420")
+    p.add_argument("--port", type=int, default=9425)
+    p.add_argument("--host", default="127.0.0.1")
+    args = p.parse_args(argv)
+    host, _, port = args.master.rpartition(":")
+    dash = Dashboard((host or "127.0.0.1", int(port)))
+    server = ThreadingHTTPServer((args.host, args.port), make_handler(dash))
+    print(f"lizardfs-tpu web UI on http://{args.host}:{server.server_port}/")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -246,3 +246,62 @@ async def test_chain_write_and_wave_read(tmp_path):
     finally:
         for cs in servers:
             await cs.stop()
+
+
+def test_multistore_placement_and_ops(tmp_path):
+    from lizardfs_tpu.chunkserver.chunk_store import MultiStore
+    from lizardfs_tpu.ops import crc32 as crc_mod
+
+    ms = MultiStore([str(tmp_path / "d0"), str(tmp_path / "d1")])
+    # create several parts; both folders end up holding some
+    for cid in range(8):
+        ms.create(cid, 1, PART)
+    folders = {cf.path.split("/")[-3] for cf in ms.all_parts()}
+    assert len(ms.all_parts()) == 8
+    # ops route to the owning folder
+    block = data_generator.generate(0, MFSBLOCKSIZE).tobytes()
+    ms.write(3, 1, PART, 0, 0, block, crc_mod.crc32(block))
+    pieces = ms.read(3, 1, PART, 0, MFSBLOCKSIZE)
+    assert pieces[0][1] == block
+    ms.set_version(3, 1, 2, PART)
+    assert ms.get(3, PART).version == 2
+    ms.duplicate(3, 2, PART, 100, 1)
+    assert ms.get(100, PART) is not None
+    ms.delete(3, 2, PART)
+    assert ms.get(3, PART) is None
+    total, used = ms.space()
+    assert total > 0
+    # rescan from cold finds everything
+    ms2 = MultiStore([str(tmp_path / "d0"), str(tmp_path / "d1")])
+    found = ms2.scan()
+    assert len(found) == 8  # 7 remaining + duplicate
+
+
+@pytest.mark.asyncio
+async def test_multidisk_chunkserver_e2e(tmp_path):
+    from tests.test_cluster import make_goals
+    from lizardfs_tpu.master.server import MasterServer
+    from lizardfs_tpu.client.client import Client
+
+    master = MasterServer(str(tmp_path / "m"), goals=make_goals())
+    await master.start()
+    servers = []
+    for i in range(3):
+        cs = ChunkServer(
+            [str(tmp_path / f"cs{i}a"), str(tmp_path / f"cs{i}b")],
+            master_addr=("127.0.0.1", master.port),
+        )
+        await cs.start()
+        servers.append(cs)
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    try:
+        f = await c.create(1, "multi.bin")
+        payload = data_generator.generate(0, 300_000).tobytes()
+        await c.write_file(f.inode, payload)
+        assert (await c.read_file(f.inode)) == payload
+    finally:
+        await c.close()
+        for cs in servers:
+            await cs.stop()
+        await master.stop()

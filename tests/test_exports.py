@@ -1,0 +1,95 @@
+"""Exports access control + topology read locality."""
+
+import pytest
+
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.master.exports import ExportRule, Exports, Topology
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.proto import status as st
+
+from tests.test_cluster import make_goals
+
+
+def test_export_rule_parsing_and_matching():
+    exp = Exports.load(
+        """
+# comment
+*              /      ro
+127.0.0.0/8    /      rw
+10.0.0.5       /data  rw,maproot=99,password=sesame
+"""
+    )
+    assert exp.match("8.8.8.8").readonly is True
+    assert exp.match("127.0.0.1").readonly is False  # more specific wins
+    assert exp.match("10.0.0.5") .path == "/"  # wrong password -> next best
+    r = exp.match("10.0.0.5", "sesame")
+    assert r.path == "/data" and r.maproot == 99
+    with pytest.raises(ValueError):
+        Exports.load("* / wat")
+
+
+def test_topology_distance():
+    topo = Topology.load(
+        """
+10.1.0.0/16  1
+10.2.0.0/16  2
+"""
+    )
+    assert topo.distance("10.1.0.5", "10.1.9.9") == 1  # same rack
+    assert topo.distance("10.1.0.5", "10.2.0.5") == 2
+    assert topo.distance("8.8.8.8", "10.1.0.5") == 2
+    assert topo.distance("10.1.0.5", "10.1.0.5") == 0  # same host
+
+
+@pytest.mark.asyncio
+async def test_readonly_and_subtree_exports(tmp_path):
+    exports = Exports.load(
+        """
+127.0.0.1 /pub ro,password=view
+127.0.0.1 /    rw
+"""
+    )
+    master = MasterServer(
+        str(tmp_path / "m"), goals=make_goals(), exports=exports
+    )
+    await master.start()
+    cs = ChunkServer(str(tmp_path / "cs"), master_addr=("127.0.0.1", master.port))
+    await cs.start()
+    try:
+        # rw session sets up content
+        rw = Client("127.0.0.1", master.port)
+        await rw.connect()
+        pub = await rw.mkdir(1, "pub")
+        f = await rw.create(pub.inode, "readme")
+        await rw.write_file(f.inode, b"public data")
+
+        # password selects the /pub ro export: root remapped + read-only
+        ro = Client("127.0.0.1", master.port)
+        await ro.connect(password="view")
+        got = await ro.lookup(1, "readme")  # 1 == exported /pub
+        assert got.inode == f.inode
+        assert (await ro.read_file(got.inode)) == b"public data"
+        with pytest.raises(st.StatusError) as e:
+            await ro.create(1, "nope")
+        assert e.value.code == st.EROFS
+        await ro.close()
+        await rw.close()
+    finally:
+        await cs.stop()
+        await master.stop()
+
+
+@pytest.mark.asyncio
+async def test_no_matching_export_refused(tmp_path):
+    exports = Exports.load("10.99.0.0/16 / rw\n")  # localhost not covered
+    master = MasterServer(
+        str(tmp_path / "m"), goals=make_goals(), exports=exports
+    )
+    await master.start()
+    try:
+        c = Client("127.0.0.1", master.port)
+        with pytest.raises(ConnectionError):
+            await c.connect()
+    finally:
+        await master.stop()

@@ -146,3 +146,36 @@ async def test_cli_snapshot_xattr_quota_trash(tmp_path, capsys):
         assert capsys.readouterr().out.endswith("snapshot me")
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_webui_endpoints(tmp_path):
+    import threading
+    import urllib.request
+
+    from http.server import ThreadingHTTPServer
+    from lizardfs_tpu.tools.webui import Dashboard, make_handler
+
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    try:
+        dash = Dashboard(("127.0.0.1", cluster.master.port))
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(dash))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_port
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.read().decode()
+
+        html = await asyncio.to_thread(fetch, "/")
+        assert "lizardfs-tpu" in html and "chunkservers" in html
+        info = json.loads(await asyncio.to_thread(fetch, "/api/info"))
+        assert info["personality"] == "master"
+        health = json.loads(await asyncio.to_thread(fetch, "/api/health"))
+        assert set(health) == {"healthy", "endangered", "lost"}
+        httpd.shutdown()
+    finally:
+        await cluster.stop()
