@@ -36,6 +36,28 @@ def make_mesh(devices=None, axis: str = "stripe") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def make_mesh_2d(
+    stripe: int, block: int, devices=None,
+    axes: tuple[str, str] = ("stripe", "block"),
+) -> Mesh:
+    """2-D mesh: stripe-parallel x block-parallel.
+
+    The stripe axis is the tensor-parallel analog (parts of one stripe
+    spread over chips, joined by the parity reduce-scatter); the block
+    axis is the data-parallel analog (disjoint block ranges, no
+    communication at all). On multi-host topologies put the stripe axis
+    within a slice (ICI) and the block axis across hosts (DCN) — the
+    block axis never communicates, so DCN bandwidth is irrelevant.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if stripe * block != len(devices):
+        raise ValueError(
+            f"mesh {stripe}x{block} needs {stripe * block} devices, "
+            f"have {len(devices)}"
+        )
+    return Mesh(np.array(devices).reshape(stripe, block), axes)
+
+
 def sharded_encode_with_crcs(mesh: Mesh, k: int, m: int, block_size: int):
     """Build a jitted wide-stripe encode+CRC step over ``mesh``.
 
@@ -44,10 +66,14 @@ def sharded_encode_with_crcs(mesh: Mesh, k: int, m: int, block_size: int):
     (parity (m, nb, block_size) block-sharded, data_crcs (k, nb),
     parity_crcs (m, nb)). nb and k must be divisible by the mesh size.
     """
-    n_dev = mesh.devices.size
-    axis = mesh.axis_names[0]
-    if k % n_dev:
-        raise ValueError(f"k={k} not divisible by mesh size {n_dev}")
+    stripe_axis = mesh.axis_names[0]
+    n_stripe = mesh.shape[stripe_axis]
+    block_axis = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    n_block = mesh.shape[block_axis] if block_axis else 1
+    n_dev = n_stripe
+    axis = stripe_axis
+    if k % n_stripe:
+        raise ValueError(f"k={k} not divisible by stripe axis {n_stripe}")
 
     def local_step(bigm_local, data_local):
         # data_local: (k/n, N); bigm_local: (8m, 8k/n) column slice
@@ -77,21 +103,34 @@ def sharded_encode_with_crcs(mesh: Mesh, k: int, m: int, block_size: int):
         ).reshape(m, nb_loc)
         return parity_local, dcrc, pcrc
 
+    if block_axis is None:
+        in_specs = (P(None, axis), P(axis, None))
+        out_specs = (P(None, axis, None), P(axis, None), P(None, axis))
+    else:
+        # 2-D: parts over 'stripe', block ranges over 'block' (pure data
+        # parallelism, zero communication on that axis). The scattered
+        # parity's block dim is partitioned by 'block' first, then by
+        # the reduce-scatter within each block group.
+        in_specs = (P(None, stripe_axis), P(stripe_axis, block_axis))
+        out_specs = (
+            P(None, (block_axis, stripe_axis), None),
+            P(stripe_axis, block_axis),
+            P(None, (block_axis, stripe_axis)),
+        )
+
     step = jax.jit(
         jax.shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(P(None, axis), P(axis, None)),
-            out_specs=(P(None, axis, None), P(axis, None), P(None, axis)),
+            local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs
         )
     )
 
     def run(data):
         nb = data.shape[1] // block_size
-        if data.shape[1] % block_size or nb % n_dev:
+        if data.shape[1] % block_size or nb % (n_stripe * n_block):
             raise ValueError(
                 f"data bytes per part must be nb*{block_size} with nb "
-                f"divisible by mesh size {n_dev}; got {data.shape[1]}"
+                f"divisible by mesh extent {n_stripe * n_block}; got "
+                f"{data.shape[1]}"
             )
         bigm = jnp.asarray(jax_ec.encoding_bitmatrix(k, m))
         return step(bigm, data)

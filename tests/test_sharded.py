@@ -31,3 +31,28 @@ def test_sharded_encode_byte_identical(mesh, k, m):
 def test_sharded_rejects_bad_divisibility(mesh):
     with pytest.raises(ValueError):
         sharded_encode_with_crcs(mesh, 12, 4, 512)
+
+
+@pytest.mark.parametrize("stripe,block", [(4, 2), (2, 4), (8, 1)])
+def test_sharded_2d_mesh_byte_identical(stripe, block):
+    from lizardfs_tpu.parallel.sharded import make_mesh_2d
+
+    mesh = make_mesh_2d(stripe, block)
+    k, m, bs = 8, 4, 512
+    nb = 2 * stripe * block
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    run = sharded_encode_with_crcs(mesh, k, m, bs)
+    parity, dcrc, pcrc = run(data)
+    cpu = CpuChunkEncoder()
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(parity).reshape(m, -1), wp)
+    np.testing.assert_array_equal(np.asarray(dcrc), wd)
+    np.testing.assert_array_equal(np.asarray(pcrc), wpc)
+
+
+def test_mesh_2d_validates_device_count():
+    from lizardfs_tpu.parallel.sharded import make_mesh_2d
+
+    with pytest.raises(ValueError):
+        make_mesh_2d(3, 2)
