@@ -18,19 +18,37 @@ from lizardfs_tpu.constants import MFSBLOCKSIZE
 
 
 class BlockCache:
-    """LRU of 64 KiB chunk blocks keyed (inode, chunk_index, block)."""
+    """LRU of 64 KiB chunk blocks keyed (inode, chunk_index, block).
 
-    def __init__(self, max_bytes: int = 64 * 2**20):
+    Entries expire after ``max_age`` seconds: this client only sees its
+    OWN writes, so the age bound limits how stale a read can be when
+    another client mutates the file (the reference's readdata cache uses
+    the same timeout-expiry model).
+    """
+
+    def __init__(self, max_bytes: int = 64 * 2**20, max_age: float = 3.0):
+        import time
+
         self.max_bytes = max_bytes
+        self.max_age = max_age
+        self._now = time.monotonic
         self._used = 0
-        self._entries: OrderedDict[tuple[int, int, int], bytes] = OrderedDict()
+        self._entries: OrderedDict[
+            tuple[int, int, int], tuple[bytes, float]
+        ] = OrderedDict()
         self.hits = 0
         self.misses = 0
 
     def get(self, inode: int, ci: int, block: int) -> bytes | None:
         key = (inode, ci, block)
-        data = self._entries.get(key)
-        if data is None:
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        data, ts = entry
+        if self._now() - ts > self.max_age:
+            self._used -= len(data)
+            del self._entries[key]
             self.misses += 1
             return None
         self._entries.move_to_end(key)
@@ -41,11 +59,11 @@ class BlockCache:
         key = (inode, ci, block)
         old = self._entries.pop(key, None)
         if old is not None:
-            self._used -= len(old)
-        self._entries[key] = data
+            self._used -= len(old[0])
+        self._entries[key] = (data, self._now())
         self._used += len(data)
         while self._used > self.max_bytes and self._entries:
-            _, evicted = self._entries.popitem(last=False)
+            _, (evicted, _) = self._entries.popitem(last=False)
             self._used -= len(evicted)
 
     def invalidate(self, inode: int, ci: int | None = None) -> None:
@@ -55,7 +73,7 @@ class BlockCache:
             if k[0] == inode and (ci is None or k[1] == ci)
         ]
         for k in keys:
-            self._used -= len(self._entries.pop(k))
+            self._used -= len(self._entries.pop(k)[0])
 
 
 class ReadaheadAdviser:

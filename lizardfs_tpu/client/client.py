@@ -70,6 +70,8 @@ class Client:
         # multithreaded; the reference serializes via its per-inode
         # write journal, writedata.cc)
         self._chunk_write_locks: dict[tuple[int, int], asyncio.Lock] = {}
+        # waiting lock requests: (inode, token) -> grant queue
+        self._lock_grants: dict[tuple[int, int], asyncio.Queue] = {}
 
     def _record(self, op: str, **kw) -> None:
         import time as _time
@@ -92,6 +94,7 @@ class Client:
                 )
                 self.master = conn
                 self.session_id = reply.session_id
+                conn.on_push(m.MatoclLockGranted, self._on_lock_granted)
                 return
             except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
                 last = e
@@ -285,15 +288,19 @@ class Client:
         )
         return r.status == st.OK
 
+    async def _on_lock_granted(self, push: m.MatoclLockGranted) -> None:
+        q = self._lock_grants.get((push.inode, push.token))
+        if q is not None:
+            q.put_nowait(True)
+
     async def _lock(self, inode, op, token, start, end, ltype, wait, timeout):
+        key = (inode, token)
         grant_q: asyncio.Queue = asyncio.Queue()
-
-        async def on_grant(push: m.MatoclLockGranted):
-            if push.inode == inode and push.token == token:
-                grant_q.put_nowait(True)
-
         if wait:
-            self.master.on_push(m.MatoclLockGranted, on_grant)
+            # one persistent push handler (installed at connect) fans out
+            # to per-(inode, token) waiters — concurrent waits don't
+            # clobber each other
+            self._lock_grants[key] = grant_q
         try:
             r = await self.master.call(
                 m.CltomaLockOp, op=op, inode=inode, token=token, start=start,
@@ -302,12 +309,21 @@ class Client:
             if r.status == st.OK:
                 return True
             if r.status == st.LOCKED and wait:
-                await asyncio.wait_for(grant_q.get(), timeout)
-                return True
+                try:
+                    await asyncio.wait_for(grant_q.get(), timeout)
+                    return True
+                except asyncio.TimeoutError:
+                    # cancel the queued request master-side so it isn't
+                    # granted to a caller that already gave up
+                    await self.master.call(
+                        m.CltomaLockOp, op=op, inode=inode, token=token,
+                        start=start, end=end, ltype=0, wait=False,
+                    )
+                    return False
             return False
         finally:
             if wait:
-                self.master._push_handlers.pop(m.MatoclLockGranted, None)
+                self._lock_grants.pop(key, None)
 
     # --- write path -------------------------------------------------------------------
 

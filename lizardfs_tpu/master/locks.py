@@ -99,6 +99,13 @@ class FileLocks:
         caller maps to LOCKED)."""
         if ltype == LOCK_UNLOCK:
             self._remove_owner_range(owner, start, end)
+            # an unlock also cancels this owner's queued requests in the
+            # range (a waiter that gave up sends unlock to abort cleanly)
+            self.pending = [
+                p for p in self.pending
+                if not (p.owner == owner and p.start < (end or MAX_OFFSET)
+                        and start < p.end)
+            ]
             return True
         conflict = self.test(owner, start, end, ltype)
         if conflict is not None:

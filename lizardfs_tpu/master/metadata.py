@@ -45,13 +45,37 @@ class MetadataStore:
                     self.registry.release_chunk(cid)
 
     def _op_rmdir(self, op):
+        parent = self.fs.dir_node(op["parent"])
+        child = parent.children.get(op["name"])
+        node = self.fs.nodes.get(child) if child else None
         self.fs.apply_rmdir(op["parent"], op["name"], op["ts"])
+        if node is not None:
+            self.quotas.charge(node.uid, node.gid, -1, 0)
 
     def _op_rename(self, op):
+        # snapshot any destination entry that the rename will overwrite:
+        # if it leaves the tree entirely (no trash), its chunk references
+        # and quota charges must be released here — the fs layer knows
+        # nothing about the registry or quotas
+        pre = None
+        pd = self.fs.nodes.get(op["parent_dst"])
+        if pd is not None and pd.ftype == 2:
+            existing = pd.children.get(op["name_dst"])
+            if existing is not None:
+                ex = self.fs.nodes.get(existing)
+                if ex is not None:
+                    pre = (ex.inode, ex.uid, ex.gid, ex.length,
+                           list(ex.chunks), ex.ftype)
         self.fs.apply_rename(
             op["parent_src"], op["name_src"], op["parent_dst"], op["name_dst"],
             op["ts"],
         )
+        if pre is not None and pre[0] not in self.fs.nodes:
+            _, uid, gid, length, chunks, ftype = pre
+            self.quotas.charge(uid, gid, -1, -length if ftype == 1 else 0)
+            for cid in chunks:
+                if cid:
+                    self.registry.release_chunk(cid)
 
     def _op_link(self, op):
         self.fs.apply_link(op["inode"], op["parent"], op["name"], op["ts"])

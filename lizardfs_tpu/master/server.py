@@ -286,11 +286,15 @@ class MasterServer(Daemon):
                 if reply is not None:
                     await framing.send_message(writer, reply)
         finally:
-            self.sessions.get(session_id, {})["connected"] = False
-            self._session_writers.pop(session_id, None)
-            # a dying session releases its locks; queued waiters may wake
-            for inode in self.locks.release_session(session_id):
-                self._grant_pending_locks(inode)
+            # a reconnected client may have superseded this connection
+            # under the same session id — only the CURRENT connection may
+            # tear the session down (otherwise the stale loop would
+            # release locks the reconnected client still holds)
+            if self._session_writers.get(session_id) is writer:
+                self.sessions.get(session_id, {})["connected"] = False
+                self._session_writers.pop(session_id, None)
+                for inode in self.locks.release_session(session_id):
+                    self._grant_pending_locks(inode)
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -372,20 +376,52 @@ class MasterServer(Daemon):
         "CltomaSetQuota", "CltomaUndelete",
     )
 
-    def _apply_session_view(self, msg, session: dict):
+    _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
+                     "dst_parent", "src_inode")
+
+    def _in_subtree(self, inode: int, root: int) -> bool:
+        """Is ``inode`` reachable under ``root``? Walks all parent
+        chains (hardlinks may have several)."""
+        if root == fsmod.ROOT_INODE or inode == root:
+            return True
+        seen: set[int] = set()
+        frontier = [inode]
+        for _ in range(4096):
+            if not frontier:
+                return False
+            nxt: list[int] = []
+            for i in frontier:
+                if i == root:
+                    return True
+                node = self.meta.fs.nodes.get(i)
+                if node is None:
+                    continue
+                for p in node.parents:
+                    if p not in seen:
+                        seen.add(p)
+                        nxt.append(p)
+            frontier = nxt
+        return False
+
+    def _apply_session_view(self, msg, session: dict) -> bool:
         """Subtree exports + root squash: remap the client's root inode
-        to the exported directory and squash root uids to maproot."""
+        to the exported directory, refuse inodes outside the exported
+        subtree, squash root uids to maproot. False = access denied."""
         root = session.get("root", fsmod.ROOT_INODE)
         if root != fsmod.ROOT_INODE:
-            for field in ("parent", "inode", "parent_src", "parent_dst",
-                          "dst_parent", "src_inode"):
+            for field in self._INODE_FIELDS:
                 if getattr(msg, field, None) == fsmod.ROOT_INODE:
                     setattr(msg, field, root)
+            for field in self._INODE_FIELDS:
+                value = getattr(msg, field, None)
+                if value is not None and not self._in_subtree(value, root):
+                    return False
         maproot = session.get("maproot")
         if maproot is not None:
             for field in ("uid", "gid"):
                 if getattr(msg, field, None) == 0:
                     setattr(msg, field, maproot)
+        return True
 
     async def _handle_client(self, msg, session_id: int = 0):
         fs = self.meta.fs
@@ -394,7 +430,8 @@ class MasterServer(Daemon):
         if session:
             if session.get("readonly") and type(msg).__name__ in self._MUTATING:
                 return self._error_reply(msg, st.EROFS)
-            self._apply_session_view(msg, session)
+            if not self._apply_session_view(msg, session):
+                return self._error_reply(msg, st.EACCES)
         if isinstance(msg, m.CltomaLookup):
             node = fs.lookup(msg.parent, msg.name)
             return self._attr_reply(msg.req_id, node)
@@ -571,7 +608,9 @@ class MasterServer(Daemon):
             ok = self.locks.posix(
                 inode, session_id, token, msg.start, msg.end, msg.ltype, msg.wait
             )
-        if ok and msg.ltype == LOCK_UNLOCK:
+        if ok:
+            # any successful change can free capacity (full unlock, but
+            # also downgrades and range narrowing) — retry waiters
             self._grant_pending_locks(inode)
         return m.MatoclLockReply(
             req_id=msg.req_id, status=st.OK if ok else st.LOCKED
@@ -742,7 +781,15 @@ class MasterServer(Daemon):
                     created.append((cs_id, part))
             except (ConnectionError, asyncio.TimeoutError):
                 pass
-        if len(created) < len(chunk.parts):
+        # the duplicate set must be READABLE (any k distinct parts for
+        # striped slices, >=1 copy for std); missing redundancy is
+        # rebuilt by the health loop on the new chunk — a single down
+        # replica must not block writes to a snapshot-shared chunk
+        distinct = {part for _, part in created}
+        needed = (
+            geometry.required_parts_to_recover(t) if not t.is_standard else 1
+        )
+        if len(distinct) < needed:
             for cs_id, part in created:
                 link = self.cs_links.get(cs_id)
                 if link is not None:
@@ -768,6 +815,8 @@ class MasterServer(Daemon):
         for cs_id, part in created:
             new_chunk.parts.add((cs_id, part))
         new_chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
+        if self.meta.registry.evaluate(new_chunk).needs_work:
+            self.meta.registry.mark_endangered(new_id)
         self.log.info(
             "COW: chunk %d -> %d for inode %d", chunk.chunk_id, new_id, msg.inode
         )

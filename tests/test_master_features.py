@@ -205,3 +205,60 @@ async def test_subtree_stats_dirinfo(tmp_path):
         assert node.stat_inodes == 3 and node.stat_bytes == 1000
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_quota_rmdir_and_rename_release(tmp_path):
+    """Quota usage must shrink on rmdir; rename-over-file must release
+    the overwritten file's chunks (trash_time=0 path)."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        q = cluster.master.meta.quotas
+        base_inodes = q.entry("user", 5, create=True).used_inodes
+        d = await c.mkdir(1, "tmpdir", uid=5, gid=5)
+        assert q.entry("user", 5).used_inodes == base_inodes + 1
+        await c.rmdir(1, "tmpdir")
+        assert q.entry("user", 5).used_inodes == base_inodes
+
+        # rename-over-file with trash disabled releases chunks
+        a = await c.create(1, "a.bin")
+        b = await c.create(1, "b.bin")
+        await c.settrashtime(b.inode, 0)
+        await c.write_file(b.inode, b"y" * 100_000)
+        nchunks = len(cluster.master.meta.registry.chunks)
+        assert nchunks == 1
+        await c.rename(1, "a.bin", 1, "b.bin")  # overwrites b
+        assert len(cluster.master.meta.registry.chunks) == 0
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_concurrent_lock_waiters(tmp_path):
+    """Two blocking waiters on different inodes must both get grants."""
+    from lizardfs_tpu.master.locks import LOCK_EXCLUSIVE, LOCK_UNLOCK
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c1 = await cluster.client()
+        c2 = await cluster.client()
+        f1 = await c1.create(1, "l1")
+        f2 = await c1.create(1, "l2")
+        assert await c1.flock(f1.inode, LOCK_EXCLUSIVE, token=1)
+        assert await c1.flock(f2.inode, LOCK_EXCLUSIVE, token=2)
+        w1 = asyncio.ensure_future(
+            c2.flock(f1.inode, LOCK_EXCLUSIVE, token=1, wait=True, timeout=5)
+        )
+        w2 = asyncio.ensure_future(
+            c2.flock(f2.inode, LOCK_EXCLUSIVE, token=2, wait=True, timeout=5)
+        )
+        await asyncio.sleep(0.1)
+        await c1.flock(f2.inode, LOCK_UNLOCK, token=2)
+        await c1.flock(f1.inode, LOCK_UNLOCK, token=1)
+        assert await asyncio.wait_for(w1, 5) is True
+        assert await asyncio.wait_for(w2, 5) is True
+    finally:
+        await cluster.stop()
