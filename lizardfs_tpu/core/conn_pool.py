@@ -1,0 +1,71 @@
+"""Idle-connection reuse pool for chunkserver links.
+
+The reference keeps a pool of idle TCP connections to chunkservers and
+reuses them across read operations (reference:
+src/common/connection_pool.{h,cc}, chunk_connector.{h,cc}). Same here:
+``acquire`` hands out an idle (reader, writer) pair or dials a new one;
+``release`` returns it after a fully-drained exchange. Connections are
+validated cheaply on acquire (EOF check) and expire after an idle TTL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class PooledConnection:
+    __slots__ = ("reader", "writer", "idle_since")
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.idle_since = 0.0
+
+
+class ConnectionPool:
+    def __init__(self, max_idle_per_addr: int = 4, idle_ttl: float = 5.0):
+        self.max_idle = max_idle_per_addr
+        self.idle_ttl = idle_ttl
+        self._idle: dict[tuple[str, int], list[PooledConnection]] = {}
+
+    async def acquire(self, addr: tuple[str, int]) -> PooledConnection:
+        bucket = self._idle.get(addr, [])
+        now = time.monotonic()
+        while bucket:
+            conn = bucket.pop()
+            if now - conn.idle_since > self.idle_ttl:
+                conn.writer.close()
+                continue
+            if conn.reader.at_eof() or conn.writer.is_closing():
+                conn.writer.close()
+                continue
+            return conn
+        reader, writer = await asyncio.open_connection(*addr)
+        return PooledConnection(reader, writer)
+
+    def release(self, addr: tuple[str, int], conn: PooledConnection) -> None:
+        """Return a connection after a complete request/response cycle."""
+        if conn.writer.is_closing() or conn.reader.at_eof():
+            conn.writer.close()
+            return
+        bucket = self._idle.setdefault(addr, [])
+        if len(bucket) >= self.max_idle:
+            conn.writer.close()
+            return
+        conn.idle_since = time.monotonic()
+        bucket.append(conn)
+
+    def discard(self, conn: PooledConnection) -> None:
+        """Drop a connection whose stream state is unknown (errors)."""
+        conn.writer.close()
+
+    def close_all(self) -> None:
+        for bucket in self._idle.values():
+            for conn in bucket:
+                conn.writer.close()
+        self._idle.clear()
+
+
+# module-level default pool shared by read executors in one process
+GLOBAL_POOL = ConnectionPool()

@@ -42,14 +42,19 @@ async def read_part_range(
     into_offset: int = 0,
 ) -> np.ndarray:
     """Read one range of one part from one chunkserver, verifying piece
-    CRCs (ReadOperationExecutor analog)."""
+    CRCs (ReadOperationExecutor analog). Connections come from the
+    process-wide pool and are returned after a clean, fully-drained
+    exchange (ConnectionPool analog)."""
+    from lizardfs_tpu.core.conn_pool import GLOBAL_POOL
+
     out = into if into is not None else np.zeros(size, dtype=np.uint8)
     if size == 0:
         return out[into_offset:into_offset]
-    reader, writer = await asyncio.open_connection(*addr)
+    conn = await GLOBAL_POOL.acquire(addr)
+    clean = False
     try:
         await framing.send_message(
-            writer,
+            conn.writer,
             m.CltocsRead(
                 req_id=1,
                 chunk_id=chunk_id,
@@ -61,7 +66,7 @@ async def read_part_range(
         )
         received = 0
         while True:
-            msg = await framing.read_message(reader)
+            msg = await framing.read_message(conn.reader)
             if isinstance(msg, m.CstoclReadData):
                 data = np.frombuffer(msg.data, dtype=np.uint8)
                 if crc_mod.crc32(msg.data) != msg.crc:
@@ -72,6 +77,7 @@ async def read_part_range(
                 out[into_offset + rel : into_offset + rel + len(data)] = data
                 received += len(data)
             elif isinstance(msg, m.CstoclReadStatus):
+                clean = True  # stream fully drained, even on error status
                 if msg.status != st.OK:
                     raise ReadError(f"read failed: {st.name(msg.status)}")
                 if received < size:
@@ -82,11 +88,10 @@ async def read_part_range(
             else:
                 raise ReadError(f"unexpected message {type(msg).__name__}")
     finally:
-        writer.close()
-        try:
-            await writer.wait_closed()
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        if clean:
+            GLOBAL_POOL.release(addr, conn)
+        else:
+            GLOBAL_POOL.discard(conn)
 
 
 async def execute_plan(
