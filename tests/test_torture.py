@@ -1,0 +1,142 @@
+"""Torture test: randomized file ops under continuous fault injection.
+
+The ContinuousTests/LongSystemTests analog (reference: killing daemons
+mid-IO, e.g. test_xor_overwriting_faulty_chunkservers.sh): a shadow
+model of the namespace + contents is maintained locally; random
+writes/reads/renames/deletes interleave with chunkserver kills and
+restarts; at the end, every surviving file must read back byte-exact
+and chunks must return to full health.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster, EC_GOAL, XOR_GOAL
+
+
+@pytest.mark.asyncio
+async def test_torture_random_ops_with_failures(tmp_path):
+    rng = random.Random(0xFEED)
+    cluster = Cluster(tmp_path, n_cs=7)
+    await cluster.start(health_interval=0.2)
+    c = await cluster.client()
+    c.retries = 8
+    model: dict[str, bytes] = {}  # name -> contents
+    inodes: dict[str, int] = {}
+    goals = [2, EC_GOAL, XOR_GOAL]
+    down: list[tuple[int, ChunkServer]] = []  # (index, stopped server)
+
+    async def op_create():
+        name = f"f{rng.randrange(10**9)}"
+        attr = await c.create(1, name)
+        await c.setgoal(attr.inode, rng.choice(goals))
+        size = rng.randrange(1, 3 * MFSBLOCKSIZE)
+        payload = data_generator.generate(rng.randrange(10**6), size).tobytes()
+        await c.write_file(attr.inode, payload)
+        model[name] = payload
+        inodes[name] = attr.inode
+
+    async def op_overwrite():
+        if not model:
+            return
+        name = rng.choice(sorted(model))
+        off = rng.randrange(0, max(len(model[name]), 1))
+        size = rng.randrange(1, 2 * MFSBLOCKSIZE)
+        patch = data_generator.generate(rng.randrange(10**6), size).tobytes()
+        await c.pwrite(inodes[name], off, patch)
+        buf = bytearray(model[name])
+        if off + size > len(buf):
+            buf.extend(b"\0" * (off + size - len(buf)))
+        buf[off : off + size] = patch
+        model[name] = bytes(buf)
+
+    async def op_read():
+        if not model:
+            return
+        name = rng.choice(sorted(model))
+        assert await c.read_file(inodes[name]) == model[name], f"read {name}"
+
+    async def op_delete():
+        if not model:
+            return
+        name = rng.choice(sorted(model))
+        await c.unlink(1, name)
+        del model[name]
+        del inodes[name]
+
+    async def op_rename():
+        if not model:
+            return
+        name = rng.choice(sorted(model))
+        new = f"r{rng.randrange(10**9)}"
+        await c.rename(1, name, 1, new)
+        model[new] = model.pop(name)
+        inodes[new] = inodes.pop(name)
+
+    async def op_kill_cs():
+        alive = [
+            (i, s) for i, s in enumerate(cluster.chunkservers)
+            if s is not None and all(i != di for di, _ in down)
+        ]
+        # never take down more than 2 at once: ec(3,2)/xor3 tolerate it
+        if len(down) >= 2 or len(alive) <= 4:
+            return
+        i, victim = rng.choice(alive)
+        await victim.stop()
+        down.append((i, victim))
+
+    async def op_revive_cs():
+        if not down:
+            return
+        i, dead = down.pop(rng.randrange(len(down)))
+        # fresh daemon over the same data folder (restart semantics)
+        cs = ChunkServer(
+            str(tmp_path / f"cs{i}"),
+            master_addr=("127.0.0.1", cluster.master.port),
+            wave_timeout=0.2, heartbeat_interval=0.3,
+        )
+        await cs.start()
+        cluster.chunkservers[i] = cs
+
+    ops = [
+        (op_create, 4), (op_overwrite, 5), (op_read, 6), (op_delete, 1),
+        (op_rename, 1), (op_kill_cs, 1), (op_revive_cs, 2),
+    ]
+    weighted = [fn for fn, w in ops for _ in range(w)]
+
+    try:
+        for step in range(60):
+            fn = rng.choice(weighted)
+            try:
+                await fn()
+            except st.StatusError as e:
+                # transient states are acceptable mid-fault; data loss is not
+                assert e.code in (st.EIO, st.NO_CHUNK_SERVERS, st.CHUNK_BUSY), (
+                    f"step {step} {fn.__name__}: {e}"
+                )
+
+        # revive everything, let the cluster heal, then verify all bytes
+        while down:
+            await op_revive_cs()
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            reg = cluster.master.meta.registry
+            bad = [
+                ch.chunk_id for ch in reg.chunks.values()
+                if reg.evaluate(ch).missing_parts
+            ]
+            if not bad:
+                break
+        for name, payload in sorted(model.items()):
+            got = await c.read_file(inodes[name])
+            assert got == payload, f"final verify failed for {name}"
+        assert len(model) > 0  # the run actually created files
+    finally:
+        await cluster.stop()
