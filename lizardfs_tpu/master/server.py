@@ -129,6 +129,12 @@ class MasterServer(Daemon):
         if loaded is not None:
             start_version, doc = loaded
             self.meta.load_sections(doc)
+            sess = doc.get("sessions", {})
+            self.next_session = int(sess.get("next", self.next_session))
+            for sid, row in sess.get("known", {}).items():
+                self.sessions[int(sid)] = {
+                    "info": row.get("info", ""), "connected": False,
+                }
         self.changelog.version = start_version
         replayed = 0
         for version, op in self.changelog.iter_entries(start_version):
@@ -180,6 +186,15 @@ class MasterServer(Daemon):
     async def _dump_image(self) -> None:
         version = self.changelog.version
         sections = self.meta.to_sections()
+        # persist session registry (sessions.mfs analog): ids survive a
+        # master restart so reconnecting clients keep their session ids
+        sections["sessions"] = {
+            "next": self.next_session,
+            "known": {
+                str(sid): {"info": s.get("info", "")}
+                for sid, s in self.sessions.items()
+            },
+        }
         # serialization + fsync off the event loop (MetadataDumper analog)
         await asyncio.to_thread(save_image, self.data_dir, version, sections)
         self.changelog.rotate()
@@ -260,6 +275,10 @@ class MasterServer(Daemon):
         session_id = first.session_id or self.next_session
         if first.session_id == 0:
             self.next_session += 1
+        else:
+            # a client may present an id this master has never issued
+            # (failover to a shadow with an older image): never re-issue it
+            self.next_session = max(self.next_session, session_id + 1)
         self.sessions[session_id] = {
             "info": first.info, "connected": True, "ip": peer[0],
             "readonly": rule.readonly, "maproot": rule.maproot,

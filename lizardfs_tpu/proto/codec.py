@@ -93,6 +93,12 @@ class Message:
 
     MSG_TYPE: int | None = None
     FIELDS: tuple[tuple[str, str], ...] = ()
+    # fast path for data-plane messages: when FIELDS is all scalars plus
+    # optionally one trailing ``bytes`` field, the scalar prefix packs/
+    # unpacks as one struct call (per-64KiB-piece overhead matters)
+    _FAST: struct.Struct | None = None
+    _FAST_NAMES: tuple[str, ...] = ()
+    _FAST_TAIL: str | None = None
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -105,6 +111,20 @@ class Message:
                     f"{existing.__name__} vs {cls.__name__}"
                 )
             _TYPE_REGISTRY[cls.MSG_TYPE] = cls
+        fmt = ">"
+        names = []
+        tail = None
+        for i, (name, ftype) in enumerate(cls.FIELDS):
+            if ftype in _SCALARS:
+                fmt += _SCALARS[ftype][1:]
+                names.append(name)
+            elif ftype == "bytes" and i == len(cls.FIELDS) - 1:
+                tail = name
+            else:
+                return  # generic path only
+        cls._FAST = struct.Struct(fmt)
+        cls._FAST_NAMES = tuple(names)
+        cls._FAST_TAIL = tail
 
     def __init__(self, **kwargs):
         for name, _ in self.FIELDS:
@@ -115,6 +135,14 @@ class Message:
             raise TypeError(f"{type(self).__name__} unknown fields {sorted(kwargs)}")
 
     def pack_body(self) -> bytes:
+        if self._FAST is not None:
+            head = self._FAST.pack(
+                *(getattr(self, n) for n in self._FAST_NAMES)
+            )
+            if self._FAST_TAIL is None:
+                return head
+            tail = bytes(getattr(self, self._FAST_TAIL))
+            return head + struct.pack(">I", len(tail)) + tail
         out = bytearray()
         for name, ftype in self.FIELDS:
             _pack_value(ftype, getattr(self, name), out)
@@ -122,6 +150,19 @@ class Message:
 
     @classmethod
     def unpack_body(cls, buf: memoryview | bytes, off: int = 0):
+        if cls._FAST is not None:
+            msg = cls.__new__(cls)
+            for name, value in zip(
+                cls._FAST_NAMES, cls._FAST.unpack_from(buf, off)
+            ):
+                setattr(msg, name, value)
+            off += cls._FAST.size
+            if cls._FAST_TAIL is not None:
+                (n,) = struct.unpack_from(">I", buf, off)
+                off += 4
+                setattr(msg, cls._FAST_TAIL, bytes(buf[off : off + n]))
+                off += n
+            return msg, off
         buf = memoryview(buf)
         values = {}
         for name, ftype in cls.FIELDS:
