@@ -254,6 +254,30 @@ class Client:
         r = await self._call(m.CltomaGetQuota)
         return json.loads(r.json)
 
+    async def set_acl(
+        self, inode: int, access: dict | None, default: dict | None = None
+    ) -> None:
+        import json
+
+        await self._call(
+            m.CltomaSetAcl, inode=inode,
+            json=json.dumps({"access": access, "default": default}),
+        )
+
+    async def get_acl(self, inode: int) -> dict:
+        import json
+
+        r = await self._call(m.CltomaGetAcl, inode=inode)
+        return json.loads(r.json)
+
+    async def access(
+        self, inode: int, uid: int, gids: list[int], mask: int
+    ) -> bool:
+        r = await self.master.call(
+            m.CltomaAccess, inode=inode, uid=uid, gids=gids, mask=mask
+        )
+        return r.status == st.OK
+
     async def trash_list(self) -> list[dict]:
         import json
 

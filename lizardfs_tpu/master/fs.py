@@ -63,6 +63,9 @@ class Node:
     parents: list[int] = field(default_factory=list)
     # extended attributes
     xattrs: dict[str, bytes] = field(default_factory=dict)
+    # POSIX ACLs, stored as plain dicts (master/acl.py evaluates)
+    acl: dict | None = None
+    default_acl: dict | None = None
     # directories: recursive subtree statistics (fsnodes statistics
     # analog) — counts include the directory itself
     stat_inodes: int = 1
@@ -89,6 +92,10 @@ class Node:
             d["xattrs"] = {
                 k: base64.b64encode(v).decode() for k, v in self.xattrs.items()
             }
+        if self.acl is not None:
+            d["acl"] = self.acl
+        if self.default_acl is not None:
+            d["default_acl"] = self.default_acl
         if self.ftype == TYPE_FILE:
             d["length"] = self.length
             d["chunks"] = self.chunks
@@ -223,6 +230,13 @@ class FsTree:
             nlink=1,
             parents=[parent],
         )
+        # POSIX default-ACL inheritance: a directory's default ACL
+        # becomes the access ACL of new children (and propagates as the
+        # default for child directories)
+        if p.default_acl is not None:
+            n.acl = dict(p.default_acl)
+            if ftype == TYPE_DIR:
+                n.default_acl = dict(p.default_acl)
         self.nodes[inode] = n
         p.children[name] = inode
         p.mtime = p.ctime = ts
@@ -407,6 +421,14 @@ class FsTree:
         del self.trash[inode]
         self._add_stats(parent, 1, n.length)
         return n
+
+    def apply_set_acl(self, inode: int, access: dict | None,
+                      default: dict | None, ts: int) -> None:
+        n = self.node(inode)
+        n.acl = dict(access) if access else None
+        if n.ftype == TYPE_DIR:
+            n.default_acl = dict(default) if default else None
+        n.ctime = ts
 
     def apply_set_xattr(self, inode: int, name: str, value_b64: str, ts: int) -> None:
         import base64

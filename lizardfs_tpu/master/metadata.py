@@ -124,6 +124,11 @@ class MetadataStore:
     def _op_undelete(self, op):
         self.fs.apply_undelete(op["inode"], op["ts"])
 
+    def _op_set_acl(self, op):
+        self.fs.apply_set_acl(
+            op["inode"], op.get("access"), op.get("default"), op["ts"]
+        )
+
     def _op_set_xattr(self, op):
         self.fs.apply_set_xattr(op["inode"], op["name"], op["value"], op["ts"])
 

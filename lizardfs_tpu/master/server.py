@@ -392,7 +392,7 @@ class MasterServer(Daemon):
         "CltomaUnlink", "CltomaRmdir", "CltomaRename", "CltomaSetGoal",
         "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
         "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
-        "CltomaSetQuota", "CltomaUndelete",
+        "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
     )
 
     _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
@@ -595,6 +595,44 @@ class MasterServer(Daemon):
             )
         if isinstance(msg, m.CltomaLockOp):
             return self._lock_op(msg, session_id)
+        if isinstance(msg, m.CltomaSetAcl):
+            try:
+                payload = json.loads(msg.json)
+            except ValueError:
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
+            from lizardfs_tpu.master.acl import Acl
+
+            for key in ("access", "default"):
+                if payload.get(key) is not None:
+                    Acl.from_dict(payload[key])  # validate shape
+            fs.node(msg.inode)
+            self.commit({
+                "op": "set_acl", "inode": msg.inode,
+                "access": payload.get("access"),
+                "default": payload.get("default"), "ts": now,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaGetAcl):
+            node = fs.node(msg.inode)
+            return m.MatoclAclReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({
+                    "access": node.acl, "default": node.default_acl,
+                    "mode": node.mode, "uid": node.uid, "gid": node.gid,
+                }),
+            )
+        if isinstance(msg, m.CltomaAccess):
+            from lizardfs_tpu.master import acl as acl_mod
+
+            node = fs.node(msg.inode)
+            a = acl_mod.Acl.from_dict(node.acl) if node.acl else None
+            ok = acl_mod.check_access(
+                node.mode, node.uid, node.gid, a, msg.uid, list(msg.gids),
+                msg.mask,
+            )
+            return m.MatoclStatusReply(
+                req_id=msg.req_id, status=st.OK if ok else st.EACCES
+            )
         if isinstance(msg, m.CltomaTrashList):
             rows = [
                 {"inode": inode, "name": name, "expires": exp, "parent": parent}

@@ -376,6 +376,38 @@ class MatoclLockGranted(Message):
     FIELDS = (("inode", "u32"), ("token", "u64"))
 
 
+class CltomaSetAcl(Message):
+    """Set/clear POSIX ACLs; json = {"access": {...}|null,
+    "default": {...}|null} (see master/acl.py dict shape)."""
+
+    MSG_TYPE = 1056
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("json", "str"))
+
+
+class CltomaGetAcl(Message):
+    MSG_TYPE = 1058
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclAclReply(Message):
+    MSG_TYPE = 1059
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+class CltomaAccess(Message):
+    """Permission probe: can (uid, gid) access inode with mask r4/w2/x1?
+    Evaluated against mode bits + POSIX ACLs (access(2) analog)."""
+
+    MSG_TYPE = 1060
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+        ("mask", "u8"),
+    )
+
+
 class CltomaTrashList(Message):
     MSG_TYPE = 1052
     FIELDS = (("req_id", "u32"),)
