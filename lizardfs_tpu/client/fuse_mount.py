@@ -226,6 +226,32 @@ class LizardFuse:
         out.st_mtim.tv_sec = attr.mtime
         out.st_ctim.tv_sec = attr.ctime
 
+    # --- special inodes (.oplog / .stats / .masterinfo analogs,
+    #     src/mount/special_inode*.cc) ----------------------------------
+
+    def _special_content(self, path: bytes) -> bytes | None:
+        name = path.decode()
+        if name == "/.stats":
+            lines = [
+                f"{op}: {count}"
+                for op, count in sorted(self.client.op_counters.items())
+            ]
+            lines.append(f"cache_hits: {self.client.cache.hits}")
+            lines.append(f"cache_misses: {self.client.cache.misses}")
+            return ("\n".join(lines) + "\n").encode()
+        if name == "/.oplog":
+            lines = [
+                f"{ts:.3f} {op}" for ts, op, _ in list(self.client.oplog)
+            ]
+            return ("\n".join(lines) + "\n").encode()
+        if name == "/.masterinfo":
+            addr = self.client.master_addrs[0]
+            return (
+                f"master: {addr[0]}:{addr[1]}\n"
+                f"session: {self.client.session_id}\n"
+            ).encode()
+        return None
+
     # --- operations -------------------------------------------------------
 
     def build_operations(self) -> FuseOperations:
@@ -246,6 +272,16 @@ class LizardFuse:
             setattr(ops, name, cb)
 
         def op_getattr(path, out):
+            special = self._special_content(path)
+            if special is not None:
+                ctypes.memset(
+                    ctypes.byref(out.contents), 0, ctypes.sizeof(Stat)
+                )
+                out.contents.st_mode = stat_mod.S_IFREG | 0o444
+                out.contents.st_nlink = 1
+                out.contents.st_size = len(special)
+                out.contents.st_blksize = MFSBLOCKSIZE
+                return 0
             self._fill_stat(self._resolve(path), out.contents)
             return 0
 
@@ -279,6 +315,9 @@ class LizardFuse:
             return 0
 
         def op_open(path, fi):
+            if self._special_content(path) is not None:
+                fi.contents.fh = 0
+                return 0
             fi.contents.fh = self._resolve(path).inode
             return 0
 
@@ -311,6 +350,11 @@ class LizardFuse:
             return 0
 
         def op_read(path, buf, size, offset, fi):
+            special = self._special_content(path)
+            if special is not None:
+                piece = special[offset : offset + size]
+                ctypes.memmove(buf, piece, len(piece))
+                return len(piece)
             inode = fi.contents.fh or self._resolve(path).inode
             data = self._run(self.client.read_file(inode, offset, size))
             ctypes.memmove(buf, data, len(data))

@@ -113,6 +113,9 @@ class MasterServer(Daemon):
         self.health_interval = health_interval
         self.image_interval = image_interval
         self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
+        from lizardfs_tpu.master.tasks import TaskManager
+
+        self.task_manager = TaskManager(self.commit)
         # personality: "master" (active) or "shadow" (applies the
         # changelog stream from active_addr; promotable at runtime)
         # (src/master/personality.h:25-69 analog)
@@ -147,6 +150,13 @@ class MasterServer(Daemon):
         self.add_timer(self.health_interval, self._health_tick)
         self.add_timer(self.image_interval, self._dump_image)
         self.add_timer(10.0, self._purge_trash)
+        self.add_timer(0.05, self._task_tick)
+
+    async def _task_tick(self) -> None:
+        """Run a batch of background metadata jobs (TaskManager analog:
+        long-running work in slices so client service never stalls)."""
+        if self.is_active:
+            self.task_manager.tick()
 
     async def start(self) -> None:
         await super().start()
@@ -1356,6 +1366,43 @@ class MasterServer(Daemon):
                 )
             self.promote()
             return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")
+        if msg.command in ("rremove-task", "setgoal-task", "settrashtime-task"):
+            from lizardfs_tpu.master import tasks as tasks_mod
+
+            try:
+                payload = json.loads(msg.json)
+                now = int(time.time())
+                if msg.command == "rremove-task":
+                    gen = tasks_mod.recursive_remove_ops(
+                        self.meta.fs, int(payload["parent"]),
+                        str(payload["name"]), now,
+                    )
+                elif msg.command == "setgoal-task":
+                    gen = tasks_mod.subtree_setgoal_ops(
+                        self.meta.fs, int(payload["inode"]),
+                        int(payload["goal"]), now,
+                    )
+                else:
+                    gen = tasks_mod.subtree_settrashtime_ops(
+                        self.meta.fs, int(payload["inode"]),
+                        int(payload["seconds"]), now,
+                    )
+                task = self.task_manager.submit(msg.command, gen)
+            except (KeyError, ValueError, fsmod.FsError) as e:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json=json.dumps({"error": str(e)[:200]}),
+                )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(task.to_dict())
+            )
+        if msg.command == "list-tasks":
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps([
+                    t.to_dict() for t in self.task_manager.tasks.values()
+                ]),
+            )
         if msg.command == "metadata-checksum":
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,

@@ -61,6 +61,12 @@ async def test_fuse_mount_end_to_end(tmp_path):
             os.truncate(mnt / "renamed.txt", 10)
             assert os.stat(mnt / "renamed.txt").st_size == 10
             assert sorted(os.listdir(mnt)) == ["dir", "renamed.txt", "slink"]
+            # special inodes (.stats/.oplog/.masterinfo analogs)
+            stats = open(mnt / ".stats").read()
+            assert "CltomaCreate" in stats and "cache_hits" in stats
+            info = open(mnt / ".masterinfo").read()
+            assert "master: 127.0.0.1" in info and "session:" in info
+            assert "CltomaLookup" in open(mnt / ".oplog").read()
 
         await asyncio.to_thread(work)
     finally:

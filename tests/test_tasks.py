@@ -1,0 +1,72 @@
+"""Background task manager: incremental recursive jobs."""
+
+import asyncio
+import json
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+
+from tests.test_cluster import Cluster, EC_GOAL
+
+
+async def admin(port, command, payload):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    await framing.send_message(
+        w, m.AdminCommand(req_id=1, command=command, json=json.dumps(payload))
+    )
+    reply = await framing.read_message(r)
+    w.close()
+    return json.loads(reply.json), reply.status
+
+
+@pytest.mark.asyncio
+async def test_incremental_recursive_jobs(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        top = await c.mkdir(1, "big")
+        inodes = []
+        for i in range(3):
+            d = await c.mkdir(top.inode, f"d{i}")
+            for j in range(10):
+                f = await c.create(d.inode, f"f{j}")
+                await c.write_file(f.inode, b"z" * 1000)
+                inodes.append(f.inode)
+        port = cluster.master.port
+
+        # subtree setgoal runs in batches off the admin protocol
+        doc, status = await admin(
+            port, "setgoal-task", {"inode": top.inode, "goal": EC_GOAL}
+        )
+        assert status == 0
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            tasks, _ = await admin(port, "list-tasks", {})
+            if all(t["finished"] for t in tasks):
+                break
+        assert (await c.getattr(inodes[0])).goal == EC_GOAL
+        assert (await c.getattr(inodes[-1])).goal == EC_GOAL
+
+        # recursive remove of the whole subtree
+        doc, status = await admin(
+            port, "rremove-task", {"parent": 1, "name": "big"}
+        )
+        assert status == 0
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            tasks, _ = await admin(port, "list-tasks", {})
+            if all(t["finished"] for t in tasks):
+                break
+        entries = await c.readdir(1)
+        assert "big" not in [e.name for e in entries]
+        done = [t for t in tasks if t["kind"] == "rremove-task"][0]
+        assert done["done_units"] == 3 * 10 + 3 + 1  # files + dirs + root
+        assert done["error"] == ""
+
+        # bad submissions are rejected cleanly
+        doc, status = await admin(port, "rremove-task", {"parent": 1, "name": "nope"})
+        assert status != 0
+    finally:
+        await cluster.stop()
