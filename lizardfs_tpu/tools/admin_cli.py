@@ -86,7 +86,11 @@ async def _amain(argv) -> int:
 
 
 def main(argv=None) -> int:
-    return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+    try:
+        return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+    except (ConnectionError, OSError) as e:
+        print(f"error: cannot reach daemon: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -393,7 +393,17 @@ async def _amain(argv) -> int:
 
 
 def main(argv=None) -> int:
-    return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+    try:
+        return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) went away: exit quietly
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    except (ConnectionError, OSError) as e:
+        print(f"error: cannot reach master: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":
