@@ -15,6 +15,7 @@ Server selection is label-aware weighted-by-free-space choice
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 
 from lizardfs_tpu.core import geometry
@@ -297,4 +298,40 @@ class ChunkRegistry:
                 out.append(("replicate", chunk, p))
             for cs_id, p in state.redundant:
                 out.append(("delete", chunk, cs_id, p))
+        if not out:
+            move = self.rebalance_candidate()
+            if move is not None:
+                out.append(move)
         return out
+
+    # fullness-gap threshold before a part is migrated (fraction)
+    REBALANCE_GAP = 0.20
+
+    def rebalance_candidate(self):
+        """One ('move', chunk, src_cs, part, dst_cs) when the fullest and
+        emptiest servers diverge by more than REBALANCE_GAP (the
+        reference's continuous rebalancing, chunks.cc replication loop).
+        Only healthy, unlocked chunks move; one migration at a time keeps
+        the loop gentle."""
+        servers = [s for s in self.connected_servers() if s.total_space > 0]
+        if len(servers) < 2:
+            return None
+        fullest = max(servers, key=lambda s: s.used_space / s.total_space)
+        emptiest = min(servers, key=lambda s: s.used_space / s.total_space)
+        gap = (fullest.used_space / fullest.total_space
+               - emptiest.used_space / emptiest.total_space)
+        if gap < self.REBALANCE_GAP:
+            return None
+        now = time.monotonic()
+        for chunk in self.chunks.values():
+            if chunk.locked_until > now:
+                continue
+            holders = {cs for cs, _ in chunk.parts}
+            if emptiest.cs_id in holders:
+                continue
+            for cs_id, part in sorted(chunk.parts):
+                if cs_id == fullest.cs_id:
+                    if self.evaluate(chunk).needs_work:
+                        break  # unhealthy chunks are repair work, not moves
+                    return ("move", chunk, cs_id, part, emptiest.cs_id)
+        return None

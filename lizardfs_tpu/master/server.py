@@ -1129,6 +1129,12 @@ class MasterServer(Daemon):
             elif item[0] == "delete":
                 _, chunk, cs_id, part = item
                 self.spawn(self._delete_redundant(chunk, cs_id, part))
+            elif item[0] == "move":
+                _, chunk, src_cs, part, dst_cs = item
+                key = (chunk.chunk_id, part)
+                if key not in self._replicating:
+                    self._replicating.add(key)
+                    self.spawn(self._move_part(chunk, src_cs, part, dst_cs))
 
     async def _delete_orphan(self, link, dead, t, part: int) -> None:
         try:
@@ -1174,6 +1180,33 @@ class MasterServer(Daemon):
             state = self.meta.registry.evaluate(chunk)
             if state.needs_work:
                 self.meta.registry.mark_endangered(chunk.chunk_id)
+
+    async def _move_part(self, chunk, src_cs: int, part: int, dst_cs: int) -> None:
+        """Rebalancing migration: replicate the part onto the target,
+        then drop the source copy (replicate-then-delete keeps the chunk
+        safe throughout)."""
+        try:
+            t = geometry.SliceType(chunk.slice_type)
+            link = self.cs_links.get(dst_cs)
+            if link is None:
+                return
+            part_id = geometry.ChunkPartType(t, part).id
+            try:
+                reply = await link.command(
+                    m.MatocsReplicate,
+                    chunk_id=chunk.chunk_id, version=chunk.version,
+                    part_id=part_id, sources=self._locations_of(chunk),
+                    timeout=60.0,
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                return
+            if reply.status != st.OK:
+                return
+            chunk.parts.add((dst_cs, part))
+            await self._delete_redundant(chunk, src_cs, part)
+            self.metrics.counter("rebalance_moves").inc()
+        finally:
+            self._replicating.discard((chunk.chunk_id, part))
 
     async def _delete_redundant(self, chunk, cs_id: int, part: int) -> None:
         link = self.cs_links.get(cs_id)

@@ -146,3 +146,35 @@ def test_choose_servers_distinct_and_weighted():
     assert len(picked) == 8
     with pytest.raises(ValueError):
         ChunkRegistry().choose_servers(1)
+
+
+def test_rebalance_candidate():
+    reg = ChunkRegistry()
+    full = reg.register_server("full", 9300, "_", 100, 90)   # 90% used
+    empty = reg.register_server("empty", 9301, "_", 100, 10)  # 10% used
+    mid = reg.register_server("mid", 9302, "_", 100, 50)
+    t = geometry.ec_type(3, 2)
+    chunk = reg.create_chunk(int(t))
+    # healthy chunk with a part on the fullest server
+    for part, cs in enumerate([full.cs_id, mid.cs_id, mid.cs_id,
+                               full.cs_id, mid.cs_id]):
+        chunk.parts.add((cs, part))
+    move = reg.rebalance_candidate()
+    assert move is not None
+    _, ch, src, part, dst = move
+    assert src == full.cs_id and dst == empty.cs_id
+    assert (src, part) in ch.parts
+    # below the gap threshold: no move
+    full.used_space = 25
+    mid.used_space = 25
+    assert reg.rebalance_candidate() is None
+    # unhealthy chunks are never rebalanced
+    full.used_space = 90
+    mid.used_space = 50
+    chunk.parts = {(full.cs_id, 0), (mid.cs_id, 1), (mid.cs_id, 2)}  # degraded
+    assert reg.rebalance_candidate() is None
+    # health_work emits the move only when no repair work exists
+    chunk.parts = {(full.cs_id, p) if p in (0, 3) else (mid.cs_id, p)
+                   for p in range(5)}
+    work = reg.health_work()
+    assert work and work[0][0] == "move"
