@@ -273,10 +273,15 @@ class Client:
     async def access(
         self, inode: int, uid: int, gids: list[int], mask: int
     ) -> bool:
-        r = await self.master.call(
-            m.CltomaAccess, inode=inode, uid=uid, gids=gids, mask=mask
-        )
-        return r.status == st.OK
+        try:
+            await self._call(
+                m.CltomaAccess, inode=inode, uid=uid, gids=gids, mask=mask
+            )
+            return True
+        except st.StatusError as e:
+            if e.code == st.EACCES:
+                return False
+            raise
 
     async def trash_list(self) -> list[dict]:
         import json

@@ -177,7 +177,9 @@ class LizardFuse:
         self._loop_thread = threading.Thread(
             target=self.loop.run_forever, daemon=True
         )
-        self._dirty: dict[int, bool] = {}
+        # open-time snapshots of special-inode content so piecewise
+        # kernel reads see a consistent document (no torn .oplog)
+        self._special_snap: dict[bytes, bytes] = {}
 
     def start(self) -> None:
         self._loop_thread.start()
@@ -315,7 +317,9 @@ class LizardFuse:
             return 0
 
         def op_open(path, fi):
-            if self._special_content(path) is not None:
+            special = self._special_content(path)
+            if special is not None:
+                self._special_snap[bytes(path)] = special
                 fi.contents.fh = 0
                 return 0
             fi.contents.fh = self._resolve(path).inode
@@ -350,7 +354,9 @@ class LizardFuse:
             return 0
 
         def op_read(path, buf, size, offset, fi):
-            special = self._special_content(path)
+            special = self._special_snap.get(bytes(path))
+            if special is None:
+                special = self._special_content(path)
             if special is not None:
                 piece = special[offset : offset + size]
                 ctypes.memmove(buf, piece, len(piece))
@@ -412,6 +418,7 @@ class LizardFuse:
             return 0
 
         def op_release(path, fi):
+            self._special_snap.pop(bytes(path), None)
             return 0
 
         def op_fsync(path, datasync, fi):

@@ -15,12 +15,13 @@ import time
 
 
 class PooledConnection:
-    __slots__ = ("reader", "writer", "idle_since")
+    __slots__ = ("reader", "writer", "idle_since", "loop")
 
     def __init__(self, reader, writer):
         self.reader = reader
         self.writer = writer
         self.idle_since = 0.0
+        self.loop = asyncio.get_running_loop()
 
 
 class ConnectionPool:
@@ -32,8 +33,17 @@ class ConnectionPool:
     async def acquire(self, addr: tuple[str, int]) -> PooledConnection:
         bucket = self._idle.get(addr, [])
         now = time.monotonic()
+        loop = asyncio.get_running_loop()
         while bucket:
             conn = bucket.pop()
+            # streams are bound to the loop that created them; a pooled
+            # pair from another (possibly closed) loop is unusable
+            if conn.loop is not loop:
+                try:
+                    conn.writer.close()
+                except RuntimeError:
+                    pass
+                continue
             if now - conn.idle_since > self.idle_ttl:
                 conn.writer.close()
                 continue
@@ -46,7 +56,11 @@ class ConnectionPool:
 
     def release(self, addr: tuple[str, int], conn: PooledConnection) -> None:
         """Return a connection after a complete request/response cycle."""
-        if conn.writer.is_closing() or conn.reader.at_eof():
+        try:
+            same_loop = conn.loop is asyncio.get_running_loop()
+        except RuntimeError:
+            same_loop = False
+        if not same_loop or conn.writer.is_closing() or conn.reader.at_eof():
             conn.writer.close()
             return
         bucket = self._idle.setdefault(addr, [])

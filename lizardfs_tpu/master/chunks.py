@@ -92,6 +92,7 @@ class ChunkRegistry:
         # deleting on chunkservers (drained by the master's health tick;
         # bounded so an idle shadow doesn't grow it forever)
         self.pending_deletes: list[ChunkInfo] = []
+        self._rebalance_cursor = 0
         self._rng = random.Random(0xEC)
 
     # --- chunkserver db -------------------------------------------------------
@@ -323,8 +324,19 @@ class ChunkRegistry:
         if gap < self.REBALANCE_GAP:
             return None
         now = time.monotonic()
-        for chunk in self.chunks.values():
-            if chunk.locked_until > now:
+        # bounded scan with a persistent cursor: never walk the whole
+        # chunk table in one health tick (millions of chunks would stall
+        # the event loop while the gap persists with no eligible chunk)
+        ids = list(self.chunks.keys())
+        if not ids:
+            return None
+        start = self._rebalance_cursor % len(ids)
+        budget = min(len(ids), 512)
+        for i in range(budget):
+            cid = ids[(start + i) % len(ids)]
+            self._rebalance_cursor = (start + i + 1) % len(ids)
+            chunk = self.chunks.get(cid)
+            if chunk is None or chunk.locked_until > now:
                 continue
             holders = {cs for cs, _ in chunk.parts}
             if emptiest.cs_id in holders:

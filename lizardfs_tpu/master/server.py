@@ -197,12 +197,15 @@ class MasterServer(Daemon):
         version = self.changelog.version
         sections = self.meta.to_sections()
         # persist session registry (sessions.mfs analog): ids survive a
-        # master restart so reconnecting clients keep their session ids
+        # master restart so reconnecting clients keep their session ids.
+        # Only LIVE sessions are persisted — one-shot CLI sessions would
+        # otherwise accumulate in every image forever.
         sections["sessions"] = {
             "next": self.next_session,
             "known": {
                 str(sid): {"info": s.get("info", "")}
                 for sid, s in self.sessions.items()
+                if s.get("connected")
             },
         }
         # serialization + fsync off the event loop (MetadataDumper analog)
@@ -219,6 +222,14 @@ class MasterServer(Daemon):
         ]
         for inode in expired:
             self.commit({"op": "purge_trash", "inode": inode})
+        # retire disconnected sessions (the in-memory registry would
+        # otherwise grow with every one-shot CLI invocation)
+        dead = [
+            sid for sid, s in self.sessions.items()
+            if not s.get("connected") and sid not in self._session_writers
+        ]
+        for sid in dead:
+            del self.sessions[sid]
 
     # --- connection dispatch ------------------------------------------------------
 
@@ -1183,8 +1194,11 @@ class MasterServer(Daemon):
 
     async def _move_part(self, chunk, src_cs: int, part: int, dst_cs: int) -> None:
         """Rebalancing migration: replicate the part onto the target,
-        then drop the source copy (replicate-then-delete keeps the chunk
-        safe throughout)."""
+        then drop the source copy. The replicate window is long (up to
+        60 s) and does NOT lock the chunk; if a client write bumped the
+        version meanwhile, the fresh copy is stale — drop it and abort
+        instead of registering it."""
+        v0 = chunk.version
         try:
             t = geometry.SliceType(chunk.slice_type)
             link = self.cs_links.get(dst_cs)
@@ -1194,13 +1208,28 @@ class MasterServer(Daemon):
             try:
                 reply = await link.command(
                     m.MatocsReplicate,
-                    chunk_id=chunk.chunk_id, version=chunk.version,
+                    chunk_id=chunk.chunk_id, version=v0,
                     part_id=part_id, sources=self._locations_of(chunk),
                     timeout=60.0,
                 )
             except (ConnectionError, asyncio.TimeoutError):
                 return
             if reply.status != st.OK:
+                return
+            current = self.meta.registry.chunks.get(chunk.chunk_id)
+            if (
+                current is not chunk
+                or chunk.version != v0
+                or chunk.locked_until > time.monotonic()
+            ):
+                # chunk changed under the migration: discard the copy
+                try:
+                    await link.command(
+                        m.MatocsDeleteChunk, chunk_id=chunk.chunk_id,
+                        version=v0, part_id=part_id,
+                    )
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
                 return
             chunk.parts.add((dst_cs, part))
             await self._delete_redundant(chunk, src_cs, part)
