@@ -38,6 +38,19 @@ from lizardfs_tpu.utils import striping
 
 log = logging.getLogger("client")
 
+# status codes worth retrying a write for (infrastructure trouble);
+# everything else (quota, permissions, invalid args) is permanent
+_TRANSIENT = {
+    st.EIO, st.NO_CHUNK_SERVERS, st.CHUNK_BUSY, st.DISCONNECTED,
+    st.TIMEOUT, st.WRONG_VERSION, st.CHUNK_LOST, st.NO_CHUNK,
+}
+
+
+def _is_transient(e: Exception) -> bool:
+    if isinstance(e, st.StatusError):
+        return e.code in _TRANSIENT
+    return isinstance(e, (ReadError, ConnectionError, OSError))
+
 
 class Client:
     def __init__(
@@ -369,7 +382,23 @@ class Client:
         index = 0
         while pos < total:
             end = min(pos + MFSCHUNKSIZE, total)
-            await self._write_chunk(inode, index, data[pos:end], file_length=end)
+            last: Exception | None = None
+            for attempt in range(self.retries):
+                if attempt:
+                    await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
+                try:
+                    await self._write_chunk(
+                        inode, index, data[pos:end], file_length=end
+                    )
+                    last = None
+                    break
+                except (st.StatusError, ReadError, ConnectionError, OSError) as e:
+                    if not _is_transient(e):
+                        raise
+                    last = e
+                    log.info("write retry %d chunk %d: %s", attempt + 1, index, e)
+            if last is not None:
+                raise st.StatusError(st.EIO, f"write failed after retries: {last}")
             pos = end
             index += 1
         if old_length > total:
@@ -405,9 +434,25 @@ class Client:
     ) -> None:
         lock = self._chunk_write_locks.setdefault((inode, ci), asyncio.Lock())
         async with lock:
-            await self._pwrite_chunk_locked(
-                inode, ci, coff, piece, old_length, new_length
-            )
+            # a failed attempt can leave parts torn (some written, some
+            # not, parity stale); each retry takes a FRESH grant — the
+            # version bump drops unreachable holders and the full region
+            # rewrite restores stripe consistency on the survivors
+            last: Exception | None = None
+            for attempt in range(self.retries):
+                if attempt:
+                    await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
+                try:
+                    await self._pwrite_chunk_locked(
+                        inode, ci, coff, piece, old_length, new_length
+                    )
+                    return
+                except (st.StatusError, ReadError, ConnectionError, OSError) as e:
+                    if not _is_transient(e):
+                        raise
+                    last = e
+                    log.info("pwrite retry %d chunk %d: %s", attempt + 1, ci, e)
+            raise st.StatusError(st.EIO, f"pwrite failed after retries: {last}")
 
     async def _pwrite_chunk_locked(
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
@@ -571,6 +616,29 @@ class Client:
         boundaries; each carries its own CRC."""
         head = locs[0]
         chain = locs[1:]
+
+        # bulk writes stream their pieces in C++ off the event loop
+        from lizardfs_tpu.core import native_io
+
+        if (
+            native_io.available()
+            and length >= native_io.NATIVE_WRITE_THRESHOLD
+        ):
+            try:
+                await native_io.run(
+                    native_io.write_part_blocking,
+                    (head.addr.host, head.addr.port),
+                    chunk_id, version, head.part_id, chain,
+                    payload[:length].tobytes(), part_offset,
+                )
+                return
+            except native_io.NativeIOError as e:
+                raise st.StatusError(
+                    e.code if e.code > 0 else st.EIO, str(e)
+                ) from None
+            except (OSError, ConnectionError) as e:
+                raise st.StatusError(st.EIO, f"native write: {e}") from None
+
         reader, writer = await asyncio.open_connection(
             head.addr.host, head.addr.port
         )

@@ -1,0 +1,198 @@
+"""Native bulk-IO bindings: whole data-plane exchanges in C++.
+
+The asyncio stack stays in charge of control flow, plans, and retries;
+when a read or write moves enough bytes, the piece loop (framing, CRC,
+scatter) runs in ``native/io_native.cpp`` over a blocking socket from a
+worker thread, with the GIL released. This is the native runtime layer
+for the data path — the Python per-piece path remains as the portable
+fallback and handles small requests where thread hop latency would
+dominate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import functools
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from lizardfs_tpu.core import native as _native_lib
+from lizardfs_tpu.proto import framing
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+
+# exchanges smaller than this stay on the asyncio path
+NATIVE_READ_THRESHOLD = 128 * 1024
+NATIVE_WRITE_THRESHOLD = 128 * 1024
+
+_lib = _native_lib._load()
+if _lib is not None:
+    try:
+        _lib.lz_read_part.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        _lib.lz_read_part.restype = ctypes.c_int
+        _lib.lz_write_part.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        _lib.lz_write_part.restype = ctypes.c_int
+    except AttributeError:
+        _lib = None
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+class NativeIOError(Exception):
+    def __init__(self, code: int, what: str):
+        self.code = code
+        names = {-1: "socket error", -2: "protocol violation", -3: "CRC mismatch"}
+        msg = names.get(code, f"status {st.name(code) if code > 0 else code}")
+        super().__init__(f"native {what}: {msg}")
+
+
+class _SocketPool:
+    """Thread-safe pool of blocking sockets keyed by address."""
+
+    def __init__(self, max_idle: int = 4):
+        self.max_idle = max_idle
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list[socket.socket]] = {}
+
+    def acquire(self, addr: tuple[str, int]) -> socket.socket:
+        with self._lock:
+            bucket = self._idle.get(addr)
+            if bucket:
+                return bucket.pop()
+        return _blocking_socket(addr, 30.0)
+
+    def release(self, addr: tuple[str, int], sock: socket.socket) -> None:
+        with self._lock:
+            bucket = self._idle.setdefault(addr, [])
+            if len(bucket) < self.max_idle:
+                bucket.append(sock)
+                return
+        sock.close()
+
+    def discard(self, sock: socket.socket) -> None:
+        sock.close()
+
+
+POOL = _SocketPool()
+
+# Dedicated executor: native IO calls block for a full network exchange.
+# Sharing asyncio's default to_thread pool would let a burst of bulk
+# transfers starve unrelated to_thread work (e.g. an in-process
+# chunkserver's disk jobs — whose acks these very calls wait on).
+EXECUTOR = ThreadPoolExecutor(max_workers=32, thread_name_prefix="native-io")
+
+
+async def run(fn, *args):
+    """Run a blocking native-IO function on the dedicated executor."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(EXECUTOR, functools.partial(fn, *args))
+
+
+def _blocking_socket(addr: tuple[str, int], io_timeout: float) -> socket.socket:
+    """Connect and return a socket whose fd is BLOCKING (a Python-level
+    timeout makes the fd non-blocking, which breaks the C send/recv
+    loops); IO deadlines are enforced by the kernel via SO_*TIMEO."""
+    sock = socket.create_connection(addr, timeout=30.0)
+    sock.settimeout(None)  # back to a blocking fd
+    tv = struct.pack("ll", int(io_timeout), 0)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        piece = sock.recv(n - len(out))
+        if not piece:
+            raise ConnectionError("peer closed")
+        out += piece
+    return bytes(out)
+
+
+def _recv_message(sock: socket.socket):
+    header = _recv_exact(sock, 8)
+    msg_type, length = struct.unpack(">II", header)
+    payload = _recv_exact(sock, length)
+    return framing.decode(msg_type, payload)
+
+
+def read_part_blocking(
+    addr: tuple[str, int],
+    chunk_id: int,
+    version: int,
+    part_id: int,
+    offset: int,
+    size: int,
+    out: np.ndarray,
+) -> None:
+    """Fill ``out[:size]`` with the requested range (called via
+    asyncio.to_thread). Retries once on a stale pooled socket."""
+    assert out.flags.c_contiguous and out.nbytes >= size
+    ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    for attempt in (0, 1):
+        sock = POOL.acquire(addr)
+        rc = _lib.lz_read_part(
+            sock.fileno(), chunk_id, version, part_id, offset, size, ptr
+        )
+        if rc == 0:
+            POOL.release(addr, sock)
+            return
+        POOL.discard(sock)
+        if rc == -1 and attempt == 0:
+            continue  # stale pooled socket: retry on a fresh connection
+        raise NativeIOError(rc, "read")
+
+
+def write_part_blocking(
+    addr: tuple[str, int],
+    chunk_id: int,
+    version: int,
+    part_id: int,
+    chain: list,
+    payload: bytes,
+    part_offset: int,
+) -> None:
+    """Full write exchange: WriteInit handshake (Python framing), bulk
+    WriteData streaming + acks (native), WriteEnd handshake."""
+    sock = _blocking_socket(addr, 60.0)
+    try:
+        sock.sendall(
+            framing.encode(
+                m.CltocsWriteInit(
+                    req_id=1, chunk_id=chunk_id, version=version,
+                    part_id=part_id, chain=chain, create=False,
+                )
+            )
+        )
+        init = _recv_message(sock)
+        if not isinstance(init, m.CstoclWriteStatus) or init.status != st.OK:
+            raise st.StatusError(getattr(init, "status", st.EIO), "write init")
+        buf = np.frombuffer(payload, dtype=np.uint8)
+        rc = _lib.lz_write_part(
+            sock.fileno(), chunk_id,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(payload), part_offset, 1,
+        )
+        if rc != 0:
+            raise NativeIOError(rc, "write")
+        sock.sendall(framing.encode(m.CltocsWriteEnd(req_id=0, chunk_id=chunk_id)))
+        end = _recv_message(sock)
+        if not isinstance(end, m.CstoclWriteStatus) or end.status != st.OK:
+            raise st.StatusError(getattr(end, "status", st.EIO), "write end")
+    finally:
+        sock.close()

@@ -50,6 +50,25 @@ async def read_part_range(
     out = into if into is not None else np.zeros(size, dtype=np.uint8)
     if size == 0:
         return out[into_offset:into_offset]
+
+    # bulk reads run the whole exchange in C++ off the event loop
+    # (framing + CRC + scatter with the GIL released)
+    from lizardfs_tpu.core import native_io
+
+    if native_io.available() and size >= native_io.NATIVE_READ_THRESHOLD:
+        view = out[into_offset : into_offset + size]
+        if view.flags.c_contiguous:
+            try:
+                await native_io.run(
+                    native_io.read_part_blocking,
+                    addr, chunk_id, version, part_id, offset, size, view,
+                )
+                return out
+            except native_io.NativeIOError as e:
+                raise ReadError(str(e)) from None
+            except (OSError, ConnectionError) as e:
+                raise ReadError(f"native read failed: {e}") from None
+
     conn = await GLOBAL_POOL.acquire(addr)
     clean = False
     try:

@@ -1,0 +1,201 @@
+// Native bulk IO for the client<->chunkserver data plane.
+//
+// Python's asyncio handles the control plane well, but shoveling 64 KiB
+// data pieces through per-message Python objects caps the data plane.
+// These functions run an ENTIRE part read or write-stream exchange in
+// C++ over a blocking socket — framing, piece CRC verification/
+// generation, buffer scatter — and are called from worker threads with
+// the GIL released (ctypes does this automatically for plain C calls).
+//
+// Wire format (keep in sync with lizardfs_tpu/proto):
+//   frame   = header(type:u32 BE, length:u32 BE) + version:u8 + body
+//   CltocsRead       (1200): req_id:u32 chunk_id:u64 version:u32
+//                            part_id:u32 offset:u32 size:u32
+//   CstoclReadData   (1201): req_id:u32 chunk_id:u64 offset:u32 crc:u32
+//                            data(u32 len + bytes)
+//   CstoclReadStatus (1202): req_id:u32 chunk_id:u64 status:u8
+//   CltocsWriteData  (1211): req_id:u32 chunk_id:u64 write_id:u32
+//                            block:u32 offset:u32 crc:u32
+//                            data(u32 len + bytes)
+//   CstoclWriteStatus(1212): req_id:u32 chunk_id:u64 write_id:u32
+//                            status:u8
+//
+// Return codes: 0 = OK; >0 = protocol status byte from the peer;
+// -1 = socket error; -2 = protocol violation; -3 = CRC mismatch.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(_WIN32)
+#error "POSIX only"
+#endif
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len);
+
+namespace {
+
+constexpr uint32_t kTypeRead = 1200;
+constexpr uint32_t kTypeReadData = 1201;
+constexpr uint32_t kTypeReadStatus = 1202;
+constexpr uint32_t kTypeWriteData = 1211;
+constexpr uint32_t kTypeWriteStatus = 1212;
+constexpr uint8_t kProtoVersion = 1;
+constexpr size_t kMaxPayload = 1u << 20;  // pieces are <= 64 KiB + header
+constexpr uint32_t kBlockSize = 64 * 1024;
+
+inline void put32(uint8_t* p, uint32_t v) {
+    p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+inline void put64(uint8_t* p, uint64_t v) {
+    put32(p, static_cast<uint32_t>(v >> 32));
+    put32(p + 4, static_cast<uint32_t>(v));
+}
+inline uint32_t get32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline uint64_t get64(const uint8_t* p) {
+    return (uint64_t(get32(p)) << 32) | get32(p + 4);
+}
+
+bool send_all(int fd, const uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::send(fd, buf, len, 0);
+        if (n <= 0) {
+            if (n < 0 && (errno == EINTR)) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+bool recv_all(int fd, uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::recv(fd, buf, len, 0);
+        if (n <= 0) {
+            if (n < 0 && (errno == EINTR)) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Read [offset, offset+size) of one part into out. Whole exchange.
+int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
+                 uint32_t part_id, uint32_t offset, uint32_t size,
+                 uint8_t* out) {
+    // request
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4];
+    size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
+    put32(req, kTypeRead);
+    put32(req + 4, static_cast<uint32_t>(body));
+    req[8] = kProtoVersion;
+    put32(req + 9, 1);            // req_id
+    put64(req + 13, chunk_id);
+    put32(req + 21, version);
+    put32(req + 25, part_id);
+    put32(req + 29, offset);
+    put32(req + 33, size);
+    if (!send_all(fd, req, sizeof(req))) return -1;
+
+    std::vector<uint8_t> payload(kMaxPayload);
+    uint64_t received = 0;
+    for (;;) {
+        uint8_t header[8];
+        if (!recv_all(fd, header, 8)) return -1;
+        uint32_t type = get32(header);
+        uint32_t length = get32(header + 4);
+        if (length < 1 || length > kMaxPayload) return -2;
+        if (length > payload.size()) payload.resize(length);
+        if (!recv_all(fd, payload.data(), length)) return -1;
+        const uint8_t* p = payload.data();
+        if (p[0] != kProtoVersion) return -2;
+        if (type == kTypeReadData) {
+            if (length < 1 + 4 + 8 + 4 + 4 + 4) return -2;
+            uint32_t piece_off = get32(p + 13);
+            uint32_t crc = get32(p + 17);
+            uint32_t dlen = get32(p + 21);
+            if (1 + 4 + 8 + 4 + 4 + 4 + dlen != length) return -2;
+            const uint8_t* data = p + 25;
+            if (piece_off < offset ||
+                uint64_t(piece_off) + dlen > uint64_t(offset) + size)
+                return -2;
+            if (lz_crc32(0, data, dlen) != crc) return -3;
+            std::memcpy(out + (piece_off - offset), data, dlen);
+            received += dlen;
+        } else if (type == kTypeReadStatus) {
+            uint8_t status = p[13];
+            if (status != 0) return status;
+            if (received < size) return -2;  // short read
+            return 0;
+        } else {
+            return -2;
+        }
+    }
+}
+
+// Stream [part_offset, part_offset+len) of payload as WriteData pieces
+// (block-bounded, CRC per piece) and collect one ack per piece.
+// Assumes WriteInit has already been exchanged on this socket.
+int lz_write_part(int fd, uint64_t chunk_id, const uint8_t* payload,
+                  uint64_t len, uint64_t part_offset,
+                  uint32_t first_write_id) {
+    std::vector<uint8_t> frame(8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 4 + kBlockSize);
+    uint32_t write_id = first_write_id;
+    uint32_t pieces = 0;
+    uint64_t pos = 0;
+    while (pos < len) {
+        uint64_t abs = part_offset + pos;
+        uint32_t block = static_cast<uint32_t>(abs / kBlockSize);
+        uint32_t block_off = static_cast<uint32_t>(abs % kBlockSize);
+        uint32_t take = kBlockSize - block_off;
+        if (take > len - pos) take = static_cast<uint32_t>(len - pos);
+        const uint8_t* data = payload + pos;
+        uint32_t crc = lz_crc32(0, data, take);
+        size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4 + 4 + take;
+        uint8_t* f = frame.data();
+        put32(f, kTypeWriteData);
+        put32(f + 4, static_cast<uint32_t>(body));
+        f[8] = kProtoVersion;
+        put32(f + 9, write_id);       // req_id
+        put64(f + 13, chunk_id);
+        put32(f + 21, write_id);
+        put32(f + 25, block);
+        put32(f + 29, block_off);
+        put32(f + 33, crc);
+        put32(f + 37, take);
+        std::memcpy(f + 41, data, take);
+        if (!send_all(fd, f, 8 + body)) return -1;
+        ++write_id;
+        ++pieces;
+        pos += take;
+    }
+    // collect acks (they may interleave arbitrarily by write_id)
+    std::vector<uint8_t> payload_buf(256);
+    for (uint32_t i = 0; i < pieces; ++i) {
+        uint8_t header[8];
+        if (!recv_all(fd, header, 8)) return -1;
+        uint32_t type = get32(header);
+        uint32_t length = get32(header + 4);
+        if (length < 1 || length > payload_buf.size()) return -2;
+        if (!recv_all(fd, payload_buf.data(), length)) return -1;
+        if (type != kTypeWriteStatus) return -2;
+        uint8_t status = payload_buf[17];
+        if (status != 0) return status;
+    }
+    return 0;
+}
+
+}  // extern "C"

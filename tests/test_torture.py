@@ -32,6 +32,7 @@ async def test_torture_random_ops_with_failures(tmp_path):
     inodes: dict[str, int] = {}
     goals = [2, EC_GOAL, XOR_GOAL]
     down: list[tuple[int, ChunkServer]] = []  # (index, stopped server)
+    write_target: list[str | None] = [None]  # file a failed write may tear
 
     async def op_create():
         name = f"f{rng.randrange(10**9)}"
@@ -39,9 +40,10 @@ async def test_torture_random_ops_with_failures(tmp_path):
         await c.setgoal(attr.inode, rng.choice(goals))
         size = rng.randrange(1, 3 * MFSBLOCKSIZE)
         payload = data_generator.generate(rng.randrange(10**6), size).tobytes()
+        inodes[name] = attr.inode
+        write_target[0] = name
         await c.write_file(attr.inode, payload)
         model[name] = payload
-        inodes[name] = attr.inode
 
     async def op_overwrite():
         if not model:
@@ -50,6 +52,7 @@ async def test_torture_random_ops_with_failures(tmp_path):
         off = rng.randrange(0, max(len(model[name]), 1))
         size = rng.randrange(1, 2 * MFSBLOCKSIZE)
         patch = data_generator.generate(rng.randrange(10**6), size).tobytes()
+        write_target[0] = name
         await c.pwrite(inodes[name], off, patch)
         buf = bytearray(model[name])
         if off + size > len(buf):
@@ -114,6 +117,7 @@ async def test_torture_random_ops_with_failures(tmp_path):
     try:
         for step in range(60):
             fn = rng.choice(weighted)
+            write_target[0] = None
             try:
                 await fn()
             except st.StatusError as e:
@@ -121,6 +125,13 @@ async def test_torture_random_ops_with_failures(tmp_path):
                 assert e.code in (st.EIO, st.NO_CHUNK_SERVERS, st.CHUNK_BUSY), (
                     f"step {step} {fn.__name__}: {e}"
                 )
+                # a write that failed even after the client's internal
+                # retries leaves that file's contents unspecified (POSIX
+                # failed-write semantics): drop it from the shadow model
+                torn = write_target[0]
+                if torn is not None:
+                    model.pop(torn, None)
+                    inodes.pop(torn, None)
 
         # revive everything, let the cluster heal, then verify all bytes
         while down:
