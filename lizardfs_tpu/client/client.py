@@ -92,6 +92,24 @@ class Client:
         self.oplog.append((_time.time(), op, kw))
         self.op_counters[op] = self.op_counters.get(op, 0) + 1
 
+    async def _retry_transient(self, what: str, attempt_fn) -> None:
+        """Run ``attempt_fn`` with exponential backoff on TRANSIENT
+        failures; permanent errors surface immediately. Always makes at
+        least one attempt regardless of the retries setting."""
+        last: Exception | None = None
+        for attempt in range(max(self.retries, 1)):
+            if attempt:
+                await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
+            try:
+                await attempt_fn()
+                return
+            except (st.StatusError, ReadError, ConnectionError, OSError) as e:
+                if not _is_transient(e):
+                    raise
+                last = e
+                log.info("%s retry %d: %s", what, attempt + 1, e)
+        raise st.StatusError(st.EIO, f"{what} failed after retries: {last}")
+
     # --- session -----------------------------------------------------------------
 
     async def connect(self, info: str = "pyclient", password: str = "") -> None:
@@ -382,23 +400,13 @@ class Client:
         index = 0
         while pos < total:
             end = min(pos + MFSCHUNKSIZE, total)
-            last: Exception | None = None
-            for attempt in range(self.retries):
-                if attempt:
-                    await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
-                try:
-                    await self._write_chunk(
-                        inode, index, data[pos:end], file_length=end
-                    )
-                    last = None
-                    break
-                except (st.StatusError, ReadError, ConnectionError, OSError) as e:
-                    if not _is_transient(e):
-                        raise
-                    last = e
-                    log.info("write retry %d chunk %d: %s", attempt + 1, index, e)
-            if last is not None:
-                raise st.StatusError(st.EIO, f"write failed after retries: {last}")
+            piece = data[pos:end]
+            ci = index
+
+            async def attempt(piece=piece, ci=ci, end=end):
+                await self._write_chunk(inode, ci, piece, file_length=end)
+
+            await self._retry_transient(f"write chunk {ci}", attempt)
             pos = end
             index += 1
         if old_length > total:
@@ -438,21 +446,12 @@ class Client:
             # not, parity stale); each retry takes a FRESH grant — the
             # version bump drops unreachable holders and the full region
             # rewrite restores stripe consistency on the survivors
-            last: Exception | None = None
-            for attempt in range(self.retries):
-                if attempt:
-                    await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
-                try:
-                    await self._pwrite_chunk_locked(
-                        inode, ci, coff, piece, old_length, new_length
-                    )
-                    return
-                except (st.StatusError, ReadError, ConnectionError, OSError) as e:
-                    if not _is_transient(e):
-                        raise
-                    last = e
-                    log.info("pwrite retry %d chunk %d: %s", attempt + 1, ci, e)
-            raise st.StatusError(st.EIO, f"pwrite failed after retries: {last}")
+            async def attempt():
+                await self._pwrite_chunk_locked(
+                    inode, ci, coff, piece, old_length, new_length
+                )
+
+            await self._retry_transient(f"pwrite chunk {ci}", attempt)
 
     async def _pwrite_chunk_locked(
         self, inode: int, ci: int, coff: int, piece: np.ndarray,

@@ -145,7 +145,9 @@ def read_part_blocking(
     assert out.flags.c_contiguous and out.nbytes >= size
     ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     for attempt in (0, 1):
-        sock = POOL.acquire(addr)
+        # second attempt dials fresh: the pool may hold several sockets
+        # staled by the same server restart
+        sock = POOL.acquire(addr) if attempt == 0 else _blocking_socket(addr, 30.0)
         rc = _lib.lz_read_part(
             sock.fileno(), chunk_id, version, part_id, offset, size, ptr
         )

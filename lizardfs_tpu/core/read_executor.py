@@ -56,18 +56,21 @@ async def read_part_range(
     from lizardfs_tpu.core import native_io
 
     if native_io.available() and size >= native_io.NATIVE_READ_THRESHOLD:
-        view = out[into_offset : into_offset + size]
-        if view.flags.c_contiguous:
-            try:
-                await native_io.run(
-                    native_io.read_part_blocking,
-                    addr, chunk_id, version, part_id, offset, size, view,
-                )
-                return out
-            except native_io.NativeIOError as e:
-                raise ReadError(str(e)) from None
-            except (OSError, ConnectionError) as e:
-                raise ReadError(f"native read failed: {e}") from None
+        # the executor thread is uninterruptible: it must scatter into a
+        # PRIVATE buffer so a cancelled straggler can't keep writing the
+        # shared plan buffer while recovery post-processing reads it
+        tmp = np.empty(size, dtype=np.uint8)
+        try:
+            await native_io.run(
+                native_io.read_part_blocking,
+                addr, chunk_id, version, part_id, offset, size, tmp,
+            )
+            out[into_offset : into_offset + size] = tmp
+            return out
+        except native_io.NativeIOError as e:
+            raise ReadError(str(e)) from None
+        except (OSError, ConnectionError) as e:
+            raise ReadError(f"native read failed: {e}") from None
 
     conn = await GLOBAL_POOL.acquire(addr)
     clean = False

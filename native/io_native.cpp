@@ -136,6 +136,7 @@ int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
             std::memcpy(out + (piece_off - offset), data, dlen);
             received += dlen;
         } else if (type == kTypeReadStatus) {
+            if (length < 14) return -2;
             uint8_t status = p[13];
             if (status != 0) return status;
             if (received < size) return -2;  // short read
@@ -192,6 +193,7 @@ int lz_write_part(int fd, uint64_t chunk_id, const uint8_t* payload,
         if (length < 1 || length > payload_buf.size()) return -2;
         if (!recv_all(fd, payload_buf.data(), length)) return -1;
         if (type != kTypeWriteStatus) return -2;
+        if (length < 18 || payload_buf[0] != kProtoVersion) return -2;
         uint8_t status = payload_buf[17];
         if (status != 0) return status;
     }
