@@ -85,6 +85,16 @@ class Client:
         self._chunk_write_locks: dict[tuple[int, int], asyncio.Lock] = {}
         # waiting lock requests: (inode, token) -> grant queue
         self._lock_grants: dict[tuple[int, int], asyncio.Queue] = {}
+        # identity attached to permission-checked ops when the caller
+        # doesn't supply one (FUSE passes the kernel caller's context)
+        self.default_uid = 0
+        self.default_gids = [0]
+
+    def _ident(self, uid, gids) -> dict:
+        return {
+            "uid": self.default_uid if uid is None else uid,
+            "gids": list(self.default_gids) if gids is None else list(gids),
+        }
 
     def _record(self, op: str, **kw) -> None:
         import time as _time
@@ -147,8 +157,11 @@ class Client:
 
     # --- metadata ops ---------------------------------------------------------------
 
-    async def lookup(self, parent: int, name: str) -> m.Attr:
-        r = await self._call(m.CltomaLookup, parent=parent, name=name)
+    async def lookup(self, parent: int, name: str, uid: int | None = None,
+                     gids: list[int] | None = None) -> m.Attr:
+        r = await self._call(
+            m.CltomaLookup, parent=parent, name=name, **self._ident(uid, gids)
+        )
         return r.attr
 
     async def getattr(self, inode: int) -> m.Attr:
@@ -171,20 +184,32 @@ class Client:
         )
         return r.attr
 
-    async def readdir(self, inode: int) -> list[m.DirEntry]:
-        r = await self._call(m.CltomaReaddir, inode=inode)
+    async def readdir(self, inode: int, uid: int | None = None,
+                      gids: list[int] | None = None) -> list[m.DirEntry]:
+        r = await self._call(
+            m.CltomaReaddir, inode=inode, **self._ident(uid, gids)
+        )
         return r.entries
 
-    async def unlink(self, parent: int, name: str) -> None:
-        await self._call(m.CltomaUnlink, parent=parent, name=name)
+    async def unlink(self, parent: int, name: str, uid: int | None = None,
+                     gids: list[int] | None = None) -> None:
+        await self._call(
+            m.CltomaUnlink, parent=parent, name=name, **self._ident(uid, gids)
+        )
 
-    async def rmdir(self, parent: int, name: str) -> None:
-        await self._call(m.CltomaRmdir, parent=parent, name=name)
+    async def rmdir(self, parent: int, name: str, uid: int | None = None,
+                     gids: list[int] | None = None) -> None:
+        await self._call(
+            m.CltomaRmdir, parent=parent, name=name, **self._ident(uid, gids)
+        )
 
-    async def rename(self, psrc: int, nsrc: str, pdst: int, ndst: str) -> None:
+    async def rename(self, psrc: int, nsrc: str, pdst: int, ndst: str,
+                     uid: int | None = None,
+                     gids: list[int] | None = None) -> None:
         await self._call(
             m.CltomaRename,
             parent_src=psrc, name_src=nsrc, parent_dst=pdst, name_dst=ndst,
+            **self._ident(uid, gids),
         )
 
     async def symlink(self, parent: int, name: str, target: str) -> m.Attr:
@@ -206,8 +231,12 @@ class Client:
     async def setgoal(self, inode: int, goal: int) -> None:
         await self._call(m.CltomaSetGoal, inode=inode, goal=goal)
 
-    async def truncate(self, inode: int, length: int) -> m.Attr:
-        r = await self._call(m.CltomaTruncate, inode=inode, length=length)
+    async def truncate(self, inode: int, length: int, uid: int | None = None,
+                       gids: list[int] | None = None) -> m.Attr:
+        r = await self._call(
+            m.CltomaTruncate, inode=inode, length=length,
+            **self._ident(uid, gids),
+        )
         self.cache.invalidate(inode)
         return r.attr
 
@@ -243,7 +272,8 @@ class Client:
     async def chunk_info(self, inode: int, chunk_index: int) -> m.MatoclReadChunk:
         """Chunk id/version/locations at a file position (fileinfo)."""
         return await self._call(
-            m.CltomaReadChunk, inode=inode, chunk_index=chunk_index
+            m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
+            **self._ident(None, None),
         )
 
     async def snapshot(self, src_inode: int, dst_parent: int, dst_name: str) -> m.Attr:
@@ -457,7 +487,10 @@ class Client:
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
         old_length: int, new_length: int,
     ) -> None:
-        grant = await self._call(m.CltomaWriteChunk, inode=inode, chunk_index=ci)
+        grant = await self._call(
+            m.CltomaWriteChunk, inode=inode, chunk_index=ci,
+            **self._ident(None, None),
+        )
         self.cache.invalidate(inode, ci)
         status_code = st.EIO
         try:
@@ -556,7 +589,8 @@ class Client:
         self, inode: int, chunk_index: int, chunk_data: np.ndarray, file_length: int
     ) -> None:
         grant = await self._call(
-            m.CltomaWriteChunk, inode=inode, chunk_index=chunk_index
+            m.CltomaWriteChunk, inode=inode, chunk_index=chunk_index,
+            **self._ident(None, None),
         )
         self.cache.invalidate(inode, chunk_index)
         status_code = st.EIO
@@ -758,7 +792,8 @@ class Client:
             if attempt:
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
             loc = await self._call(
-                m.CltomaReadChunk, inode=inode, chunk_index=chunk_index
+                m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
+                **self._ident(None, None),
             )
             if loc.chunk_id == 0:
                 return np.zeros(size, dtype=np.uint8)  # hole

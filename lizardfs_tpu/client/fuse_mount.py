@@ -168,10 +168,22 @@ def _load_libfuse():
     return None
 
 
+class FuseContext(ctypes.Structure):
+    _fields_ = [
+        ("fuse", c_void_p),
+        ("uid", ctypes.c_uint32),
+        ("gid", ctypes.c_uint32),
+        ("pid", ctypes.c_int32),
+        ("private_data", c_void_p),
+        ("umask", ctypes.c_uint32),
+    ]
+
+
 class LizardFuse:
     """Bridges libfuse callbacks to the async Client."""
 
     def __init__(self, master_addrs: list[tuple[str, int]]):
+        self.libfuse = None  # set by mount(); enables caller identity
         self.loop = asyncio.new_event_loop()
         self.client = Client("", 0, master_addrs=master_addrs)
         self._loop_thread = threading.Thread(
@@ -195,6 +207,19 @@ class LizardFuse:
 
     def _resolve_parent(self, path: bytes):
         return self._run(self.client.resolve_parent(path.decode()))
+
+    def _caller(self) -> tuple[int, list[int]]:
+        """Kernel caller identity from fuse_get_context (uid, gids)."""
+        if self.libfuse is None:
+            return 0, [0]
+        try:
+            ctx = self.libfuse.fuse_get_context()
+            if ctx:
+                c = ctx.contents
+                return int(c.uid), [int(c.gid)]
+        except Exception:  # noqa: BLE001
+            pass
+        return 0, [0]
 
     @staticmethod
     def _errno(e: Exception) -> int:
@@ -309,9 +334,12 @@ class LizardFuse:
             return 0
 
         def op_create(path, mode, fi):
+            uid, gids = self._caller()
             parent, name = self._resolve_parent(path)
             attr = self._run(
-                self.client.create(parent.inode, name, mode & 0o7777)
+                self.client.create(
+                    parent.inode, name, mode & 0o7777, uid=uid, gid=gids[0]
+                )
             )
             fi.contents.fh = attr.inode
             return 0
@@ -322,7 +350,17 @@ class LizardFuse:
                 self._special_snap[bytes(path)] = special
                 fi.contents.fh = 0
                 return 0
-            fi.contents.fh = self._resolve(path).inode
+            node = self._resolve(path)
+            # enforce at open like default_permissions: read or write
+            # intent from O_ACCMODE against mode bits + ACLs
+            uid, gids = self._caller()
+            if uid != 0:
+                accmode = fi.contents.flags & 3  # O_RDONLY/O_WRONLY/O_RDWR
+                want = {0: 4, 1: 2, 2: 6}.get(accmode, 4)
+                ok = self._run(self.client.access(node.inode, uid, gids, want))
+                if not ok:
+                    return -errno.EACCES
+            fi.contents.fh = node.inode
             return 0
 
         def op_unlink(path):
@@ -479,7 +517,9 @@ def mount(master_addrs: list[tuple[str, int]], mountpoint: str,
     if lib is None:
         print("error: libfuse2 not found", file=sys.stderr)
         return 1
+    lib.fuse_get_context.restype = ctypes.POINTER(FuseContext)
     bridge = LizardFuse(master_addrs)
+    bridge.libfuse = lib
     bridge.start()
     ops = bridge.build_operations()
     argv_list = [b"lizardfs-fuse", mountpoint.encode()]

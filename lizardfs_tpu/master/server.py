@@ -394,6 +394,16 @@ class MasterServer(Daemon):
             cur = node.parents[0]
             hops += 1
 
+    def _check_perm(self, node, uid: int, gids: list[int], want: int) -> None:
+        """Mode-bit + POSIX-ACL permission check (EACCES on refusal)."""
+        from lizardfs_tpu.master import acl as acl_mod
+
+        a = acl_mod.Acl.from_dict(node.acl) if node.acl else None
+        if not acl_mod.check_access(
+            node.mode, node.uid, node.gid, a, uid, gids, want
+        ):
+            raise fsmod.FsError(st.EACCES, f"inode {node.inode}")
+
     def _grant_pending_locks(self, inode: int) -> None:
         for granted in self.locks.retry_pending(inode):
             w = self._session_writers.get(granted.owner.session_id)
@@ -473,11 +483,13 @@ class MasterServer(Daemon):
             if not self._apply_session_view(msg, session):
                 return self._error_reply(msg, st.EACCES)
         if isinstance(msg, m.CltomaLookup):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 1)
             node = fs.lookup(msg.parent, msg.name)
             return self._attr_reply(msg.req_id, node)
         if isinstance(msg, m.CltomaGetattr):
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaMkdir):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
             self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             inode = fs.alloc_inode()
             self.commit({
@@ -488,6 +500,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(inode))
         if isinstance(msg, m.CltomaCreate):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
             self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             parent_goal = fs.dir_node(msg.parent).goal
             inode = fs.alloc_inode()
@@ -499,6 +512,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(inode))
         if isinstance(msg, m.CltomaSymlink):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
             self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
             inode = fs.alloc_inode()
             self.commit({
@@ -524,6 +538,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaReaddir):
+            self._check_perm(fs.dir_node(msg.inode), msg.uid, list(msg.gids), 4)
             node = fs.dir_node(msg.inode)
             entries = [
                 m.DirEntry(name=name, inode=i, ftype=fs.node(i).ftype)
@@ -531,15 +546,20 @@ class MasterServer(Daemon):
             ]
             return m.MatoclReaddir(req_id=msg.req_id, status=st.OK, entries=entries)
         if isinstance(msg, m.CltomaUnlink):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 2 | 1)
             self.commit({
                 "op": "unlink", "parent": msg.parent, "name": msg.name,
                 "ts": now, "to_trash": True,
             })
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaRmdir):
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 2 | 1)
             self.commit({"op": "rmdir", "parent": msg.parent, "name": msg.name, "ts": now})
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaRename):
+            ident = (msg.uid, list(msg.gids))
+            self._check_perm(fs.dir_node(msg.parent_src), *ident, 2 | 1)
+            self._check_perm(fs.dir_node(msg.parent_dst), *ident, 2 | 1)
             self.commit({
                 "op": "rename", "parent_src": msg.parent_src,
                 "name_src": msg.name_src, "parent_dst": msg.parent_dst,
@@ -560,6 +580,7 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaTruncate):
+            self._check_perm(fs.file_node(msg.inode), msg.uid, list(msg.gids), 2)
             self.commit({"op": "set_length", "inode": msg.inode,
                          "length": msg.length, "ts": now})
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
@@ -746,6 +767,7 @@ class MasterServer(Daemon):
 
     async def _read_chunk(self, msg: m.CltomaReadChunk, client_ip: str | None = None):
         node = self.meta.fs.file_node(msg.inode)
+        self._check_perm(node, msg.uid, list(msg.gids), 4)
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
         )
@@ -764,6 +786,7 @@ class MasterServer(Daemon):
 
     async def _write_chunk(self, msg: m.CltomaWriteChunk):
         node = self.meta.fs.file_node(msg.inode)
+        self._check_perm(node, msg.uid, list(msg.gids), 2)
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
         )

@@ -95,7 +95,13 @@ class MatoclRegister(Message):
 
 class CltomaLookup(Message):
     MSG_TYPE = 1002
-    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclAttrReply(Message):
@@ -136,7 +142,12 @@ class CltomaCreate(Message):
 
 class CltomaReaddir(Message):
     MSG_TYPE = 1010
-    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclReaddir(Message):
@@ -150,7 +161,13 @@ class MatoclReaddir(Message):
 
 class CltomaUnlink(Message):
     MSG_TYPE = 1012
-    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclStatusReply(Message):
@@ -162,7 +179,13 @@ class MatoclStatusReply(Message):
 
 class CltomaRmdir(Message):
     MSG_TYPE = 1014
-    FIELDS = (("req_id", "u32"), ("parent", "u32"), ("name", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("parent", "u32"),
+        ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class CltomaRename(Message):
@@ -173,6 +196,8 @@ class CltomaRename(Message):
         ("name_src", "str"),
         ("parent_dst", "u32"),
         ("name_dst", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
     )
 
 
@@ -183,7 +208,13 @@ class CltomaSetGoal(Message):
 
 class CltomaReadChunk(Message):
     MSG_TYPE = 1020
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("chunk_index", "u32"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("chunk_index", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclReadChunk(Message):
@@ -200,7 +231,13 @@ class MatoclReadChunk(Message):
 
 class CltomaWriteChunk(Message):
     MSG_TYPE = 1022
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("chunk_index", "u32"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("chunk_index", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclWriteChunk(Message):
@@ -229,7 +266,13 @@ class CltomaWriteChunkEnd(Message):
 
 class CltomaTruncate(Message):
     MSG_TYPE = 1026
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("length", "u64"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("length", "u64"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class CltomaSetattr(Message):

@@ -34,6 +34,8 @@ async def test_acl_rpc_and_inheritance(tmp_path):
     await cluster.start()
     try:
         c = await cluster.client()
+        # root opens up a world-writable area first (enforcement is on)
+        await c.setattr(1, 1, mode=0o777)
         d = await c.mkdir(1, "proj", uid=10, gid=20)
         f = await c.create(d.inode, "f1", uid=10, gid=20)
 
@@ -68,5 +70,52 @@ async def test_acl_rpc_and_inheritance(tmp_path):
         rebuilt = MetadataStore()
         rebuilt.load_sections(doc)
         assert rebuilt.fs.node(f2.inode).acl["users"] == {"11": 6}
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_permission_enforcement(tmp_path):
+    """Mode-bit + ACL enforcement on metadata and data-plane grants."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        await c.setattr(1, 1, mode=0o777)
+        d = await c.mkdir(1, "home", mode=0o750, uid=10, gid=20)
+        f = await c.create(d.inode, "secret", mode=0o600, uid=10, gid=20)
+        await c.write_file(f.inode, b"top secret")
+
+        # owner reads fine
+        assert (await c.lookup(d.inode, "secret", uid=10, gids=[20])).inode == f.inode
+        # 0o750: group member has r+x on the dir -> readdir allowed
+        entries = await c.readdir(d.inode, uid=12, gids=[20])
+        assert [x.name for x in entries] == ["secret"]
+        # ...but an outsider has nothing
+        with pytest.raises(st.StatusError) as e:
+            await c.readdir(d.inode, uid=99, gids=[99])
+        assert e.value.code == st.EACCES
+        # outsider can't even lookup through the dir (no x)
+        with pytest.raises(st.StatusError) as e:
+            await c.lookup(d.inode, "secret", uid=99, gids=[99])
+        assert e.value.code == st.EACCES
+        # group member can't open the 600 file for read at the grant level
+        cluster.master.meta.fs  # (read grant goes through CltomaReadChunk)
+        from lizardfs_tpu.proto import messages as msgs
+
+        r = await c.master.call(
+            msgs.CltomaReadChunk, inode=f.inode, chunk_index=0, uid=12, gids=[20]
+        )
+        assert r.status == st.EACCES
+        # unprivileged truncate denied
+        with pytest.raises(st.StatusError) as e:
+            await c.truncate(f.inode, 0, uid=12, gids=[20])
+        assert e.value.code == st.EACCES
+        # named-user ACL opens the file to uid 12
+        await c.set_acl(f.inode, {"users": {"12": 4}, "groups": {}, "mask": 4})
+        r = await c.master.call(
+            msgs.CltomaReadChunk, inode=f.inode, chunk_index=0, uid=12, gids=[20]
+        )
+        assert r.status == st.OK
     finally:
         await cluster.stop()

@@ -67,7 +67,7 @@ async def test_quota_enforcement(tmp_path):
     await cluster.start()
     try:
         c = await cluster.client()
-        d = await c.mkdir(1, "limited")
+        d = await c.mkdir(1, "limited", mode=0o777)
         # directory quota: at most 3 inodes in the subtree (dir itself = 1)
         await c.set_quota("dir", d.inode, hard_inodes=3)
         await c.create(d.inode, "a", uid=7, gid=7)
@@ -215,9 +215,10 @@ async def test_quota_rmdir_and_rename_release(tmp_path):
     await cluster.start()
     try:
         c = await cluster.client()
+        await c.setattr(1, 1, mode=0o777)  # let uid 5 create under /
         q = cluster.master.meta.quotas
         base_inodes = q.entry("user", 5, create=True).used_inodes
-        d = await c.mkdir(1, "tmpdir", uid=5, gid=5)
+        d = await c.mkdir(1, "tmpdir", mode=0o777, uid=5, gid=5)
         assert q.entry("user", 5).used_inodes == base_inodes + 1
         await c.rmdir(1, "tmpdir")
         assert q.entry("user", 5).used_inodes == base_inodes

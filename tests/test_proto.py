@@ -22,7 +22,7 @@ def roundtrip(msg):
 
 
 def test_serializer_roundtrips():
-    roundtrip(m.CltomaLookup(req_id=7, parent=1, name="héllo"))
+    roundtrip(m.CltomaLookup(req_id=7, parent=1, name="héllo", uid=5, gids=[5, 6]))
     roundtrip(
         m.MatoclReadChunk(
             req_id=9,
@@ -130,7 +130,7 @@ async def test_rpc_over_fake_server():
 
     # concurrent pipelined calls
     replies = await asyncio.gather(
-        *(conn.call(m.CltomaLookup, parent=1, name=f"f{i}") for i in range(5))
+        *(conn.call(m.CltomaLookup, parent=1, name=f"f{i}", uid=0, gids=[0]) for i in range(5))
     )
     assert all(r.attr.inode == 42 for r in replies)
     assert pushes == ["x"] * 5
