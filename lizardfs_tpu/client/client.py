@@ -89,6 +89,39 @@ class Client:
         # doesn't supply one (FUSE passes the kernel caller's context)
         self.default_uid = 0
         self.default_gids = [0]
+        # cluster-wide QoS (LimiterProxy analog): a TokenBucket paced by
+        # master-granted bandwidth shares; None until the master says a
+        # limit applies
+        from lizardfs_tpu.runtime.limiter import TokenBucket
+
+        self._io_bucket: TokenBucket | None = None
+        self._io_limit_next_renew = 0.0
+
+    async def _throttle(self, nbytes: int) -> None:
+        """Apply the master-coordinated IO limit to a data transfer."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now >= self._io_limit_next_renew:
+            self._io_limit_next_renew = now + 1.0
+            try:
+                r = await self.master.call(m.CltomaIoLimitRequest, timeout=5.0)
+                rate = float(r.bytes_per_sec)
+                self._io_limit_next_renew = now + r.renew_ms / 1000.0
+                if rate <= 0:
+                    self._io_bucket = None
+                elif self._io_bucket is None:
+                    from lizardfs_tpu.runtime.limiter import TokenBucket
+
+                    self._io_bucket = TokenBucket(rate, burst=rate)
+                    self._io_bucket._tokens = 0.0  # pace from the start
+                else:
+                    self._io_bucket.rate = rate
+                    self._io_bucket.burst = rate
+            except (ConnectionError, asyncio.TimeoutError, st.StatusError):
+                pass  # keep the previous allocation
+        if self._io_bucket is not None:
+            await self._io_bucket.acquire(nbytes)
 
     def _ident(self, uid, gids) -> dict:
         return {
@@ -647,6 +680,7 @@ class Client:
         head of the chain + forwarding for extra copies (WriteExecutor
         analog, write_executor.cc:66-96). Pieces never cross 64 KiB block
         boundaries; each carries its own CRC."""
+        await self._throttle(max(length, 0))
         head = locs[0]
         chain = locs[1:]
 
@@ -832,6 +866,7 @@ class Client:
             )
         if slice_type is None:
             raise ReadError("no locations for chunk")
+        await self._throttle(size)
         # first attempt: the master's topology-preferred (closest) copy;
         # retries randomize so a dead replica gets rotated off
         by_part = {

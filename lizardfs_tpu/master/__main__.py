@@ -54,6 +54,7 @@ async def _run(cfg: Config) -> None:
         active_addr=_hostport(active) if active else None,
         exports=exports,
         topology=topology,
+        io_limit_bps=cfg.get_int("IO_LIMIT_BPS", 0),
     )
     controller = None
     if cfg.get_str("ELECTION_ID", ""):

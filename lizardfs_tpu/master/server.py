@@ -94,6 +94,7 @@ class MasterServer(Daemon):
         active_addr: tuple[str, int] | None = None,
         exports=None,
         topology=None,
+        io_limit_bps: int = 0,
     ):
         super().__init__(host, port)
         self.data_dir = data_dir
@@ -116,6 +117,10 @@ class MasterServer(Daemon):
         from lizardfs_tpu.master.tasks import TaskManager
 
         self.task_manager = TaskManager(self.commit)
+        # global IO budget (bytes/s, 0 = unlimited) divided among the
+        # sessions that renewed an allocation recently
+        self.io_limit_bps = io_limit_bps
+        self._io_limited_sessions: dict[int, float] = {}  # sid -> last renew
         # personality: "master" (active) or "shadow" (applies the
         # changelog stream from active_addr; promotable at runtime)
         # (src/master/personality.h:25-69 analog)
@@ -674,6 +679,28 @@ class MasterServer(Daemon):
             )
             return m.MatoclStatusReply(
                 req_id=msg.req_id, status=st.OK if ok else st.EACCES
+            )
+        if isinstance(msg, m.CltomaIoLimitRequest):
+            if self.io_limit_bps <= 0:
+                return m.MatoclIoLimitReply(
+                    req_id=msg.req_id, status=st.OK, bytes_per_sec=0,
+                    renew_ms=10_000,
+                )
+            mono = time.monotonic()
+            self._io_limited_sessions[session_id] = mono
+            # equal shares among sessions that renewed in the last 5 s
+            live = {
+                sid for sid, ts in self._io_limited_sessions.items()
+                if mono - ts < 5.0
+            }
+            self._io_limited_sessions = {
+                sid: ts for sid, ts in self._io_limited_sessions.items()
+                if sid in live
+            }
+            share = self.io_limit_bps // max(len(live), 1)
+            return m.MatoclIoLimitReply(
+                req_id=msg.req_id, status=st.OK, bytes_per_sec=share,
+                renew_ms=1000,
             )
         if isinstance(msg, m.CltomaTrashList):
             rows = [

@@ -451,6 +451,24 @@ class CltomaAccess(Message):
     )
 
 
+class CltomaIoLimitRequest(Message):
+    """Request/renew a bandwidth allocation (globaliolimits analog:
+    the master divides the cluster budget among limited sessions)."""
+
+    MSG_TYPE = 1062
+    FIELDS = (("req_id", "u32"),)
+
+
+class MatoclIoLimitReply(Message):
+    MSG_TYPE = 1063
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("bytes_per_sec", "u64"),  # 0 = unlimited
+        ("renew_ms", "u32"),
+    )
+
+
 class CltomaTrashList(Message):
     MSG_TYPE = 1052
     FIELDS = (("req_id", "u32"),)

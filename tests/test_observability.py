@@ -94,3 +94,39 @@ async def test_admin_metrics_and_tweaks(tmp_path):
         assert any(op == "CltomaWriteChunk" for _, op, _ in c.oplog)
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_global_io_limits(tmp_path):
+    """Master-coordinated QoS: a cluster budget paces client transfers."""
+    from lizardfs_tpu.chunkserver.server import ChunkServer
+    from lizardfs_tpu.client.client import Client
+    from lizardfs_tpu.master.server import MasterServer
+    from tests.test_cluster import make_goals
+
+    master = MasterServer(
+        str(tmp_path / "m"), goals=make_goals(),
+        io_limit_bps=2_000_000,  # 2 MB/s cluster budget
+    )
+    await master.start()
+    servers = []
+    for i in range(3):
+        cs = ChunkServer(str(tmp_path / f"cs{i}"),
+                         master_addr=("127.0.0.1", master.port))
+        await cs.start()
+        servers.append(cs)
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    try:
+        f = await c.create(1, "throttled.bin")
+        payload = b"z" * 1_000_000  # 1 MB at 2 MB/s ≈ 0.5 s floor
+        t0 = time.monotonic()
+        await c.write_file(f.inode, payload)
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.25, f"write not throttled ({elapsed:.2f}s)"
+        assert c._io_bucket is not None and c._io_bucket.rate == 2_000_000
+    finally:
+        await c.close()
+        for cs in servers:
+            await cs.stop()
+        await master.stop()
