@@ -49,8 +49,32 @@ PAGE = """<!doctype html>
 <th>used / total GiB</th></tr>{servers}</table>
 <h2>metadata ops (last 120 s)</h2>
 <pre>{ops}</pre>
+<h2>charts (last 120 s)</h2>
+{charts}
 </body></html>
 """
+
+
+def sparkline(points, width=480, height=60, color="#8ab4f8"):
+    """Inline SVG sparkline of a numeric series (charts rendering)."""
+    pts = [max(float(p), 0.0) for p in points][-120:]
+    if not pts:
+        pts = [0.0]
+    peak = max(pts) or 1.0
+    n = len(pts)
+    step = width / max(n - 1, 1)
+    coords = " ".join(
+        f"{i * step:.1f},{height - 2 - (v / peak) * (height - 6):.1f}"
+        for i, v in enumerate(pts)
+    )
+    return (
+        f'<svg width="{width}" height="{height}" '
+        f'style="background:#1a1a1a;border:1px solid #333">'
+        f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+        f'points="{coords}"/>'
+        f'<text x="4" y="12" fill="#888" font-size="10">peak {peak:.0f}</text>'
+        f"</svg>"
+    )
 
 
 async def _admin(addr, msg):
@@ -117,6 +141,13 @@ class Dashboard:
                     f"{name:<24s} total={series['total']:<10.0f} "
                     f"last120s={sum(pts):.0f}"
                 )
+        charts_html = []
+        for name in ("metadata_ops", "chunks", "chunkservers_connected"):
+            series = metrics.get(name)
+            if series:
+                charts_html.append(
+                    f"<div><b>{name}</b><br>{sparkline(series['points'])}</div>"
+                )
         return PAGE.format(
             personality=info.get("personality", "?"),
             version=info.get("version", 0),
@@ -130,6 +161,7 @@ class Dashboard:
             lost_cls="bad" if health.get("lost") else "ok",
             servers="".join(rows) or "<tr><td colspan=5>none</td></tr>",
             ops="\n".join(sorted(ops_lines)) or "(no ops yet)",
+            charts="".join(charts_html) or "(no series yet)",
         )
 
 
