@@ -255,9 +255,12 @@ class Client:
         r = await self._call(m.CltomaReadlink, inode=inode)
         return r.target
 
-    async def link(self, inode: int, parent: int, name: str) -> m.Attr:
+    async def link(self, inode: int, parent: int, name: str,
+                   uid: int | None = None,
+                   gids: list[int] | None = None) -> m.Attr:
         r = await self._call(
-            m.CltomaLink, inode=inode, parent=parent, name=name
+            m.CltomaLink, inode=inode, parent=parent, name=name,
+            **self._ident(uid, gids),
         )
         return r.attr
 
@@ -276,10 +279,13 @@ class Client:
     async def setattr(
         self, inode: int, set_mask: int, mode: int = 0, uid: int = 0,
         gid: int = 0, atime: int = 0, mtime: int = 0, trash_time: int = 0,
+        caller_uid: int | None = None, caller_gids: list[int] | None = None,
     ) -> m.Attr:
+        ident = self._ident(caller_uid, caller_gids)
         r = await self._call(
             m.CltomaSetattr, inode=inode, set_mask=set_mask, mode=mode,
             uid=uid, gid=gid, atime=atime, mtime=mtime, trash_time=trash_time,
+            caller_uid=ident["uid"], caller_gids=ident["gids"],
         )
         return r.attr
 
@@ -309,11 +315,13 @@ class Client:
             **self._ident(None, None),
         )
 
-    async def snapshot(self, src_inode: int, dst_parent: int, dst_name: str) -> m.Attr:
+    async def snapshot(self, src_inode: int, dst_parent: int, dst_name: str,
+                       uid: int | None = None,
+                       gids: list[int] | None = None) -> m.Attr:
         """COW snapshot of a file or subtree (makesnapshot analog)."""
         r = await self._call(
             m.CltomaSnapshot, src_inode=src_inode, dst_parent=dst_parent,
-            dst_name=dst_name,
+            dst_name=dst_name, **self._ident(uid, gids),
         )
         return r.attr
 
@@ -349,13 +357,15 @@ class Client:
         return json.loads(r.json)
 
     async def set_acl(
-        self, inode: int, access: dict | None, default: dict | None = None
+        self, inode: int, access: dict | None, default: dict | None = None,
+        uid: int | None = None, gids: list[int] | None = None,
     ) -> None:
         import json
 
         await self._call(
             m.CltomaSetAcl, inode=inode,
             json=json.dumps({"access": access, "default": default}),
+            **self._ident(uid, gids),
         )
 
     async def get_acl(self, inode: int) -> dict:
@@ -821,6 +831,7 @@ class Client:
         )
         read_size = aligned_end - aligned_off
 
+        await self._throttle(read_size)  # QoS: charge once, not per retry
         last_error: Exception | None = None
         for attempt in range(self.retries):
             if attempt:
@@ -866,7 +877,6 @@ class Client:
             )
         if slice_type is None:
             raise ReadError("no locations for chunk")
-        await self._throttle(size)
         # first attempt: the master's topology-preferred (closest) copy;
         # retries randomize so a dead replica gets rotated off
         by_part = {

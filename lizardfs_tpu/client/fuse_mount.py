@@ -209,14 +209,26 @@ class LizardFuse:
         return self._run(self.client.resolve_parent(path.decode()))
 
     def _caller(self) -> tuple[int, list[int]]:
-        """Kernel caller identity from fuse_get_context (uid, gids)."""
+        """Kernel caller identity from fuse_get_context: uid + primary
+        gid + supplementary groups (fuse_getgroups, best effort)."""
         if self.libfuse is None:
             return 0, [0]
         try:
             ctx = self.libfuse.fuse_get_context()
-            if ctx:
-                c = ctx.contents
-                return int(c.uid), [int(c.gid)]
+            if not ctx:
+                return 0, [0]
+            c = ctx.contents
+            gids = [int(c.gid)]
+            try:
+                arr = (ctypes.c_uint32 * 32)()
+                n = self.libfuse.fuse_getgroups(32, arr)
+                if 0 < n <= 32:
+                    for g in arr[:n]:
+                        if int(g) not in gids:
+                            gids.append(int(g))
+            except Exception:  # noqa: BLE001
+                pass
+            return int(c.uid), gids
         except Exception:  # noqa: BLE001
             pass
         return 0, [0]
@@ -316,21 +328,30 @@ class LizardFuse:
             return op_getattr(path, out)
 
         def op_readdir(path, buf, filler, offset, fi):
+            uid, gids = self._caller()
             node = self._resolve(path)
             filler(buf, b".", None, 0)
             filler(buf, b"..", None, 0)
-            for entry in self._run(self.client.readdir(node.inode)):
+            for entry in self._run(
+                self.client.readdir(node.inode, uid=uid, gids=gids)
+            ):
                 filler(buf, entry.name.encode(), None, 0)
             return 0
 
         def op_mkdir(path, mode):
+            uid, gids = self._caller()
             parent, name = self._resolve_parent(path)
-            self._run(self.client.mkdir(parent.inode, name, mode & 0o7777))
+            self._run(
+                self.client.mkdir(
+                    parent.inode, name, mode & 0o7777, uid=uid, gid=gids[0]
+                )
+            )
             return 0
 
         def op_rmdir(path):
+            uid, gids = self._caller()
             parent, name = self._resolve_parent(path)
-            self._run(self.client.rmdir(parent.inode, name))
+            self._run(self.client.rmdir(parent.inode, name, uid=uid, gids=gids))
             return 0
 
         def op_create(path, mode, fi):
@@ -364,20 +385,33 @@ class LizardFuse:
             return 0
 
         def op_unlink(path):
+            uid, gids = self._caller()
             parent, name = self._resolve_parent(path)
-            self._run(self.client.unlink(parent.inode, name))
+            self._run(
+                self.client.unlink(parent.inode, name, uid=uid, gids=gids)
+            )
             return 0
 
         def op_rename(old, new):
+            uid, gids = self._caller()
             ps, ns = self._resolve_parent(old)
             pd, nd = self._resolve_parent(new)
-            self._run(self.client.rename(ps.inode, ns, pd.inode, nd))
+            self._run(
+                self.client.rename(
+                    ps.inode, ns, pd.inode, nd, uid=uid, gids=gids
+                )
+            )
             return 0
 
         def op_link(target, link):
+            uid, gids = self._caller()
             t = self._resolve(target)
             parent, name = self._resolve_parent(link)
-            self._run(self.client.link(t.inode, parent.inode, name))
+            self._run(
+                self.client.link(
+                    t.inode, parent.inode, name, uid=uid, gids=gids
+                )
+            )
             return 0
 
         def op_symlink(target, link):
@@ -411,22 +445,37 @@ class LizardFuse:
             return size
 
         def op_truncate(path, length):
+            uid, gids = self._caller()
             node = self._resolve(path)
-            self._run(self.client.truncate(node.inode, length))
+            self._run(
+                self.client.truncate(node.inode, length, uid=uid, gids=gids)
+            )
             return 0
 
         def op_ftruncate(path, length, fi):
             return op_truncate(path, length)
 
         def op_chmod(path, mode):
+            cuid, cgids = self._caller()
             node = self._resolve(path)
-            self._run(self.client.setattr(node.inode, 1, mode=mode & 0o7777))
+            self._run(
+                self.client.setattr(
+                    node.inode, 1, mode=mode & 0o7777,
+                    caller_uid=cuid, caller_gids=cgids,
+                )
+            )
             return 0
 
         def op_chown(path, uid, gid):
+            cuid, cgids = self._caller()
             node = self._resolve(path)
             mask = (2 if uid != 0xFFFFFFFF else 0) | (4 if gid != 0xFFFFFFFF else 0)
-            self._run(self.client.setattr(node.inode, mask, uid=uid, gid=gid))
+            self._run(
+                self.client.setattr(
+                    node.inode, mask, uid=uid, gid=gid,
+                    caller_uid=cuid, caller_gids=cgids,
+                )
+            )
             return 0
 
         def op_utimens(path, times):

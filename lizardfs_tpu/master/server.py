@@ -536,6 +536,7 @@ class MasterServer(Daemon):
             )
         if isinstance(msg, m.CltomaLink):
             target = fs.file_node(msg.inode)
+            self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 2 | 1)
             self._check_quota(msg.parent, target.uid, target.gid, 1, target.length)
             self.commit({
                 "op": "link", "inode": msg.inode, "parent": msg.parent,
@@ -543,8 +544,8 @@ class MasterServer(Daemon):
             })
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaReaddir):
-            self._check_perm(fs.dir_node(msg.inode), msg.uid, list(msg.gids), 4)
             node = fs.dir_node(msg.inode)
+            self._check_perm(node, msg.uid, list(msg.gids), 4)
             entries = [
                 m.DirEntry(name=name, inode=i, ftype=fs.node(i).ftype)
                 for name, i in sorted(node.children.items())
@@ -577,6 +578,15 @@ class MasterServer(Daemon):
             self.commit({"op": "setgoal", "inode": msg.inode, "goal": msg.goal, "ts": now})
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaSetattr):
+            node = fs.node(msg.inode)
+            caller = getattr(msg, "caller_uid", 0)
+            if caller != 0:
+                if msg.set_mask & (2 | 4):
+                    # chown/chgrp are root-only
+                    raise fsmod.FsError(st.EPERM, "chown requires root")
+                if caller != node.uid:
+                    # mode/times/trash-time changes need ownership
+                    raise fsmod.FsError(st.EPERM, f"inode {msg.inode}")
             self.commit({
                 "op": "setattr", "inode": msg.inode, "set_mask": msg.set_mask,
                 "mode": msg.mode, "uid": msg.uid, "gid": msg.gid,
@@ -652,7 +662,10 @@ class MasterServer(Daemon):
             for key in ("access", "default"):
                 if payload.get(key) is not None:
                     Acl.from_dict(payload[key])  # validate shape
-            fs.node(msg.inode)
+            node = fs.node(msg.inode)
+            caller = getattr(msg, "uid", 0)
+            if caller != 0 and caller != node.uid:
+                raise fsmod.FsError(st.EPERM, "setfacl requires ownership")
             self.commit({
                 "op": "set_acl", "inode": msg.inode,
                 "access": payload.get("access"),
@@ -745,6 +758,9 @@ class MasterServer(Daemon):
     async def _snapshot(self, msg: m.CltomaSnapshot, now: int):
         fs = self.meta.fs
         src = fs.node(msg.src_inode)
+        ident = (getattr(msg, "uid", 0), list(getattr(msg, "gids", [0])))
+        self._check_perm(src, *ident, 4)
+        self._check_perm(fs.dir_node(msg.dst_parent), *ident, 2 | 1)
         wi, wb = fs._node_weight(src)
         self._check_quota(msg.dst_parent, src.uid, src.gid, wi, wb)
         # pre-assign all clone inodes so replay is deterministic

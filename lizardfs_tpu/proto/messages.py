@@ -287,6 +287,8 @@ class CltomaSetattr(Message):
         ("atime", "u32"),
         ("mtime", "u32"),
         ("trash_time", "u32"),
+        ("caller_uid", "u32"),
+        ("caller_gids", "list:u32"),
     )
 
 
@@ -319,6 +321,8 @@ class CltomaLink(Message):
         ("inode", "u32"),
         ("parent", "u32"),
         ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
     )
 
 
@@ -329,6 +333,8 @@ class CltomaSnapshot(Message):
         ("src_inode", "u32"),
         ("dst_parent", "u32"),
         ("dst_name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
     )
 
 
@@ -421,10 +427,17 @@ class MatoclLockGranted(Message):
 
 class CltomaSetAcl(Message):
     """Set/clear POSIX ACLs; json = {"access": {...}|null,
-    "default": {...}|null} (see master/acl.py dict shape)."""
+    "default": {...}|null} (see master/acl.py dict shape). Only the
+    file's owner or root may change ACLs."""
 
     MSG_TYPE = 1056
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("json", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("json", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class CltomaGetAcl(Message):

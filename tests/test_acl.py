@@ -119,3 +119,45 @@ async def test_permission_enforcement(tmp_path):
         assert r.status == st.OK
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_ownership_rules_setattr_setacl(tmp_path):
+    """chmod needs ownership, chown needs root, setfacl needs ownership."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        await c.setattr(1, 1, mode=0o777)
+        f = await c.create(1, "owned", mode=0o600, uid=10, gid=20)
+
+        # non-owner chmod denied
+        with pytest.raises(st.StatusError) as e:
+            await c.setattr(f.inode, 1, mode=0o777, caller_uid=99,
+                            caller_gids=[99])
+        assert e.value.code == st.EPERM
+        # owner chmod allowed
+        await c.setattr(f.inode, 1, mode=0o640, caller_uid=10, caller_gids=[20])
+        assert (await c.getattr(f.inode)).mode == 0o640
+        # owner cannot chown (root-only)
+        with pytest.raises(st.StatusError) as e:
+            await c.setattr(f.inode, 2, uid=10, caller_uid=10, caller_gids=[20])
+        assert e.value.code == st.EPERM
+        # non-owner setfacl denied; owner allowed
+        with pytest.raises(st.StatusError) as e:
+            await c.set_acl(f.inode, {"users": {"99": 7}, "groups": {},
+                                      "mask": 7}, uid=99, gids=[99])
+        assert e.value.code == st.EPERM
+        await c.set_acl(f.inode, {"users": {"12": 4}, "groups": {}, "mask": 4},
+                        uid=10, gids=[20])
+        # link into an unwritable dir denied
+        d = await c.mkdir(1, "ro", mode=0o555, uid=10, gid=20)
+        with pytest.raises(st.StatusError) as e:
+            await c.link(f.inode, d.inode, "hl", uid=10, gids=[20])
+        assert e.value.code == st.EACCES
+        # snapshot into an unwritable dir denied
+        with pytest.raises(st.StatusError) as e:
+            await c.snapshot(f.inode, d.inode, "snap", uid=10, gids=[20])
+        assert e.value.code == st.EACCES
+    finally:
+        await cluster.stop()
