@@ -338,6 +338,20 @@ class ChunkStore:
             for b in range(nblocks, MFSBLOCKSINCHUNK):
                 self._write_crc_slot(f, b, 0)
 
+    def prefetch(self, chunk_id: int, version: int, part_id: int,
+                 offset: int, size: int) -> None:
+        """Advise the kernel to cache a part range (hdd prefetch /
+        posix_fadvise WILLNEED analog). Best-effort; never raises."""
+        try:
+            cf = self.require(chunk_id, version, part_id)
+            with open(cf.path, "rb") as f:
+                os.posix_fadvise(
+                    f.fileno(), HEADER_SIZE + offset, size,
+                    os.POSIX_FADV_WILLNEED,
+                )
+        except (ChunkStoreError, OSError, AttributeError):
+            pass
+
     # --- chunk tester (hdd_test_chunk analog) --------------------------------
 
     def test_part(self, cf: ChunkFile) -> bool:
@@ -476,6 +490,11 @@ class MultiStore:
             "truncate_part", chunk_id, part_id, chunk_id, version, part_id,
             part_length,
         )
+
+    def prefetch(self, chunk_id, version, part_id, offset, size) -> None:
+        store = self._store_of(chunk_id, part_id)
+        if store is not None:
+            store.prefetch(chunk_id, version, part_id, offset, size)
 
     def test_part(self, cf: ChunkFile) -> bool:
         for store in self.stores:

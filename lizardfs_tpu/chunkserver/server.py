@@ -379,6 +379,12 @@ class ChunkServer(Daemon):
                     break
                 if isinstance(msg, (m.AdminInfo, m.AdminCommand)):
                     await self._serve_admin(writer, msg)
+                elif isinstance(msg, m.CltocsPrefetch):
+                    # fire-and-forget page-cache warmup
+                    self.spawn(asyncio.to_thread(
+                        self.store.prefetch, msg.chunk_id, msg.version,
+                        msg.part_id, msg.offset, msg.size,
+                    ))
                 elif isinstance(msg, m.CltocsRead):
                     await self._serve_read(writer, msg)
                 elif isinstance(msg, m.CltocsWriteInit):

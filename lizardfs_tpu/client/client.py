@@ -856,9 +856,55 @@ class Client:
                 blk = data[s : s + MFSBLOCKSIZE]
                 if len(blk):
                     self.cache.put(inode, chunk_index, b, blk.tobytes())
+            if extra > 0 and aligned_end < chunk_len:
+                # sequential stream detected: warm the chunkservers' page
+                # cache for the region after this one (PREFETCH analog)
+                asyncio.ensure_future(
+                    self._send_prefetch(
+                        loc, aligned_end, min(extra, chunk_len - aligned_end)
+                    )
+                )
             rel = off - aligned_off
             return data[rel : rel + size]
         raise st.StatusError(st.EIO, f"read failed after retries: {last_error}")
+
+    async def _send_prefetch(self, loc, chunk_off: int, size: int) -> None:
+        """Fire-and-forget CltocsPrefetch to the data-part holders for
+        the chunk byte range [chunk_off, chunk_off+size)."""
+        try:
+            slice_type = None
+            targets = []
+            for pl in loc.locations:
+                cpt = geometry.ChunkPartType.from_id(pl.part_id)
+                slice_type = cpt.type if slice_type is None else slice_type
+                if cpt.is_data:
+                    targets.append((pl, cpt))
+            if slice_type is None:
+                return
+            d = slice_type.data_parts
+            lo_slot = (chunk_off // MFSBLOCKSIZE) // d
+            hi_slot = ((chunk_off + size - 1) // MFSBLOCKSIZE) // d
+            part_off = lo_slot * MFSBLOCKSIZE
+            part_size = (hi_slot - lo_slot + 1) * MFSBLOCKSIZE
+            from lizardfs_tpu.core.conn_pool import GLOBAL_POOL
+
+            for pl, cpt in targets[:8]:
+                addr = (pl.addr.host, pl.addr.port)
+                try:
+                    conn = await GLOBAL_POOL.acquire(addr)
+                    await framing.send_message(
+                        conn.writer,
+                        m.CltocsPrefetch(
+                            req_id=0, chunk_id=loc.chunk_id,
+                            version=loc.version, part_id=pl.part_id,
+                            offset=part_off, size=part_size,
+                        ),
+                    )
+                    GLOBAL_POOL.release(addr, conn)
+                except (OSError, ConnectionError):
+                    pass
+        except Exception:  # noqa: BLE001 — prefetch must never hurt reads
+            log.debug("prefetch failed", exc_info=True)
 
     async def _read_located(
         self, loc, chunk_index: int, off: int, size: int, file_length: int,

@@ -645,6 +645,21 @@ class CltocsRead(Message):
     )
 
 
+class CltocsPrefetch(Message):
+    """Hint: the client will read this range soon — pull it into the
+    page cache (LIZ_CLTOCS_PREFETCH analog). No reply."""
+
+    MSG_TYPE = 1205
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+        ("offset", "u32"),
+        ("size", "u32"),
+    )
+
+
 class CstoclReadData(Message):
     """One 64 KiB-aligned piece with its CRC (cstocl READ_DATA)."""
 
