@@ -1,0 +1,68 @@
+"""Protocol robustness: garbage and malformed frames must not crash
+daemons or corrupt state (hostile-client resilience)."""
+
+import asyncio
+import random
+import struct
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+
+from tests.test_cluster import Cluster
+
+
+async def _send_raw(port: int, payload: bytes) -> None:
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        w.write(payload)
+        await w.drain()
+        try:
+            await asyncio.wait_for(r.read(256), timeout=0.3)
+        except asyncio.TimeoutError:
+            pass
+        w.close()
+    except (ConnectionError, OSError):
+        pass  # the daemon may rightfully slam the door
+
+
+@pytest.mark.asyncio
+async def test_daemons_survive_garbage(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    rng = random.Random(0xBAD)
+    ports = [cluster.master.port] + [cs.port for cs in cluster.chunkservers]
+    try:
+        for port in ports:
+            # pure noise
+            await _send_raw(port, rng.randbytes(200))
+            # valid header, hostile length
+            await _send_raw(port, struct.pack(">II", 1000, 0xFFFFFFFF))
+            # valid header, truncated payload
+            await _send_raw(port, struct.pack(">II", 1002, 50) + b"\x01abc")
+            # known type, wrong protocol version
+            bad = bytearray(
+                framing.encode(m.CltomaGetattr(req_id=1, inode=1))
+            )
+            bad[8] = 42
+            await _send_raw(port, bytes(bad))
+            # valid registration followed by a mid-message cutoff
+            good = framing.encode(
+                m.CltomaRegister(req_id=1, session_id=0, info="fuzz",
+                                 password="")
+            )
+            await _send_raw(port, good[: len(good) // 2])
+            # messages out of role: a chunkserver command sent to a client
+            # port / a client op to a chunkserver
+            await _send_raw(port, framing.encode(
+                m.CstoclWriteStatus(req_id=1, chunk_id=1, write_id=1, status=0)
+            ))
+
+        # cluster still fully functional afterwards
+        c = await cluster.client()
+        f = await c.create(1, "still-alive")
+        await c.write_file(f.inode, b"post-fuzz data")
+        assert (await c.read_file(f.inode)) == b"post-fuzz data"
+        assert len(cluster.master.cs_links) == 2
+    finally:
+        await cluster.stop()
