@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import threading
 
 import numpy as np
 
@@ -34,7 +36,7 @@ from lizardfs_tpu.chunkserver.chunk_store import (
     MultiStore,
 )
 from lizardfs_tpu.constants import MFSBLOCKSIZE
-from lizardfs_tpu.core import geometry, plans
+from lizardfs_tpu.core import geometry, native_io, plans
 from lizardfs_tpu.core import read_executor
 from lizardfs_tpu.core.encoder import get_encoder
 from lizardfs_tpu.proto import framing
@@ -108,6 +110,11 @@ class ChunkServer(Daemon):
 
         self._repl_bps = self.tweaks.register("replication_bps", 0)
         self._repl_bucket = TokenBucket(0.0)
+        # sockets with a native stream in flight; shutdown() on stop so
+        # blocked serve threads see EPIPE instead of waiting out their
+        # deadline (a ThreadPoolExecutor joins its workers at exit)
+        self._native_streams: set = set()
+        self._active_native_serves = 0
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -170,6 +177,16 @@ class ChunkServer(Daemon):
         )
         self.cs_id = reply.cs_id
         self.log.info("registered with master as cs %d", self.cs_id)
+
+    async def stop(self) -> None:
+        import socket as _socket
+
+        for sock in list(self._native_streams):
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        await super().stop()
 
     async def _heartbeat(self) -> None:
         if self.master_addr is None:
@@ -371,6 +388,9 @@ class ChunkServer(Daemon):
 
     async def handle_connection(self, reader, writer) -> None:
         sessions: dict[int, _WriteSession] = {}
+        # in-flight _finish_write tasks still owe status frames on this
+        # writer; native streaming must not interleave with them
+        pending_writes: set[asyncio.Task] = set()
         try:
             while True:
                 try:
@@ -386,11 +406,18 @@ class ChunkServer(Daemon):
                         msg.part_id, msg.offset, msg.size,
                     ))
                 elif isinstance(msg, m.CltocsRead):
-                    await self._serve_read(writer, msg)
+                    # native streaming needs exclusive use of the socket;
+                    # in-flight pipelined writes still owe status frames
+                    await self._serve_read(
+                        writer, msg,
+                        native_ok=not sessions and not pending_writes,
+                    )
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteData):
-                    await self._serve_write_data(writer, msg, sessions)
+                    await self._serve_write_data(
+                        writer, msg, sessions, pending_writes
+                    )
                 elif isinstance(msg, m.CltocsWriteEnd):
                     session = sessions.pop(msg.chunk_id, None)
                     if session is not None:
@@ -436,7 +463,17 @@ class ChunkServer(Daemon):
             reply = m.AdminReply(req_id=msg.req_id, status=st.EINVAL, json="{}")
         await framing.send_message(writer, reply)
 
-    async def _serve_read(self, writer, msg: m.CltocsRead) -> None:
+    async def _serve_read(
+        self, writer, msg: m.CltocsRead, native_ok: bool = True
+    ) -> None:
+        if (
+            native_ok
+            and native_io.available()
+            and msg.size >= native_io.NATIVE_READ_THRESHOLD
+        ):
+            served = await self._serve_read_native(writer, msg)
+            if served:
+                return
         try:
             pieces = await asyncio.to_thread(
                 self.store.read,
@@ -466,6 +503,145 @@ class ChunkServer(Daemon):
                     data=bytes(data),
                 ),
             )
+        await framing.send_message(
+            writer,
+            m.CstoclReadStatus(
+                req_id=msg.req_id, chunk_id=msg.chunk_id, status=st.OK
+            ),
+        )
+
+    async def _serve_read_native(self, writer, msg: m.CltocsRead) -> bool:
+        """Stream the response via native/io_native.cpp — load + CRC
+        verify under the chunk lock, then frame + send off the event
+        loop with the lock released and the GIL dropped. Returns False
+        to fall back to the per-piece asyncio path."""
+        try:
+            cf = self.store.require(msg.chunk_id, msg.version, msg.part_id)
+        except ChunkStoreError as e:
+            await framing.send_message(
+                writer,
+                m.CstoclReadStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id, status=e.code
+                ),
+            )
+            return True
+        max_bytes = cf.max_blocks() * MFSBLOCKSIZE
+        if msg.offset + msg.size > max_bytes:
+            await framing.send_message(
+                writer,
+                m.CstoclReadStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id, status=st.EINVAL
+                ),
+            )
+            return True
+        sock = writer.get_extra_info("socket")
+        if sock is None:
+            return False
+        if self._active_native_serves >= native_io.SERVE_CONCURRENCY_LIMIT:
+            return False  # executor saturated (stalled clients): asyncio path
+
+        def load():
+            with cf.lock:
+                return native_io.load_read_blocking(
+                    cf.path, msg.offset, msg.size, cf.data_length()
+                )
+
+        self._active_native_serves += 1
+        try:
+            return await self._serve_read_native_inner(
+                writer, msg, cf, sock, load
+            )
+        finally:
+            self._active_native_serves -= 1
+
+    async def _serve_read_native_inner(
+        self, writer, msg, cf, sock, load
+    ) -> bool:
+        try:
+            rc, buf, crcs = await native_io.run_serve(load)
+        except FileNotFoundError:
+            rc = st.NO_CHUNK  # file vanished between require() and open
+        except OSError:
+            rc = st.EIO  # transient local error (EMFILE, EACCES, ...)
+        if rc != st.OK:
+            self.log.warning(
+                "native read of %016X:%d failed: %s",
+                msg.chunk_id, msg.part_id, st.name(rc),
+            )
+            await framing.send_message(
+                writer,
+                m.CstoclReadStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id, status=rc
+                ),
+            )
+            return True
+        self.metrics.counter("bytes_read").inc(float(msg.size))
+        # raw fd sends must not jump ahead of queued transport bytes;
+        # drain() only waits below the high-water mark, so under
+        # sustained output the loaded buffer is streamed through the
+        # transport instead of being thrown away for a second disk pass
+        await writer.drain()
+        if writer.transport.get_write_buffer_size() != 0:
+            await self._stream_pieces_asyncio(writer, msg, buf, crcs)
+            return True
+        try:
+            # the streaming thread owns this dup: the connection task may
+            # be cancelled (and the transport fd closed + reused) while
+            # the thread is still sending
+            fd = os.dup(sock.fileno())
+        except OSError:
+            await self._stream_pieces_asyncio(writer, msg, buf, crcs)
+            return True
+        # exactly one of {worker thread, cancellation handler} claims the
+        # dup — a job cancelled while still queued never runs its
+        # finally, so the loser of this race must not touch the fd
+        claim = threading.Lock()
+
+        def stream_job():
+            if not claim.acquire(blocking=False):
+                return -1  # cancelled before start; fd already closed
+            return native_io.stream_read_blocking(
+                fd, msg.chunk_id, msg.req_id, msg.offset, msg.size,
+                buf, crcs,
+            )
+
+        self._native_streams.add(sock)
+        try:
+            rc = await native_io.run_serve(stream_job)
+        except BaseException:
+            # covers CancelledError and executor-rejected submissions
+            # (RuntimeError after shutdown): close the dup iff the
+            # worker never claimed it
+            if claim.acquire(blocking=False):
+                os.close(fd)
+            raise
+        finally:
+            self._native_streams.discard(sock)
+        if rc < 0:
+            writer.close()  # socket died mid-stream; let the loop unwind
+        return True
+
+    async def _stream_pieces_asyncio(self, writer, msg, buf, crcs) -> None:
+        """Send an already-loaded + verified range as normal framed
+        messages (used when the transport still has queued bytes)."""
+        pos = msg.offset
+        end = msg.offset + msg.size
+        idx = 0
+        while pos < end:
+            block_start = (pos // MFSBLOCKSIZE) * MFSBLOCKSIZE
+            piece_end = min(end, block_start + MFSBLOCKSIZE)
+            await framing.send_message(
+                writer,
+                m.CstoclReadData(
+                    req_id=msg.req_id,
+                    chunk_id=msg.chunk_id,
+                    offset=pos,
+                    crc=int(crcs[idx]),
+                    data=bytes(buf[pos - msg.offset:piece_end - msg.offset]),
+                ),
+            )
+            idx += 1
+            pos = piece_end
         await framing.send_message(
             writer,
             m.CstoclReadStatus(
@@ -542,7 +718,9 @@ class ChunkServer(Daemon):
                 session.down_status.setdefault(wid, st.DISCONNECTED)
                 ev.set()
 
-    async def _serve_write_data(self, writer, msg: m.CltocsWriteData, sessions):
+    async def _serve_write_data(
+        self, writer, msg: m.CltocsWriteData, sessions, pending_writes
+    ):
         """Forward downstream in-order, then complete the local write and
         the upstream ack in a background task — the connection loop keeps
         reading, so blocks pipeline through the chain instead of paying
@@ -569,7 +747,9 @@ class ChunkServer(Daemon):
             except (ConnectionError, OSError):
                 session.down_status[msg.write_id] = st.DISCONNECTED
                 down_ev.set()
-        self.spawn(self._finish_write(writer, session, msg, down_ev))
+        task = self.spawn(self._finish_write(writer, session, msg, down_ev))
+        pending_writes.add(task)
+        task.add_done_callback(pending_writes.discard)
 
     async def _finish_write(self, writer, session, msg, down_ev) -> None:
         code = st.OK

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import functools
+import os
 import socket
 import struct
 import threading
@@ -43,6 +44,17 @@ if _lib is not None:
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
         ]
         _lib.lz_write_part.restype = ctypes.c_int
+        _lib.lz_load_read.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint32),
+        ]
+        _lib.lz_load_read.restype = ctypes.c_int
+        _lib.lz_stream_read.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
+        ]
+        _lib.lz_stream_read.restype = ctypes.c_int
     except AttributeError:
         _lib = None
 
@@ -94,11 +106,31 @@ POOL = _SocketPool()
 # chunkserver's disk jobs — whose acks these very calls wait on).
 EXECUTOR = ThreadPoolExecutor(max_workers=32, thread_name_prefix="native-io")
 
+# Server-side serving gets its own pool: in-process clusters (tests,
+# benches) have client exchanges above PARKED in EXECUTOR threads
+# waiting on the very responses these serve calls produce — sharing one
+# pool would deadlock at saturation.
+SERVE_EXECUTOR = ThreadPoolExecutor(
+    max_workers=16, thread_name_prefix="native-serve"
+)
+# native serves in flight above this fall back to the asyncio path, so
+# stalled slow-draining clients (which may legally pin a serve thread
+# until their deadline) cannot head-of-line-block healthy readers
+SERVE_CONCURRENCY_LIMIT = 12
+
 
 async def run(fn, *args):
     """Run a blocking native-IO function on the dedicated executor."""
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(EXECUTOR, functools.partial(fn, *args))
+
+
+async def run_serve(fn, *args):
+    """Run a blocking server-side serve function on its own executor."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        SERVE_EXECUTOR, functools.partial(fn, *args)
+    )
 
 
 def _blocking_socket(addr: tuple[str, int], io_timeout: float) -> socket.socket:
@@ -198,3 +230,63 @@ def write_part_blocking(
             raise st.StatusError(getattr(end, "status", st.EIO), "write end")
     finally:
         sock.close()
+
+
+def _n_pieces(offset: int, size: int) -> int:
+    from lizardfs_tpu.constants import MFSBLOCKSIZE
+    return (offset + size - 1) // MFSBLOCKSIZE - offset // MFSBLOCKSIZE + 1
+
+
+def load_read_blocking(
+    path: str, offset: int, size: int, data_len: int
+) -> tuple[int, np.ndarray, np.ndarray]:
+    """Server side, phase 1: load + CRC-verify one part range.
+
+    Runs with the chunk-file lock held (caller's job). OSError from a
+    vanished file propagates — the caller maps it to a status frame.
+    Returns ``(status, data, piece_crcs)``.
+    """
+    buf = np.empty(size, dtype=np.uint8)
+    crcs = np.empty(_n_pieces(offset, size), dtype=np.uint32)
+    file_fd = os.open(path, os.O_RDONLY)
+    try:
+        rc = _lib.lz_load_read(
+            file_fd, offset, size, data_len,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            crcs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+    finally:
+        os.close(file_fd)
+    return rc, buf, crcs
+
+
+def stream_read_blocking(
+    sock_fd: int,
+    chunk_id: int,
+    req_id: int,
+    offset: int,
+    size: int,
+    data: np.ndarray,
+    crcs: np.ndarray,
+) -> int:
+    """Server side, phase 2: stream loaded pieces on the asyncio socket.
+
+    ``sock_fd`` is non-blocking — the C side polls on EAGAIN. The caller
+    passes a dup'd fd and THIS function owns it: the connection task may
+    be cancelled (and the transport's fd closed and reused) while this
+    thread is still sending, so the thread must work on its own fd and
+    close it here. The caller must have flushed the asyncio write buffer
+    and be the only writer on the connection until this returns.
+    Returns 0, or -1 if the socket died mid-stream.
+    """
+    # absolute deadline: 30 s of grace plus a 512 KiB/s floor rate, so a
+    # stalled client cannot pin a serve thread indefinitely
+    max_ms = 30_000 + size // 512
+    try:
+        return _lib.lz_stream_read(
+            sock_fd, chunk_id, req_id, offset, size,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            crcs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), max_ms,
+        )
+    finally:
+        os.close(sock_fd)

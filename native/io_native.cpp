@@ -24,6 +24,7 @@
 // -1 = socket error; -2 = protocol violation; -3 = CRC mismatch.
 
 #include <cerrno>
+#include <ctime>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -31,6 +32,8 @@
 #if defined(_WIN32)
 #error "POSIX only"
 #endif
+#include <algorithm>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -198,6 +201,200 @@ int lz_write_part(int fd, uint64_t chunk_id, const uint8_t* payload,
         if (status != 0) return status;
     }
     return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Server side: serve one CltocsRead in two phases so the chunk-file
+// lock never spans network IO.
+//
+//   lz_load_read   — pread every touched block, verify against the
+//                    on-disk CRC table, scatter the requested range
+//                    into a contiguous buffer + per-piece CRCs.
+//                    Called with the chunk-file lock held.
+//   lz_stream_read — frame and send CstoclReadData pieces + the final
+//                    CstoclReadStatus on the asyncio socket (non-
+//                    blocking: poll on EAGAIN). Called WITHOUT the
+//                    lock; load errors are reported by the Python
+//                    side through its own framing instead.
+//
+// On-disk layout (keep in sync with chunkserver/chunk_store.py):
+// [1 KiB signature][4 KiB big-endian u32 CRC table][block data...].
+
+namespace {
+
+constexpr size_t kSignatureSize = 1024;
+constexpr size_t kHeaderSize = kSignatureSize + 4 * 1024;
+constexpr uint8_t kStatusOk = 0;
+constexpr uint8_t kStatusCrcError = 20;
+constexpr uint8_t kStatusEio = 9;
+
+int64_t monotonic_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+// asyncio sockets are non-blocking: wait for POLLOUT on EAGAIN, but
+// never past deadline_ms — a trickle-draining client must not pin a
+// serve thread forever (per-poll timeouts reset on every byte of
+// progress; the absolute deadline does not).
+bool send_all_poll(int fd, const uint8_t* buf, size_t len,
+                   int64_t deadline_ms) {
+    while (len) {
+        ssize_t n = ::send(fd, buf, len, MSG_NOSIGNAL);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int64_t left = deadline_ms - monotonic_ms();
+                if (left <= 0) return false;
+                struct pollfd pfd{fd, POLLOUT, 0};
+                int pr = ::poll(&pfd, 1,
+                                static_cast<int>(std::min<int64_t>(left, 30000)));
+                if (pr < 0 && errno == EINTR) continue;
+                if (pr < 0) return false;
+                continue;  // pr==0: re-check the deadline
+            }
+            return false;
+        }
+        if (n == 0) return false;
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+uint32_t empty_block_crc() {
+    static const uint32_t crc = [] {
+        std::vector<uint8_t> zeros(kBlockSize, 0);
+        return lz_crc32(0, zeros.data(), zeros.size());
+    }();
+    return crc;
+}
+
+bool pread_full(int fd, uint8_t* buf, size_t len, uint64_t off, size_t* got) {
+    size_t done = 0;
+    while (done < len) {
+        ssize_t n = ::pread(fd, buf + done, len - done,
+                            static_cast<off_t>(off + done));
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        if (n == 0) break;  // EOF: caller zero-pads
+        done += static_cast<size_t>(n);
+    }
+    *got = done;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Phase 1: load + verify [offset, offset+size) of the part file into
+// out_data (contiguous) and out_crcs (one u32 per touched block piece).
+// Returns 0, or the protocol status byte to send (CRC_ERROR / EIO).
+int lz_load_read(int file_fd, uint32_t offset, uint32_t size,
+                 uint64_t data_len, uint8_t* out_data, uint32_t* out_crcs) {
+    std::vector<uint8_t> block(kBlockSize);
+    uint64_t pos = offset;
+    const uint64_t end = static_cast<uint64_t>(offset) + size;
+
+    // one pread covers every touched CRC slot (contiguous in the table)
+    const uint64_t first_blk = offset / kBlockSize;
+    const uint64_t last_blk = (end - 1) / kBlockSize;
+    std::vector<uint8_t> slots(4 * (last_blk - first_blk + 1), 0);
+    size_t sgot = 0;
+    if (!pread_full(file_fd, slots.data(), slots.size(),
+                    kSignatureSize + 4 * first_blk, &sgot) ||
+        sgot < slots.size()) {
+        // the CRC table always exists in a well-formed file; a short
+        // read means header truncation — refuse rather than fabricate
+        // sparse zero data with self-consistent CRCs
+        return kStatusEio;
+    }
+
+    size_t piece_idx = 0;
+    while (pos < end) {
+        const uint64_t blk = pos / kBlockSize;
+        const uint64_t block_start = blk * kBlockSize;
+        const uint64_t piece_end =
+            std::min<uint64_t>(end, block_start + kBlockSize);
+        const size_t piece_len = static_cast<size_t>(piece_end - pos);
+
+        size_t got = 0;
+        if (!pread_full(file_fd, block.data(), kBlockSize,
+                        kHeaderSize + block_start, &got)) {
+            return kStatusEio;
+        }
+        if (got < kBlockSize)
+            std::memset(block.data() + got, 0, kBlockSize - got);
+
+        const uint32_t stored = get32(slots.data() + 4 * (blk - first_blk));
+
+        uint32_t crc;
+        if (block_start < data_len || stored != 0) {
+            // inside the data region a zero slot means a sparse hole
+            const uint32_t expected = stored ? stored : empty_block_crc();
+            if (lz_crc32(0, block.data(), kBlockSize) != expected)
+                return kStatusCrcError;
+            crc = expected;
+        } else {
+            crc = empty_block_crc();
+        }
+
+        const size_t in_block = static_cast<size_t>(pos - block_start);
+        if (piece_len != kBlockSize)
+            crc = lz_crc32(0, block.data() + in_block, piece_len);
+        std::memcpy(out_data + (pos - offset), block.data() + in_block,
+                    piece_len);
+        out_crcs[piece_idx++] = crc;
+        pos = piece_end;
+    }
+    return 0;
+}
+
+// Phase 2: stream the loaded range as CstoclReadData frames + the final
+// OK CstoclReadStatus. Returns 0, or -1 if the socket died.
+int lz_stream_read(int sock_fd, uint64_t chunk_id, uint32_t req_id,
+                   uint32_t offset, uint32_t size, const uint8_t* data,
+                   const uint32_t* crcs, uint32_t max_ms) {
+    const int64_t deadline = monotonic_ms() + max_ms;
+    // frame = header + version + req_id + chunk_id + offset + crc
+    //         + data(u32 len + bytes)
+    constexpr size_t kPre = 8 + 1 + 4 + 8 + 4 + 4 + 4;
+    std::vector<uint8_t> frame(kPre + kBlockSize);
+    uint64_t pos = offset;
+    const uint64_t end = static_cast<uint64_t>(offset) + size;
+    size_t piece_idx = 0;
+    while (pos < end) {
+        const uint64_t block_start = (pos / kBlockSize) * kBlockSize;
+        const uint64_t piece_end =
+            std::min<uint64_t>(end, block_start + kBlockSize);
+        const size_t piece_len = static_cast<size_t>(piece_end - pos);
+        uint8_t* f = frame.data();
+        put32(f, kTypeReadData);
+        put32(f + 4, static_cast<uint32_t>(1 + 4 + 8 + 4 + 4 + 4 + piece_len));
+        f[8] = kProtoVersion;
+        put32(f + 9, req_id);
+        put64(f + 13, chunk_id);
+        put32(f + 21, static_cast<uint32_t>(pos));
+        put32(f + 25, crcs[piece_idx++]);
+        put32(f + 29, static_cast<uint32_t>(piece_len));
+        std::memcpy(f + kPre, data + (pos - offset), piece_len);
+        if (!send_all_poll(sock_fd, f, kPre + piece_len, deadline)) return -1;
+        pos = piece_end;
+    }
+    uint8_t st[8 + 1 + 4 + 8 + 1];
+    put32(st, kTypeReadStatus);
+    put32(st + 4, 1 + 4 + 8 + 1);
+    st[8] = kProtoVersion;
+    put32(st + 9, req_id);
+    put64(st + 13, chunk_id);
+    st[21] = kStatusOk;
+    return send_all_poll(sock_fd, st, sizeof(st), deadline) ? 0 : -1;
 }
 
 }  // extern "C"

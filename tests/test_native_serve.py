@@ -1,0 +1,186 @@
+"""Server-side native read streaming (native/io_native.cpp:lz_serve_read)."""
+
+import os
+
+import pytest
+
+from lizardfs_tpu.core import native_io
+from lizardfs_tpu.chunkserver import chunk_store
+
+from tests.test_cluster import Cluster
+
+pytestmark = pytest.mark.skipif(
+    not native_io.available(), reason="native lib not built"
+)
+
+
+@pytest.mark.asyncio
+async def test_native_serve_read_roundtrip(tmp_path, monkeypatch):
+    """A large read must be streamed by the native path, byte-identical."""
+    calls = []
+    real = native_io.stream_read_blocking
+
+    def spy(*args):
+        calls.append(args)
+        return real(*args)
+
+    monkeypatch.setattr(native_io, "stream_read_blocking", spy)
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        data = bytes(os.urandom(1 << 20))
+        f = await c.create(1, "big")
+        await c.write_file(f.inode, data)
+        assert (await c.read_file(f.inode)) == data
+        assert calls, "native serve path was never taken"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_native_serve_sparse_tail(tmp_path):
+    """Reads past stored data come back as zeros (sparse semantics)."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "sparse")
+        await c.write_file(f.inode, b"\xaa" * 1000)
+        await c.truncate(f.inode, 900 * 1024)  # extend far past data
+        got = await c.read_file(f.inode)
+        assert got[:1000] == b"\xaa" * 1000
+        assert got[1000:] == b"\0" * (900 * 1024 - 1000)
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_native_serve_detects_corruption(tmp_path):
+    """Bit rot on one replica: native CRC verify rejects it and the
+    client recovers from the healthy copy."""
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        data = bytes(os.urandom(512 * 1024))
+        f = await c.create(1, "rotten")
+        await c.setgoal(f.inode, 2)
+        await c.write_file(f.inode, data)
+
+        # flip one byte in the data region of every part on CS 0
+        store = cluster.chunkservers[0].store
+        parts = list(store.all_parts())
+        assert parts
+        for cf in parts:
+            with open(cf.path, "r+b") as fh:
+                fh.seek(chunk_store.HEADER_SIZE + 100)
+                b = fh.read(1)
+                fh.seek(chunk_store.HEADER_SIZE + 100)
+                fh.write(bytes([b[0] ^ 0xFF]))
+
+        assert (await c.read_file(f.inode)) == data
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_read_pipelined_behind_write_does_not_interleave(tmp_path):
+    """A large read racing an unacknowledged pipelined write on the SAME
+    connection must not let native raw-fd sends interleave with the
+    write-status frame still owed by a background task."""
+    import asyncio
+
+    from lizardfs_tpu.ops import crc32 as crc_mod
+    from lizardfs_tpu.proto import framing, messages as m
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        cs = cluster.chunkservers[0]
+        reader, writer = await asyncio.open_connection("127.0.0.1", cs.port)
+        framing.write_message(writer, m.CltocsWriteInit(
+            req_id=1, chunk_id=7, version=1, part_id=0, chain=[], create=True,
+        ))
+        await writer.drain()
+        st0 = await framing.read_message(reader)
+        assert isinstance(st0, m.CstoclWriteStatus) and st0.status == 0
+
+        # fill 4 blocks, then pipeline a big read before the last write acks
+        payload = os.urandom(64 * 1024)
+        for blk in range(4):
+            framing.write_message(writer, m.CltocsWriteData(
+                req_id=2 + blk, chunk_id=7, write_id=blk, block=blk,
+                offset=0, crc=crc_mod.crc32(payload), data=payload,
+            ))
+        framing.write_message(writer, m.CltocsRead(
+            req_id=50, chunk_id=7, version=1, part_id=0,
+            offset=0, size=256 * 1024,
+        ))
+        await writer.drain()
+
+        acks = 0
+        got = bytearray(256 * 1024)
+        done = False
+        while not done or acks < 4:
+            msg = await asyncio.wait_for(framing.read_message(reader), 5)
+            if isinstance(msg, m.CstoclWriteStatus):
+                assert msg.status == 0
+                acks += 1
+            elif isinstance(msg, m.CstoclReadData):
+                assert crc_mod.crc32(msg.data) == msg.crc
+                got[msg.offset:msg.offset + len(msg.data)] = msg.data
+            elif isinstance(msg, m.CstoclReadStatus):
+                assert msg.status == 0
+                done = True
+        # the read may overtake still-unacked writes (ordering between
+        # unacked writes and reads is the client's job) — but every
+        # frame must parse cleanly and each block is all-or-nothing
+        for blk in range(4):
+            piece = bytes(got[blk * 65536:(blk + 1) * 65536])
+            assert piece in (payload, b"\0" * 65536)
+
+        # after all acks, a second big read must see every block
+        framing.write_message(writer, m.CltocsRead(
+            req_id=60, chunk_id=7, version=1, part_id=0,
+            offset=0, size=256 * 1024,
+        ))
+        await writer.drain()
+        got2 = bytearray(256 * 1024)
+        while True:
+            msg = await asyncio.wait_for(framing.read_message(reader), 5)
+            if isinstance(msg, m.CstoclReadData):
+                assert crc_mod.crc32(msg.data) == msg.crc
+                got2[msg.offset:msg.offset + len(msg.data)] = msg.data
+            elif isinstance(msg, m.CstoclReadStatus):
+                assert msg.status == 0
+                break
+        assert bytes(got2) == payload * 4
+        writer.close()
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_truncated_header_not_served_as_zeros(tmp_path):
+    """A chunk file truncated inside its 5 KiB header must yield an
+    error, never fabricated sparse zeros with status OK."""
+    import asyncio
+
+    from lizardfs_tpu.proto.status import StatusError
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "trunc")
+        await c.write_file(f.inode, b"\xcd" * (256 * 1024))
+        store = cluster.chunkservers[0].store
+        for cf in store.all_parts():
+            # signature intact, CRC table cut BEFORE the slots this read
+            # needs — the native load must EIO, not zero-fill
+            os.truncate(cf.path, 1030)
+        with pytest.raises((StatusError, OSError)):
+            await asyncio.wait_for(c.read_file(f.inode), 30)
+    finally:
+        await cluster.stop()
