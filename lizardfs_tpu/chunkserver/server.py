@@ -114,7 +114,6 @@ class ChunkServer(Daemon):
         # blocked serve threads see EPIPE instead of waiting out their
         # deadline (a ThreadPoolExecutor joins its workers at exit)
         self._native_streams: set = set()
-        self._active_native_serves = 0
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -537,7 +536,7 @@ class ChunkServer(Daemon):
         sock = writer.get_extra_info("socket")
         if sock is None:
             return False
-        if self._active_native_serves >= native_io.SERVE_CONCURRENCY_LIMIT:
+        if not native_io.serve_slot_available():
             return False  # executor saturated (stalled clients): asyncio path
 
         def load():
@@ -546,13 +545,13 @@ class ChunkServer(Daemon):
                     cf.path, msg.offset, msg.size, cf.data_length()
                 )
 
-        self._active_native_serves += 1
+        native_io.serve_slot_acquire()
         try:
             return await self._serve_read_native_inner(
                 writer, msg, cf, sock, load
             )
         finally:
-            self._active_native_serves -= 1
+            native_io.serve_slot_release()
 
     async def _serve_read_native_inner(
         self, writer, msg, cf, sock, load

@@ -115,8 +115,25 @@ SERVE_EXECUTOR = ThreadPoolExecutor(
 )
 # native serves in flight above this fall back to the asyncio path, so
 # stalled slow-draining clients (which may legally pin a serve thread
-# until their deadline) cannot head-of-line-block healthy readers
+# until their deadline) cannot head-of-line-block healthy readers. The
+# counter is process-global like the executor it guards (an in-process
+# cluster runs several chunkservers on one pool).
 SERVE_CONCURRENCY_LIMIT = 12
+active_serves = 0
+
+
+def serve_slot_available() -> bool:
+    return active_serves < SERVE_CONCURRENCY_LIMIT
+
+
+def serve_slot_acquire() -> None:
+    global active_serves
+    active_serves += 1
+
+
+def serve_slot_release() -> None:
+    global active_serves
+    active_serves -= 1
 
 
 async def run(fn, *args):

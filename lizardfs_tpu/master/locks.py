@@ -1,11 +1,12 @@
-"""File locking: POSIX byte-range locks + BSD flock with pending queues.
+"""File locking: POSIX byte-range locks + BSD flock (held state).
 
 Mirror of the reference's lock engine (reference: src/master/locks.h:
 29-224 LockRanges/FileLocks): per-file interval lists of shared/
 exclusive locks, owner = (session_id, owner_token); overlapping ranges
-from one owner merge/split POSIX-style; blocked requests queue and are
-re-tried when locks release (the caller delivers wakeups). Session
-disconnect releases everything the session held.
+from one owner merge/split POSIX-style. Only HELD locks live here —
+they replicate via the changelog and persist in the metadata image.
+Blocked (waiting) requests are live-master-only state queued by the
+master server, which re-tests and commits grants as locks release.
 """
 
 from __future__ import annotations
@@ -36,20 +37,11 @@ class Range:
         return self.start < end and start < self.end
 
 
-@dataclass
-class PendingLock:
-    owner: Owner
-    start: int
-    end: int
-    ltype: int
-
-
 class FileLocks:
     """Locks of one file: interval list + FIFO pending queue."""
 
     def __init__(self):
         self.ranges: list[Range] = []
-        self.pending: list[PendingLock] = []
 
     # --- queries -----------------------------------------------------------
 
@@ -91,26 +83,13 @@ class FileLocks:
                 merged.append(r)
         self.ranges = others + merged
 
-    def apply(
-        self, owner: Owner, start: int, end: int, ltype: int, wait: bool
-    ) -> bool:
-        """Try to set/clear a lock. True = applied; False = queued
-        (wait=True) or refused (wait=False raises via return False —
-        caller maps to LOCKED)."""
+    def apply(self, owner: Owner, start: int, end: int, ltype: int) -> bool:
+        """Set/clear a held lock. True = applied; False = refused
+        (conflict — the caller maps to LOCKED or queues the waiter)."""
         if ltype == LOCK_UNLOCK:
             self._remove_owner_range(owner, start, end)
-            # an unlock also cancels this owner's queued requests in the
-            # range (a waiter that gave up sends unlock to abort cleanly)
-            self.pending = [
-                p for p in self.pending
-                if not (p.owner == owner and p.start < (end or MAX_OFFSET)
-                        and start < p.end)
-            ]
             return True
-        conflict = self.test(owner, start, end, ltype)
-        if conflict is not None:
-            if wait:
-                self.pending.append(PendingLock(owner, start, end, ltype))
+        if self.test(owner, start, end, ltype) is not None:
             return False
         self._remove_owner_range(owner, start, end)
         self.ranges.append(Range(start, end, ltype, owner))
@@ -119,28 +98,10 @@ class FileLocks:
 
     def release_session(self, session_id: int) -> None:
         self.ranges = [r for r in self.ranges if r.owner.session_id != session_id]
-        self.pending = [
-            p for p in self.pending if p.owner.session_id != session_id
-        ]
-
-    def retry_pending(self) -> list[PendingLock]:
-        """Grant whatever queued locks now fit (FIFO). Returns granted."""
-        granted = []
-        still: list[PendingLock] = []
-        for p in self.pending:
-            if self.test(p.owner, p.start, p.end, p.ltype) is None:
-                self._remove_owner_range(p.owner, p.start, p.end)
-                self.ranges.append(Range(p.start, p.end, p.ltype, p.owner))
-                self._merge_owner(p.owner)
-                granted.append(p)
-            else:
-                still.append(p)
-        self.pending = still
-        return granted
 
     @property
     def empty(self) -> bool:
-        return not self.ranges and not self.pending
+        return not self.ranges
 
 
 class LockManager:
@@ -160,15 +121,15 @@ class LockManager:
         return fl
 
     def posix(self, inode: int, session_id: int, token: int, start: int,
-              end: int, ltype: int, wait: bool) -> bool:
+              end: int, ltype: int) -> bool:
         return self._file(self.posix_files, inode).apply(
-            Owner(session_id, token), start, end or MAX_OFFSET, ltype, wait
+            Owner(session_id, token), start, end or MAX_OFFSET, ltype
         )
 
-    def flock(self, inode: int, session_id: int, token: int, ltype: int,
-              wait: bool) -> bool:
+    def flock(self, inode: int, session_id: int, token: int,
+              ltype: int) -> bool:
         return self._file(self.flock_files, inode).apply(
-            Owner(session_id, token), 0, MAX_OFFSET, ltype, wait
+            Owner(session_id, token), 0, MAX_OFFSET, ltype
         )
 
     def test(self, inode: int, session_id: int, token: int, start: int,
@@ -186,26 +147,24 @@ class LockManager:
         return fl.test(Owner(session_id, token), 0, MAX_OFFSET, ltype)
 
     def release_session(self, session_id: int) -> list[int]:
-        """Release all locks of a session; returns inodes with newly
-        grantable pending locks."""
+        """Release all held locks of a session; returns the inodes that
+        freed capacity (the caller retries its queued waiters there)."""
         woken = []
         for table in (self.posix_files, self.flock_files):
             for inode, fl in list(table.items()):
-                before = len(fl.ranges) + len(fl.pending)
+                before = len(fl.ranges)
                 fl.release_session(session_id)
-                if len(fl.ranges) + len(fl.pending) != before:
+                if len(fl.ranges) != before:
                     woken.append(inode)
                 if fl.empty:
                     del table[inode]
         return woken
 
-    def retry_pending(self, inode: int) -> list[PendingLock]:
-        granted = []
+    def session_inodes(self, session_id: int) -> list[int]:
+        """Inodes where the session holds locks."""
+        inodes = set()
         for table in (self.posix_files, self.flock_files):
-            fl = table.get(inode)
-            if fl is None:
-                continue
-            granted.extend(fl.retry_pending())
-            if fl.empty:
-                del table[inode]
-        return granted
+            for inode, fl in table.items():
+                if any(r.owner.session_id == session_id for r in fl.ranges):
+                    inodes.add(inode)
+        return sorted(inodes)

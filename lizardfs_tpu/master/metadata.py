@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from lizardfs_tpu.master.chunks import ChunkRegistry
 from lizardfs_tpu.master.fs import FsError, FsTree
+from lizardfs_tpu.master.locks import LockManager
 from lizardfs_tpu.master.quotas import QuotaDatabase
 
 
@@ -19,6 +20,15 @@ class MetadataStore:
         self.fs = FsTree()
         self.registry = ChunkRegistry()
         self.quotas = QuotaDatabase()
+        # held file locks replicate through the changelog so a promoted
+        # shadow still knows them (reference: LOCK section,
+        # src/master/filesystem_store.cc:952-1180); pending waiters are
+        # live-master-only state
+        self.locks = LockManager()
+        # session-id allocation replicates so a promoted shadow never
+        # re-issues an id whose locks are still held (sessions.mfs
+        # analog for the id space; live connection state stays local)
+        self.next_session = 1
 
     # --- op application (the one true mutation path) -------------------------
 
@@ -168,6 +178,21 @@ class MetadataStore:
             old.refcount -= 1
         self.fs.apply_set_chunk(op["inode"], op["chunk_index"], op["new_chunk_id"])
 
+    def _op_lock_posix(self, op):
+        self.locks.posix(
+            op["inode"], op["sid"], op["token"], op["start"], op["end"],
+            op["ltype"],
+        )
+
+    def _op_lock_flock(self, op):
+        self.locks.flock(op["inode"], op["sid"], op["token"], op["ltype"])
+
+    def _op_lock_release_session(self, op):
+        self.locks.release_session(op["sid"])
+
+    def _op_session_new(self, op):
+        self.next_session = max(self.next_session, op["sid"] + 1)
+
     # --- persistence sections --------------------------------------------------
 
     def to_sections(self) -> dict:
@@ -183,6 +208,21 @@ class MetadataStore:
                 ],
             },
             "quotas": self.quotas.to_dict(),
+            "next_session": self.next_session,
+            "locks": {
+                kind: {
+                    str(inode): [
+                        [r.start, r.end, r.ltype, r.owner.session_id,
+                         r.owner.token]
+                        for r in fl.ranges
+                    ]
+                    for inode, fl in table.items() if fl.ranges
+                }
+                for kind, table in (
+                    ("posix", self.locks.posix_files),
+                    ("flock", self.locks.flock_files),
+                )
+            },
         }
 
     def load_sections(self, doc: dict) -> None:
@@ -198,6 +238,20 @@ class MetadataStore:
             c.refcount = row.get("refcount", 1)
         self.registry.next_chunk_id = ch["next_chunk_id"]
         self.quotas = QuotaDatabase.from_dict(doc.get("quotas", {}))
+        self.locks = LockManager()
+        self.next_session = int(doc.get("next_session", 1))
+        from lizardfs_tpu.master.locks import FileLocks, Owner, Range
+
+        for kind, table in (
+            ("posix", self.locks.posix_files),
+            ("flock", self.locks.flock_files),
+        ):
+            for inode_s, rows in doc.get("locks", {}).get(kind, {}).items():
+                fl = table[int(inode_s)] = FileLocks()
+                fl.ranges = [
+                    Range(start, end, ltype, Owner(sid, token))
+                    for start, end, ltype, sid, token in rows
+                ]
 
     def checksum(self) -> str:
         """Divergence-detection digest over FS + persistent chunk state."""

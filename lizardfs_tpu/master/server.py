@@ -30,7 +30,7 @@ from lizardfs_tpu.core import geometry
 from lizardfs_tpu.master import fs as fsmod
 from lizardfs_tpu.master.changelog import Changelog, load_image, save_image
 from lizardfs_tpu.master.chunks import ChunkServerInfo
-from lizardfs_tpu.master.locks import LOCK_UNLOCK, LockManager
+from lizardfs_tpu.master.locks import LOCK_UNLOCK, MAX_OFFSET
 from lizardfs_tpu.master.metadata import MetadataStore
 from lizardfs_tpu.master.quotas import KIND_DIR
 from lizardfs_tpu.proto import framing
@@ -104,8 +104,14 @@ class MasterServer(Daemon):
         self.cs_links: dict[int, _CsLink] = {}
         self.shadow_writers: list[asyncio.StreamWriter] = []
         self.sessions: dict[int, dict] = {}
-        self.next_session = 1
-        self.locks = LockManager()
+        # orphaned lock owners (no live connection) first seen at ts;
+        # released after _ORPHAN_LOCK_TIMEOUT (promotion leaves locks of
+        # sessions that never reconnect)
+        self._orphan_lock_seen: dict[int, float] = {}
+        # pending (blocked) lock requests are live-master-only: entries
+        # {kind, sid, token, start, end, ltype} keyed by inode; held
+        # locks live in self.meta.locks (changelog-replicated)
+        self._pending_locks: dict[int, list[dict]] = {}
         self._session_writers: dict[int, asyncio.StreamWriter] = {}
         from lizardfs_tpu.master.exports import Exports, Topology
 
@@ -138,7 +144,11 @@ class MasterServer(Daemon):
             start_version, doc = loaded
             self.meta.load_sections(doc)
             sess = doc.get("sessions", {})
-            self.next_session = int(sess.get("next", self.next_session))
+            # legacy-image fallback only; the authoritative counter is
+            # metadata's replicated next_session
+            self.meta.next_session = max(
+                self.meta.next_session, int(sess.get("next", 1))
+            )
             for sid, row in sess.get("known", {}).items():
                 self.sessions[int(sid)] = {
                     "info": row.get("info", ""), "connected": False,
@@ -206,7 +216,7 @@ class MasterServer(Daemon):
         # Only LIVE sessions are persisted — one-shot CLI sessions would
         # otherwise accumulate in every image forever.
         sections["sessions"] = {
-            "next": self.next_session,
+
             "known": {
                 str(sid): {"info": s.get("info", "")}
                 for sid, s in self.sessions.items()
@@ -217,6 +227,8 @@ class MasterServer(Daemon):
         await asyncio.to_thread(save_image, self.data_dir, version, sections)
         self.changelog.rotate()
         self.changelog.open()
+
+    _ORPHAN_LOCK_TIMEOUT = 60.0
 
     async def _purge_trash(self) -> None:
         if not self.is_active:
@@ -235,6 +247,25 @@ class MasterServer(Daemon):
         ]
         for sid in dead:
             del self.sessions[sid]
+        # release locks whose owning session has no live connection and
+        # never reconnected (orphans from a promotion or client crash)
+        owners = set()
+        for table in (self.meta.locks.posix_files, self.meta.locks.flock_files):
+            for fl in table.values():
+                owners.update(r.owner.session_id for r in fl.ranges)
+        live = set(self._session_writers)
+        now_f = time.time()
+        for sid in owners - live:
+            first_seen = self._orphan_lock_seen.setdefault(sid, now_f)
+            if now_f - first_seen >= self._ORPHAN_LOCK_TIMEOUT:
+                held = self.meta.locks.session_inodes(sid)
+                self.commit({"op": "lock_release_session", "sid": sid})
+                self._orphan_lock_seen.pop(sid, None)
+                for inode in held:
+                    self._grant_pending_locks(inode)
+        for sid in list(self._orphan_lock_seen):
+            if sid in live or sid not in owners:
+                del self._orphan_lock_seen[sid]
 
     # --- connection dispatch ------------------------------------------------------
 
@@ -298,13 +329,11 @@ class MasterServer(Daemon):
                     ),
                 )
                 return
-        session_id = first.session_id or self.next_session
-        if first.session_id == 0:
-            self.next_session += 1
-        else:
-            # a client may present an id this master has never issued
-            # (failover to a shadow with an older image): never re-issue it
-            self.next_session = max(self.next_session, session_id + 1)
+        session_id = first.session_id or self.meta.next_session
+        # replicate the allocation: a promoted shadow must never re-issue
+        # an id whose locks are still held (and whose disconnect would
+        # then release a stranger's locks)
+        self.commit({"op": "session_new", "sid": session_id})
         self.sessions[session_id] = {
             "info": first.info, "connected": True, "ip": peer[0],
             "readonly": rule.readonly, "maproot": rule.maproot,
@@ -338,7 +367,23 @@ class MasterServer(Daemon):
             if self._session_writers.get(session_id) is writer:
                 self.sessions.get(session_id, {})["connected"] = False
                 self._session_writers.pop(session_id, None)
-                for inode in self.locks.release_session(session_id):
+                if self._stopping.is_set():
+                    # master shutdown, not client departure: locks must
+                    # survive the restart (the image is dumped next);
+                    # the client reconnects with the same session id
+                    return
+                held = self.meta.locks.session_inodes(session_id)
+                queued = [
+                    i for i, q in self._pending_locks.items()
+                    if any(p["sid"] == session_id for p in q)
+                ]
+                for q in self._pending_locks.values():
+                    q[:] = [p for p in q if p["sid"] != session_id]
+                if held:
+                    self.commit(
+                        {"op": "lock_release_session", "sid": session_id}
+                    )
+                for inode in {*held, *queued}:
                     self._grant_pending_locks(inode)
 
     def _error_reply(self, msg, code: int):
@@ -410,18 +455,53 @@ class MasterServer(Daemon):
             raise fsmod.FsError(st.EACCES, f"inode {node.inode}")
 
     def _grant_pending_locks(self, inode: int) -> None:
-        for granted in self.locks.retry_pending(inode):
-            w = self._session_writers.get(granted.owner.session_id)
-            if w is not None:
-                try:
-                    framing.write_message(
-                        w,
-                        m.MatoclLockGranted(
-                            inode=inode, token=granted.owner.token
-                        ),
-                    )
-                except (ConnectionError, RuntimeError):
-                    pass
+        queue = self._pending_locks.get(inode)
+        if not queue:
+            self._pending_locks.pop(inode, None)
+            return
+        still = []
+        for p in queue:
+            if self._lock_conflict(inode, p) is None:
+                self._commit_lock(inode, p)
+                w = self._session_writers.get(p["sid"])
+                if w is not None:
+                    try:
+                        framing.write_message(
+                            w,
+                            m.MatoclLockGranted(inode=inode, token=p["token"]),
+                        )
+                    except (ConnectionError, RuntimeError):
+                        pass
+            else:
+                still.append(p)
+        if still:
+            self._pending_locks[inode] = still
+        else:
+            self._pending_locks.pop(inode, None)
+
+    def _lock_conflict(self, inode: int, p: dict):
+        if p["ltype"] == LOCK_UNLOCK:
+            return None
+        if p["kind"] == "flock":
+            return self.meta.locks.test_flock(
+                inode, p["sid"], p["token"], p["ltype"]
+            )
+        return self.meta.locks.test(
+            inode, p["sid"], p["token"], p["start"], p["end"], p["ltype"]
+        )
+
+    def _commit_lock(self, inode: int, p: dict) -> None:
+        if p["kind"] == "flock":
+            self.commit({
+                "op": "lock_flock", "inode": inode, "sid": p["sid"],
+                "token": p["token"], "ltype": p["ltype"],
+            })
+        else:
+            self.commit({
+                "op": "lock_posix", "inode": inode, "sid": p["sid"],
+                "token": p["token"], "start": p["start"], "end": p["end"],
+                "ltype": p["ltype"],
+            })
 
     _MUTATING = (
         "CltomaMkdir", "CltomaCreate", "CltomaSymlink", "CltomaLink",
@@ -734,23 +814,43 @@ class MasterServer(Daemon):
         inode, token = msg.inode, msg.token
         self.meta.fs.file_node(inode)  # must exist and be a file
         if msg.op == 2:  # test (F_GETLK); checks both spaces
-            conflict = self.locks.test(
+            conflict = self.meta.locks.test(
                 inode, session_id, token, msg.start, msg.end, msg.ltype
-            ) or self.locks.test_flock(inode, session_id, token, msg.ltype)
+            ) or self.meta.locks.test_flock(
+                inode, session_id, token, msg.ltype
+            )
             return m.MatoclLockReply(
                 req_id=msg.req_id,
                 status=st.OK if conflict is None else st.LOCKED,
             )
-        if msg.op == 1:  # flock
-            ok = self.locks.flock(inode, session_id, token, msg.ltype, msg.wait)
-        else:  # posix range
-            ok = self.locks.posix(
-                inode, session_id, token, msg.start, msg.end, msg.ltype, msg.wait
-            )
-        if ok:
+        p = {
+            "kind": "flock" if msg.op == 1 else "posix",
+            "sid": session_id, "token": token,
+            "start": msg.start, "end": msg.end, "ltype": msg.ltype,
+        }
+        if self._lock_conflict(inode, p) is None:
+            self._commit_lock(inode, p)
+            if msg.ltype == LOCK_UNLOCK:
+                # an unlock also cancels this owner's queued requests in
+                # the range (a waiter that gave up aborts cleanly)
+                queue = self._pending_locks.get(inode, [])
+                end = msg.end or MAX_OFFSET
+                queue[:] = [
+                    q for q in queue
+                    if not (q["sid"] == session_id and q["token"] == token
+                            and q["kind"] == p["kind"]
+                            and (q["kind"] == "flock"
+                                 or (q["start"] < end
+                                     and msg.start < (q["end"] or MAX_OFFSET))))
+                ]
             # any successful change can free capacity (full unlock, but
             # also downgrades and range narrowing) — retry waiters
             self._grant_pending_locks(inode)
+            ok = True
+        else:
+            if msg.wait:
+                self._pending_locks.setdefault(inode, []).append(p)
+            ok = False
         return m.MatoclLockReply(
             req_id=msg.req_id, status=st.OK if ok else st.LOCKED
         )

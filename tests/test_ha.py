@@ -238,3 +238,62 @@ async def test_election_three_nodes(tmp_path):
     finally:
         for n in nodes.values():
             await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_locks_survive_shadow_promotion(tmp_path):
+    """Held locks replicate through the changelog: after promotion the
+    new master still refuses conflicting locks and can release them."""
+    active = MasterServer(str(tmp_path / "m"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "s"),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    try:
+        c1 = Client("127.0.0.1", active.port)
+        await c1.connect()
+        f = await c1.create(1, "locked")
+        assert await c1.flock(f.inode, 2, token=1)          # exclusive
+        assert await c1.posix_lock(f.inode, 0, 100, 2, token=2)
+
+        for _ in range(100):
+            if shadow.changelog.version == active.changelog.version:
+                break
+            await asyncio.sleep(0.05)
+        assert shadow.meta.checksum() == active.meta.checksum()
+        # the shadow's lock tables already mirror the held locks
+        assert shadow.meta.locks.flock_files[f.inode].ranges
+        assert shadow.meta.locks.posix_files[f.inode].ranges
+
+        await active.stop()
+        reply = await admin(shadow.port, "promote-shadow")
+        assert reply.status == 0
+
+        # a different session's conflicting locks are refused by the
+        # promoted master; non-conflicting ranges are granted
+        c2 = Client("127.0.0.1", shadow.port)
+        await c2.connect()
+        n = await c2.lookup(1, "locked")
+        # session-id allocation replicated: c2 must NOT be issued c1's id
+        assert c2.session_id != c1.session_id
+        assert not await c2.flock(n.inode, 2, token=9)
+        assert not await c2.posix_lock(n.inode, 50, 80, 2, token=9)
+        assert await c2.posix_lock(n.inode, 200, 300, 2, token=9)
+        # F_GETLK sees the replicated locks too (the test path must read
+        # the same lock tables the image load rebuilt)
+        assert not await c2.test_lock(n.inode, 0, 50, 2, token=9)
+        await c2.close()
+        await asyncio.sleep(0)
+
+        # c2's disconnect releases only c2's locks — c1's survive (a
+        # session-id collision here once released a stranger's locks)
+        c3 = Client("127.0.0.1", shadow.port)
+        await c3.connect()
+        assert not await c3.flock(n.inode, 2, token=11)
+        assert await c3.posix_lock(n.inode, 200, 300, 2, token=11)
+        await c3.close()
+        await c1.close()
+    finally:
+        await shadow.stop()
