@@ -65,6 +65,7 @@ class Client:
         # master_addrs: full list of master addresses (active + shadows);
         # the client cycles until the active one accepts its session
         self.master_addrs = master_addrs or [(master_host, master_port)]
+        self.current_master_addr = self.master_addrs[0]
         self.master: RpcConnection | None = None
         self.session_id = 0
         self.encoder = encoder or get_encoder("cpu")
@@ -167,6 +168,7 @@ class Client:
                     password=password,
                 )
                 self.master = conn
+                self.current_master_addr = addr  # failover moves this
                 self.session_id = reply.session_id
                 conn.on_push(m.MatoclLockGranted, self._on_lock_granted)
                 return

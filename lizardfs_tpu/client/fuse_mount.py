@@ -196,6 +196,12 @@ class LizardFuse:
     def start(self) -> None:
         self._loop_thread.start()
         self._run(self.client.connect(info="fuse-mount"))
+        # local master proxy (masterproxy.cc analog): tools inside the
+        # mount reach the master via the address in .masterinfo
+        from lizardfs_tpu.client.masterproxy import MasterProxy
+
+        self.proxy = MasterProxy(lambda: self.client.current_master_addr)
+        self._run(self.proxy.start())
 
     def _run(self, coro, timeout: float = 60.0):
         return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
@@ -284,9 +290,11 @@ class LizardFuse:
             ]
             return ("\n".join(lines) + "\n").encode()
         if name == "/.masterinfo":
-            addr = self.client.master_addrs[0]
+            addr = self.client.current_master_addr
+            proxy = getattr(self, "proxy", None)
             return (
                 f"master: {addr[0]}:{addr[1]}\n"
+                f"masterproxy: 127.0.0.1:{proxy.port if proxy else 0}\n"
                 f"session: {self.client.session_id}\n"
             ).encode()
         return None

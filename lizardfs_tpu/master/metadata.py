@@ -253,10 +253,24 @@ class MetadataStore:
                     for start, end, ltype, sid, token in rows
                 ]
 
-    def checksum(self) -> str:
-        """Divergence-detection digest over FS + persistent chunk state."""
+    def checksum(self, cache_key: int | None = None) -> str:
+        """Divergence-detection digest over FS + persistent chunk state.
+
+        ``cache_key`` (the changelog version) memoizes the digest so
+        repeated probes at the same version cost nothing; the full
+        serialization still runs once per version — an incremental
+        checksum (the reference's filesystem_checksum) is the scaling
+        follow-up.
+        """
         import hashlib
         import json
 
+        if cache_key is not None and getattr(
+            self, "_checksum_cache", (None, None)
+        )[0] == cache_key:
+            return self._checksum_cache[1]
         blob = json.dumps(self.to_sections(), sort_keys=True).encode()
-        return hashlib.sha256(blob).hexdigest()
+        digest = hashlib.sha256(blob).hexdigest()
+        if cache_key is not None:
+            self._checksum_cache = (cache_key, digest)
+        return digest

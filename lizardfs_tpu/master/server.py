@@ -173,12 +173,20 @@ class MasterServer(Daemon):
         if self.is_active:
             self.task_manager.tick()
 
+    shadow_verify_interval = 30.0
+
     async def start(self) -> None:
         await super().start()
         if self.personality == "shadow":
             if self.active_addr is None:
                 raise ValueError("shadow personality needs active_addr")
             self._shadow_task = self.spawn(self._shadow_follow())
+            # divergence detection (filesystem_checksum analog): compare
+            # whole-metadata digests with the active at equal versions.
+            # spawn directly — add_timer only registers before start()
+            self.spawn(self._run_timer(
+                self.shadow_verify_interval, self._shadow_verify_checksum
+            ))
 
     @property
     def is_active(self) -> bool:
@@ -1469,8 +1477,40 @@ class MasterServer(Daemon):
                 return
             await asyncio.sleep(1.0)
 
+    async def _shadow_verify_checksum(self) -> None:
+        if self.personality != "shadow":
+            return
+        try:
+            reader, writer = await asyncio.open_connection(*self.active_addr)
+            await framing.send_message(
+                writer,
+                m.AdminCommand(
+                    req_id=1, command="metadata-checksum", json="{}"
+                ),
+            )
+            reply = await asyncio.wait_for(framing.read_message(reader), 5.0)
+            writer.close()
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            return  # active unreachable; the follow loop handles that
+        try:
+            doc = json.loads(reply.json)
+        except (AttributeError, ValueError):
+            return
+        if doc.get("version") != self.changelog.version:
+            return  # mid-catch-up; compare only at equal versions
+        if doc.get("checksum") != self.meta.checksum(self.changelog.version):
+            self.log.error(
+                "shadow metadata DIVERGED from active at v%d — "
+                "re-downloading the image", self.changelog.version,
+            )
+            self._force_image_download = True
+            w = getattr(self, "_follow_writer", None)
+            if w is not None:
+                w.close()  # the follow loop reconnects and re-downloads
+
     async def _shadow_follow_once(self) -> None:
         reader, writer = await asyncio.open_connection(*self.active_addr)
+        self._follow_writer = writer
         try:
             await framing.send_message(
                 writer,
@@ -1479,7 +1519,11 @@ class MasterServer(Daemon):
             hello = await framing.read_message(reader)
             if not isinstance(hello, m.MatomlRegisterReply) or hello.status != st.OK:
                 raise ConnectionError("active master rejected shadow registration")
-            if hello.version > self.changelog.version:
+            if (
+                hello.version > self.changelog.version
+                or getattr(self, "_force_image_download", False)
+            ):
+                self._force_image_download = False
                 await self._shadow_download_image(reader, writer)
             while self.personality == "shadow":
                 msg = await framing.read_message(reader)
@@ -1636,7 +1680,7 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps({
                     "version": self.changelog.version,
-                    "checksum": self.meta.checksum(),
+                    "checksum": self.meta.checksum(self.changelog.version),
                 }),
             )
         return m.AdminReply(req_id=msg.req_id, status=st.EINVAL, json="{}")

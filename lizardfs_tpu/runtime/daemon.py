@@ -55,15 +55,40 @@ class Daemon:
         from lizardfs_tpu.proto import messages as m
         from lizardfs_tpu.proto import status as st
 
-        if getattr(msg, "command", None) == "metrics":
+        command = getattr(msg, "command", None)
+        if command in ("metrics", "metrics-csv"):
             try:
                 payload = json.loads(msg.json) if msg.json else {}
             except ValueError:
                 payload = {}
             resolution = payload.get("resolution", "sec")
+            if resolution not in ("sec", "min", "hour"):
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL, json="{}"
+                )
+            doc = self.metrics.to_dict(resolution)
+            if command == "metrics":
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
+                )
+            # charts.cc CSV export analog: one row per series, oldest
+            # first; series younger than the window get EMPTY leading
+            # cells (a fabricated 0 would read as a real zero sample)
+            width = max(
+                (len(s["points"]) for s in doc.values()), default=0
+            )
+            rows = ["series," + ",".join(
+                f"t-{i}" for i in range(width, 0, -1)
+            )]
+            for name, series in doc.items():
+                points = series["points"]
+                padded = [""] * (width - len(points)) + [
+                    str(v) for v in points
+                ]
+                rows.append(name + "," + ",".join(padded))
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
-                json=json.dumps(self.metrics.to_dict(resolution)),
+                json=json.dumps({"csv": "\n".join(rows) + "\n"}),
             )
         if getattr(msg, "command", None) == "tweaks":
             return m.AdminReply(

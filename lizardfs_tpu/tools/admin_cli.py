@@ -40,7 +40,7 @@ async def _amain(argv) -> int:
         choices=[
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
-            "metrics", "tweaks", "tweaks-set",
+            "metrics", "metrics-csv", "tweaks", "tweaks-set",
         ],
     )
     p.add_argument("extra", nargs="*", help="tweaks-set: NAME VALUE; metrics: [resolution]")
@@ -51,9 +51,12 @@ async def _amain(argv) -> int:
     cmd = args.command
     if cmd in ("list-chunkservers", "list-sessions"):
         reply = await _admin(addr, "info")
-    elif cmd == "metrics":
+    elif cmd in ("metrics", "metrics-csv"):
         resolution = args.extra[0] if args.extra else "sec"
         reply = await _admin(addr, cmd, json.dumps({"resolution": resolution}))
+        if cmd == "metrics-csv" and reply.status == 0:
+            print(json.loads(reply.json)["csv"], end="")
+            return 0
     elif cmd == "tweaks-set":
         if len(args.extra) != 2:
             print("usage: tweaks-set NAME VALUE", file=sys.stderr)

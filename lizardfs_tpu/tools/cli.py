@@ -29,6 +29,23 @@ FTYPE_CHAR = {1: "-", 2: "d", 3: "l"}
 
 
 def _addrs(s: str) -> list[tuple[str, int]]:
+    if s.startswith("mount:"):
+        # discover the master through a mounted filesystem's local proxy
+        # (masterproxy.cc analog): .masterinfo names the relay address
+        import os
+
+        info = os.path.join(s[len("mount:"):], ".masterinfo")
+        try:
+            with open(info) as f:
+                for line in f:
+                    if line.startswith("masterproxy:"):
+                        host, _, port = line.split()[1].rpartition(":")
+                        return [(host, int(port))]
+        except OSError as e:
+            raise ConnectionError(
+                f"{s!r} is not a lizardfs mount ({e})"
+            ) from e
+        raise ConnectionError(f"no masterproxy line in {info}")
     out = []
     for item in s.split(","):
         host, _, port = item.strip().rpartition(":")
@@ -369,7 +386,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="lizardfs", description=__doc__)
     p.add_argument(
         "--master", default="127.0.0.1:9420",
-        help="master address(es), host:port[,host:port...]",
+        help="master address(es) host:port[,host:port...], or "
+             "mount:/path to discover via a mounted FS's .masterinfo",
     )
     sub = p.add_subparsers(dest="command", required=True)
     for name, (_, params) in COMMANDS.items():

@@ -297,3 +297,40 @@ async def test_locks_survive_shadow_promotion(tmp_path):
         await c1.close()
     finally:
         await shadow.stop()
+
+
+@pytest.mark.asyncio
+async def test_shadow_detects_divergence_and_heals(tmp_path):
+    """A shadow whose state drifts from the active (corruption, bug)
+    must notice via the checksum comparison and re-download the image."""
+    active = MasterServer(str(tmp_path / "m"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "s"),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    shadow.shadow_verify_interval = 0.2
+    await shadow.start()
+    try:
+        c = Client("127.0.0.1", active.port)
+        await c.connect()
+        await c.mkdir(1, "dir")
+        await c.close()
+        for _ in range(100):
+            if shadow.changelog.version == active.changelog.version:
+                break
+            await asyncio.sleep(0.05)
+        assert shadow.meta.checksum() == active.meta.checksum()
+
+        # corrupt the shadow's in-memory state behind its back
+        shadow.meta.fs.node(1).mode = 0o123
+        assert shadow.meta.checksum() != active.meta.checksum()
+
+        for _ in range(100):
+            if shadow.meta.checksum() == active.meta.checksum():
+                break
+            await asyncio.sleep(0.1)
+        assert shadow.meta.checksum() == active.meta.checksum()
+    finally:
+        await shadow.stop()
+        await active.stop()

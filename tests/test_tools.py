@@ -5,6 +5,8 @@ import json
 
 import pytest
 
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.proto import framing, messages as m
 from lizardfs_tpu.tools import admin_cli, cli
 from lizardfs_tpu.utils import data_generator
 
@@ -177,5 +179,58 @@ async def test_webui_endpoints(tmp_path):
         health = json.loads(await asyncio.to_thread(fetch, "/api/health"))
         assert set(health) == {"healthy", "endangered", "lost"}
         httpd.shutdown()
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_masterproxy_relay(tmp_path):
+    """Tools reach the master through the mount's local proxy relay."""
+    from lizardfs_tpu.client.masterproxy import MasterProxy
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    proxy = MasterProxy(lambda: ("127.0.0.1", cluster.master.port))
+    await proxy.start()
+    try:
+        c = Client("", 0, master_addrs=[("127.0.0.1", proxy.port)])
+        await c.connect(info="via-proxy")
+        f = await c.create(1, "through-proxy")
+        await c.write_file(f.inode, b"relayed")
+        assert (await c.read_file(f.inode)) == b"relayed"
+        await c.close()
+    finally:
+        await proxy.stop()
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_admin_metrics_csv(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "x")
+        await c.write_file(f.inode, b"data")
+        await asyncio.sleep(1.2)  # let the 1 s metrics sampler tick
+        r, w = await asyncio.open_connection("127.0.0.1", cluster.master.port)
+        await framing.send_message(w, m.AdminCommand(
+            req_id=1, command="metrics-csv", json='{"resolution": "sec"}'))
+        reply = await framing.read_message(r)
+        w.close()
+        assert reply.status == 0
+        csv = json.loads(reply.json)["csv"]
+        assert csv.startswith("series,")
+        ops_row = next(
+            line for line in csv.splitlines()
+            if line.startswith("metadata_ops,")
+        )
+        # data cells are numbers, not dict keys
+        cells = [c for c in ops_row.split(",")[1:] if c]
+        assert cells
+        assert all(
+            cell.replace(".", "", 1).replace("-", "", 1).isdigit()
+            for cell in cells
+        )
     finally:
         await cluster.stop()
