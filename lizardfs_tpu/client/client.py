@@ -376,6 +376,24 @@ class Client:
         r = await self._call(m.CltomaGetAcl, inode=inode)
         return json.loads(r.json)
 
+    async def set_rich_acl(
+        self, inode: int, acl: dict | None,
+        uid: int | None = None, gids: list[int] | None = None,
+    ) -> None:
+        import json
+
+        await self._call(
+            m.CltomaSetRichAcl, inode=inode,
+            json=json.dumps(acl) if acl is not None else "",
+            **self._ident(uid, gids),
+        )
+
+    async def get_rich_acl(self, inode: int) -> dict | None:
+        import json
+
+        r = await self._call(m.CltomaGetRichAcl, inode=inode)
+        return json.loads(r.json).get("rich")
+
     async def access(
         self, inode: int, uid: int, gids: list[int], mask: int
     ) -> bool:

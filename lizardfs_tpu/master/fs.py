@@ -66,6 +66,9 @@ class Node:
     # POSIX ACLs, stored as plain dicts (master/acl.py evaluates)
     acl: dict | None = None
     default_acl: dict | None = None
+    # RichACL (NFSv4-style, master/richacl.py evaluates); when set it
+    # takes precedence over the POSIX ACL for permission checks
+    rich_acl: dict | None = None
     # directories: recursive subtree statistics (fsnodes statistics
     # analog) — counts include the directory itself
     stat_inodes: int = 1
@@ -96,6 +99,8 @@ class Node:
             d["acl"] = self.acl
         if self.default_acl is not None:
             d["default_acl"] = self.default_acl
+        if self.rich_acl is not None:
+            d["rich_acl"] = self.rich_acl
         if self.ftype == TYPE_FILE:
             d["length"] = self.length
             d["chunks"] = self.chunks
@@ -237,6 +242,14 @@ class FsTree:
             n.acl = dict(p.default_acl)
             if ftype == TYPE_DIR:
                 n.default_acl = dict(p.default_acl)
+        if p.rich_acl is not None:
+            from lizardfs_tpu.master import richacl as richacl_mod
+
+            inherited = richacl_mod.RichAcl.from_dict(p.rich_acl).inherited(
+                ftype == TYPE_DIR
+            )
+            if inherited is not None:
+                n.rich_acl = inherited.to_dict()
         self.nodes[inode] = n
         p.children[name] = inode
         p.mtime = p.ctime = ts
@@ -428,6 +441,12 @@ class FsTree:
         n.acl = dict(access) if access else None
         if n.ftype == TYPE_DIR:
             n.default_acl = dict(default) if default else None
+        n.ctime = ts
+
+    def apply_set_rich_acl(self, inode: int, acl: dict | None,
+                           ts: int) -> None:
+        n = self.node(inode)
+        n.rich_acl = dict(acl) if acl else None
         n.ctime = ts
 
     def apply_set_xattr(self, inode: int, name: str, value_b64: str, ts: int) -> None:

@@ -139,6 +139,9 @@ class MetadataStore:
             op["inode"], op.get("access"), op.get("default"), op["ts"]
         )
 
+    def _op_set_rich_acl(self, op):
+        self.fs.apply_set_rich_acl(op["inode"], op.get("acl"), op["ts"])
+
     def _op_set_xattr(self, op):
         self.fs.apply_set_xattr(op["inode"], op["name"], op["value"], op["ts"])
 

@@ -452,14 +452,24 @@ class MasterServer(Daemon):
             cur = node.parents[0]
             hops += 1
 
-    def _check_perm(self, node, uid: int, gids: list[int], want: int) -> None:
-        """Mode-bit + POSIX-ACL permission check (EACCES on refusal)."""
+    def _access_ok(self, node, uid: int, gids: list[int], want: int) -> bool:
+        """One permission decision for every call site: RichACL if set,
+        else mode bits + POSIX ACL."""
+        if node.rich_acl is not None:
+            from lizardfs_tpu.master.richacl import RichAcl
+
+            return RichAcl.from_dict(node.rich_acl).check_access(
+                node.uid, node.gid, uid, gids, want
+            )
         from lizardfs_tpu.master import acl as acl_mod
 
         a = acl_mod.Acl.from_dict(node.acl) if node.acl else None
-        if not acl_mod.check_access(
+        return acl_mod.check_access(
             node.mode, node.uid, node.gid, a, uid, gids, want
-        ):
+        )
+
+    def _check_perm(self, node, uid: int, gids: list[int], want: int) -> None:
+        if not self._access_ok(node, uid, gids, want):
             raise fsmod.FsError(st.EACCES, f"inode {node.inode}")
 
     def _grant_pending_locks(self, inode: int) -> None:
@@ -517,6 +527,7 @@ class MasterServer(Daemon):
         "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
         "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
         "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
+        "CltomaSetRichAcl",
     )
 
     _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
@@ -760,6 +771,32 @@ class MasterServer(Daemon):
                 "default": payload.get("default"), "ts": now,
             })
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaSetRichAcl):
+            from lizardfs_tpu.master.richacl import RichAcl
+
+            try:
+                payload = json.loads(msg.json) if msg.json else None
+                if payload is not None:
+                    if not isinstance(payload, dict):
+                        raise ValueError("acl payload must be an object")
+                    RichAcl.from_dict(payload)  # validate shape + principals
+            except (ValueError, KeyError, TypeError, AttributeError):
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
+            node = fs.node(msg.inode)
+            caller = getattr(msg, "uid", 0)
+            if caller != 0 and caller != node.uid:
+                raise fsmod.FsError(st.EPERM, "setrichacl requires ownership")
+            self.commit({
+                "op": "set_rich_acl", "inode": msg.inode,
+                "acl": payload, "ts": now,
+            })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaGetRichAcl):
+            node = fs.node(msg.inode)
+            return m.MatoclAclReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({"rich": node.rich_acl}),
+            )
         if isinstance(msg, m.CltomaGetAcl):
             node = fs.node(msg.inode)
             return m.MatoclAclReply(
@@ -773,11 +810,7 @@ class MasterServer(Daemon):
             from lizardfs_tpu.master import acl as acl_mod
 
             node = fs.node(msg.inode)
-            a = acl_mod.Acl.from_dict(node.acl) if node.acl else None
-            ok = acl_mod.check_access(
-                node.mode, node.uid, node.gid, a, msg.uid, list(msg.gids),
-                msg.mask,
-            )
+            ok = self._access_ok(node, msg.uid, list(msg.gids), msg.mask)
             return m.MatoclStatusReply(
                 req_id=msg.req_id, status=st.OK if ok else st.EACCES
             )

@@ -450,9 +450,30 @@ class MatoclAclReply(Message):
     FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
 
 
+class CltomaSetRichAcl(Message):
+    """Set/clear an NFSv4-style RichACL; json = {"aces": [...]} (see
+    master/richacl.py dict shape) or null to clear. Owner/root only.
+    A RichACL takes precedence over POSIX ACLs on the inode."""
+
+    MSG_TYPE = 1064
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("json", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
+
+
+class CltomaGetRichAcl(Message):
+    MSG_TYPE = 1065
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
 class CltomaAccess(Message):
     """Permission probe: can (uid, gid) access inode with mask r4/w2/x1?
-    Evaluated against mode bits + POSIX ACLs (access(2) analog)."""
+    Evaluated against the inode's RichACL when one is set, else mode
+    bits + POSIX ACLs (access(2) analog)."""
 
     MSG_TYPE = 1060
     FIELDS = (

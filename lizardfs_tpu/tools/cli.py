@@ -341,6 +341,79 @@ async def cmd_undelete(c: Client, args) -> int:
     return 0
 
 
+async def cmd_setrichacl(c: Client, args) -> int:
+    """setrichacl PATH ACE[,ACE...] | setrichacl --clear PATH
+
+    ACE syntax: [deny:]who:rwx[:fdino] — who is owner@|group@|
+    everyone@|u:UID|g:GID; flags f=file-inherit d=dir-inherit
+    i=inherit-only n=no-propagate. Examples:
+      setrichacl /dir 'deny:u:1000:w,everyone@:rx:fd'
+    """
+    from lizardfs_tpu.master import richacl as rmod
+
+    a = await c.resolve(args.path)
+    if args.clear:
+        await c.set_rich_acl(a.inode, None)
+        return 0
+    aces = []
+    try:
+        for spec in args.aces.split(","):
+            parts = spec.strip().split(":")
+            ace_type = rmod.ALLOW
+            if parts[0] == "deny":
+                ace_type = rmod.DENY
+                parts = parts[1:]
+            if parts[0] in ("u", "g"):
+                who = parts[0] + ":" + str(int(parts[1]))
+                parts = parts[2:]
+            elif parts[0] in (rmod.OWNER, rmod.GROUP, rmod.EVERYONE):
+                who = parts[0]
+                parts = parts[1:]
+            else:
+                raise ValueError(f"unknown principal {parts[0]!r}")
+            mask = 0
+            for ch in parts[0]:
+                mask |= {"r": 4, "w": 2, "x": 1}[ch]
+            flags = 0
+            if len(parts) > 1:
+                for ch in parts[1]:
+                    flags |= {"f": rmod.FILE_INHERIT, "d": rmod.DIR_INHERIT,
+                              "i": rmod.INHERIT_ONLY,
+                              "n": rmod.NO_PROPAGATE}[ch]
+            aces.append(rmod.Ace(ace_type, flags, mask, who))
+    except (ValueError, KeyError, IndexError) as e:
+        print(f"error: bad ACE spec: {e} — syntax: "
+              "[deny:]owner@|group@|everyone@|u:UID|g:GID:rwx[:fdino]",
+              file=sys.stderr)
+        return 2
+    await c.set_rich_acl(a.inode, rmod.RichAcl(aces).to_dict())
+    return 0
+
+
+async def cmd_getrichacl(c: Client, args) -> int:
+    from lizardfs_tpu.master import richacl as rmod
+
+    a = await c.resolve(args.path)
+    doc = await c.get_rich_acl(a.inode)
+    if doc is None:
+        print(f"{args.path}: no richacl")
+        return 0
+    for ace in rmod.RichAcl.from_dict(doc).aces:
+        kind = "deny " if ace.ace_type == rmod.DENY else "allow"
+        perms = "".join(
+            ch for bit, ch in ((4, "r"), (2, "w"), (1, "x")) if ace.mask & bit
+        )
+        flags = "".join(
+            ch for bit, ch in (
+                (rmod.FILE_INHERIT, "f"), (rmod.DIR_INHERIT, "d"),
+                (rmod.INHERIT_ONLY, "i"), (rmod.NO_PROPAGATE, "n"),
+            ) if ace.flags & bit
+        )
+        print(f"{kind} {ace.who:12s} {perms or '-'}"
+              + (f" [{flags}]" if flags else ""))
+    return 0
+
+
 COMMANDS = {
     "ls": (cmd_ls, [("path", {})]),
     "mkdir": (cmd_mkdir, [("path", {})]),
@@ -365,6 +438,11 @@ COMMANDS = {
     "dirinfo": (cmd_dirinfo, [("path", {})]),
     "rremove": (cmd_rremove, [("path", {})]),
     "snapshot": (cmd_snapshot, [("src", {}), ("dst", {})]),
+    "setrichacl": (cmd_setrichacl, [
+        ("path", {}), ("aces", {"nargs": "?", "default": ""}),
+        ("--clear", {"action": "store_true"}),
+    ]),
+    "getrichacl": (cmd_getrichacl, [("path", {})]),
     "getxattr": (cmd_getxattr, [("path", {}), ("name", {})]),
     "setxattr": (cmd_setxattr, [("path", {}), ("name", {}), ("value", {})]),
     "listxattr": (cmd_listxattr, [("path", {})]),

@@ -161,3 +161,121 @@ async def test_ownership_rules_setattr_setacl(tmp_path):
         assert e.value.code == st.EACCES
     finally:
         await cluster.stop()
+
+
+def test_richacl_evaluation_order():
+    """NFSv4 semantics: first decision per bit wins; deny beats a later
+    allow; undecided bits deny."""
+    from lizardfs_tpu.master.richacl import (
+        ALLOW, DENY, EVERYONE, GROUP, OWNER, Ace, RichAcl,
+    )
+
+    r = RichAcl([
+        Ace(DENY, 0, 2, "u:5"),          # uid 5: no write
+        Ace(ALLOW, 0, 7, "g:100"),       # group 100: rwx
+        Ace(ALLOW, 0, 4, EVERYONE),      # world: read
+    ])
+    assert r.check_access(1, 1, 5, [100], 4)       # read via group
+    assert not r.check_access(1, 1, 5, [100], 2)   # deny wins over group allow
+    assert r.check_access(1, 1, 9, [100], 7)       # group member full
+    assert r.check_access(1, 1, 9, [9], 4)         # world read
+    assert not r.check_access(1, 1, 9, [9], 1)     # x undecided -> deny
+    assert r.check_access(1, 1, 0, [0], 7)         # root bypass
+
+    owner = RichAcl([Ace(ALLOW, 0, 7, OWNER), Ace(ALLOW, 0, 4, GROUP)])
+    assert owner.check_access(42, 7, 42, [42], 7)
+    assert owner.check_access(42, 7, 8, [7], 4)
+    assert not owner.check_access(42, 7, 8, [7], 2)
+
+
+def test_richacl_inheritance_flags():
+    from lizardfs_tpu.master.richacl import (
+        ALLOW, DIR_INHERIT, EVERYONE, FILE_INHERIT, INHERIT_ONLY,
+        NO_PROPAGATE, Ace, RichAcl,
+    )
+
+    src = RichAcl([
+        Ace(ALLOW, FILE_INHERIT, 4, EVERYONE),
+        Ace(ALLOW, DIR_INHERIT | INHERIT_ONLY, 7, "u:5"),
+        Ace(ALLOW, DIR_INHERIT | NO_PROPAGATE, 2, "g:9"),
+        Ace(ALLOW, 0, 7, EVERYONE),          # no inherit flags
+    ])
+    f = src.inherited(is_dir=False)
+    assert [a.who for a in f.aces] == [EVERYONE]
+    assert f.aces[0].flags == 0              # files stop propagation
+
+    d = src.inherited(is_dir=True)
+    assert [a.who for a in d.aces] == ["u:5", "g:9"]
+    assert d.aces[0].flags & DIR_INHERIT     # keeps inheriting
+    assert not (d.aces[0].flags & INHERIT_ONLY)  # now applies to the dir
+    assert d.aces[1].flags == 0              # NO_PROPAGATE stripped all
+
+
+def test_richacl_from_posix_matches_posix_decisions():
+    from lizardfs_tpu.master import acl as acl_mod
+    from lizardfs_tpu.master.richacl import from_posix
+
+    cases = [
+        (0o750, acl_mod.Acl(named_users={5: 6}, named_groups={}, mask=6)),
+        # permissive other bits: group-class members must NOT fall
+        # through to everyone@ (POSIX classes are closed)
+        (0o604, acl_mod.Acl(named_users={5: 0}, named_groups={8: 2},
+                            mask=7)),
+        (0o617, None),
+    ]
+    for mode, a in cases:
+        r = from_posix(mode, a)
+        for uid in (1, 5, 9, 11):
+            for gids in ([2], [8], [9], [2, 8]):
+                for want in (4, 2, 1, 6, 7):
+                    posix = acl_mod.check_access(mode, 1, 2, a, uid, gids, want)
+                    rich = r.check_access(1, 2, uid, gids, want)
+                    assert posix == rich, (
+                        oct(mode), uid, gids, want, posix, rich
+                    )
+
+
+@pytest.mark.asyncio
+async def test_richacl_cluster_roundtrip(tmp_path):
+    """Set a RichACL through the wire; enforcement + inheritance +
+    replication to persisted state."""
+    from lizardfs_tpu.master.richacl import (
+        ALLOW, DENY, DIR_INHERIT, EVERYONE, FILE_INHERIT, Ace, RichAcl,
+    )
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "secure")
+        racl = RichAcl([
+            Ace(DENY, 0, 7, "u:777"),
+            Ace(ALLOW, FILE_INHERIT | DIR_INHERIT, 7, EVERYONE),
+        ])
+        await c.set_rich_acl(d.inode, racl.to_dict())
+        assert (await c.get_rich_acl(d.inode))["aces"][0]["w"] == "u:777"
+
+        # enforcement: uid 777 denied, others allowed
+        assert not await c.access(d.inode, 777, [777], 4)
+        assert await c.access(d.inode, 888, [888], 7)
+        with pytest.raises(st.StatusError):
+            await c.lookup(d.inode, "x", uid=777, gids=[777])
+
+        # children inherit (FILE_INHERIT strips flags; dirs keep them)
+        await c.setattr(1, 1, mode=0o777)
+        f = await c.create(d.inode, "f", uid=888, gid=888)
+        facl = await c.get_rich_acl(f.inode)
+        assert facl is not None and facl["aces"][0]["f"] == 0
+        sub = await c.mkdir(d.inode, "sub", uid=888, gid=888)
+        sacl = await c.get_rich_acl(sub.inode)
+        assert sacl["aces"][0]["f"] & (FILE_INHERIT | DIR_INHERIT)
+
+        # only the owner may change it
+        with pytest.raises(st.StatusError):
+            await c.set_rich_acl(d.inode, None, uid=999, gids=[999])
+        # clearing restores POSIX-mode checks
+        await c.set_rich_acl(d.inode, None)
+        assert await c.get_rich_acl(d.inode) is None
+        assert await c.access(d.inode, 777, [777], 4)
+    finally:
+        await cluster.stop()
