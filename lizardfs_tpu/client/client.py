@@ -853,6 +853,7 @@ class Client:
 
         await self._throttle(read_size)  # QoS: charge once, not per retry
         last_error: Exception | None = None
+        bad_addrs: set[tuple[str, int]] = set()  # replicas that failed us
         for attempt in range(self.retries):
             if attempt:
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
@@ -865,10 +866,11 @@ class Client:
             try:
                 data = await self._read_located(
                     loc, chunk_index, aligned_off, read_size, file_length,
-                    attempt=attempt,
+                    attempt=attempt, avoid=bad_addrs,
                 )
             except (ReadError, ConnectionError, OSError) as e:
                 last_error = e
+                bad_addrs.update(getattr(e, "used_addrs", ()))
                 log.info("read retry %d for chunk %d: %s", attempt + 1, loc.chunk_id, e)
                 continue
             for b in range(lo_b, aligned_end // MFSBLOCKSIZE + 1):
@@ -928,7 +930,7 @@ class Client:
 
     async def _read_located(
         self, loc, chunk_index: int, off: int, size: int, file_length: int,
-        attempt: int = 0,
+        attempt: int = 0, avoid: set[tuple[str, int]] | None = None,
     ) -> np.ndarray:
         import random
 
@@ -943,12 +945,21 @@ class Client:
             )
         if slice_type is None:
             raise ReadError("no locations for chunk")
+
         # first attempt: the master's topology-preferred (closest) copy;
-        # retries randomize so a dead replica gets rotated off
-        by_part = {
-            p: (locs[0] if attempt == 0 else random.choice(locs))
-            for p, locs in copies.items()
-        }
+        # retries avoid replicas that already failed this read, then
+        # randomize among what is left (a dead replica rotates off
+        # instead of being re-drawn by chance)
+        def pick(locs):
+            good = [l for l in locs if l[0] not in (avoid or ())]
+            pool = good or locs
+            return pool[0] if attempt == 0 else random.choice(pool)
+
+        by_part = {p: pick(locs) for p, locs in copies.items()}
+
+        def _tag(err):
+            err.used_addrs = [addr for addr, _ in by_part.values()]
+            return err
         chunk_len = min(
             max(file_length - chunk_index * MFSCHUNKSIZE, 0), MFSCHUNKSIZE
         )
@@ -962,10 +973,13 @@ class Client:
                 slice_type, [plans.RequestedPartInfo(0, size)], size
             )
             plan.read_operations.append(plans.ReadOp(0, off, size, 0, 0))
-            result = await execute_plan(
-                plan, loc.chunk_id, loc.version, by_part,
-                wave_timeout=self.wave_timeout,
-            )
+            try:
+                result = await execute_plan(
+                    plan, loc.chunk_id, loc.version, by_part,
+                    wave_timeout=self.wave_timeout,
+                )
+            except (ReadError, ConnectionError, OSError) as e:
+                raise _tag(e)
             return np.asarray(result[:size])
         # striped slice: read covering stripe slots from all data parts
         d = slice_type.data_parts
@@ -982,6 +996,9 @@ class Client:
         if not planner.is_readable(wanted):
             raise ReadError("not enough parts available")
         plan = planner.build_plan(wanted, lo_slot, nslots, part_sizes)
+        # striped plans rotate bad parts internally via waves — no
+        # blacklist tagging here, or one dead server would push every
+        # healthy part off its topology-preferred copy on retry
         buf = await execute_plan(
             plan, loc.chunk_id, loc.version, by_part,
             wave_timeout=self.wave_timeout,

@@ -488,6 +488,13 @@ class FsTree:
                 symlink_target=node.symlink_target, nlink=1,
                 parents=[parent_inode], xattrs=dict(node.xattrs),
             )
+            # ACLs travel with the snapshot (dropping them while keeping
+            # a setrichacl-lifted mode would widen access on the clone)
+            new.acl = dict(node.acl) if node.acl else None
+            new.default_acl = (
+                dict(node.default_acl) if node.default_acl else None
+            )
+            new.rich_acl = dict(node.rich_acl) if node.rich_acl else None
             self.nodes[new_inode] = new
             self.nodes[parent_inode].children[name] = new_inode
             self.next_inode = max(self.next_inode, new_inode + 1)

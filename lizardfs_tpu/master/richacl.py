@@ -85,10 +85,29 @@ class RichAcl:
     # --- evaluation (richacl.cc permission walk analog) --------------------
 
     def check_access(self, owner_uid: int, owner_gid: int, uid: int,
-                     gids: list[int], want: int) -> bool:
-        """NFSv4 walk: first decision per bit wins; undecided bits deny."""
+                     gids: list[int], want: int,
+                     mode: int | None = None) -> bool:
+        """NFSv4 walk: first decision per bit wins; undecided bits deny.
+
+        When ``mode`` is given it acts as the Linux-richacl file masks:
+        the mode's class bits BOUND what the ACEs can grant (so chmod
+        restricts a RichACL'd file and an inherited ACL cannot exceed
+        the create mode). setrichacl lifts the mode to the ACL's
+        per-class unions (compute_max_masks), so a freshly set ACL is
+        not immediately capped.
+        """
         if uid == 0:
             return True
+        # class membership is over ALL applicable ACEs — it must not be
+        # truncated by the grant walk's early exit (a named-user ACE
+        # after a deciding everyone@ ACE still puts the caller in the
+        # group class for the mode masks)
+        matched_class = any(
+            ace.who != EVERYONE
+            and not ace.flags & INHERIT_ONLY
+            and ace.matches(owner_uid, owner_gid, uid, gids)
+            for ace in self.aces
+        )
         allowed = 0
         denied = 0
         for ace in self.aces:
@@ -103,7 +122,33 @@ class RichAcl:
                 denied |= undecided
             if (want & denied) or (want & ~(allowed | denied)) == 0:
                 break
+        if mode is not None:
+            if uid == owner_uid:
+                mask = (mode >> 6) & 7
+            elif owner_gid in gids or matched_class:
+                mask = (mode >> 3) & 7
+            else:
+                mask = mode & 7
+            allowed &= mask
         return (want & allowed) == want and not (want & denied)
+
+    def compute_max_masks(self, owner_uid: int) -> tuple[int, int, int]:
+        """Per-class unions of the ALLOW grants (richacl_compute_max_
+        masks analog): what mode bits setrichacl should publish."""
+        owner = group = other = 0
+        for ace in self.aces:
+            if ace.ace_type != ALLOW or ace.flags & INHERIT_ONLY:
+                continue
+            if ace.who == OWNER or ace.who == f"u:{owner_uid}":
+                owner |= ace.mask
+            elif ace.who == EVERYONE:
+                owner |= ace.mask
+                group |= ace.mask
+                other |= ace.mask
+            else:
+                owner |= ace.mask
+                group |= ace.mask
+        return owner, group, other
 
     # --- inheritance (richacl inheritance flag semantics) ------------------
 
@@ -116,6 +161,14 @@ class RichAcl:
                 if ace.flags & NO_PROPAGATE:
                     flags &= ~(FILE_INHERIT | DIR_INHERIT | NO_PROPAGATE)
                 out.append(Ace(ace.ace_type, flags, ace.mask, ace.who))
+            elif is_dir and ace.flags & FILE_INHERIT:
+                # NFSv4: a file-only-inheritable ACE passes THROUGH a
+                # subdirectory (inherit-only there) so files deeper in
+                # the tree still inherit it
+                if not ace.flags & NO_PROPAGATE:
+                    out.append(Ace(ace.ace_type,
+                                   FILE_INHERIT | INHERIT_ONLY,
+                                   ace.mask, ace.who))
             elif not is_dir and ace.flags & FILE_INHERIT:
                 # files never propagate further: strip inheritance flags
                 out.append(Ace(ace.ace_type, 0, ace.mask, ace.who))

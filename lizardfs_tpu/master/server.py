@@ -459,7 +459,7 @@ class MasterServer(Daemon):
             from lizardfs_tpu.master.richacl import RichAcl
 
             return RichAcl.from_dict(node.rich_acl).check_access(
-                node.uid, node.gid, uid, gids, want
+                node.uid, node.gid, uid, gids, want, mode=node.mode
             )
         from lizardfs_tpu.master import acl as acl_mod
 
@@ -776,10 +776,13 @@ class MasterServer(Daemon):
 
             try:
                 payload = json.loads(msg.json) if msg.json else None
+                racl = None
                 if payload is not None:
-                    if not isinstance(payload, dict):
-                        raise ValueError("acl payload must be an object")
-                    RichAcl.from_dict(payload)  # validate shape + principals
+                    if not isinstance(payload, dict) or not isinstance(
+                        payload.get("aces"), list
+                    ):
+                        raise ValueError("acl payload must be {aces: [...]}")
+                    racl = RichAcl.from_dict(payload)
             except (ValueError, KeyError, TypeError, AttributeError):
                 return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
             node = fs.node(msg.inode)
@@ -788,8 +791,22 @@ class MasterServer(Daemon):
                 raise fsmod.FsError(st.EPERM, "setrichacl requires ownership")
             self.commit({
                 "op": "set_rich_acl", "inode": msg.inode,
-                "acl": payload, "ts": now,
+                # normalized form only — never persist unvalidated keys
+                "acl": racl.to_dict() if racl is not None else None,
+                "ts": now,
             })
+            if racl is not None:
+                # publish the ACL's per-class grant unions as the mode
+                # (richacl_compute_max_masks analog) so the mode masks
+                # do not immediately cap a freshly set ACL
+                o, g, oth = racl.compute_max_masks(node.uid)
+                new_mode = (node.mode & ~0o777) | (o << 6) | (g << 3) | oth
+                if new_mode != node.mode:
+                    self.commit({
+                        "op": "setattr", "inode": msg.inode, "set_mask": 1,
+                        "mode": new_mode, "uid": node.uid, "gid": node.gid,
+                        "atime": node.atime, "mtime": node.mtime, "ts": now,
+                    })
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaGetRichAcl):
             node = fs.node(msg.inode)

@@ -396,8 +396,16 @@ async def cmd_getrichacl(c: Client, args) -> int:
     a = await c.resolve(args.path)
     doc = await c.get_rich_acl(a.inode)
     if doc is None:
-        print(f"{args.path}: no richacl")
-        return 0
+        # synthesize the equivalent view from mode + POSIX ACL (the
+        # acl_converter.cc getrichacl path for POSIX-only inodes)
+        from lizardfs_tpu.master import acl as acl_mod
+        from lizardfs_tpu.master.richacl import from_posix
+
+        posix = await c.get_acl(a.inode)
+        pacl = (acl_mod.Acl.from_dict(posix["access"])
+                if posix.get("access") else None)
+        doc = from_posix(posix["mode"], pacl).to_dict()
+        print(f"{args.path}: no richacl; synthetic from POSIX:")
     for ace in rmod.RichAcl.from_dict(doc).aces:
         kind = "deny " if ace.ace_type == rmod.DENY else "allow"
         perms = "".join(

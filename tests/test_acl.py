@@ -205,10 +205,12 @@ def test_richacl_inheritance_flags():
     assert f.aces[0].flags == 0              # files stop propagation
 
     d = src.inherited(is_dir=True)
-    assert [a.who for a in d.aces] == ["u:5", "g:9"]
-    assert d.aces[0].flags & DIR_INHERIT     # keeps inheriting
-    assert not (d.aces[0].flags & INHERIT_ONLY)  # now applies to the dir
-    assert d.aces[1].flags == 0              # NO_PROPAGATE stripped all
+    # the FILE_INHERIT-only ACE passes through as inherit-only
+    assert [a.who for a in d.aces] == [EVERYONE, "u:5", "g:9"]
+    assert d.aces[0].flags == FILE_INHERIT | INHERIT_ONLY
+    assert d.aces[1].flags & DIR_INHERIT     # keeps inheriting
+    assert not (d.aces[1].flags & INHERIT_ONLY)  # now applies to the dir
+    assert d.aces[2].flags == 0              # NO_PROPAGATE stripped all
 
 
 def test_richacl_from_posix_matches_posix_decisions():
@@ -277,5 +279,86 @@ async def test_richacl_cluster_roundtrip(tmp_path):
         await c.set_rich_acl(d.inode, None)
         assert await c.get_rich_acl(d.inode) is None
         assert await c.access(d.inode, 777, [777], 4)
+    finally:
+        await cluster.stop()
+
+
+def test_richacl_mode_masks_bound_grants():
+    """The mode's class bits cap what ACEs grant (Linux richacl masks):
+    chmod restricts, inherited ACLs cannot exceed the create mode."""
+    from lizardfs_tpu.master.richacl import ALLOW, EVERYONE, Ace, RichAcl
+
+    r = RichAcl([Ace(ALLOW, 0, 7, EVERYONE)])
+    # 0600: other class gets nothing despite everyone@ rwx
+    assert not r.check_access(1, 1, 9, [9], 4, mode=0o600)
+    assert r.check_access(1, 1, 1, [1], 4, mode=0o600)    # owner: r ok
+    assert not r.check_access(1, 1, 1, [1], 1, mode=0o600)  # owner: no x
+    # group class (owning gid) bounded by group bits
+    assert r.check_access(1, 2, 9, [2], 4, mode=0o640)
+    assert not r.check_access(1, 2, 9, [2], 2, mode=0o640)
+    # no mode -> pure ACE semantics
+    assert r.check_access(1, 1, 9, [9], 7)
+
+
+def test_richacl_compute_max_masks():
+    from lizardfs_tpu.master.richacl import (
+        ALLOW, DENY, EVERYONE, GROUP, OWNER, Ace, RichAcl,
+    )
+
+    r = RichAcl([
+        Ace(ALLOW, 0, 7, OWNER),
+        Ace(DENY, 0, 2, "u:5"),
+        Ace(ALLOW, 0, 6, "g:9"),
+        Ace(ALLOW, 0, 4, EVERYONE),
+    ])
+    assert r.compute_max_masks(owner_uid=1) == (7, 6, 4)
+
+
+def test_richacl_file_inherit_passes_through_subdirs():
+    """NFSv4: FILE_INHERIT-only ACEs traverse subdirectories as
+    inherit-only so deep files still inherit them."""
+    from lizardfs_tpu.master.richacl import (
+        ALLOW, EVERYONE, FILE_INHERIT, INHERIT_ONLY, Ace, RichAcl,
+    )
+
+    top = RichAcl([Ace(ALLOW, FILE_INHERIT, 4, EVERYONE)])
+    sub = top.inherited(is_dir=True)
+    assert sub is not None
+    assert sub.aces[0].flags == FILE_INHERIT | INHERIT_ONLY
+    # the pass-through ACE does not apply to the subdir itself
+    assert not sub.check_access(1, 1, 9, [9], 4)
+    deep_file = sub.inherited(is_dir=False)
+    assert deep_file.aces[0].flags == 0
+    assert deep_file.check_access(1, 1, 9, [9], 4)
+
+
+def test_richacl_class_membership_survives_early_break():
+    """A named-user ACE after a deciding everyone@ ACE still puts the
+    caller in the group mask class (Linux richacl class rules)."""
+    from lizardfs_tpu.master.richacl import ALLOW, EVERYONE, Ace, RichAcl
+
+    r = RichAcl([Ace(ALLOW, 0, 4, EVERYONE), Ace(ALLOW, 0, 7, "u:9")])
+    # mode 0770: other class gets nothing — but uid 9 is group-class
+    assert r.check_access(1, 1, 9, [9], 4, mode=0o770)
+    # a true stranger stays in the other class
+    assert not r.check_access(1, 1, 8, [8], 4, mode=0o770)
+
+
+@pytest.mark.asyncio
+async def test_snapshot_preserves_richacl(tmp_path):
+    from lizardfs_tpu.master.richacl import ALLOW, DENY, EVERYONE, Ace, RichAcl
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "orig")
+        racl = RichAcl([Ace(DENY, 0, 7, "u:777"),
+                        Ace(ALLOW, 0, 7, EVERYONE)])
+        await c.set_rich_acl(d.inode, racl.to_dict())
+        snap = await c.snapshot(d.inode, 1, "snap")
+        sacl = await c.get_rich_acl(snap.inode)
+        assert sacl is not None and sacl["aces"][0]["w"] == "u:777"
+        assert not await c.access(snap.inode, 777, [777], 4)
     finally:
         await cluster.stop()
