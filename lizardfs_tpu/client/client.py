@@ -83,7 +83,8 @@ class Client:
         # write on a shared stripe must not interleave (FUSE is
         # multithreaded; the reference serializes via its per-inode
         # write journal, writedata.cc)
-        self._chunk_write_locks: dict[tuple[int, int], asyncio.Lock] = {}
+        # (inode, chunk) -> [asyncio.Lock, refcount]; see _pwrite_chunk
+        self._chunk_write_locks: dict[tuple[int, int], list] = {}
         # waiting lock requests: (inode, token) -> grant queue
         self._lock_grants: dict[tuple[int, int], asyncio.Queue] = {}
         # identity attached to permission-checked ops when the caller
@@ -266,8 +267,10 @@ class Client:
         )
         return r.attr
 
-    async def setgoal(self, inode: int, goal: int) -> None:
-        await self._call(m.CltomaSetGoal, inode=inode, goal=goal)
+    async def setgoal(self, inode: int, goal: int,
+                      uid: int | None = None) -> None:
+        await self._call(m.CltomaSetGoal, inode=inode, goal=goal,
+                         uid=self.default_uid if uid is None else uid)
 
     async def truncate(self, inode: int, length: int, uid: int | None = None,
                        gids: list[int] | None = None) -> m.Attr:
@@ -327,35 +330,49 @@ class Client:
         )
         return r.attr
 
-    async def set_xattr(self, inode: int, name: str, value: bytes) -> None:
-        await self._call(m.CltomaSetXattr, inode=inode, name=name, value=value)
+    async def set_xattr(self, inode: int, name: str, value: bytes,
+                        uid: int | None = None,
+                        gids: list[int] | None = None) -> None:
+        await self._call(m.CltomaSetXattr, inode=inode, name=name,
+                         value=value, **self._ident(uid, gids))
 
-    async def get_xattr(self, inode: int, name: str) -> bytes:
-        r = await self._call(m.CltomaGetXattr, inode=inode, name=name)
+    async def get_xattr(self, inode: int, name: str,
+                        uid: int | None = None,
+                        gids: list[int] | None = None) -> bytes:
+        r = await self._call(m.CltomaGetXattr, inode=inode, name=name,
+                             **self._ident(uid, gids))
         return r.value
 
-    async def remove_xattr(self, inode: int, name: str) -> None:
-        await self._call(m.CltomaSetXattr, inode=inode, name=name, value=b"")
+    async def remove_xattr(self, inode: int, name: str,
+                           uid: int | None = None,
+                           gids: list[int] | None = None) -> None:
+        await self._call(m.CltomaSetXattr, inode=inode, name=name, value=b"",
+                         **self._ident(uid, gids))
 
-    async def list_xattr(self, inode: int) -> list[str]:
+    async def list_xattr(self, inode: int, uid: int | None = None,
+                         gids: list[int] | None = None) -> list[str]:
+        # uid/gids accepted for interface symmetry; listxattr(2) does not
+        # require access to the inode, so no identity goes on the wire
         r = await self._call(m.CltomaListXattr, inode=inode)
         return r.names
 
     async def set_quota(
         self, kind: str, owner_id: int, *, soft_inodes: int = 0,
         hard_inodes: int = 0, soft_bytes: int = 0, hard_bytes: int = 0,
-        remove: bool = False,
+        remove: bool = False, uid: int | None = None,
     ) -> None:
         await self._call(
             m.CltomaSetQuota, kind=kind, owner_id=owner_id,
             soft_inodes=soft_inodes, hard_inodes=hard_inodes,
             soft_bytes=soft_bytes, hard_bytes=hard_bytes, remove=remove,
+            uid=self.default_uid if uid is None else uid,
         )
 
-    async def get_quota(self) -> list[dict]:
+    async def get_quota(self, uid: int | None = None,
+                        gids: list[int] | None = None) -> list[dict]:
         import json
 
-        r = await self._call(m.CltomaGetQuota)
+        r = await self._call(m.CltomaGetQuota, **self._ident(uid, gids))
         return json.loads(r.json)
 
     async def set_acl(
@@ -407,14 +424,16 @@ class Client:
                 return False
             raise
 
-    async def trash_list(self) -> list[dict]:
+    async def trash_list(self, uid: int | None = None) -> list[dict]:
         import json
 
-        r = await self._call(m.CltomaTrashList)
+        r = await self._call(m.CltomaTrashList,
+                             uid=self.default_uid if uid is None else uid)
         return json.loads(r.json)
 
-    async def undelete(self, inode: int) -> None:
-        await self._call(m.CltomaUndelete, inode=inode)
+    async def undelete(self, inode: int, uid: int | None = None) -> None:
+        await self._call(m.CltomaUndelete, inode=inode,
+                         uid=self.default_uid if uid is None else uid)
 
     # --- locking -----------------------------------------------------------
 
@@ -533,18 +552,31 @@ class Client:
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
         old_length: int, new_length: int,
     ) -> None:
-        lock = self._chunk_write_locks.setdefault((inode, ci), asyncio.Lock())
-        async with lock:
-            # a failed attempt can leave parts torn (some written, some
-            # not, parity stale); each retry takes a FRESH grant — the
-            # version bump drops unreachable holders and the full region
-            # rewrite restores stripe consistency on the survivors
-            async def attempt():
-                await self._pwrite_chunk_locked(
-                    inode, ci, coff, piece, old_length, new_length
-                )
+        key = (inode, ci)
+        # [lock, refcount]: long-lived mounts touch unboundedly many
+        # (inode, chunk) pairs, so entries are dropped once nobody holds
+        # or awaits them (a plain locked() check would race with waiters)
+        entry = self._chunk_write_locks.get(key)
+        if entry is None:
+            entry = self._chunk_write_locks[key] = [asyncio.Lock(), 0]
+        entry[1] += 1
+        try:
+            async with entry[0]:
+                # a failed attempt can leave parts torn (some written,
+                # some not, parity stale); each retry takes a FRESH grant
+                # — the version bump drops unreachable holders and the
+                # full region rewrite restores stripe consistency on the
+                # survivors
+                async def attempt():
+                    await self._pwrite_chunk_locked(
+                        inode, ci, coff, piece, old_length, new_length
+                    )
 
-            await self._retry_transient(f"pwrite chunk {ci}", attempt)
+                await self._retry_transient(f"pwrite chunk {ci}", attempt)
+        finally:
+            entry[1] -= 1
+            if entry[1] == 0 and self._chunk_write_locks.get(key) is entry:
+                del self._chunk_write_locks[key]
 
     async def _pwrite_chunk_locked(
         self, inode: int, ci: int, coff: int, piece: np.ndarray,

@@ -520,14 +520,18 @@ class LizardFuse:
             return 0
 
         def op_setxattr(path, name, value, size, flags):
+            uid, gids = self._caller()
             node = self._resolve(path)
             raw = ctypes.string_at(value, size)
-            self._run(self.client.set_xattr(node.inode, name.decode(), raw))
+            self._run(self.client.set_xattr(
+                node.inode, name.decode(), raw, uid=uid, gids=gids))
             return 0
 
         def op_getxattr(path, name, value, size):
+            uid, gids = self._caller()
             node = self._resolve(path)
-            data = self._run(self.client.get_xattr(node.inode, name.decode()))
+            data = self._run(self.client.get_xattr(
+                node.inode, name.decode(), uid=uid, gids=gids))
             if size == 0:
                 return len(data)
             if size < len(data):
@@ -547,8 +551,10 @@ class LizardFuse:
             return len(blob)
 
         def op_removexattr(path, name):
+            uid, gids = self._caller()
             node = self._resolve(path)
-            self._run(self.client.remove_xattr(node.inode, name.decode()))
+            self._run(self.client.remove_xattr(
+                node.inode, name.decode(), uid=uid, gids=gids))
             return 0
 
         for name, fn in (

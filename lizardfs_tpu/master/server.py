@@ -32,7 +32,7 @@ from lizardfs_tpu.master.changelog import Changelog, load_image, save_image
 from lizardfs_tpu.master.chunks import ChunkServerInfo
 from lizardfs_tpu.master.locks import LOCK_UNLOCK, MAX_OFFSET
 from lizardfs_tpu.master.metadata import MetadataStore
-from lizardfs_tpu.master.quotas import KIND_DIR
+from lizardfs_tpu.master.quotas import KIND_DIR, KIND_GROUP, KIND_USER
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
@@ -572,9 +572,21 @@ class MasterServer(Daemon):
                     return False
         maproot = session.get("maproot")
         if maproot is not None:
-            for field in ("uid", "gid"):
+            # Squash caller IDENTITY fields only.  CltomaSetattr carries
+            # caller identity in caller_uid/caller_gids while its uid/gid
+            # are the chown TARGET — those must pass through untouched
+            # (the squashed caller is then not root and the handler
+            # denies the chown).
+            scalars = (("caller_uid",) if isinstance(msg, m.CltomaSetattr)
+                       else ("uid", "gid", "caller_uid"))
+            for field in scalars:
                 if getattr(msg, field, None) == 0:
                     setattr(msg, field, maproot)
+            for field in ("gids", "caller_gids"):
+                vals = getattr(msg, field, None)
+                if vals:
+                    setattr(msg, field,
+                            [maproot if v == 0 else v for v in vals])
         return True
 
     async def _handle_client(self, msg, session_id: int = 0):
@@ -674,6 +686,9 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaSetGoal):
             if msg.goal not in self.goals:
                 return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
+            node = fs.node(msg.inode)
+            if msg.uid != 0 and msg.uid != node.uid:
+                raise fsmod.FsError(st.EPERM, "setgoal requires ownership")
             self.commit({"op": "setgoal", "inode": msg.inode, "goal": msg.goal, "ts": now})
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaSetattr):
@@ -709,6 +724,7 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaSetXattr):
             import base64
 
+            self._check_perm(fs.node(msg.inode), msg.uid, list(msg.gids), 2)
             self.commit({
                 "op": "set_xattr", "inode": msg.inode, "name": msg.name,
                 "value": base64.b64encode(msg.value).decode(), "ts": now,
@@ -716,6 +732,7 @@ class MasterServer(Daemon):
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaGetXattr):
             node = fs.node(msg.inode)
+            self._check_perm(node, msg.uid, list(msg.gids), 4)
             if msg.name not in node.xattrs:
                 return m.MatoclXattrReply(
                     req_id=msg.req_id, status=st.ENOATTR, value=b""
@@ -729,6 +746,8 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK, names=sorted(node.xattrs)
             )
         if isinstance(msg, m.CltomaSetQuota):
+            if msg.uid != 0:
+                raise fsmod.FsError(st.EPERM, "setquota requires root")
             self.commit({
                 "op": "set_quota", "kind": msg.kind, "owner_id": msg.owner_id,
                 "soft_inodes": msg.soft_inodes, "hard_inodes": msg.hard_inodes,
@@ -738,13 +757,22 @@ class MasterServer(Daemon):
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaGetQuota):
             rows = []
+            gidset = set(msg.gids) if msg.uid != 0 else frozenset()
             for (kind, oid), e in sorted(self.meta.quotas.entries.items()):
+                node = fs.nodes.get(oid) if kind == KIND_DIR else None
+                if msg.uid != 0:
+                    # non-root sees only its own rows: its user quota,
+                    # its groups' quotas, and dir quotas it owns
+                    if not (
+                        (kind == KIND_USER and oid == msg.uid)
+                        or (kind == KIND_GROUP and oid in gidset)
+                        or (node is not None and node.uid == msg.uid)
+                    ):
+                        continue
                 row = {"kind": kind, "id": oid, **e.to_dict()}
-                if kind == KIND_DIR:
-                    node = fs.nodes.get(oid)
-                    if node is not None:
-                        row["used_inodes"] = node.stat_inodes
-                        row["used_bytes"] = node.stat_bytes
+                if node is not None:
+                    row["used_inodes"] = node.stat_inodes
+                    row["used_bytes"] = node.stat_bytes
                 rows.append(row)
             return m.MatoclQuotaReply(
                 req_id=msg.req_id, status=st.OK, json=json.dumps(rows)
@@ -857,6 +885,9 @@ class MasterServer(Daemon):
             rows = [
                 {"inode": inode, "name": name, "expires": exp, "parent": parent}
                 for inode, (name, exp, parent) in sorted(fs.trash.items())
+                if msg.uid == 0
+                or (fs.nodes.get(inode) is not None
+                    and fs.nodes[inode].uid == msg.uid)
             ]
             return m.MatoclTrashList(
                 req_id=msg.req_id, status=st.OK, json=json.dumps(rows)
@@ -864,6 +895,10 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaUndelete):
             if msg.inode not in fs.trash:
                 return m.MatoclStatusReply(req_id=msg.req_id, status=st.ENOENT)
+            node = fs.nodes.get(msg.inode)
+            # fail closed: an unresolvable trash entry is nobody's to restore
+            if msg.uid != 0 and (node is None or msg.uid != node.uid):
+                raise fsmod.FsError(st.EPERM, "undelete requires ownership")
             self.commit({"op": "undelete", "inode": msg.inode, "ts": now})
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         return m.MatoclStatusReply(req_id=getattr(msg, "req_id", 0), status=st.EINVAL)

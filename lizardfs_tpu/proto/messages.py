@@ -203,7 +203,12 @@ class CltomaRename(Message):
 
 class CltomaSetGoal(Message):
     MSG_TYPE = 1018
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("goal", "u8"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("goal", "u8"),
+        ("uid", "u32"),
+    )
 
 
 class CltomaReadChunk(Message):
@@ -346,13 +351,21 @@ class CltomaSetXattr(Message):
         ("req_id", "u32"),
         ("inode", "u32"),
         ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
         ("value", "bytes"),
     )
 
 
 class CltomaGetXattr(Message):
     MSG_TYPE = 1040
-    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("name", "str"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("name", "str"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
 
 
 class MatoclXattrReply(Message):
@@ -361,6 +374,7 @@ class MatoclXattrReply(Message):
 
 
 class CltomaListXattr(Message):
+    # carries no identity: listxattr(2) needs no access on the inode
     MSG_TYPE = 1042
     FIELDS = (("req_id", "u32"), ("inode", "u32"))
 
@@ -384,12 +398,13 @@ class CltomaSetQuota(Message):
         ("soft_bytes", "u64"),
         ("hard_bytes", "u64"),
         ("remove", "bool"),
+        ("uid", "u32"),
     )
 
 
 class CltomaGetQuota(Message):
     MSG_TYPE = 1046
-    FIELDS = (("req_id", "u32"),)
+    FIELDS = (("req_id", "u32"), ("uid", "u32"), ("gids", "list:u32"))
 
 
 class MatoclQuotaReply(Message):
@@ -505,7 +520,7 @@ class MatoclIoLimitReply(Message):
 
 class CltomaTrashList(Message):
     MSG_TYPE = 1052
-    FIELDS = (("req_id", "u32"),)
+    FIELDS = (("req_id", "u32"), ("uid", "u32"))
 
 
 class MatoclTrashList(Message):
@@ -515,7 +530,11 @@ class MatoclTrashList(Message):
 
 class CltomaUndelete(Message):
     MSG_TYPE = 1054
-    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("uid", "u32"),
+    )
 
 
 # --------------------------------------------------------------------------

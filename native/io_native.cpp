@@ -132,7 +132,10 @@ int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
             uint32_t dlen = get32(p + 21);
             if (1 + 4 + 8 + 4 + 4 + 4 + dlen != length) return -2;
             const uint8_t* data = p + 25;
-            if (piece_off < offset ||
+            // Pieces must arrive in order and contiguously; a byte
+            // counter alone would let overlapping pieces mask gaps of
+            // uninitialized memory in the caller's buffer.
+            if (piece_off != offset + received ||
                 uint64_t(piece_off) + dlen > uint64_t(offset) + size)
                 return -2;
             if (lz_crc32(0, data, dlen) != crc) return -3;

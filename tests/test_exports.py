@@ -93,3 +93,124 @@ async def test_no_matching_export_refused(tmp_path):
             await c.connect()
     finally:
         await master.stop()
+
+@pytest.mark.asyncio
+async def test_maproot_squashes_caller_identity(tmp_path):
+    """A maproot session must lose root privileges on EVERY message:
+    setattr carries identity in caller_uid/caller_gids (not uid/gid,
+    which are the chown target) and must not be able to chown; xattr
+    and quota ops must carry and honor identity too."""
+    exports = Exports.load(
+        """
+127.0.0.1 / rw,password=squash,maproot=99
+127.0.0.1 / rw
+"""
+    )
+    master = MasterServer(
+        str(tmp_path / "m"), goals=make_goals(), exports=exports
+    )
+    await master.start()
+    try:
+        real = Client("127.0.0.1", master.port)
+        await real.connect()
+        await real.setattr(1, set_mask=1, mode=0o777)  # world-writable root
+        f = await real.create(1, "owned-by-root")
+        await real.setattr(f.inode, set_mask=1, mode=0o600)  # root-only file
+
+        sq = Client("127.0.0.1", master.port)
+        await sq.connect(password="squash")
+        # files created by squashed root are owned by maproot
+        g = await sq.create(1, "squashed")
+        assert (await sq.getattr(g.inode)).uid == 99
+
+        # chown must be denied: caller_uid was squashed to 99
+        with pytest.raises(st.StatusError) as e:
+            await sq.setattr(f.inode, set_mask=2 | 4, uid=99, gid=99)
+        assert e.value.code == st.EPERM
+        # mode change on a root-owned inode must be denied too
+        with pytest.raises(st.StatusError) as e:
+            await sq.setattr(f.inode, set_mask=1, mode=0o777)
+        assert e.value.code == st.EPERM
+        # setxattr on a 0600 root file: squashed caller has no write perm
+        with pytest.raises(st.StatusError) as e:
+            await sq.set_xattr(f.inode, "user.x", b"v")
+        assert e.value.code == st.EACCES
+        with pytest.raises(st.StatusError) as e:
+            await sq.get_xattr(f.inode, "user.x")
+        assert e.value.code == st.EACCES
+        # quota changes are root-only
+        with pytest.raises(st.StatusError) as e:
+            await sq.set_quota("user", 99, hard_inodes=10)
+        assert e.value.code == st.EPERM
+        # setgoal needs ownership
+        with pytest.raises(st.StatusError) as e:
+            await sq.setgoal(f.inode, 2)
+        assert e.value.code == st.EPERM
+        # ... but all of these work on the squashed client's OWN file
+        await sq.set_xattr(g.inode, "user.mine", b"ok")
+        assert (await sq.get_xattr(g.inode, "user.mine")) == b"ok"
+        await sq.setgoal(g.inode, 2)
+
+        # a REAL root session chowns a file TO uid 0: the target uid/gid
+        # must not be remapped (regression: squash used to rewrite them)
+        await real.setattr(g.inode, set_mask=2 | 4, uid=0, gid=0)
+        assert (await real.getattr(g.inode)).uid == 0
+        # real root may also set quotas
+        await real.set_quota("user", 99, hard_inodes=10)
+
+        await sq.close()
+        await real.close()
+    finally:
+        await master.stop()
+
+
+@pytest.mark.asyncio
+async def test_unprivileged_identity_enforced_without_squash(tmp_path):
+    """Even on a plain rw export, a non-root caller cannot touch other
+    users' xattrs/goals/quota/trash (the messages carry identity now)."""
+    master = MasterServer(str(tmp_path / "m"), goals=make_goals())
+    await master.start()
+    try:
+        root = Client("127.0.0.1", master.port)
+        await root.connect()
+        f = await root.create(1, "secret")
+        await root.setattr(f.inode, set_mask=1, mode=0o600)
+
+        user = Client("127.0.0.1", master.port)
+        await user.connect()
+        user.default_uid = 1000
+        user.default_gids = [1000]
+        with pytest.raises(st.StatusError):
+            await user.set_xattr(f.inode, "user.x", b"v")
+        with pytest.raises(st.StatusError):
+            await user.get_xattr(f.inode, "user.x")
+        # listxattr(2) requires no read access on the inode
+        assert (await user.list_xattr(f.inode)) == []
+        with pytest.raises(st.StatusError) as e:
+            await user.set_quota("user", 1000, hard_bytes=1 << 30)
+        assert e.value.code == st.EPERM
+        with pytest.raises(st.StatusError) as e:
+            await user.setgoal(f.inode, 2)
+        assert e.value.code == st.EPERM
+
+        # quota listing: non-root sees only its own rows
+        await root.set_quota("user", 1000, hard_bytes=1 << 30)
+        await root.set_quota("user", 2000, hard_bytes=1 << 20)
+        mine = await user.get_quota()
+        assert [(r["kind"], r["id"]) for r in mine] == [("user", 1000)]
+        all_rows = {(r["kind"], r["id"]) for r in await root.get_quota()}
+        assert {("user", 1000), ("user", 2000)} <= all_rows
+
+        # trash: user neither sees nor restores root's file
+        await root.unlink(1, "secret")
+        assert (await user.trash_list()) == []
+        assert [r["inode"] for r in await root.trash_list()] == [f.inode]
+        with pytest.raises(st.StatusError) as e:
+            await user.undelete(f.inode)
+        assert e.value.code == st.EPERM
+        await root.undelete(f.inode)  # owner (root) can
+
+        await user.close()
+        await root.close()
+    finally:
+        await master.stop()
