@@ -125,9 +125,12 @@ class Client:
         if self._io_bucket is not None:
             await self._io_bucket.acquire(nbytes)
 
+    def _uid(self, uid) -> int:
+        return self.default_uid if uid is None else uid
+
     def _ident(self, uid, gids) -> dict:
         return {
-            "uid": self.default_uid if uid is None else uid,
+            "uid": self._uid(uid),
             "gids": list(self.default_gids) if gids is None else list(gids),
         }
 
@@ -270,7 +273,7 @@ class Client:
     async def setgoal(self, inode: int, goal: int,
                       uid: int | None = None) -> None:
         await self._call(m.CltomaSetGoal, inode=inode, goal=goal,
-                         uid=self.default_uid if uid is None else uid)
+                         uid=self._uid(uid))
 
     async def truncate(self, inode: int, length: int, uid: int | None = None,
                        gids: list[int] | None = None) -> m.Attr:
@@ -365,7 +368,7 @@ class Client:
             m.CltomaSetQuota, kind=kind, owner_id=owner_id,
             soft_inodes=soft_inodes, hard_inodes=hard_inodes,
             soft_bytes=soft_bytes, hard_bytes=hard_bytes, remove=remove,
-            uid=self.default_uid if uid is None else uid,
+            uid=self._uid(uid),
         )
 
     async def get_quota(self, uid: int | None = None,
@@ -428,12 +431,12 @@ class Client:
         import json
 
         r = await self._call(m.CltomaTrashList,
-                             uid=self.default_uid if uid is None else uid)
+                             uid=self._uid(uid))
         return json.loads(r.json)
 
     async def undelete(self, inode: int, uid: int | None = None) -> None:
         await self._call(m.CltomaUndelete, inode=inode,
-                         uid=self.default_uid if uid is None else uid)
+                         uid=self._uid(uid))
 
     # --- locking -----------------------------------------------------------
 

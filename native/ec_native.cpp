@@ -189,6 +189,105 @@ const CrcTables& crc_tables() {
     return tables;
 }
 
+#if defined(__x86_64__)
+// --- CRC-32 via PCLMULQDQ carry-less-multiply folding ----------------------
+//
+// The standard reflected-CRC folding technique (Intel's "Fast CRC
+// Computation for Generic Polynomials Using PCLMULQDQ" scheme, the same
+// one zlib and the kernel use for this polynomial): fold 64 bytes per
+// iteration with 4 x 128-bit lanes, collapse to one lane, then Barrett-
+// reduce. Roughly 10-20x the slice-by-8 table loop — on this host the
+// data plane CRCs every byte at least twice (sender + receiver), so CRC
+// speed directly caps cluster throughput.
+//
+// Folding constants for P = 0xEDB88320 (reflected), register layout
+// {hi, lo} = {x^(D-32)-type, x^(D+32)-type} per the kernel's R2R1/R4R3
+// ordering:
+//   512-bit fold: {0x1c6e41596, 0x154442bd4}
+//   128-bit fold: {0x0ccaa009e, 0x1751997d0}
+//   64->32:       0x163cd6124
+//   Barrett:      {mu = 0x1f7011641, P' = 0x1db710641}
+//
+// Operates on the RAW (pre/post-inverted) crc state; len must be >= 64
+// and a multiple of 16 (caller peels the tail onto the table path).
+__attribute__((target("pclmul,sse4.1")))
+uint32_t crc32_clmul_raw(uint32_t crc, const uint8_t* buf, size_t len) {
+    const __m128i k1k2 = _mm_set_epi64x(0x01c6e41596, 0x0154442bd4);
+    const __m128i k3k4 = _mm_set_epi64x(0x00ccaa009e, 0x01751997d0);
+    __m128i x1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf));
+    __m128i x2 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 16));
+    __m128i x3 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 32));
+    __m128i x4 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 48));
+    x1 = _mm_xor_si128(x1, _mm_cvtsi32_si128(static_cast<int>(crc)));
+    buf += 64;
+    len -= 64;
+    while (len >= 64) {
+        __m128i y1 = _mm_clmulepi64_si128(x1, k1k2, 0x00);
+        __m128i y2 = _mm_clmulepi64_si128(x2, k1k2, 0x00);
+        __m128i y3 = _mm_clmulepi64_si128(x3, k1k2, 0x00);
+        __m128i y4 = _mm_clmulepi64_si128(x4, k1k2, 0x00);
+        x1 = _mm_clmulepi64_si128(x1, k1k2, 0x11);
+        x2 = _mm_clmulepi64_si128(x2, k1k2, 0x11);
+        x3 = _mm_clmulepi64_si128(x3, k1k2, 0x11);
+        x4 = _mm_clmulepi64_si128(x4, k1k2, 0x11);
+        x1 = _mm_xor_si128(_mm_xor_si128(x1, y1),
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf)));
+        x2 = _mm_xor_si128(_mm_xor_si128(x2, y2),
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 16)));
+        x3 = _mm_xor_si128(_mm_xor_si128(x3, y3),
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 32)));
+        x4 = _mm_xor_si128(_mm_xor_si128(x4, y4),
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf + 48)));
+        buf += 64;
+        len -= 64;
+    }
+    // collapse the 4 lanes into one
+    __m128i y;
+    y = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, y), x2);
+    y = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, y), x3);
+    y = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, y), x4);
+    while (len >= 16) {
+        y = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+        x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+        x1 = _mm_xor_si128(_mm_xor_si128(x1, y),
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(buf)));
+        buf += 16;
+        len -= 16;
+    }
+    // reduce 128 -> 64 bits
+    const __m128i mask32 = _mm_setr_epi32(~0, 0, ~0, 0);
+    y = _mm_clmulepi64_si128(x1, k3k4, 0x10);
+    x1 = _mm_srli_si128(x1, 8);
+    x1 = _mm_xor_si128(x1, y);
+    // reduce 64 -> 32 bits
+    const __m128i k5 = _mm_set_epi64x(0, 0x0163cd6124);
+    y = _mm_srli_si128(x1, 4);
+    x1 = _mm_and_si128(x1, mask32);
+    x1 = _mm_clmulepi64_si128(x1, k5, 0x00);
+    x1 = _mm_xor_si128(x1, y);
+    // Barrett reduction
+    const __m128i poly_mu = _mm_set_epi64x(0x01f7011641, 0x01db710641);
+    y = _mm_and_si128(x1, mask32);
+    y = _mm_clmulepi64_si128(y, poly_mu, 0x10);
+    y = _mm_and_si128(y, mask32);
+    y = _mm_clmulepi64_si128(y, poly_mu, 0x00);
+    x1 = _mm_xor_si128(x1, y);
+    return static_cast<uint32_t>(_mm_extract_epi32(x1, 1));
+}
+
+bool have_pclmul() {
+    static const bool ok = __builtin_cpu_supports("pclmul") &&
+                           __builtin_cpu_supports("sse4.1");
+    return ok;
+}
+#endif  // __x86_64__
+
 }  // namespace
 
 extern "C" {
@@ -219,6 +318,14 @@ void lz_ec_encode(size_t len, int k, int rows, const uint8_t* matrix,
 uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len) {
     const auto& T = crc_tables().t;
     crc ^= 0xFFFFFFFFu;
+#if defined(__x86_64__)
+    if (len >= 64 && have_pclmul()) {
+        size_t n = len & ~size_t(15);
+        crc = crc32_clmul_raw(crc, data, n);
+        data += n;
+        len -= n;
+    }
+#endif
     while (len && (reinterpret_cast<uintptr_t>(data) & 7)) {
         crc = T[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
         --len;
