@@ -62,7 +62,11 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
 
         client.encoder = get_encoder(encoder)
     await client.connect()
+    import numpy as np
+
     payload = data_generator.generate(0, size_mb * 2**20).tobytes()
+    payload_arr = np.frombuffer(payload, dtype=np.uint8)
+    back = np.empty(len(payload), dtype=np.uint8)
     rows = []
     try:
         for goal_id, label in GOALS:
@@ -72,10 +76,12 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             await client.write_file(f.inode, payload)
             wt = time.perf_counter() - t0
             client.cache.invalidate(f.inode)  # cold read
+            back[:] = 0
             t0 = time.perf_counter()
-            back = await client.read_file(f.inode)
+            n = await client.read_file_into(f.inode, 0, back)
             rt = time.perf_counter() - t0
-            assert back == payload, f"corruption at goal {label}"
+            assert n == len(payload)
+            assert np.array_equal(back, payload_arr), f"corruption at goal {label}"
             rows.append({
                 "goal": label,
                 "write_MBps": round(size_mb / wt, 1),

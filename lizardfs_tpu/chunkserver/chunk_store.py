@@ -17,6 +17,8 @@ bgjobs pool analog, src/chunkserver/bgjobs.h).
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 import struct
 import threading
@@ -36,6 +38,19 @@ _SIG = struct.Struct(">8sQII")
 
 # CRC of an empty (all-zero) block, used for sparse/unwritten slots.
 EMPTY_BLOCK_CRC = crc_mod.crc32(b"\0" * MFSBLOCKSIZE)
+
+
+@contextlib.contextmanager
+def _flocked(f, exclusive: bool):
+    """File lock shared with the native data plane: the C++ serving
+    threads hold their own open file descriptions, so flock (not the
+    in-process ChunkFile.lock) is what keeps block+CRC updates atomic
+    across the two planes."""
+    fcntl.flock(f.fileno(), fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH)
+    try:
+        yield
+    finally:
+        fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
 
 class ChunkStoreError(Exception):
@@ -251,7 +266,7 @@ class ChunkStore:
         if offset < 0 or size < 0 or offset + size > max_bytes:
             raise ChunkStoreError(st.EINVAL, f"read range {offset}+{size}")
         pieces = []
-        with cf.lock, open(cf.path, "rb") as f:
+        with cf.lock, open(cf.path, "rb") as f, _flocked(f, exclusive=False):
             data_len = cf.data_length()
             pos = offset
             end = offset + size
@@ -303,7 +318,7 @@ class ChunkStore:
             raise ChunkStoreError(st.EINVAL, "write crosses block boundary")
         if crc_mod.crc32(data) != data_crc:
             raise ChunkStoreError(st.CRC_ERROR, "piece crc mismatch on write")
-        with cf.lock, open(cf.path, "r+b") as f:
+        with cf.lock, open(cf.path, "r+b") as f, _flocked(f, exclusive=True):
             block_start = block * MFSBLOCKSIZE
             if len(data) == MFSBLOCKSIZE:
                 new_block = bytes(data)
@@ -325,7 +340,7 @@ class ChunkStore:
         """Truncate a part's data region to part_length bytes; the
         trailing partial block is zero-padded and its CRC refreshed."""
         cf = self.require(chunk_id, version, part_id)
-        with cf.lock, open(cf.path, "r+b") as f:
+        with cf.lock, open(cf.path, "r+b") as f, _flocked(f, exclusive=True):
             nblocks = (part_length + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
             f.truncate(HEADER_SIZE + part_length)
             if part_length % MFSBLOCKSIZE:
@@ -357,7 +372,8 @@ class ChunkStore:
     def test_part(self, cf: ChunkFile) -> bool:
         """Verify all stored CRCs of one part; False = damaged."""
         try:
-            with cf.lock, open(cf.path, "rb") as f:
+            with cf.lock, open(cf.path, "rb") as f, \
+                    _flocked(f, exclusive=False):
                 data_len = cf.data_length()
                 nblocks = (data_len + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
                 for b in range(nblocks):

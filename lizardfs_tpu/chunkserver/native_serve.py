@@ -1,0 +1,75 @@
+"""Lifecycle wrapper for the native C++ data-plane server.
+
+``native/serve_native.cpp`` runs the chunkserver's data hot path —
+accept loop, frame parsing, block IO with CRC maintenance, write-chain
+forwarding — entirely in C++ threads (the network_worker_thread.cc
+analog; reference src/chunkserver/network_worker_thread.cc:402-755).
+The asyncio ``ChunkServer`` starts one listener here, registers its port
+with the master as ``data_port``, and the master hands that address out
+in part locations; the asyncio server on the control port remains the
+portable fallback and the control plane.
+
+Coherence with the Python ``ChunkStore``:
+  * part files are created/deleted/versioned by the Python store on
+    master commands; the C++ plane resolves paths per request, so
+    renames (set_version) and deletes take effect immediately,
+  * block reads/writes on BOTH planes take an ``flock`` on the chunk
+    file (shared/exclusive), so the chunk tester never sees torn blocks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from lizardfs_tpu.core import native as _native_lib
+
+_lib = _native_lib._load()
+if _lib is not None:
+    try:
+        _lib.lz_serve_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int
+        ]
+        _lib.lz_serve_start.restype = ctypes.c_int
+        _lib.lz_serve_port.argtypes = [ctypes.c_int]
+        _lib.lz_serve_port.restype = ctypes.c_int
+        _lib.lz_serve_stop.argtypes = [ctypes.c_int]
+        _lib.lz_serve_stop.restype = None
+        _lib.lz_serve_stats.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        _lib.lz_serve_stats.restype = None
+    except AttributeError:
+        _lib = None
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+class DataPlaneServer:
+    """One native data-plane listener bound to a set of data folders."""
+
+    def __init__(self, folders: list[str], host: str = "127.0.0.1",
+                 port: int = 0):
+        if _lib is None:
+            raise RuntimeError("native serve library unavailable")
+        blob = "\n".join(folders).encode()
+        self._handle = _lib.lz_serve_start(blob, host.encode(), port)
+        if self._handle < 0:
+            raise RuntimeError("lz_serve_start failed")
+        self.port = _lib.lz_serve_port(self._handle)
+
+    def stats(self) -> dict[str, int]:
+        out = (ctypes.c_uint64 * 4)()
+        _lib.lz_serve_stats(self._handle, out)
+        return {
+            "bytes_read": out[0],
+            "bytes_written": out[1],
+            "read_ops": out[2],
+            "write_ops": out[3],
+        }
+
+    def stop(self) -> None:
+        if self._handle >= 0:
+            _lib.lz_serve_stop(self._handle)
+            self._handle = -1

@@ -87,10 +87,15 @@ class ChunkServer(Daemon):
         encoder_name: str | None = "cpu",
         wave_timeout: float = 0.3,
         heartbeat_interval: float = 5.0,
+        native_data_plane: bool = True,
     ):
         super().__init__(host, port)
         folders = [data_folder] if isinstance(data_folder, str) else list(data_folder)
         self.store = MultiStore(folders)
+        # native C++ data-plane listener (network_worker_thread analog);
+        # its port is registered with the master as data_port
+        self.data_server = None
+        self._want_native_plane = native_data_plane
         # one or more master addresses (active + shadows); registration
         # cycles until the active master accepts
         if isinstance(master_addr, tuple):
@@ -121,6 +126,20 @@ class ChunkServer(Daemon):
         await asyncio.to_thread(self.store.scan)
         for folder in self.store.damaged_folders:
             self.log.warning("data folder %s is damaged; skipping", folder)
+        if self._want_native_plane:
+            from lizardfs_tpu.chunkserver import native_serve
+
+            if native_serve.available():
+                try:
+                    self.data_server = native_serve.DataPlaneServer(
+                        [s.folder for s in self.store.stores], self.host
+                    )
+                    self.log.info(
+                        "native data plane on %s:%d",
+                        self.host, self.data_server.port,
+                    )
+                except RuntimeError as e:
+                    self.log.warning("native data plane unavailable: %s", e)
         self.add_timer(self.heartbeat_interval, self._heartbeat)
         self.add_timer(60.0, self._test_chunks)
 
@@ -130,6 +149,9 @@ class ChunkServer(Daemon):
             await self._connect_master()
 
     async def teardown(self) -> None:
+        if self.data_server is not None:
+            await asyncio.to_thread(self.data_server.stop)
+            self.data_server = None
         if self.master is not None:
             await self.master.close()
 
@@ -173,6 +195,7 @@ class ChunkServer(Daemon):
             ],
             total_space=total,
             used_space=used,
+            data_port=self.data_server.port if self.data_server else 0,
         )
         self.cs_id = reply.cs_id
         self.log.info("registered with master as cs %d", self.cs_id)
@@ -196,6 +219,14 @@ class ChunkServer(Daemon):
             except OSError:
                 return
         total, used = self.store.space()
+        if self.data_server is not None:
+            # fold native-plane counters into the metrics registry so
+            # charts/admin see one consistent view
+            s = self.data_server.stats()
+            self.metrics.gauge("native_bytes_read").set(float(s["bytes_read"]))
+            self.metrics.gauge("native_bytes_written").set(
+                float(s["bytes_written"])
+            )
         try:
             await self.master.call(
                 m.CstomaHeartbeat,
@@ -411,12 +442,16 @@ class ChunkServer(Daemon):
                         writer, msg,
                         native_ok=not sessions and not pending_writes,
                     )
+                elif isinstance(msg, m.CltocsReadBulk):
+                    await self._serve_read_bulk(writer, msg)
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteData):
                     await self._serve_write_data(
                         writer, msg, sessions, pending_writes
                     )
+                elif isinstance(msg, m.CltocsWriteBulk):
+                    await self._serve_write_bulk(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteEnd):
                     session = sessions.pop(msg.chunk_id, None)
                     if session is not None:
@@ -506,6 +541,41 @@ class ChunkServer(Daemon):
             writer,
             m.CstoclReadStatus(
                 req_id=msg.req_id, chunk_id=msg.chunk_id, status=st.OK
+            ),
+        )
+
+    async def _serve_read_bulk(self, writer, msg: m.CltocsReadBulk) -> None:
+        """Asyncio fallback for the bulk read op (serve_native.cpp is
+        the fast path): load pieces, reply with ONE frame whose CRCs the
+        receiver verifies."""
+        def reply_err(code):
+            return framing.send_message(
+                writer,
+                m.CstoclReadBulkData(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id, status=code,
+                    offset=msg.offset, crcs=[], data=b"",
+                ),
+            )
+
+        if msg.offset % MFSBLOCKSIZE != 0 or msg.size == 0:
+            await reply_err(st.EINVAL)
+            return
+        try:
+            pieces = await asyncio.to_thread(
+                self.store.read,
+                msg.chunk_id, msg.version, msg.part_id, msg.offset, msg.size,
+            )
+        except ChunkStoreError as e:
+            await reply_err(e.code)
+            return
+        self.metrics.counter("bytes_read").inc(float(msg.size))
+        await framing.send_message(
+            writer,
+            m.CstoclReadBulkData(
+                req_id=msg.req_id, chunk_id=msg.chunk_id, status=st.OK,
+                offset=msg.offset,
+                crcs=[crc for _, _, crc in pieces],
+                data=b"".join(bytes(d) for _, d, _ in pieces),
             ),
         )
 
@@ -777,6 +847,76 @@ class ChunkServer(Daemon):
             )
         except (ConnectionError, OSError):
             pass
+
+    async def _serve_write_bulk(self, writer, msg: m.CltocsWriteBulk, sessions):
+        """Asyncio fallback for the bulk write op (serve_native.cpp is
+        the fast path): apply the whole block-aligned range, forward the
+        frame down the chain, single combined ack."""
+        session = sessions.get(msg.chunk_id)
+
+        async def ack(code):
+            await framing.send_message(
+                writer,
+                m.CstoclWriteStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id,
+                    write_id=msg.write_id, status=code,
+                ),
+            )
+
+        if session is None or msg.part_offset % MFSBLOCKSIZE != 0:
+            await ack(st.EINVAL)
+            return
+        down_ok = st.OK
+        down_ev = None
+        if session.downstream is not None:
+            # register the ack event BEFORE anything can fail, so a
+            # downstream death during the local apply fails this write
+            # promptly instead of timing out
+            down_ev = asyncio.Event()
+            session.down_event[msg.write_id] = down_ev
+            _, dw = session.downstream
+            try:
+                await framing.send_message(dw, msg)
+            except (ConnectionError, OSError):
+                down_ok = st.DISCONNECTED
+
+        def apply_all():
+            data = np.frombuffer(msg.data, dtype=np.uint8)
+            pos = 0
+            for i, crc in enumerate(msg.crcs):
+                piece = data[pos:pos + MFSBLOCKSIZE]
+                self.store.write(
+                    msg.chunk_id, session.version, session.part_id,
+                    (msg.part_offset + pos) // MFSBLOCKSIZE, 0,
+                    piece.tobytes(), int(crc),
+                )
+                pos += len(piece)
+
+        code = st.OK
+        try:
+            await asyncio.to_thread(apply_all)
+        except ChunkStoreError as e:
+            code = e.code
+        except Exception:
+            self.log.exception("bulk write failed")
+            code = st.EIO
+        self.metrics.counter("bytes_written").inc(float(len(msg.data)))
+        if down_ev is not None:
+            if code == st.OK and down_ok == st.OK:
+                if msg.write_id in session.down_status:
+                    down_ev.set()
+                try:
+                    await asyncio.wait_for(down_ev.wait(), 30.0)
+                    code = session.down_status.pop(
+                        msg.write_id, st.DISCONNECTED
+                    )
+                except asyncio.TimeoutError:
+                    code = st.TIMEOUT
+            elif code == st.OK:
+                code = down_ok
+            session.down_event.pop(msg.write_id, None)
+            session.down_status.pop(msg.write_id, None)
+        await ack(code)
 
     def _local_write(self, session: _WriteSession, msg: m.CltocsWriteData) -> None:
         self.metrics.counter("bytes_written").inc(float(len(msg.data)))

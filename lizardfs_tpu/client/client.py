@@ -73,6 +73,8 @@ class Client:
         self.retries = retries
         self._info = "pyclient"
         self.cache = BlockCache()
+        # reads at least this large bypass the block cache (bulk path)
+        self.CACHE_BYPASS_BYTES = 4 * 1024 * 1024
         self._readahead: dict[int, ReadaheadAdviser] = {}
         # operation log ring + counters (.oplog / .stats analog)
         from collections import deque
@@ -511,19 +513,36 @@ class Client:
         data = np.frombuffer(bytes(data), dtype=np.uint8)
         total = len(data)
         old_length = (await self.getattr(inode)).length
+        # a small in-flight window pipelines chunk N+1's grant + transfer
+        # behind chunk N's tail (write_cache_window analog); chunks are
+        # independent (separate ids/versions) and the master's
+        # WriteChunkEnd only ever grows the file, so completion order
+        # doesn't matter
+        window = asyncio.Semaphore(2)
+
+        async def write_one(ci: int, piece: np.ndarray, end: int) -> None:
+            async with window:
+                async def attempt():
+                    await self._write_chunk(inode, ci, piece, file_length=end)
+
+                await self._retry_transient(f"write chunk {ci}", attempt)
+
+        tasks = []
         pos = 0
         index = 0
         while pos < total:
             end = min(pos + MFSCHUNKSIZE, total)
-            piece = data[pos:end]
-            ci = index
-
-            async def attempt(piece=piece, ci=ci, end=end):
-                await self._write_chunk(inode, ci, piece, file_length=end)
-
-            await self._retry_transient(f"write chunk {ci}", attempt)
+            tasks.append(asyncio.ensure_future(
+                write_one(index, data[pos:end], end)
+            ))
             pos = end
             index += 1
+        try:
+            for t in tasks:
+                await t
+        finally:
+            for t in tasks:
+                t.cancel()
         if old_length > total:
             await self.truncate(inode, total)
 
@@ -761,7 +780,7 @@ class Client:
                     native_io.write_part_blocking,
                     (head.addr.host, head.addr.port),
                     chunk_id, version, head.part_id, chain,
-                    payload[:length].tobytes(), part_offset,
+                    payload[:length], part_offset,
                 )
                 return
             except native_io.NativeIOError as e:
@@ -849,33 +868,91 @@ class Client:
         if end <= offset:
             return b""
         out = np.zeros(end - offset, dtype=np.uint8)
+        await self._read_into(inode, offset, out, length)
+        return out.tobytes()
+
+    async def read_file_into(
+        self, inode: int, offset: int, out: np.ndarray
+    ) -> int:
+        """pread-style zero-extra-copy read: fill ``out`` with file bytes
+        at ``offset``; returns bytes read (short at EOF). On the bulk
+        path the network recv lands directly in ``out``. ``out`` must be
+        C-contiguous uint8."""
+        attr = await self.getattr(inode)
+        length = attr.length
+        end = min(offset + out.size, length)
+        if end <= offset:
+            return 0
+        n = end - offset
+        await self._read_into(inode, offset, out[:n], length)
+        return n
+
+    async def _read_into(
+        self, inode: int, offset: int, out: np.ndarray, length: int
+    ) -> None:
+        """Fill ``out`` (C-contiguous uint8) with [offset, offset+len(out)).
+
+        Pipelines chunk ranges: while one chunk's bytes stream in C++,
+        the next chunk's locate RPC and stream startup proceed (each
+        task writes a disjoint slice of ``out``)."""
+        end = offset + out.size
+        window = asyncio.Semaphore(3)
+
+        async def read_one(index, chunk_off, take, dst):
+            async with window:
+                piece = await self._read_chunk_range(
+                    inode, index, chunk_off, take, length,
+                    into=out, into_offset=dst,
+                )
+                if piece is not None:
+                    out[dst : dst + take] = piece
+
+        tasks = []
         pos = offset
         while pos < end:
             index = pos // MFSCHUNKSIZE
             chunk_off = pos % MFSCHUNKSIZE
             take = min(MFSCHUNKSIZE - chunk_off, end - pos)
-            piece = await self._read_chunk_range(inode, index, chunk_off, take, length)
-            out[pos - offset : pos - offset + take] = piece
+            tasks.append(asyncio.ensure_future(
+                read_one(index, chunk_off, take, pos - offset)
+            ))
             pos += take
-        return out.tobytes()
+        try:
+            for t in tasks:
+                await t
+        finally:
+            for t in tasks:
+                t.cancel()
 
     async def _read_chunk_range(
-        self, inode: int, chunk_index: int, off: int, size: int, file_length: int
-    ) -> np.ndarray:
+        self, inode: int, chunk_index: int, off: int, size: int,
+        file_length: int, into: np.ndarray | None = None,
+        into_offset: int = 0,
+    ) -> np.ndarray | None:
+        """Read one chunk range. Returns the bytes — or ``None`` when
+        they were scattered directly into ``into`` (bulk aligned reads
+        of standard chunks land network bytes in the caller's buffer)."""
         chunk_len = min(
             max(file_length - chunk_index * MFSCHUNKSIZE, 0), MFSCHUNKSIZE
         )
-        # cache fast path: all covering blocks resident
+        # bulk reads skip the block cache entirely: probing + filling it
+        # costs a per-64KiB-block copy, and streaming workloads would
+        # only evict it anyway (the reference's readcache is similarly
+        # bypassed by its readahead path for large requests)
+        bulk = size >= self.CACHE_BYPASS_BYTES
         lo_b = off // MFSBLOCKSIZE
         hi_b = (off + size - 1) // MFSBLOCKSIZE
-        cached = [
-            self.cache.get(inode, chunk_index, b) for b in range(lo_b, hi_b + 1)
-        ]
-        if all(c is not None for c in cached):
-            joined = b"".join(cached)
-            rel = off - lo_b * MFSBLOCKSIZE
-            if len(joined) >= rel + size:
-                return np.frombuffer(joined, dtype=np.uint8)[rel : rel + size]
+        if not bulk:
+            # cache fast path: all covering blocks resident
+            cached = [
+                self.cache.get(inode, chunk_index, b)
+                for b in range(lo_b, hi_b + 1)
+            ]
+            if all(c is not None for c in cached):
+                joined = b"".join(cached)
+                rel = off - lo_b * MFSBLOCKSIZE
+                if len(joined) >= rel + size:
+                    return np.frombuffer(joined, dtype=np.uint8)[rel : rel + size]
 
         # block-align the request and extend by the readahead window
         adviser = self._readahead.setdefault(inode, ReadaheadAdviser())
@@ -897,22 +974,33 @@ class Client:
                 **self._ident(None, None),
             )
             if loc.chunk_id == 0:
+                if into is not None:
+                    into[into_offset : into_offset + size] = 0
+                    return None
                 return np.zeros(size, dtype=np.uint8)  # hole
+            # direct scatter into the caller's buffer is possible only
+            # when the network range IS the requested range
+            direct = (
+                into is not None and aligned_off == off and read_size == size
+            )
             try:
                 data = await self._read_located(
                     loc, chunk_index, aligned_off, read_size, file_length,
                     attempt=attempt, avoid=bad_addrs,
+                    into=into if direct else None,
+                    into_offset=into_offset,
                 )
             except (ReadError, ConnectionError, OSError) as e:
                 last_error = e
                 bad_addrs.update(getattr(e, "used_addrs", ()))
                 log.info("read retry %d for chunk %d: %s", attempt + 1, loc.chunk_id, e)
                 continue
-            for b in range(lo_b, aligned_end // MFSBLOCKSIZE + 1):
-                s = b * MFSBLOCKSIZE - aligned_off
-                blk = data[s : s + MFSBLOCKSIZE]
-                if len(blk):
-                    self.cache.put(inode, chunk_index, b, blk.tobytes())
+            if not bulk and data is not None:
+                for b in range(lo_b, aligned_end // MFSBLOCKSIZE + 1):
+                    s = b * MFSBLOCKSIZE - aligned_off
+                    blk = data[s : s + MFSBLOCKSIZE]
+                    if len(blk):
+                        self.cache.put(inode, chunk_index, b, blk.tobytes())
             if extra > 0 and aligned_end < chunk_len:
                 # sequential stream detected: warm the chunkservers' page
                 # cache for the region after this one (PREFETCH analog)
@@ -921,6 +1009,8 @@ class Client:
                         loc, aligned_end, min(extra, chunk_len - aligned_end)
                     )
                 )
+            if data is None:
+                return None  # landed in `into` already
             rel = off - aligned_off
             return data[rel : rel + size]
         raise st.StatusError(st.EIO, f"read failed after retries: {last_error}")
@@ -966,7 +1056,8 @@ class Client:
     async def _read_located(
         self, loc, chunk_index: int, off: int, size: int, file_length: int,
         attempt: int = 0, avoid: set[tuple[str, int]] | None = None,
-    ) -> np.ndarray:
+        into: np.ndarray | None = None, into_offset: int = 0,
+    ) -> np.ndarray | None:
         import random
 
         # available parts: part index -> list of (addr, wire part id) copies
@@ -1008,13 +1099,23 @@ class Client:
                 slice_type, [plans.RequestedPartInfo(0, size)], size
             )
             plan.read_operations.append(plans.ReadOp(0, off, size, 0, 0))
+            in_place = (
+                into is not None and into.flags.c_contiguous
+                and into.dtype == np.uint8
+            )
+            buffer = (
+                into[into_offset : into_offset + size] if in_place else None
+            )
             try:
                 result = await execute_plan(
                     plan, loc.chunk_id, loc.version, by_part,
                     wave_timeout=self.wave_timeout,
+                    buffer=buffer,
                 )
             except (ReadError, ConnectionError, OSError) as e:
                 raise _tag(e)
+            if in_place:
+                return None  # bytes landed in `into`
             return np.asarray(result[:size])
         # striped slice: read covering stripe slots from all data parts
         d = slice_type.data_parts

@@ -39,11 +39,21 @@ if _lib is not None:
             ctypes.c_uint32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
         ]
         _lib.lz_read_part.restype = ctypes.c_int
+        _lib.lz_read_part_bulk.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        _lib.lz_read_part_bulk.restype = ctypes.c_int
         _lib.lz_write_part.argtypes = [
             ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
         ]
         _lib.lz_write_part.restype = ctypes.c_int
+        _lib.lz_write_part_bulk.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        _lib.lz_write_part_bulk.restype = ctypes.c_int
         _lib.lz_load_read.argtypes = [
             ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint32),
@@ -160,6 +170,12 @@ def _blocking_socket(addr: tuple[str, int], io_timeout: float) -> socket.socket:
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # deep buffers cut syscall/context-switch count for bulk streams
+    for opt in (socket.SO_RCVBUF, socket.SO_SNDBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, 4 * 1024 * 1024)
+        except OSError:
+            pass
     return sock
 
 
@@ -180,6 +196,21 @@ def _recv_message(sock: socket.socket):
     return framing.decode(msg_type, payload)
 
 
+def abort_read(cell: dict) -> None:
+    """Kill an in-flight read_part_blocking from another thread: the
+    executor thread is uninterruptible inside the C exchange, but a
+    socket shutdown makes its recv fail immediately. Used before
+    retrying a read whose thread may still be scattering into a shared
+    destination buffer."""
+    cell["aborted"] = True
+    sock = cell.get("sock")
+    if sock is not None:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+
 def read_part_blocking(
     addr: tuple[str, int],
     chunk_id: int,
@@ -188,23 +219,40 @@ def read_part_blocking(
     offset: int,
     size: int,
     out: np.ndarray,
+    cell: dict | None = None,
 ) -> None:
     """Fill ``out[:size]`` with the requested range (called via
-    asyncio.to_thread). Retries once on a stale pooled socket."""
+    asyncio.to_thread). Retries once on a stale pooled socket.
+
+    Block-aligned requests use the bulk exchange (one reply frame,
+    receiver-verified CRCs, server sendfile) — the fast path; unaligned
+    ones fall back to the per-piece protocol.  ``cell`` (optional dict)
+    publishes the live socket so abort_read() can cancel the exchange."""
+    from lizardfs_tpu.constants import MFSBLOCKSIZE
+
     assert out.flags.c_contiguous and out.nbytes >= size
     ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    fn = (_lib.lz_read_part_bulk if offset % MFSBLOCKSIZE == 0
+          else _lib.lz_read_part)
     for attempt in (0, 1):
         # second attempt dials fresh: the pool may hold several sockets
         # staled by the same server restart
         sock = POOL.acquire(addr) if attempt == 0 else _blocking_socket(addr, 30.0)
-        rc = _lib.lz_read_part(
+        if cell is not None:
+            cell["sock"] = sock
+            if cell.get("aborted"):
+                POOL.discard(sock)
+                raise NativeIOError(-1, "read (aborted)")
+        rc = fn(
             sock.fileno(), chunk_id, version, part_id, offset, size, ptr
         )
+        if cell is not None:
+            cell.pop("sock", None)
         if rc == 0:
             POOL.release(addr, sock)
             return
         POOL.discard(sock)
-        if rc == -1 and attempt == 0:
+        if rc == -1 and attempt == 0 and not (cell or {}).get("aborted"):
             continue  # stale pooled socket: retry on a fresh connection
         raise NativeIOError(rc, "read")
 
@@ -215,7 +263,7 @@ def write_part_blocking(
     version: int,
     part_id: int,
     chain: list,
-    payload: bytes,
+    payload: bytes | np.ndarray,
     part_offset: int,
 ) -> None:
     """Full write exchange: WriteInit handshake (Python framing), bulk
@@ -233,11 +281,18 @@ def write_part_blocking(
         init = _recv_message(sock)
         if not isinstance(init, m.CstoclWriteStatus) or init.status != st.OK:
             raise st.StatusError(getattr(init, "status", st.EIO), "write init")
-        buf = np.frombuffer(payload, dtype=np.uint8)
-        rc = _lib.lz_write_part(
+        buf = (payload if isinstance(payload, np.ndarray)
+               else np.frombuffer(payload, dtype=np.uint8))
+        if not buf.flags.c_contiguous:
+            buf = np.ascontiguousarray(buf)
+        from lizardfs_tpu.constants import MFSBLOCKSIZE
+
+        fn = (_lib.lz_write_part_bulk if part_offset % MFSBLOCKSIZE == 0
+              else _lib.lz_write_part)
+        rc = fn(
             sock.fileno(), chunk_id,
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            len(payload), part_offset, 1,
+            len(buf), part_offset, 1,
         )
         if rc != 0:
             raise NativeIOError(rc, "write")

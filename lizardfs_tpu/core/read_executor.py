@@ -40,6 +40,7 @@ async def read_part_range(
     size: int,
     into: np.ndarray | None = None,
     into_offset: int = 0,
+    direct: bool = False,
 ) -> np.ndarray:
     """Read one range of one part from one chunkserver, verifying piece
     CRCs (ReadOperationExecutor analog). Connections come from the
@@ -56,17 +57,48 @@ async def read_part_range(
     from lizardfs_tpu.core import native_io
 
     if native_io.available() and size >= native_io.NATIVE_READ_THRESHOLD:
-        # the executor thread is uninterruptible: it must scatter into a
-        # PRIVATE buffer so a cancelled straggler can't keep writing the
-        # shared plan buffer while recovery post-processing reads it
-        tmp = np.empty(size, dtype=np.uint8)
-        try:
-            await native_io.run(
+        # the executor thread is uninterruptible: by default it scatters
+        # into a PRIVATE buffer so a cancelled straggler can't keep
+        # writing the shared plan buffer while recovery post-processing
+        # reads it; single-op plans (`direct`) have no stragglers and
+        # skip the extra copy
+        scatter_direct = (
+            direct and into is not None and out.flags.c_contiguous
+            and out.dtype == np.uint8
+        )
+        if scatter_direct:
+            tmp = out[into_offset : into_offset + size]  # view, no copy
+        else:
+            tmp = np.empty(size, dtype=np.uint8)
+        # when scattering into the CALLER's buffer, the uninterruptible
+        # executor thread must not outlive this coroutine: a cancelled
+        # or failed attempt would otherwise keep writing `out` while a
+        # retry refills the same region. The cell lets us shut the
+        # socket down (killing the thread's recv) and join it.
+        import functools
+
+        cell: dict = {}
+        fut = asyncio.get_running_loop().run_in_executor(
+            native_io.EXECUTOR,
+            functools.partial(
                 native_io.read_part_blocking,
                 addr, chunk_id, version, part_id, offset, size, tmp,
-            )
-            out[into_offset : into_offset + size] = tmp
+                cell if scatter_direct else None,
+            ),
+        )
+        try:
+            await asyncio.shield(fut)
+            if not scatter_direct:
+                out[into_offset : into_offset + size] = tmp
             return out
+        except asyncio.CancelledError:
+            if scatter_direct:
+                native_io.abort_read(cell)
+                try:
+                    await asyncio.wait_for(asyncio.shield(fut), 10.0)
+                except (Exception, asyncio.CancelledError):
+                    pass
+            raise
         except native_io.NativeIOError as e:
             raise ReadError(str(e)) from None
         except (OSError, ConnectionError) as e:
@@ -123,12 +155,19 @@ async def execute_plan(
     locations: dict[int, tuple[tuple[str, int], int]],
     wave_timeout: float = DEFAULT_WAVE_TIMEOUT,
     total_timeout: float = DEFAULT_TOTAL_TIMEOUT,
+    buffer: np.ndarray | None = None,
 ) -> np.ndarray:
     """Execute a plan; returns the post-processed result bytes.
 
     locations: slice part index -> ((host, port), wire part_id).
+    ``buffer`` (optional, C-contiguous uint8 of plan.buffer_size) lets
+    the caller provide the scatter target so successful single-op plans
+    write the result in place.
     """
-    buffer = np.zeros(plan.buffer_size, dtype=np.uint8)
+    if buffer is None:
+        buffer = np.zeros(plan.buffer_size, dtype=np.uint8)
+    else:
+        assert buffer.size == plan.buffer_size and buffer.dtype == np.uint8
     available: list[int] = []
     unreadable: list[int] = []
     pending: dict[asyncio.Task, int] = {}
@@ -136,6 +175,8 @@ async def execute_plan(
     loop = asyncio.get_running_loop()
     deadline = loop.time() + total_timeout
     current_wave = -1
+
+    single_op = len(plan.read_operations) == 1
 
     def start_wave(w: int):
         for op in plan.read_operations:
@@ -155,6 +196,7 @@ async def execute_plan(
                     op.request_size,
                     into=buffer,
                     into_offset=op.buffer_offset,
+                    direct=single_op,
                 )
             )
             pending[task] = op.part

@@ -31,10 +31,16 @@ class ChunkServerInfo:
     total_space: int = 0
     used_space: int = 0
     connected: bool = True
+    data_port: int = 0  # native data-plane port (0 = use control port)
 
     @property
     def addr(self) -> tuple[str, int]:
         return (self.host, self.port)
+
+    @property
+    def data_addr_port(self) -> int:
+        """Port clients should use for data-plane ops."""
+        return self.data_port or self.port
 
     @property
     def free_space(self) -> int:
@@ -98,7 +104,8 @@ class ChunkRegistry:
     # --- chunkserver db -------------------------------------------------------
 
     def register_server(
-        self, host: str, port: int, label: str, total: int, used: int
+        self, host: str, port: int, label: str, total: int, used: int,
+        data_port: int = 0,
     ) -> ChunkServerInfo:
         # reconnection of the same host:port replaces the old entry
         for srv in self.servers.values():
@@ -107,8 +114,12 @@ class ChunkRegistry:
                 srv.label = label
                 srv.total_space = total
                 srv.used_space = used
+                srv.data_port = data_port
                 return srv
-        cs = ChunkServerInfo(self.next_cs_id, host, port, label, total, used)
+        cs = ChunkServerInfo(
+            self.next_cs_id, host, port, label, total, used,
+            data_port=data_port,
+        )
         self.next_cs_id += 1
         self.servers[cs.cs_id] = cs
         return cs

@@ -390,15 +390,23 @@ class FsTree:
         n.chunks[chunk_index] = chunk_id
         return n
 
-    def apply_set_length(self, inode: int, length: int, ts: int) -> list[int]:
+    def apply_set_length(self, inode: int, length: int, ts: int,
+                         drop_chunks: bool = True) -> list[int]:
         """Set file length; returns chunk ids dropped past the new end
-        (the caller releases them in the chunk registry)."""
+        (the caller releases them in the chunk registry).
+
+        ``drop_chunks=False`` is the write-path grow (WriteChunkEnd):
+        concurrent chunk writes attach higher chunk indices before
+        earlier chunks finish, so a length update for chunk N must never
+        discard an already-attached chunk N+1 — only truncate drops."""
         n = self.file_node(inode)
         delta = length - n.length
         for parent in n.parents:
             self._add_stats(parent, 0, delta)
         n.length = length
         n.mtime = n.ctime = ts
+        if not drop_chunks:
+            return []
         nchunks = (length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE if length else 0
         removed = [c for c in n.chunks[nchunks:] if c]
         del n.chunks[nchunks:]

@@ -102,7 +102,10 @@ class MetadataStore:
     def _op_set_length(self, op):
         node = self.fs.file_node(op["inode"])
         delta = op["length"] - node.length
-        removed = self.fs.apply_set_length(op["inode"], op["length"], op["ts"])
+        removed = self.fs.apply_set_length(
+            op["inode"], op["length"], op["ts"],
+            drop_chunks=op.get("drop_chunks", True),
+        )
         self.quotas.charge(node.uid, node.gid, 0, delta)
         for cid in removed:
             self.registry.release_chunk(cid)

@@ -995,7 +995,7 @@ class MasterServer(Daemon):
         rows.sort(key=lambda r: (r[0], r[1]))
         return [
             m.PartLocation(
-                addr=m.Addr(host=srv.host, port=srv.port),
+                addr=m.Addr(host=srv.host, port=srv.data_addr_port),
                 part_id=geometry.ChunkPartType(t, part).id,
             )
             for part, _, srv in rows
@@ -1279,9 +1279,12 @@ class MasterServer(Daemon):
                 delta = msg.file_length - node.length
                 parent = node.parents[0] if node.parents else fsmod.ROOT_INODE
                 self._check_quota(parent, node.uid, node.gid, 0, delta)
+                # write-path grow: never drop chunks — a concurrent
+                # write may have attached a higher chunk index already
                 self.commit({
                     "op": "set_length", "inode": msg.inode,
                     "length": msg.file_length, "ts": int(time.time()),
+                    "drop_chunks": False,
                 })
         return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
 
@@ -1300,6 +1303,7 @@ class MasterServer(Daemon):
         srv = self.meta.registry.register_server(
             first.addr.host, first.addr.port, first.label,
             first.total_space, first.used_space,
+            data_port=getattr(first, "data_port", 0),
         )
         link.cs_id = srv.cs_id
         self.cs_links[srv.cs_id] = link

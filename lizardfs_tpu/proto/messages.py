@@ -551,6 +551,9 @@ class CstomaRegister(Message):
         ("chunks", "list:msg:ChunkPartInfo"),
         ("total_space", "u64"),
         ("used_space", "u64"),
+        # native C++ data-plane listener port (0 = none; data ops then
+        # go to the control port's asyncio server)
+        ("data_port", "u16"),
     )
 
 
@@ -700,6 +703,41 @@ class CltocsPrefetch(Message):
     )
 
 
+class CltocsReadBulk(Message):
+    """Bulk read: the whole range comes back in ONE reply frame with a
+    per-block CRC table, so the server can sendfile() the data region
+    and the receiver can land bytes directly in the destination buffer.
+    ``offset`` must be 64 KiB-block-aligned."""
+
+    MSG_TYPE = 1206
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("version", "u32"),
+        ("part_id", "u32"),
+        ("offset", "u32"),
+        ("size", "u32"),
+    )
+
+
+class CstoclReadBulkData(Message):
+    """Reply to CltocsReadBulk: piece CRCs (one per touched block; the
+    trailing partial piece's CRC covers the bytes as transmitted) + the
+    raw range. Integrity is verified by the RECEIVER — the sender vouches
+    only for its stored CRC table (the periodic chunk tester still
+    verifies server-side)."""
+
+    MSG_TYPE = 1207
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("status", "u8"),
+        ("offset", "u32"),
+        ("crcs", "list:u32"),
+        ("data", "bytes"),
+    )
+
+
 class CstoclReadData(Message):
     """One 64 KiB-aligned piece with its CRC (cstocl READ_DATA)."""
 
@@ -742,6 +780,22 @@ class CltocsWriteData(Message):
         ("block", "u32"),  # block index within the part
         ("offset", "u32"),  # offset within the block
         ("crc", "u32"),  # CRC of this piece
+        ("data", "bytes"),
+    )
+
+
+class CltocsWriteBulk(Message):
+    """Bulk write: one frame carries a block-aligned range with one CRC
+    per touched 64 KiB piece; ONE CstoclWriteStatus acks the whole range
+    (vs one ack per piece). Chain forwarding relays the frame verbatim."""
+
+    MSG_TYPE = 1214
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("write_id", "u32"),
+        ("part_offset", "u32"),  # must be 64 KiB-aligned
+        ("crcs", "list:u32"),
         ("data", "bytes"),
     )
 

@@ -153,6 +153,112 @@ int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
     }
 }
 
+// Bulk read: one CstoclReadBulkData reply — CRC table + raw range —
+// received DIRECTLY into the caller's buffer, then verified here (the
+// sender does no CRC pass; see serve_native.cpp).  offset must be
+// 64 KiB-aligned.  Returns 0, peer status, -1 socket, -2 protocol,
+// -3 CRC mismatch.
+int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
+                      uint32_t part_id, uint32_t offset, uint32_t size,
+                      uint8_t* out) {
+    constexpr uint32_t kTypeReadBulk = 1206;
+    constexpr uint32_t kTypeReadBulkData = 1207;
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4];
+    size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
+    put32(req, kTypeReadBulk);
+    put32(req + 4, static_cast<uint32_t>(body));
+    req[8] = kProtoVersion;
+    put32(req + 9, 1);
+    put64(req + 13, chunk_id);
+    put32(req + 21, version);
+    put32(req + 25, part_id);
+    put32(req + 29, offset);
+    put32(req + 33, size);
+    if (!send_all(fd, req, sizeof(req))) return -1;
+
+    uint8_t header[8];
+    if (!recv_all(fd, header, 8)) return -1;
+    uint32_t type = get32(header);
+    uint32_t length = get32(header + 4);
+    if (type != kTypeReadBulkData) return -2;
+    if (length < 1 + 4 + 8 + 1 + 4 + 4 + 4) return -2;
+    uint8_t fixed[22];
+    if (!recv_all(fd, fixed, sizeof(fixed))) return -1;
+    if (fixed[0] != kProtoVersion) return -2;
+    uint8_t status = fixed[13];
+    uint32_t nblocks_expected =
+        (offset + size - 1) / kBlockSize - offset / kBlockSize + 1;
+    uint32_t ncrcs = get32(fixed + 18);
+    if (status != 0) {
+        // drain the (empty) remainder so the socket stays reusable
+        uint32_t rest = length - 22;
+        std::vector<uint8_t> sink(rest);
+        if (rest && !recv_all(fd, sink.data(), rest)) return -1;
+        return status;
+    }
+    if (ncrcs != nblocks_expected) return -2;
+    std::vector<uint8_t> crcs(4 * ncrcs);
+    if (!recv_all(fd, crcs.data(), crcs.size())) return -1;
+    uint8_t dlen_raw[4];
+    if (!recv_all(fd, dlen_raw, 4)) return -1;
+    uint32_t dlen = get32(dlen_raw);
+    if (dlen != size || length != 22 + 4 * ncrcs + 4 + dlen) return -2;
+    if (!recv_all(fd, out, size)) return -1;
+    // receiver-side integrity pass (the only CRC pass on this path)
+    uint32_t end = offset + size;
+    for (uint32_t b = 0; b < ncrcs; ++b) {
+        uint32_t piece_start = offset + b * kBlockSize;
+        uint32_t piece_end = std::min(end, piece_start + kBlockSize);
+        if (lz_crc32(0, out + (piece_start - offset),
+                     piece_end - piece_start) != get32(crcs.data() + 4 * b))
+            return -3;
+    }
+    return 0;
+}
+
+// Bulk write: ONE CltocsWriteBulk frame (per-piece CRC table + raw
+// range) and ONE WriteStatus ack for the whole range.  part_offset must
+// be 64 KiB-aligned.  Assumes WriteInit was already exchanged.
+int lz_write_part_bulk(int fd, uint64_t chunk_id, const uint8_t* payload,
+                       uint64_t len, uint64_t part_offset,
+                       uint32_t write_id) {
+    constexpr uint32_t kTypeWriteBulk = 1214;
+    if (part_offset % kBlockSize != 0 || len > (64u << 20)) return -2;
+    uint32_t ncrcs = static_cast<uint32_t>((len + kBlockSize - 1) / kBlockSize);
+    std::vector<uint8_t> head(8 + 25 + 4 * ncrcs + 4);
+    size_t body = head.size() - 8 + len;
+    put32(head.data(), kTypeWriteBulk);
+    put32(head.data() + 4, static_cast<uint32_t>(body));
+    head[8] = kProtoVersion;
+    put32(head.data() + 9, write_id);
+    put64(head.data() + 13, chunk_id);
+    put32(head.data() + 21, write_id);
+    put32(head.data() + 25, static_cast<uint32_t>(part_offset));
+    put32(head.data() + 29, ncrcs);
+    for (uint32_t b = 0; b < ncrcs; ++b) {
+        uint64_t start = static_cast<uint64_t>(b) * kBlockSize;
+        uint32_t piece = static_cast<uint32_t>(
+            std::min<uint64_t>(kBlockSize, len - start));
+        put32(head.data() + 33 + 4 * b,
+              lz_crc32(0, payload + start, piece));
+    }
+    put32(head.data() + 33 + 4 * ncrcs, static_cast<uint32_t>(len));
+    if (!send_all(fd, head.data(), head.size())) return -1;
+    if (!send_all(fd, payload, len)) return -1;
+    // single ack
+    uint8_t hdr[8];
+    uint8_t pay[32];
+    if (!recv_all(fd, hdr, 8)) return -1;
+    uint32_t type = get32(hdr);
+    uint32_t length = get32(hdr + 4);
+    if (type != kTypeWriteStatus || length < 18 || length > sizeof(pay))
+        return -2;
+    if (!recv_all(fd, pay, length)) return -1;
+    if (pay[0] != kProtoVersion) return -2;
+    if (get32(pay + 13) != write_id) return -2;
+    return pay[17];
+}
+
 // Stream [part_offset, part_offset+len) of payload as WriteData pieces
 // (block-bounded, CRC per piece) and collect one ack per piece.
 // Assumes WriteInit has already been exchanged on this socket.

@@ -1,0 +1,1396 @@
+// Native chunkserver data-plane server.
+//
+// The reference chunkserver serves its data plane from C++ worker
+// threads (reference: src/chunkserver/network_worker_thread.cc:402-755
+// serving state machine, hddspacemgr.cc block IO).  Round 1 kept the
+// accept loop and write chain in Python asyncio and only offloaded bulk
+// reads; this file moves the WHOLE hot path native: a listener whose
+// connection threads parse frames, do block IO with CRC maintenance,
+// forward write chains downstream, and relay acks — no Python in the
+// data path.  The asyncio server remains the control plane (admin,
+// replication commands) and the portable fallback.
+//
+// Wire format (keep in sync with lizardfs_tpu/proto/messages.py):
+//   frame = header(type:u32 BE, length:u32 BE) + version:u8 + body
+//   CltocsRead       (1200): req_id:u32 chunk_id:u64 version:u32
+//                            part_id:u32 offset:u32 size:u32
+//   CstoclReadData   (1201): req_id chunk_id offset:u32 crc:u32 data
+//   CstoclReadStatus (1202): req_id chunk_id status:u8
+//   CltocsPrefetch   (1205): like Read, no reply
+//   CltocsWriteInit  (1210): req_id chunk_id version part_id
+//                            chain(list of {host:str port:u16 part:u32})
+//                            create:bool
+//   CltocsWriteData  (1211): req_id chunk_id write_id:u32 block:u32
+//                            offset:u32 crc:u32 data
+//   CstoclWriteStatus(1212): req_id chunk_id write_id status:u8
+//   CltocsWriteEnd   (1213): req_id chunk_id
+//
+// On-disk chunk format (chunk_store.py, reference chunk.h:154-176):
+//   chunk_<id:016X>_<version:08X>.liz inside <id&0xFF:02X>/ subfolders:
+//   [1 KiB signature][4 KiB CRC table: 1024 BE u32][64 KiB blocks...]
+//   signature = "LIZTPU10" + chunk_id:u64 BE + version:u32 BE + part:u32 BE
+//
+// Cross-runtime coherence: every block read/write takes an flock on the
+// file (shared for reads, exclusive for writes).  The Python store holds
+// its own file descriptions, so flock serializes the two planes.
+
+#include <algorithm>
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/file.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/uio.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+extern "C" uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len);
+
+namespace {
+
+constexpr uint32_t kTypeRead = 1200;
+constexpr uint32_t kTypeReadData = 1201;
+constexpr uint32_t kTypeReadStatus = 1202;
+constexpr uint32_t kTypePrefetch = 1205;
+constexpr uint32_t kTypeReadBulk = 1206;
+constexpr uint32_t kTypeReadBulkData = 1207;
+constexpr uint32_t kTypeWriteInit = 1210;
+constexpr uint32_t kTypeWriteData = 1211;
+constexpr uint32_t kTypeWriteStatus = 1212;
+constexpr uint32_t kTypeWriteEnd = 1213;
+constexpr uint32_t kTypeWriteBulk = 1214;
+constexpr uint8_t kProtoVersion = 1;
+
+constexpr uint32_t kBlockSize = 64 * 1024;
+constexpr uint32_t kBlocksInChunk = 1024;
+constexpr uint32_t kSignatureSize = 1024;
+constexpr uint32_t kCrcTableSize = 4 * kBlocksInChunk;
+constexpr uint32_t kHeaderSize = kSignatureSize + kCrcTableSize;
+constexpr size_t kMaxFrame = 2u << 20;  // data frames are <= 64 KiB + headers
+
+// status codes (lizardfs_tpu/proto/status.py)
+constexpr uint8_t stOK = 0;
+constexpr uint8_t stEINVAL = 5;
+constexpr uint8_t stEIO = 9;
+constexpr uint8_t stINDEX_TOO_BIG = 13;
+constexpr uint8_t stNO_CHUNK = 16;
+constexpr uint8_t stWRONG_VERSION = 19;
+constexpr uint8_t stCRC_ERROR = 20;
+constexpr uint8_t stDISCONNECTED = 21;
+
+inline void put16(uint8_t* p, uint16_t v) { p[0] = v >> 8; p[1] = v; }
+inline void put32(uint8_t* p, uint32_t v) {
+    p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+inline void put64(uint8_t* p, uint64_t v) {
+    put32(p, static_cast<uint32_t>(v >> 32));
+    put32(p + 4, static_cast<uint32_t>(v));
+}
+inline uint16_t get16(const uint8_t* p) {
+    return (uint16_t(p[0]) << 8) | p[1];
+}
+inline uint32_t get32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline uint64_t get64(const uint8_t* p) {
+    return (uint64_t(get32(p)) << 32) | get32(p + 4);
+}
+
+bool send_all(int fd, const uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::send(fd, buf, len, MSG_NOSIGNAL);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+bool recv_all(int fd, uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::recv(fd, buf, len, 0);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+void set_bulk_sockopts(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int bufsz = 4 * 1024 * 1024;  // deep buffers: fewer wakeups per MiB
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+}
+
+uint32_t empty_block_crc() {
+    static const uint32_t crc = [] {
+        std::vector<uint8_t> zeros(kBlockSize, 0);
+        return lz_crc32(0, zeros.data(), zeros.size());
+    }();
+    return crc;
+}
+
+// --- slice geometry (core/geometry.py, slice_traits.h) ---------------------
+
+struct PartGeom {
+    int type;
+    int part;
+};
+
+inline PartGeom part_geom(uint32_t part_id) {
+    return {static_cast<int>(part_id / 64), static_cast<int>(part_id % 64)};
+}
+
+inline bool type_is_xor(int t) { return t >= 2 && t <= 9; }
+inline bool type_is_ec(int t) { return t >= 10 && t < 10 + 31 * 32; }
+
+inline int data_parts(int t) {
+    if (type_is_xor(t)) return t - 2 + 2;       // xor2..xor9
+    if (type_is_ec(t)) return 2 + (t - 10) / 32;  // ec(k,m), k = 2..32
+    return 1;
+}
+
+inline bool part_is_parity(const PartGeom& g) {
+    if (type_is_xor(g.type)) return g.part == 0;
+    if (type_is_ec(g.type)) return g.part >= data_parts(g.type);
+    return false;
+}
+
+inline int blocks_in_part(uint32_t part_id) {
+    PartGeom g = part_geom(part_id);
+    int d = data_parts(g.type);
+    int idx = 0;
+    if (!part_is_parity(g)) idx = type_is_xor(g.type) ? g.part - 1 : g.part;
+    return (static_cast<int>(kBlocksInChunk) + d - idx - 1) / d;
+}
+
+// --- chunk files ----------------------------------------------------------
+
+std::string chunk_path(const std::string& folder, uint64_t chunk_id,
+                       uint32_t version) {
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "%02X/chunk_%016lX_%08X.liz",
+                  static_cast<unsigned>(chunk_id & 0xFF),
+                  static_cast<unsigned long>(chunk_id), version);
+    return folder + "/" + buf;
+}
+
+// find the part's file across folders: 0 = found (path set);
+// stWRONG_VERSION if another version of the chunk exists; stNO_CHUNK.
+uint8_t resolve_chunk(const std::vector<std::string>& folders,
+                      uint64_t chunk_id, uint32_t version,
+                      std::string* path) {
+    char prefix[40];
+    std::snprintf(prefix, sizeof(prefix), "chunk_%016lX_",
+                  static_cast<unsigned long>(chunk_id));
+    bool other_version = false;
+    for (const auto& folder : folders) {
+        std::string p = chunk_path(folder, chunk_id, version);
+        if (::access(p.c_str(), F_OK) == 0) {
+            *path = std::move(p);
+            return stOK;
+        }
+        char sub[8];
+        std::snprintf(sub, sizeof(sub), "/%02X",
+                      static_cast<unsigned>(chunk_id & 0xFF));
+        DIR* d = ::opendir((folder + sub).c_str());
+        if (d != nullptr) {
+            while (struct dirent* e = ::readdir(d)) {
+                if (std::strncmp(e->d_name, prefix, 23) == 0) {
+                    other_version = true;
+                    break;
+                }
+            }
+            ::closedir(d);
+        }
+    }
+    return other_version ? stWRONG_VERSION : stNO_CHUNK;
+}
+
+struct Sig {
+    uint64_t chunk_id;
+    uint32_t version;
+    uint32_t part_id;
+};
+
+bool read_signature(int fd, Sig* sig) {
+    uint8_t buf[24];
+    if (::pread(fd, buf, sizeof(buf), 0) != static_cast<ssize_t>(sizeof(buf)))
+        return false;
+    if (std::memcmp(buf, "LIZTPU10", 8) != 0) return false;
+    sig->chunk_id = get64(buf + 8);
+    sig->version = get32(buf + 16);
+    sig->part_id = get32(buf + 20);
+    return true;
+}
+
+// Every operation opens its own descriptor (write sessions keep theirs
+// for the session's lifetime).  An open() is a few microseconds next to
+// a 64 KiB+ transfer, and per-op descriptors buy two guarantees a
+// shared-fd cache cannot give: no eviction/recycling race (a cached fd
+// closed under a concurrent op could be reused by an unrelated file),
+// and distinct open file descriptions, so flock excludes native threads
+// from EACH OTHER as well as from the Python plane.
+int open_chunk(const std::string& path, bool rw, Sig* sig) {
+    int fd = ::open(path.c_str(), rw ? O_RDWR : O_RDONLY);
+    if (fd < 0) return -1;
+    if (!read_signature(fd, sig)) {
+        ::close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+// --- server object --------------------------------------------------------
+
+struct WriteSession {
+    uint64_t chunk_id = 0;
+    uint32_t version = 0;
+    uint32_t part_id = 0;
+    int fd = -1;           // owned by the session (closed at teardown)
+    int max_blocks = 0;
+    int down_fd = -1;      // owned here
+    std::thread relay;
+    std::mutex mu;
+    std::map<uint32_t, uint8_t> local_done;   // write_id -> status
+    std::map<uint32_t, uint8_t> down_acked;   // write_id -> status
+    bool down_dead = false;
+};
+
+struct Server {
+    std::vector<std::string> folders;
+    int listen_fd = -1;
+    int port = 0;
+    std::atomic<bool> stopping{false};
+    std::thread accept_thread;
+    // live connections: fds are pruned as connections close (a stale
+    // entry could alias a recycled descriptor); threads run detached
+    // and are awaited at stop via the counter + condvar
+    std::mutex conn_mu;
+    std::condition_variable conn_cv;
+    std::vector<int> conn_fds;
+    size_t active_conns = 0;
+    std::atomic<uint64_t> bytes_read{0}, bytes_written{0};
+    std::atomic<uint64_t> read_ops{0}, write_ops{0};
+};
+
+std::mutex g_servers_mu;
+std::vector<Server*> g_servers;
+
+// frame scratch assembled per send
+bool send_status(int fd, std::mutex* send_mu, uint32_t type, uint32_t req_id,
+                 uint64_t chunk_id, uint32_t write_id, uint8_t status) {
+    // ReadStatus: ver req chunk status (14); WriteStatus adds write_id (18)
+    uint8_t f[8 + 18];
+    size_t body = (type == kTypeWriteStatus) ? 18 : 14;
+    put32(f, type);
+    put32(f + 4, static_cast<uint32_t>(body));
+    f[8] = kProtoVersion;
+    put32(f + 9, req_id);
+    put64(f + 13, chunk_id);
+    if (type == kTypeWriteStatus) {
+        put32(f + 21, write_id);
+        f[25] = status;
+    } else {
+        f[21] = status;
+    }
+    if (send_mu != nullptr) {
+        std::lock_guard<std::mutex> g(*send_mu);
+        return send_all(fd, f, 8 + body);
+    }
+    return send_all(fd, f, 8 + body);
+}
+
+// --- read serving ---------------------------------------------------------
+
+void serve_read(Server& srv, int cfd, std::mutex* send_mu,
+                const uint8_t* body) {
+    uint32_t req_id = get32(body);
+    uint64_t chunk_id = get64(body + 4);
+    uint32_t version = get32(body + 12);
+    uint32_t part_id = get32(body + 16);
+    uint32_t offset = get32(body + 20);
+    uint32_t size = get32(body + 24);
+
+    uint8_t code = stOK;
+    std::string path;
+    int fd = -1;
+    Sig sig{};
+    uint64_t max_bytes =
+        static_cast<uint64_t>(blocks_in_part(part_id)) * kBlockSize;
+    if (size == 0 || offset + static_cast<uint64_t>(size) > max_bytes) {
+        code = stEINVAL;
+    } else {
+        code = resolve_chunk(srv.folders, chunk_id, version, &path);
+    }
+    if (code == stOK) {
+        fd = open_chunk(path, /*rw=*/false, &sig);
+        if (fd < 0) {
+            code = stNO_CHUNK;
+        } else if (sig.chunk_id != chunk_id || sig.version != version ||
+                   sig.part_id != part_id) {
+            ::close(fd);
+            fd = -1;
+            code = stNO_CHUNK;
+        }
+    }
+    if (code != stOK) {
+        send_status(cfd, send_mu, kTypeReadStatus, req_id, chunk_id, 0, code);
+        return;
+    }
+
+    uint32_t first_b = offset / kBlockSize;
+    uint32_t last_b = (offset + size - 1) / kBlockSize;
+    uint32_t nblocks = last_b - first_b + 1;
+    std::vector<uint8_t> data(static_cast<size_t>(nblocks) * kBlockSize);
+    std::vector<uint8_t> crc_raw(4 * nblocks);
+    std::vector<uint32_t> piece_crc(nblocks);
+
+    ::flock(fd, LOCK_SH);
+    struct stat stbuf;
+    uint64_t data_len = 0;
+    if (::fstat(fd, &stbuf) == 0 && stbuf.st_size > kHeaderSize)
+        data_len = static_cast<uint64_t>(stbuf.st_size) - kHeaderSize;
+    bool io_ok =
+        ::pread(fd, crc_raw.data(), crc_raw.size(),
+                kSignatureSize + 4 * first_b) ==
+            static_cast<ssize_t>(crc_raw.size());
+    if (io_ok) {
+        ssize_t n = ::pread(fd, data.data(), data.size(),
+                            kHeaderSize + static_cast<uint64_t>(first_b) *
+                                              kBlockSize);
+        if (n < 0) {
+            io_ok = false;
+        } else if (static_cast<size_t>(n) < data.size()) {
+            std::memset(data.data() + n, 0, data.size() - n);
+        }
+    }
+    ::flock(fd, LOCK_UN);
+    ::close(fd);
+    if (!io_ok) {
+        send_status(cfd, send_mu, kTypeReadStatus, req_id, chunk_id, 0, stEIO);
+        return;
+    }
+
+    for (uint32_t b = 0; b < nblocks && code == stOK; ++b) {
+        uint32_t stored = get32(crc_raw.data() + 4 * b);
+        uint64_t block_start =
+            static_cast<uint64_t>(first_b + b) * kBlockSize;
+        uint32_t expected = stored != 0 ? stored : empty_block_crc();
+        if (block_start < data_len || stored != 0) {
+            if (lz_crc32(0, data.data() + static_cast<size_t>(b) * kBlockSize,
+                         kBlockSize) != expected) {
+                code = stCRC_ERROR;
+                break;
+            }
+        }
+        piece_crc[b] = expected;
+    }
+    if (code != stOK) {
+        send_status(cfd, send_mu, kTypeReadStatus, req_id, chunk_id, 0, code);
+        return;
+    }
+
+    // stream pieces with writev: 33-byte fixed prefix + data slice each
+    std::vector<uint8_t> prefixes(static_cast<size_t>(nblocks) * 33);
+    std::vector<struct iovec> iov(2 * nblocks + 1);
+    size_t niov = 0;
+    uint32_t end = offset + size;
+    for (uint32_t b = 0; b < nblocks; ++b) {
+        uint32_t block_start = (first_b + b) * kBlockSize;
+        uint32_t piece_off = b == 0 ? offset : block_start;
+        uint32_t piece_end = std::min(end, block_start + kBlockSize);
+        uint32_t dlen = piece_end - piece_off;
+        uint32_t crc = piece_crc[b];
+        if (dlen != kBlockSize) {  // partial piece: CRC of the piece itself
+            crc = lz_crc32(0,
+                           data.data() + (piece_off - first_b * kBlockSize),
+                           dlen);
+        }
+        uint8_t* p = prefixes.data() + static_cast<size_t>(b) * 33;
+        put32(p, kTypeReadData);
+        put32(p + 4, 25 + dlen);
+        p[8] = kProtoVersion;
+        put32(p + 9, req_id);
+        put64(p + 13, chunk_id);
+        put32(p + 21, piece_off);
+        put32(p + 25, crc);
+        put32(p + 29, dlen);
+        iov[niov].iov_base = p;
+        iov[niov].iov_len = 33;
+        ++niov;
+        iov[niov].iov_base =
+            data.data() + (piece_off - first_b * kBlockSize);
+        iov[niov].iov_len = dlen;
+        ++niov;
+    }
+    // status frame appended after all pieces for a single writev run
+    uint8_t status_frame[8 + 14];
+    put32(status_frame, kTypeReadStatus);
+    put32(status_frame + 4, 14);
+    status_frame[8] = kProtoVersion;
+    put32(status_frame + 9, req_id);
+    put64(status_frame + 13, chunk_id);
+    status_frame[21] = stOK;
+    iov[niov].iov_base = status_frame;
+    iov[niov].iov_len = 22;
+    ++niov;
+
+    if (send_mu != nullptr) send_mu->lock();
+    size_t sent_iov = 0;
+    bool ok = true;
+    while (sent_iov < niov) {
+        int batch = static_cast<int>(std::min<size_t>(niov - sent_iov, 512));
+        ssize_t n = ::writev(cfd, iov.data() + sent_iov, batch);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            ok = false;
+            break;
+        }
+        size_t left = static_cast<size_t>(n);
+        while (sent_iov < niov && left >= iov[sent_iov].iov_len) {
+            left -= iov[sent_iov].iov_len;
+            ++sent_iov;
+        }
+        if (left) {  // partial iovec: advance within it
+            iov[sent_iov].iov_base =
+                static_cast<uint8_t*>(iov[sent_iov].iov_base) + left;
+            iov[sent_iov].iov_len -= left;
+        }
+    }
+    if (send_mu != nullptr) send_mu->unlock();
+    if (ok) {
+        srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
+        srv.read_ops.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+// --- bulk read: one reply frame, data via sendfile ------------------------
+//
+// The sender ships its STORED per-block CRCs and the raw file range
+// (zeros for sparse tails); the receiver does the only CRC pass.  On a
+// single core this halves the per-byte CPU of a read, and sendfile
+// skips the userspace data copy entirely.
+
+void send_bulk_error(int cfd, std::mutex* send_mu, uint32_t req_id,
+                     uint64_t chunk_id, uint8_t status) {
+    uint8_t f[8 + 1 + 4 + 8 + 1 + 4 + 4 + 4];
+    put32(f, kTypeReadBulkData);
+    put32(f + 4, 1 + 4 + 8 + 1 + 4 + 4 + 4);
+    f[8] = kProtoVersion;
+    put32(f + 9, req_id);
+    put64(f + 13, chunk_id);
+    f[21] = status;
+    put32(f + 22, 0);  // offset
+    put32(f + 26, 0);  // empty crc list
+    put32(f + 30, 0);  // empty data
+    std::lock_guard<std::mutex> g(*send_mu);
+    send_all(cfd, f, sizeof(f));
+}
+
+void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
+                     const uint8_t* body) {
+    uint32_t req_id = get32(body);
+    uint64_t chunk_id = get64(body + 4);
+    uint32_t version = get32(body + 12);
+    uint32_t part_id = get32(body + 16);
+    uint32_t offset = get32(body + 20);
+    uint32_t size = get32(body + 24);
+
+    uint8_t code = stOK;
+    std::string path;
+    int fd = -1;
+    Sig sig{};
+    uint64_t max_bytes =
+        static_cast<uint64_t>(blocks_in_part(part_id)) * kBlockSize;
+    if (size == 0 || offset % kBlockSize != 0 ||
+        offset + static_cast<uint64_t>(size) > max_bytes) {
+        code = stEINVAL;
+    } else {
+        code = resolve_chunk(srv.folders, chunk_id, version, &path);
+    }
+    if (code == stOK) {
+        fd = open_chunk(path, /*rw=*/false, &sig);
+        if (fd >= 0 && (sig.chunk_id != chunk_id || sig.version != version ||
+                        sig.part_id != part_id)) {
+            ::close(fd);
+            fd = -1;
+        }
+        if (fd < 0) code = stNO_CHUNK;
+    }
+    if (code != stOK) {
+        send_bulk_error(cfd, send_mu, req_id, chunk_id, code);
+        return;
+    }
+
+    uint32_t first_b = offset / kBlockSize;
+    uint32_t last_b = (offset + size - 1) / kBlockSize;
+    uint32_t nblocks = last_b - first_b + 1;
+    std::vector<uint8_t> crc_raw(4 * nblocks);
+
+    ::flock(fd, LOCK_SH);
+    struct stat stbuf;
+    uint64_t data_len = 0;
+    if (::fstat(fd, &stbuf) == 0 && stbuf.st_size > kHeaderSize)
+        data_len = static_cast<uint64_t>(stbuf.st_size) - kHeaderSize;
+    bool io_ok =
+        ::pread(fd, crc_raw.data(), crc_raw.size(),
+                kSignatureSize + 4 * first_b) ==
+        static_cast<ssize_t>(crc_raw.size());
+    // piece CRCs: full pieces use the stored table (holes -> empty CRC);
+    // a partial tail piece gets a fresh CRC over its bytes (one block)
+    std::vector<uint8_t> crcs_be(4 * nblocks);
+    uint32_t end = offset + size;
+    uint32_t tail_len = end % kBlockSize;
+    if (io_ok) {
+        for (uint32_t b = 0; b < nblocks; ++b) {
+            uint32_t stored = get32(crc_raw.data() + 4 * b);
+            put32(crcs_be.data() + 4 * b,
+                  stored != 0 ? stored : empty_block_crc());
+        }
+        if (tail_len != 0) {
+            static thread_local std::vector<uint8_t> tailbuf;
+            tailbuf.assign(tail_len, 0);
+            uint64_t tail_pos =
+                kHeaderSize + static_cast<uint64_t>(last_b) * kBlockSize;
+            ssize_t n = ::pread(fd, tailbuf.data(), tail_len, tail_pos);
+            if (n < 0) {
+                io_ok = false;
+            } else {
+                if (static_cast<size_t>(n) < tail_len)
+                    std::memset(tailbuf.data() + n, 0, tail_len - n);
+                put32(crcs_be.data() + 4 * (nblocks - 1),
+                      lz_crc32(0, tailbuf.data(), tail_len));
+            }
+        }
+    }
+    // release the flock BEFORE the (possibly slow) network send: a
+    // writer racing the sendfile at worst produces a CRC mismatch the
+    // receiver retries, while holding the lock would stall every write
+    // to this chunk for the transfer duration
+    ::flock(fd, LOCK_UN);
+    if (!io_ok) {
+        ::close(fd);
+        send_bulk_error(cfd, send_mu, req_id, chunk_id, stEIO);
+        return;
+    }
+
+    // reply = fixed fields + crc list + u32 data length, then raw data
+    std::vector<uint8_t> head(8 + 1 + 4 + 8 + 1 + 4 + 4 + 4 * nblocks + 4);
+    size_t payload_len = head.size() - 8 + size;
+    put32(head.data(), kTypeReadBulkData);
+    put32(head.data() + 4, static_cast<uint32_t>(payload_len));
+    head[8] = kProtoVersion;
+    put32(head.data() + 9, req_id);
+    put64(head.data() + 13, chunk_id);
+    head[21] = stOK;
+    put32(head.data() + 22, offset);
+    put32(head.data() + 26, nblocks);
+    std::memcpy(head.data() + 30, crcs_be.data(), 4 * nblocks);
+    put32(head.data() + 30 + 4 * nblocks, size);
+
+    uint64_t file_start = kHeaderSize + static_cast<uint64_t>(offset);
+    uint64_t in_file =
+        data_len > offset ? std::min<uint64_t>(data_len - offset, size) : 0;
+
+    bool ok;
+    {
+        std::lock_guard<std::mutex> g(*send_mu);
+        ok = send_all(cfd, head.data(), head.size());
+        off_t off = static_cast<off_t>(file_start);
+        uint64_t left = in_file;
+        while (ok && left) {
+            ssize_t n = ::sendfile(cfd, fd, &off, left);
+            if (n < 0) {
+                if (errno == EINTR || errno == EAGAIN) continue;
+                ok = false;
+                break;
+            }
+            if (n == 0) break;  // file shrank mid-send: pad below
+            left -= static_cast<uint64_t>(n);
+        }
+        if (ok && (size - in_file + left) > 0) {
+            static const std::vector<uint8_t> zeros(1 << 20, 0);
+            uint64_t pad = size - in_file + left;
+            while (ok && pad) {
+                size_t take = std::min<uint64_t>(pad, zeros.size());
+                ok = send_all(cfd, zeros.data(), take);
+                pad -= take;
+            }
+        }
+    }
+    ::close(fd);
+    if (ok) {
+        srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
+        srv.read_ops.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+// --- write serving --------------------------------------------------------
+
+uint8_t do_local_write(Server& srv, WriteSession& s, uint32_t block,
+                       uint32_t off_in_block, const uint8_t* piece,
+                       uint32_t dlen, uint32_t piece_crc_wire) {
+    if (block >= static_cast<uint32_t>(s.max_blocks)) return stINDEX_TOO_BIG;
+    if (off_in_block + dlen > kBlockSize) return stEINVAL;
+    if (lz_crc32(0, piece, dlen) != piece_crc_wire) return stCRC_ERROR;
+    uint64_t block_pos =
+        kHeaderSize + static_cast<uint64_t>(block) * kBlockSize;
+    uint8_t ret = stOK;
+    ::flock(s.fd, LOCK_EX);
+    uint32_t new_crc;
+    if (dlen == kBlockSize) {
+        if (::pwrite(s.fd, piece, dlen, block_pos) !=
+            static_cast<ssize_t>(dlen))
+            ret = stEIO;
+        new_crc = piece_crc_wire;
+    } else {
+        static thread_local std::vector<uint8_t> blockbuf;
+        blockbuf.resize(kBlockSize);
+        ssize_t n = ::pread(s.fd, blockbuf.data(), kBlockSize, block_pos);
+        if (n < 0) n = 0;
+        if (static_cast<size_t>(n) < kBlockSize)
+            std::memset(blockbuf.data() + n, 0, kBlockSize - n);
+        std::memcpy(blockbuf.data() + off_in_block, piece, dlen);
+        new_crc = lz_crc32(0, blockbuf.data(), kBlockSize);
+        if (::pwrite(s.fd, blockbuf.data(), kBlockSize, block_pos) !=
+            static_cast<ssize_t>(kBlockSize))
+            ret = stEIO;
+    }
+    if (ret == stOK) {
+        uint8_t crcbuf[4];
+        put32(crcbuf, new_crc);
+        if (::pwrite(s.fd, crcbuf, 4, kSignatureSize + 4ull * block) != 4)
+            ret = stEIO;
+    }
+    ::flock(s.fd, LOCK_UN);
+    if (ret == stOK) {
+        srv.bytes_written.fetch_add(dlen, std::memory_order_relaxed);
+        srv.write_ops.fetch_add(1, std::memory_order_relaxed);
+    }
+    return ret;
+}
+
+// relay thread: downstream acks -> upstream (combined with local status)
+void relay_down(WriteSession* s, int up_fd, std::mutex* send_mu) {
+    std::vector<uint8_t> payload(64);
+    for (;;) {
+        uint8_t header[8];
+        if (!recv_all(s->down_fd, header, 8)) break;
+        uint32_t type = get32(header);
+        uint32_t length = get32(header + 4);
+        if (length < 1 || length > payload.size()) break;
+        if (!recv_all(s->down_fd, payload.data(), length)) break;
+        if (type != kTypeWriteStatus || length < 18) continue;
+        uint32_t write_id = get32(payload.data() + 13);
+        uint8_t status = payload[17];
+        bool ack_now = false;
+        uint8_t combined = status;
+        {
+            std::lock_guard<std::mutex> g(s->mu);
+            auto it = s->local_done.find(write_id);
+            if (it != s->local_done.end()) {
+                combined = it->second != stOK ? it->second : status;
+                s->local_done.erase(it);
+                ack_now = true;
+            } else {
+                s->down_acked[write_id] = status;
+            }
+        }
+        if (ack_now) {
+            send_status(up_fd, send_mu, kTypeWriteStatus, write_id,
+                        s->chunk_id, write_id, combined);
+        }
+    }
+    // downstream died: everything still pending fails DISCONNECTED
+    std::vector<uint32_t> pending;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->down_dead = true;
+        for (auto& kv : s->local_done) pending.push_back(kv.first);
+        s->local_done.clear();
+    }
+    for (uint32_t wid : pending) {
+        send_status(up_fd, send_mu, kTypeWriteStatus, wid, s->chunk_id, wid,
+                    stDISCONNECTED);
+    }
+}
+
+int connect_addr(const std::string& host, uint16_t port) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[8];
+    std::snprintf(portstr, sizeof(portstr), "%u", port);
+    struct addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0) return -1;
+    int fd = -1;
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd >= 0) set_bulk_sockopts(fd);
+    return fd;
+}
+
+uint8_t create_chunk_file(const std::string& folder, uint64_t chunk_id,
+                          uint32_t version, uint32_t part_id,
+                          std::string* path) {
+    char sub[8];
+    std::snprintf(sub, sizeof(sub), "/%02X",
+                  static_cast<unsigned>(chunk_id & 0xFF));
+    std::string subdir = folder + sub;
+    ::mkdir(subdir.c_str(), 0755);
+    std::string p = chunk_path(folder, chunk_id, version);
+    int fd = ::open(p.c_str(), O_CREAT | O_EXCL | O_WRONLY, 0644);
+    if (fd < 0) return errno == EEXIST ? stOK : stEIO;
+    std::vector<uint8_t> header(kHeaderSize, 0);
+    std::memcpy(header.data(), "LIZTPU10", 8);
+    put64(header.data() + 8, chunk_id);
+    put32(header.data() + 16, version);
+    put32(header.data() + 20, part_id);
+    bool ok = ::write(fd, header.data(), header.size()) ==
+              static_cast<ssize_t>(header.size());
+    ::close(fd);
+    if (!ok) {
+        ::unlink(p.c_str());
+        return stEIO;
+    }
+    *path = std::move(p);
+    return stOK;
+}
+
+void teardown_session(WriteSession* s) {
+    if (s->down_fd >= 0) {
+        ::shutdown(s->down_fd, SHUT_RDWR);
+    }
+    if (s->relay.joinable()) s->relay.join();
+    if (s->down_fd >= 0) {
+        ::close(s->down_fd);
+        s->down_fd = -1;
+    }
+    if (s->fd >= 0) {
+        ::close(s->fd);
+        s->fd = -1;
+    }
+    delete s;
+}
+
+void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
+                      const uint8_t* body, uint32_t blen,
+                      std::unordered_map<uint64_t, WriteSession*>* sessions) {
+    // parse
+    if (blen < 4 + 8 + 4 + 4 + 4 + 1) return;
+    uint32_t req_id = get32(body);
+    uint64_t chunk_id = get64(body + 4);
+    uint32_t version = get32(body + 12);
+    uint32_t part_id = get32(body + 16);
+    uint32_t nchain = get32(body + 20);
+    size_t pos = 24;
+    struct ChainEntry {
+        std::string host;
+        uint16_t port;
+        uint32_t part_id;
+    };
+    std::vector<ChainEntry> chain;
+    bool parse_ok = nchain <= 64;
+    for (uint32_t i = 0; parse_ok && i < nchain; ++i) {
+        if (pos + 4 > blen) { parse_ok = false; break; }
+        uint32_t hlen = get32(body + pos);
+        pos += 4;
+        if (pos + hlen + 2 + 4 > blen || hlen > 256) { parse_ok = false; break; }
+        ChainEntry e;
+        e.host.assign(reinterpret_cast<const char*>(body + pos), hlen);
+        pos += hlen;
+        e.port = get16(body + pos);
+        pos += 2;
+        e.part_id = get32(body + pos);
+        pos += 4;
+        chain.push_back(std::move(e));
+    }
+    if (!parse_ok || pos + 1 > blen) {
+        send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0,
+                    stEINVAL);
+        return;
+    }
+    bool create = body[pos] != 0;
+
+    uint8_t code = stOK;
+    std::string path;
+    code = resolve_chunk(srv.folders, chunk_id, version, &path);
+    if (code == stNO_CHUNK && create) {
+        // place on the emptiest folder (MultiStore._emptiest analog)
+        const std::string* best = nullptr;
+        uint64_t best_free = 0;
+        for (const auto& folder : srv.folders) {
+            struct statvfs sv;
+            uint64_t free = 0;
+            if (::statvfs(folder.c_str(), &sv) == 0)
+                free = static_cast<uint64_t>(sv.f_bavail) * sv.f_frsize;
+            if (best == nullptr || free > best_free) {
+                best = &folder;
+                best_free = free;
+            }
+        }
+        code = best != nullptr
+                   ? create_chunk_file(*best, chunk_id, version, part_id, &path)
+                   : stEIO;
+        if (code == stOK && path.empty()) {
+            // EEXIST race: someone else created it; resolve again
+            code = resolve_chunk(srv.folders, chunk_id, version, &path);
+        }
+    }
+    std::unique_ptr<WriteSession> s(new WriteSession);
+    if (code == stOK) {
+        Sig sig{};
+        s->fd = open_chunk(path, /*rw=*/true, &sig);
+        if (s->fd >= 0 && (sig.chunk_id != chunk_id ||
+                           sig.version != version ||
+                           sig.part_id != part_id)) {
+            ::close(s->fd);
+            s->fd = -1;
+            code = stNO_CHUNK;
+        } else if (s->fd < 0) {
+            code = stEIO;
+        }
+    }
+    if (code == stOK && !chain.empty()) {
+        s->down_fd = connect_addr(chain[0].host, chain[0].port);
+        if (s->down_fd < 0) {
+            code = stDISCONNECTED;
+        } else {
+            // forward WriteInit with the remaining chain
+            std::vector<uint8_t> f;
+            f.resize(8 + 1 + 4 + 8 + 4 + 4 + 4);
+            f[8] = kProtoVersion;
+            put32(f.data() + 9, req_id);
+            put64(f.data() + 13, chunk_id);
+            put32(f.data() + 21, version);
+            put32(f.data() + 25, chain[0].part_id);
+            put32(f.data() + 29, static_cast<uint32_t>(chain.size() - 1));
+            for (size_t i = 1; i < chain.size(); ++i) {
+                size_t base = f.size();
+                f.resize(base + 4 + chain[i].host.size() + 2 + 4);
+                put32(f.data() + base,
+                      static_cast<uint32_t>(chain[i].host.size()));
+                std::memcpy(f.data() + base + 4, chain[i].host.data(),
+                            chain[i].host.size());
+                put16(f.data() + base + 4 + chain[i].host.size(),
+                      chain[i].port);
+                put32(f.data() + base + 4 + chain[i].host.size() + 2,
+                      chain[i].part_id);
+            }
+            f.push_back(create ? 1 : 0);
+            put32(f.data(), kTypeWriteInit);
+            put32(f.data() + 4, static_cast<uint32_t>(f.size() - 8));
+            bool ok = send_all(s->down_fd, f.data(), f.size());
+            // wait for downstream init ack
+            uint8_t hdr[8];
+            uint8_t pay[32];
+            if (ok && recv_all(s->down_fd, hdr, 8)) {
+                uint32_t t = get32(hdr);
+                uint32_t l = get32(hdr + 4);
+                if (t == kTypeWriteStatus && l == 18 &&
+                    recv_all(s->down_fd, pay, l)) {
+                    code = pay[17];
+                } else {
+                    code = stEIO;
+                }
+            } else {
+                code = stDISCONNECTED;
+            }
+            if (code != stOK) {
+                ::close(s->down_fd);
+                s->down_fd = -1;
+            }
+        }
+    }
+    if (code == stOK) {
+        s->chunk_id = chunk_id;
+        s->version = version;
+        s->part_id = part_id;
+        s->max_blocks = blocks_in_part(part_id);
+        WriteSession* raw = s.release();
+        if (raw->down_fd >= 0) {
+            raw->relay = std::thread(relay_down, raw, cfd, send_mu);
+        }
+        auto it = sessions->find(chunk_id);
+        if (it != sessions->end()) teardown_session(it->second);
+        (*sessions)[chunk_id] = raw;
+    }
+    send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0, code);
+}
+
+void serve_write_data(Server& srv, int cfd, std::mutex* send_mu,
+                      const uint8_t* frame, uint32_t flen,
+                      std::unordered_map<uint64_t, WriteSession*>* sessions) {
+    // frame = full raw frame (header + payload) so chain forward can
+    // resend verbatim; body starts at frame+9 (after header + version)
+    const uint8_t* body = frame + 9;
+    uint32_t blen = flen - 9;
+    if (blen < 32) return;
+    uint64_t chunk_id = get64(body + 4);
+    uint32_t write_id = get32(body + 12);
+    uint32_t block = get32(body + 16);
+    uint32_t off_in_block = get32(body + 20);
+    uint32_t crc = get32(body + 24);
+    uint32_t dlen = get32(body + 28);
+    if (32 + dlen != blen) return;
+    auto it = sessions->find(chunk_id);
+    if (it == sessions->end()) {
+        send_status(cfd, send_mu, kTypeWriteStatus, write_id, chunk_id,
+                    write_id, stEINVAL);
+        return;
+    }
+    WriteSession* s = it->second;
+    bool chained = s->down_fd >= 0;
+    if (chained) {
+        if (!send_all(s->down_fd, frame, flen)) {
+            std::lock_guard<std::mutex> g(s->mu);
+            s->down_dead = true;
+        }
+    }
+    uint8_t code =
+        do_local_write(srv, *s, block, off_in_block, body + 32, dlen, crc);
+    if (!chained) {
+        send_status(cfd, send_mu, kTypeWriteStatus, write_id, chunk_id,
+                    write_id, code);
+        return;
+    }
+    bool ack_now = false;
+    uint8_t combined = code;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto d = s->down_acked.find(write_id);
+        if (d != s->down_acked.end()) {
+            combined = code != stOK ? code : d->second;
+            s->down_acked.erase(d);
+            ack_now = true;
+        } else if (s->down_dead) {
+            combined = code != stOK ? code : stDISCONNECTED;
+            ack_now = true;
+        } else {
+            s->local_done[write_id] = code;
+        }
+    }
+    if (ack_now) {
+        send_status(cfd, send_mu, kTypeWriteStatus, write_id, chunk_id,
+                    write_id, combined);
+    }
+}
+
+// Bulk write: the frame can be tens of MiB, so it is STREAMED — the
+// fixed part + CRC list are read first, then data flows through a
+// bounded buffer: each batch is forwarded raw to the chain downstream
+// (pipelining) and written locally block by block.  One WriteStatus
+// acks the whole range (local result combined with the downstream ack
+// through the same relay bookkeeping as per-piece writes).
+void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
+                      const uint8_t* header8, uint32_t length,
+                      std::unordered_map<uint64_t, WriteSession*>* sessions,
+                      bool* conn_ok) {
+    *conn_ok = false;  // until the full frame is consumed
+    // fixed: ver(1) req(4) chunk(8) write_id(4) part_offset(4) ncrcs(4)
+    uint8_t fixed[25];
+    if (length < sizeof(fixed) + 4 || !recv_all(cfd, fixed, sizeof(fixed)))
+        return;
+    if (fixed[0] != kProtoVersion) return;
+    uint32_t req_id = get32(fixed + 1);
+    uint64_t chunk_id = get64(fixed + 5);
+    uint32_t write_id = get32(fixed + 13);
+    uint32_t part_offset = get32(fixed + 17);
+    uint32_t ncrcs = get32(fixed + 21);
+    if (ncrcs > kBlocksInChunk ||
+        length < sizeof(fixed) + 4ull * ncrcs + 4)
+        return;
+    std::vector<uint8_t> crcs(4 * ncrcs);
+    uint8_t dlen_raw[4];
+    if (!recv_all(cfd, crcs.data(), crcs.size())) return;
+    if (!recv_all(cfd, dlen_raw, 4)) return;
+    uint32_t dlen = get32(dlen_raw);
+    if (length != sizeof(fixed) + 4 * ncrcs + 4 + dlen) return;
+
+    auto it = sessions->find(chunk_id);
+    WriteSession* s = it == sessions->end() ? nullptr : it->second;
+    uint8_t code = stOK;
+    if (s == nullptr) {
+        code = stEINVAL;
+    } else if (part_offset % kBlockSize != 0 ||
+               (dlen && (part_offset + static_cast<uint64_t>(dlen) >
+                         static_cast<uint64_t>(s->max_blocks) * kBlockSize)) ||
+               ncrcs != (dlen + kBlockSize - 1) / kBlockSize) {
+        code = stEINVAL;
+    }
+    bool chained = s != nullptr && s->down_fd >= 0;
+    if (chained) {
+        // forward header + fixed + crcs + dlen downstream before data
+        uint8_t hdr[8];
+        std::memcpy(hdr, header8, 8);
+        bool fwd = send_all(s->down_fd, hdr, 8) &&
+                   send_all(s->down_fd, fixed, sizeof(fixed)) &&
+                   send_all(s->down_fd, crcs.data(), crcs.size()) &&
+                   send_all(s->down_fd, dlen_raw, 4);
+        if (!fwd) {
+            std::lock_guard<std::mutex> g(s->mu);
+            s->down_dead = true;
+            chained = false;
+        }
+    }
+
+    // stream data: recv in block-multiple batches, forward + write
+    static thread_local std::vector<uint8_t> batch;
+    const uint32_t kBatch = 64 * kBlockSize;  // 4 MiB
+    batch.resize(std::min(dlen, kBatch));
+    uint32_t done = 0;
+    while (done < dlen) {
+        uint32_t take = std::min(dlen - done, kBatch);
+        if (!recv_all(cfd, batch.data(), take)) return;  // conn dead
+        if (chained && !send_all(s->down_fd, batch.data(), take)) {
+            std::lock_guard<std::mutex> g(s->mu);
+            s->down_dead = true;
+            chained = false;
+        }
+        if (code == stOK) {
+            // verify piece CRCs, then land the whole batch with ONE
+            // flock + ONE data pwrite + ONE CRC-table pwrite (vs 3
+            // syscalls per 64 KiB block)
+            uint32_t nb = (take + kBlockSize - 1) / kBlockSize;
+            uint32_t first_block = (part_offset + done) / kBlockSize;
+            static thread_local std::vector<uint8_t> slot_be;
+            slot_be.resize(4 * nb);
+            for (uint32_t b = 0; b < nb && code == stOK; ++b) {
+                uint32_t piece_len =
+                    std::min(kBlockSize, take - b * kBlockSize);
+                uint32_t wire_crc =
+                    get32(crcs.data() + 4 * ((done / kBlockSize) + b));
+                if (lz_crc32(0, batch.data() + b * kBlockSize, piece_len) !=
+                    wire_crc) {
+                    code = stCRC_ERROR;
+                    break;
+                }
+                if (first_block + b >=
+                    static_cast<uint32_t>(s->max_blocks)) {
+                    code = stINDEX_TOO_BIG;
+                    break;
+                }
+                slot_be[4 * b] = 0;  // patched below
+                put32(slot_be.data() + 4 * b, wire_crc);
+            }
+            if (code == stOK) {
+                uint64_t pos = kHeaderSize +
+                               static_cast<uint64_t>(first_block) * kBlockSize;
+                ::flock(s->fd, LOCK_EX);
+                // a partial tail piece rewrites only its bytes but the
+                // stored CRC must cover the FULL (zero-padded) block
+                uint32_t tail = take % kBlockSize;
+                if (tail != 0) {
+                    static thread_local std::vector<uint8_t> blockbuf;
+                    blockbuf.assign(kBlockSize, 0);
+                    uint64_t tpos = pos + (nb - 1ull) * kBlockSize;
+                    ssize_t n = ::pread(s->fd, blockbuf.data(), kBlockSize,
+                                        tpos);
+                    if (n < 0) n = 0;
+                    if (static_cast<size_t>(n) < kBlockSize)
+                        std::memset(blockbuf.data() + n, 0, kBlockSize - n);
+                    std::memcpy(blockbuf.data(),
+                                batch.data() + (nb - 1) * kBlockSize, tail);
+                    put32(slot_be.data() + 4 * (nb - 1),
+                          lz_crc32(0, blockbuf.data(), kBlockSize));
+                    if (::pwrite(s->fd, blockbuf.data(), kBlockSize, tpos) !=
+                        static_cast<ssize_t>(kBlockSize))
+                        code = stEIO;
+                    if (nb > 1 &&
+                        ::pwrite(s->fd, batch.data(),
+                                 (nb - 1ull) * kBlockSize, pos) !=
+                            static_cast<ssize_t>((nb - 1ull) * kBlockSize))
+                        code = stEIO;
+                } else if (::pwrite(s->fd, batch.data(), take, pos) !=
+                           static_cast<ssize_t>(take)) {
+                    code = stEIO;
+                }
+                if (code == stOK &&
+                    ::pwrite(s->fd, slot_be.data(), slot_be.size(),
+                             kSignatureSize + 4ull * first_block) !=
+                        static_cast<ssize_t>(slot_be.size()))
+                    code = stEIO;
+                ::flock(s->fd, LOCK_UN);
+                if (code == stOK) {
+                    srv.bytes_written.fetch_add(take,
+                                                std::memory_order_relaxed);
+                    srv.write_ops.fetch_add(1, std::memory_order_relaxed);
+                }
+            }
+        }
+        done += take;
+    }
+    *conn_ok = true;  // frame fully consumed; socket still in sync
+
+    bool down_was_dead = false;
+    if (s != nullptr && s->down_fd >= 0) {
+        std::lock_guard<std::mutex> g(s->mu);
+        down_was_dead = s->down_dead;
+    }
+    if (s == nullptr || s->down_fd < 0 || down_was_dead) {
+        uint8_t combined = code;
+        if (s != nullptr && s->down_fd >= 0 && down_was_dead &&
+            combined == stOK)
+            combined = stDISCONNECTED;
+        send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id,
+                    write_id, combined);
+        return;
+    }
+    bool ack_now = false;
+    uint8_t combined = code;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto d = s->down_acked.find(write_id);
+        if (d != s->down_acked.end()) {
+            combined = code != stOK ? code : d->second;
+            s->down_acked.erase(d);
+            ack_now = true;
+        } else if (s->down_dead) {
+            combined = code != stOK ? code : stDISCONNECTED;
+            ack_now = true;
+        } else {
+            s->local_done[write_id] = code;
+        }
+    }
+    if (ack_now) {
+        send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id,
+                    write_id, combined);
+    }
+}
+
+// --- connection / accept loops --------------------------------------------
+
+void connection_loop(Server& srv, int cfd) {
+    set_bulk_sockopts(cfd);
+    std::unordered_map<uint64_t, WriteSession*> sessions;
+    std::mutex send_mu;
+    std::vector<uint8_t> frame;
+    for (;;) {
+        uint8_t header[8];
+        if (!recv_all(cfd, header, 8)) break;
+        uint32_t type = get32(header);
+        uint32_t length = get32(header + 4);
+        if (type == kTypeWriteBulk) {
+            // streamed: the frame may be tens of MiB and never lands in
+            // one buffer
+            if (length < 1 || length > (96u << 20)) break;
+            bool conn_ok = false;
+            serve_write_bulk(srv, cfd, &send_mu, header, length, &sessions,
+                             &conn_ok);
+            if (!conn_ok) break;
+            continue;
+        }
+        if (length < 1 || length > kMaxFrame) break;
+        frame.resize(8 + length);
+        std::memcpy(frame.data(), header, 8);
+        if (!recv_all(cfd, frame.data() + 8, length)) break;
+        if (frame[8] != kProtoVersion) break;
+        const uint8_t* body = frame.data() + 9;
+        uint32_t blen = length - 1;
+        if (type == kTypeRead && blen >= 28) {
+            serve_read(srv, cfd, &send_mu, body);
+        } else if (type == kTypeReadBulk && blen >= 28) {
+            serve_read_bulk(srv, cfd, &send_mu, body);
+        } else if (type == kTypeWriteData) {
+            serve_write_data(srv, cfd, &send_mu, frame.data(),
+                             static_cast<uint32_t>(frame.size()), &sessions);
+        } else if (type == kTypeWriteInit) {
+            serve_write_init(srv, cfd, &send_mu, body, blen, &sessions);
+        } else if (type == kTypeWriteEnd && blen >= 12) {
+            uint32_t req_id = get32(body);
+            uint64_t chunk_id = get64(body + 4);
+            auto it = sessions.find(chunk_id);
+            if (it != sessions.end()) {
+                WriteSession* s = it->second;
+                if (s->down_fd >= 0) {
+                    send_all(s->down_fd, frame.data(), frame.size());
+                }
+                sessions.erase(it);
+                teardown_session(s);
+            }
+            send_status(cfd, &send_mu, kTypeWriteStatus, req_id, chunk_id, 0,
+                        stOK);
+        } else if (type == kTypePrefetch && blen >= 28) {
+            uint64_t chunk_id = get64(body + 4);
+            uint32_t version = get32(body + 12);
+            uint32_t offset = get32(body + 20);
+            uint32_t size = get32(body + 24);
+            std::string path;
+            if (resolve_chunk(srv.folders, chunk_id, version, &path) == stOK) {
+                Sig sig{};
+                int fd = open_chunk(path, /*rw=*/false, &sig);
+                if (fd >= 0) {
+                    ::posix_fadvise(fd, kHeaderSize + offset, size,
+                                    POSIX_FADV_WILLNEED);
+                    ::close(fd);
+                }
+            }
+        } else {
+            break;  // not a data-plane frame: this port serves data only
+        }
+    }
+    for (auto& kv : sessions) teardown_session(kv.second);
+    {
+        std::lock_guard<std::mutex> g(srv.conn_mu);
+        auto it = std::find(srv.conn_fds.begin(), srv.conn_fds.end(), cfd);
+        if (it != srv.conn_fds.end()) srv.conn_fds.erase(it);
+    }
+    ::close(cfd);
+    {
+        std::lock_guard<std::mutex> g(srv.conn_mu);
+        --srv.active_conns;
+    }
+    srv.conn_cv.notify_all();
+}
+
+void accept_loop(Server* srv) {
+    for (;;) {
+        int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+        if (cfd < 0) {
+            if (errno == EINTR) continue;
+            break;  // listen fd closed: stopping
+        }
+        if (srv->stopping.load()) {
+            ::close(cfd);
+            break;
+        }
+        {
+            std::lock_guard<std::mutex> g(srv->conn_mu);
+            srv->conn_fds.push_back(cfd);
+            ++srv->active_conns;
+        }
+        std::thread([srv, cfd] { connection_loop(*srv, cfd); }).detach();
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a data-plane server over newline-separated data folders.
+// Returns a handle >= 0, or -1.  port 0 = ephemeral (query lz_serve_port).
+int lz_serve_start(const char* folders_nl, const char* host, int port) {
+    auto srv = std::make_unique<Server>();
+    const char* p = folders_nl;
+    while (p != nullptr && *p) {
+        const char* nl = std::strchr(p, '\n');
+        size_t len = nl != nullptr ? static_cast<size_t>(nl - p)
+                                   : std::strlen(p);
+        if (len) srv->folders.emplace_back(p, len);
+        p = nl != nullptr ? nl + 1 : nullptr;
+    }
+    if (srv->folders.empty()) return -1;
+
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr {};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd);
+        return -1;
+    }
+    if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(fd, 128) < 0) {
+        ::close(fd);
+        return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+    srv->listen_fd = fd;
+    srv->port = ntohs(addr.sin_port);
+    Server* raw = srv.release();
+    raw->accept_thread = std::thread(accept_loop, raw);
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    g_servers.push_back(raw);
+    return static_cast<int>(g_servers.size() - 1);
+}
+
+int lz_serve_port(int handle) {
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+        g_servers[handle] == nullptr)
+        return -1;
+    return g_servers[handle]->port;
+}
+
+void lz_serve_stop(int handle) {
+    Server* srv = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_servers_mu);
+        if (handle < 0 || handle >= static_cast<int>(g_servers.size()))
+            return;
+        srv = g_servers[handle];
+        g_servers[handle] = nullptr;
+    }
+    if (srv == nullptr) return;
+    srv->stopping.store(true);
+    ::shutdown(srv->listen_fd, SHUT_RDWR);
+    ::close(srv->listen_fd);
+    if (srv->accept_thread.joinable()) srv->accept_thread.join();
+    bool drained;
+    {
+        std::unique_lock<std::mutex> g(srv->conn_mu);
+        for (int cfd : srv->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+        drained = srv->conn_cv.wait_for(
+            g, std::chrono::seconds(10),
+            [srv] { return srv->active_conns == 0; });
+    }
+    // a straggler thread past the timeout still references srv: leak it
+    // rather than free memory under a live thread
+    if (drained) delete srv;
+}
+
+void lz_serve_stats(int handle, uint64_t* out) {
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+        g_servers[handle] == nullptr) {
+        out[0] = out[1] = out[2] = out[3] = 0;
+        return;
+    }
+    Server* srv = g_servers[handle];
+    out[0] = srv->bytes_read.load();
+    out[1] = srv->bytes_written.load();
+    out[2] = srv->read_ops.load();
+    out[3] = srv->write_ops.load();
+}
+
+}  // extern "C"

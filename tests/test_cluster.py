@@ -30,9 +30,10 @@ def make_goals():
 
 
 class Cluster:
-    def __init__(self, tmp_path, n_cs: int = 6):
+    def __init__(self, tmp_path, n_cs: int = 6, native_data_plane: bool = True):
         self.tmp_path = tmp_path
         self.n_cs = n_cs
+        self.native_data_plane = native_data_plane
         self.master: MasterServer | None = None
         self.chunkservers: list[ChunkServer] = []
         self.clients: list[Client] = []
@@ -49,6 +50,7 @@ class Cluster:
                 str(self.tmp_path / f"cs{i}"),
                 master_addr=("127.0.0.1", self.master.port),
                 wave_timeout=0.2,
+                native_data_plane=self.native_data_plane,
             )
             await cs.start()
             self.chunkservers.append(cs)

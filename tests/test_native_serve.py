@@ -16,16 +16,20 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.mark.asyncio
 async def test_native_serve_read_roundtrip(tmp_path, monkeypatch):
-    """A large read must be streamed by the native path, byte-identical."""
+    """With the C++ data-plane listener off, a large read must still be
+    served by the asyncio server's bulk fallback path (builds without
+    the full data plane), byte-identical."""
+    from lizardfs_tpu.chunkserver.server import ChunkServer
+
     calls = []
-    real = native_io.stream_read_blocking
+    real = ChunkServer._serve_read_bulk
 
-    def spy(*args):
-        calls.append(args)
-        return real(*args)
+    async def spy(self, writer, msg):
+        calls.append(msg)
+        return await real(self, writer, msg)
 
-    monkeypatch.setattr(native_io, "stream_read_blocking", spy)
-    cluster = Cluster(tmp_path, n_cs=2)
+    monkeypatch.setattr(ChunkServer, "_serve_read_bulk", spy)
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
     await cluster.start()
     try:
         c = await cluster.client()

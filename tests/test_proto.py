@@ -59,6 +59,7 @@ def test_serializer_roundtrips():
             chunks=[m.ChunkPartInfo(chunk_id=1, version=1, part_id=650)],
             total_space=1 << 40,
             used_space=123,
+            data_port=9423,
         )
     )
     roundtrip(m.MatomlChangelogLine(version=42, line="CREATE(1,foo)"))
