@@ -50,6 +50,11 @@ def _flocked(f, exclusive: bool):
     try:
         yield
     finally:
+        # drain Python's userspace buffer while the lock is still held —
+        # otherwise the trailing CRC-slot write lands after LOCK_UN and
+        # a reader in the window sees new data with a stale CRC
+        if exclusive:
+            f.flush()
         fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
 

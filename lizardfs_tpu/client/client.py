@@ -543,6 +543,7 @@ class Client:
         finally:
             for t in tasks:
                 t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
         if old_length > total:
             await self.truncate(inode, total)
 
@@ -923,6 +924,10 @@ class Client:
         finally:
             for t in tasks:
                 t.cancel()
+            # join the stragglers: their native reader threads may still
+            # be scattering into `out`; the caller must never see the
+            # exception before every writer is done with the buffer
+            await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _read_chunk_range(
         self, inode: int, chunk_index: int, off: int, size: int,
@@ -954,9 +959,15 @@ class Client:
                 if len(joined) >= rel + size:
                     return np.frombuffer(joined, dtype=np.uint8)[rel : rel + size]
 
-        # block-align the request and extend by the readahead window
+        # block-align the request and extend by the readahead window;
+        # bulk reads skip the extension — they bypass the cache, so
+        # extra bytes would be fetched only to be discarded, and an
+        # extended range disqualifies the zero-copy direct scatter
         adviser = self._readahead.setdefault(inode, ReadaheadAdviser())
-        extra = adviser.advise(chunk_index * MFSCHUNKSIZE + off, size)
+        extra = (
+            0 if bulk
+            else adviser.advise(chunk_index * MFSCHUNKSIZE + off, size)
+        )
         aligned_off = lo_b * MFSBLOCKSIZE
         aligned_end = min(
             -(-(off + size + extra) // MFSBLOCKSIZE) * MFSBLOCKSIZE, chunk_len
