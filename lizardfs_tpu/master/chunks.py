@@ -92,8 +92,19 @@ class ChunkRegistry:
         self.servers: dict[int, ChunkServerInfo] = {}
         self.next_chunk_id = 1
         self.next_cs_id = 1
-        # endangered queue served before routine work (chunks.cc:2562)
-        self.endangered: list[int] = []
+        # endangered queue served before routine work (chunks.cc:2562):
+        # FIFO + membership set, O(1) push/pop — NOT a scan cursor; the
+        # routine walk below keeps its own cursor
+        from collections import deque
+
+        self.endangered: deque[int] = deque()
+        self._endangered_set: set[int] = set()
+        # persistent background-scan cursor (chunks.cc:1807-1830
+        # ChunkWorker coroutine analog): the id list snapshots once per
+        # full cycle instead of being rebuilt every tick
+        self._scan_ids: list[int] = []
+        self._scan_idx = 0
+        self._rebalance_ids: list[int] = []
         # chunks released from metadata whose on-disk parts still need
         # deleting on chunkservers (drained by the master's health tick;
         # bounded so an idle shadow doesn't grow it forever)
@@ -229,7 +240,8 @@ class ChunkRegistry:
         return RedundancyState(missing, redundant, safe, readable)
 
     def mark_endangered(self, chunk_id: int) -> None:
-        if chunk_id not in self.endangered:
+        if chunk_id not in self._endangered_set:
+            self._endangered_set.add(chunk_id)
             self.endangered.append(chunk_id)
 
     # --- server selection (get_servers_for_new_chunk analog) ----------------------
@@ -288,19 +300,57 @@ class ChunkRegistry:
 
     # --- health walk (ChunkWorker coroutine analog) --------------------------------
 
+    # routine-scan evaluation budget per tick: bounds event-loop time
+    # regardless of table size (the endangered queue is served first and
+    # separately)
+    SCAN_BUDGET = 256
+
+    def _scan_batch(self, n: int) -> list[int]:
+        """Next ``n`` chunk ids from the persistent cursor; the id list
+        re-snapshots once per full cycle (O(all chunks) amortized over
+        a whole sweep, never per tick)."""
+        if self._scan_idx >= len(self._scan_ids):
+            self._scan_ids = list(self.chunks.keys())
+            self._scan_idx = 0
+            if not self._scan_ids:
+                return []
+        batch = self._scan_ids[self._scan_idx : self._scan_idx + n]
+        self._scan_idx += len(batch)
+        return batch
+
     def health_work(self, limit: int = 64):
         """Yield up to ``limit`` work items: ('replicate', chunk, part) or
-        ('delete', chunk, cs_id, part). Endangered chunks first."""
+        ('delete', chunk, cs_id, part).
+
+        Endangered chunks drain FIRST from a real FIFO (items that don't
+        fit this tick simply stay queued); the routine walk then resumes
+        from its cursor with a bounded evaluation budget — one tick costs
+        O(limit + SCAN_BUDGET) whatever the table size."""
         out = []
-        priority = set(self.endangered)
-        queue = list(self.endangered)
-        self.endangered.clear()
-        queue.extend(cid for cid in self.chunks if cid not in priority)
-        for i, cid in enumerate(queue):
+        # 1) priority: endangered queue. Evaluation-bounded too — after
+        # a chunkserver bounce the whole table may be queued but mostly
+        # healthy again, and popping it all in one tick would be an
+        # O(all chunks) stall.
+        pops = 0
+        while self.endangered and len(out) < limit and pops < self.SCAN_BUDGET:
+            pops += 1
+            cid = self.endangered.popleft()
+            self._endangered_set.discard(cid)
+            chunk = self.chunks.get(cid)
+            if chunk is None:
+                continue
+            state = self.evaluate(chunk)
+            for p in state.missing_parts:
+                out.append(("replicate", chunk, p))
+            for cs_id, p in state.redundant:
+                out.append(("delete", chunk, cs_id, p))
+        # 2) routine: bounded cursor walk; if the tick fills up, rewind
+        # the cursor over the unvisited remainder — next tick resumes
+        # exactly there
+        batch = self._scan_batch(self.SCAN_BUDGET)
+        for i, cid in enumerate(batch):
             if len(out) >= limit:
-                # leave the unprocessed tail for the next round
-                for c in queue[i:]:
-                    self.mark_endangered(c)
+                self._scan_idx -= len(batch) - i
                 break
             chunk = self.chunks.get(cid)
             if chunk is None:
@@ -337,15 +387,19 @@ class ChunkRegistry:
         now = time.monotonic()
         # bounded scan with a persistent cursor: never walk the whole
         # chunk table in one health tick (millions of chunks would stall
-        # the event loop while the gap persists with no eligible chunk)
-        ids = list(self.chunks.keys())
+        # the event loop while the gap persists with no eligible chunk);
+        # the id snapshot refreshes once per wrap, not per call
+        if self._rebalance_cursor >= len(self._rebalance_ids):
+            self._rebalance_ids = list(self.chunks.keys())
+            self._rebalance_cursor = 0
+        ids = self._rebalance_ids
         if not ids:
             return None
-        start = self._rebalance_cursor % len(ids)
-        budget = min(len(ids), 512)
+        start = self._rebalance_cursor
+        budget = min(len(ids) - start, 512)
         for i in range(budget):
-            cid = ids[(start + i) % len(ids)]
-            self._rebalance_cursor = (start + i + 1) % len(ids)
+            cid = ids[start + i]
+            self._rebalance_cursor = start + i + 1
             chunk = self.chunks.get(cid)
             if chunk is None or chunk.locked_until > now:
                 continue

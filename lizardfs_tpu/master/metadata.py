@@ -29,6 +29,9 @@ class MetadataStore:
         # re-issues an id whose locks are still held (sessions.mfs
         # analog for the id space; live connection state stays local)
         self.next_session = 1
+        # incremental metadata digest (see checksum())
+        self._digest = 0
+        self.reset_digest()
 
     # --- op application (the one true mutation path) -------------------------
 
@@ -36,7 +39,22 @@ class MetadataStore:
         fn = getattr(self, "_op_" + op["op"], None)
         if fn is None:
             raise ValueError(f"unknown op {op['op']!r}")
+        # incremental digest (filesystem_checksum.cc analog): XOR out
+        # the touched entities' pre-state hashes, apply, XOR in their
+        # post-state hashes. _touched(op) must include every entity that
+        # existed before AND may change; entities that appear only after
+        # the op (post-only keys) hashed 0 before, so the union form is
+        # exact for them. entity_hash(missing) == 0 by convention.
+        keys = self._touched(op)
+        delta = 0
+        for key in keys:
+            delta ^= self._entity_hash(key)
         fn(op)
+        # unchanged keys cancel (h ^ h == 0); changed keys contribute
+        # pre ^ post; post-only keys contribute their fresh hash once
+        for key in keys | self._touched(op):
+            delta ^= self._entity_hash(key)
+        self._digest ^= delta
 
     def _op_mknode(self, op):
         self.fs.apply_mknode(
@@ -258,25 +276,233 @@ class MetadataStore:
                     Range(start, end, ltype, Owner(sid, token))
                     for start, end, ltype, sid, token in rows
                 ]
+        self.reset_digest()
+
+    # --- incremental checksum (filesystem_checksum.cc analog) ---------------
+    #
+    # The digest is the XOR of 128-bit hashes of every persistent entity:
+    # nodes, trash entries, chunks, quota entries, per-inode lock tables,
+    # and a misc tuple of allocator counters. apply() maintains it in
+    # O(touched entities) per op; full_digest() recomputes from scratch
+    # (used at load, by offline tools, and by the background verifier in
+    # the image-dump child — the filesystem_checksum_background_updater
+    # analog). Derived aggregates (directory stat_inodes/stat_bytes) are
+    # excluded: they are recomputable and would make every write touch
+    # its whole ancestor chain.
+
+    def _h(self, *parts) -> int:
+        import hashlib
+
+        b = hashlib.blake2b(repr(parts).encode(), digest_size=16)
+        return int.from_bytes(b.digest(), "big")
+
+    def _entity_hash(self, key: tuple) -> int:
+        kind = key[0]
+        if kind == "node":
+            n = self.fs.nodes.get(key[1])
+            if n is None:
+                return 0
+            # children are hashed as separate ("edge", parent, name)
+            # entities — otherwise every create in a directory would
+            # hash the whole directory (O(children) per op); derived
+            # stats are excluded as recomputable. Collections with
+            # nondeterministic order (xattrs, acls) canonicalize.
+            import json
+
+            return self._h(
+                "node", n.inode, n.ftype, n.mode, n.uid, n.gid, n.atime,
+                n.mtime, n.ctime, n.goal, n.trash_time, n.nlink,
+                tuple(n.parents),
+                tuple(sorted(n.xattrs.items())) if n.xattrs else (),
+                json.dumps(n.acl, sort_keys=True),
+                json.dumps(n.default_acl, sort_keys=True),
+                json.dumps(n.rich_acl, sort_keys=True),
+                n.length, tuple(n.chunks) if n.chunks else (),
+                n.symlink_target,
+            )
+        if kind == "edge":
+            p = self.fs.nodes.get(key[1])
+            if p is None or p.ftype != 2:
+                return 0
+            child = p.children.get(key[2])
+            return 0 if child is None else self._h("edge", key[1], key[2],
+                                                   child)
+        if kind == "trash":
+            entry = self.fs.trash.get(key[1])
+            return 0 if entry is None else self._h("trash", key[1], tuple(entry))
+        if kind == "chunk":
+            c = self.registry.chunks.get(key[1])
+            if c is None:
+                return 0
+            return self._h(
+                "chunk", c.chunk_id, c.version, c.slice_type, c.copies,
+                c.refcount, c.goal_id,
+            )
+        if kind == "quota":
+            e = self.quotas.entries.get((key[1], key[2]))
+            if e is None:
+                return 0
+            import json
+
+            return self._h("quota", key[1], key[2],
+                           json.dumps(e.to_dict(), sort_keys=True))
+        if kind == "locks":
+            table = (self.locks.posix_files if key[1] == "posix"
+                     else self.locks.flock_files)
+            fl = table.get(key[2])
+            if fl is None or not fl.ranges:
+                return 0
+            return self._h("locks", key[1], key[2], [
+                (r.start, r.end, r.ltype, r.owner.session_id, r.owner.token)
+                for r in fl.ranges
+            ])
+        if kind == "misc":
+            # next_inode / next_chunk_id are EXCLUDED: the server
+            # pre-reserves them outside apply() (alloc_inode, chunk-id
+            # reservation), and apply maintains them monotonically via
+            # max(), so shadows converge on them from the ops alone
+            return self._h("misc", self.next_session)
+        raise ValueError(f"unknown entity kind {kind!r}")
+
+    def _touched(self, op: dict) -> set[tuple]:
+        """Entities whose state the op may change — evaluated against
+        the CURRENT state (called both before and after apply; must be a
+        superset of reality each time)."""
+        t = op["op"]
+        out: set[tuple] = {("misc",)}
+        fs = self.fs
+
+        def node_quota(inode):
+            n = fs.nodes.get(inode)
+            if n is not None:
+                out.add(("quota", "user", n.uid))
+                out.add(("quota", "group", n.gid))
+
+        def node_chunks(inode):
+            n = fs.nodes.get(inode)
+            if n is not None:
+                for cid in getattr(n, "chunks", ()):
+                    if cid:
+                        out.add(("chunk", cid))
+
+        def child_of(parent, name):
+            p = fs.nodes.get(parent)
+            if p is not None and p.ftype == 2:
+                c = p.children.get(name)
+                if c is not None:
+                    out.add(("node", c))
+                    out.add(("trash", c))
+                    node_quota(c)
+                    node_chunks(c)
+
+        if t == "mknode":
+            out |= {("node", op["parent"]), ("node", op["inode"]),
+                    ("edge", op["parent"], op["name"]),
+                    ("quota", "user", op["uid"]),
+                    ("quota", "group", op["gid"])}
+        elif t in ("unlink", "rmdir"):
+            out.add(("node", op["parent"]))
+            out.add(("edge", op["parent"], op["name"]))
+            child_of(op["parent"], op["name"])
+        elif t == "rename":
+            out |= {("node", op["parent_src"]), ("node", op["parent_dst"]),
+                    ("edge", op["parent_src"], op["name_src"]),
+                    ("edge", op["parent_dst"], op["name_dst"])}
+            child_of(op["parent_src"], op["name_src"])
+            child_of(op["parent_dst"], op["name_dst"])
+        elif t == "link":
+            out |= {("node", op["inode"]), ("node", op["parent"]),
+                    ("edge", op["parent"], op["name"])}
+        elif t in ("setattr", "setgoal", "set_chunk", "set_acl",
+                   "set_rich_acl", "set_xattr"):
+            out.add(("node", op["inode"]))
+        elif t == "set_length":
+            out.add(("node", op["inode"]))
+            node_quota(op["inode"])
+            node_chunks(op["inode"])
+        elif t in ("create_chunk", "bump_chunk_version", "delete_chunk"):
+            out.add(("chunk", op["chunk_id"]))
+        elif t in ("purge_trash", "undelete"):
+            out |= {("node", op["inode"]), ("trash", op["inode"])}
+            node_quota(op["inode"])
+            node_chunks(op["inode"])
+            entry = fs.trash.get(op["inode"])
+            if entry is not None:
+                out.add(("node", entry[2]))  # restore target dir
+            out.add(("node", 1))  # undelete falls back to the root
+            n = fs.nodes.get(op["inode"])
+            if n is not None:
+                # the restored edge's name may have a collision suffix:
+                # find it by child inode (post state; rare op)
+                for p in n.parents:
+                    out.add(("node", p))
+                    pn = fs.nodes.get(p)
+                    if pn is not None and pn.ftype == 2:
+                        for name, child in pn.children.items():
+                            if child == op["inode"]:
+                                out.add(("edge", p, name))
+        elif t == "set_quota":
+            out.add(("quota", op["kind"], op["owner_id"]))
+        elif t == "snapshot":
+            out.add(("node", op["dst_parent"]))
+            out.add(("edge", op["dst_parent"], op["dst_name"]))
+            for old_s, new in op["inode_map"].items():
+                out |= {("node", int(old_s)), ("node", new)}
+                node_chunks(int(old_s))
+                node_chunks(new)
+                node_quota(int(old_s))
+                # cloned directories bring fresh edges (post-only keys)
+                nn = fs.nodes.get(new)
+                if nn is not None and nn.ftype == 2:
+                    for name in nn.children:
+                        out.add(("edge", new, name))
+        elif t == "cow_chunk":
+            out |= {("chunk", op["old_chunk_id"]),
+                    ("chunk", op["new_chunk_id"]), ("node", op["inode"])}
+        elif t in ("lock_posix", "lock_flock"):
+            kind = "posix" if t == "lock_posix" else "flock"
+            out.add(("locks", kind, op["inode"]))
+        elif t == "lock_release_session":
+            sid = op["sid"]
+            for kind, table in (("posix", self.locks.posix_files),
+                                ("flock", self.locks.flock_files)):
+                for inode, fl in table.items():
+                    if any(r.owner.session_id == sid for r in fl.ranges):
+                        out.add(("locks", kind, inode))
+        elif t == "session_new":
+            pass  # misc only
+        return out
+
+    def full_digest(self) -> int:
+        """Recompute the digest from scratch (O(everything))."""
+        d = self._entity_hash(("misc",))
+        for inode, n in self.fs.nodes.items():
+            d ^= self._entity_hash(("node", inode))
+            if n.ftype == 2:
+                for name in n.children:
+                    d ^= self._entity_hash(("edge", inode, name))
+        for inode in self.fs.trash:
+            d ^= self._entity_hash(("trash", inode))
+        for cid in self.registry.chunks:
+            d ^= self._entity_hash(("chunk", cid))
+        for kind, oid in self.quotas.entries:
+            d ^= self._entity_hash(("quota", kind, oid))
+        for lkind, table in (("posix", self.locks.posix_files),
+                             ("flock", self.locks.flock_files)):
+            for inode in table:
+                d ^= self._entity_hash(("locks", lkind, inode))
+        return d
 
     def checksum(self, cache_key: int | None = None) -> str:
-        """Divergence-detection digest over FS + persistent chunk state.
+        """Divergence-detection digest over the persistent metadata.
 
-        ``cache_key`` (the changelog version) memoizes the digest so
-        repeated probes at the same version cost nothing; the full
-        serialization still runs once per version — an incremental
-        checksum (the reference's filesystem_checksum) is the scaling
-        follow-up.
-        """
-        import hashlib
-        import json
+        Maintained INCREMENTALLY per applied op (the reference's
+        filesystem_checksum.cc); a probe costs O(1) no matter the
+        namespace size. ``cache_key`` is accepted for interface
+        compatibility and ignored."""
+        return f"{self._digest:032x}"
 
-        if cache_key is not None and getattr(
-            self, "_checksum_cache", (None, None)
-        )[0] == cache_key:
-            return self._checksum_cache[1]
-        blob = json.dumps(self.to_sections(), sort_keys=True).encode()
-        digest = hashlib.sha256(blob).hexdigest()
-        if cache_key is not None:
-            self._checksum_cache = (cache_key, digest)
-        return digest
+    def reset_digest(self) -> None:
+        """Re-anchor the incremental digest to current state (after a
+        bulk load or verified drift)."""
+        self._digest = self.full_digest()

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 
 from lizardfs_tpu.constants import MFSCHUNKSIZE
@@ -145,10 +146,13 @@ class MasterServer(Daemon):
             self.meta.load_sections(doc)
             sess = doc.get("sessions", {})
             # legacy-image fallback only; the authoritative counter is
-            # metadata's replicated next_session
+            # metadata's replicated next_session. O(1) digest fixup —
+            # only the misc entity changes.
+            old_misc = self.meta._entity_hash(("misc",))
             self.meta.next_session = max(
                 self.meta.next_session, int(sess.get("next", 1))
             )
+            self.meta._digest ^= old_misc ^ self.meta._entity_hash(("misc",))
             for sid, row in sess.get("known", {}).items():
                 self.sessions[int(sid)] = {
                     "info": row.get("info", ""), "connected": False,
@@ -218,23 +222,84 @@ class MasterServer(Daemon):
 
     async def _dump_image(self) -> None:
         version = self.changelog.version
-        sections = self.meta.to_sections()
         # persist session registry (sessions.mfs analog): ids survive a
         # master restart so reconnecting clients keep their session ids.
         # Only LIVE sessions are persisted — one-shot CLI sessions would
         # otherwise accumulate in every image forever.
-        sections["sessions"] = {
-
+        sessions_section = {
             "known": {
                 str(sid): {"info": s.get("info", "")}
                 for sid, s in self.sessions.items()
                 if s.get("connected")
             },
         }
-        # serialization + fsync off the event loop (MetadataDumper analog)
-        await asyncio.to_thread(save_image, self.data_dir, version, sections)
-        self.changelog.rotate()
-        self.changelog.open()
+        # MetadataDumper analog (metadata_dumper.h:37): fork and let the
+        # CHILD serialize the copy-on-write snapshot — the master's loop
+        # blocks only for the fork itself (page-table copy), not for the
+        # O(namespace) serialization. The fork happens synchronously
+        # here, so the snapshot is consistent with `version`.
+        ok = False
+        try:
+            pid = os.fork()
+        except OSError:
+            pid = -1
+        inc_digest = self.meta._digest
+        if pid == 0:
+            code = 1
+            try:
+                sections = self.meta.to_sections()
+                sections["sessions"] = sessions_section
+                save_image(self.data_dir, version, sections)
+                # background checksum verification on the CO-W snapshot
+                # (filesystem_checksum_background_updater analog): the
+                # full recompute costs the child, not the serving loop
+                code = 3 if self.meta.full_digest() != inc_digest else 0
+            finally:
+                os._exit(code)
+        elif pid > 0:
+            rc = await self._wait_child(pid, timeout=600.0)
+            ok = rc in (0, 3)
+            if rc == 3:
+                self.log.error(
+                    "incremental metadata digest drift detected (v%d); "
+                    "re-anchoring", version,
+                )
+                self.metrics.counter("digest_drift").inc()
+                self.meta.reset_digest()
+            elif not ok:
+                self.log.error("forked metadata dump failed (v%d)", version)
+        else:
+            # no fork (exotic platform): serialize on the loop thread's
+            # snapshot, write off-loop
+            sections = self.meta.to_sections()
+            sections["sessions"] = sessions_section
+            await asyncio.to_thread(save_image, self.data_dir, version, sections)
+            ok = True
+        if ok:
+            self.changelog.rotate()
+            self.changelog.open()
+
+    async def _wait_child(self, pid: int, timeout: float) -> int:
+        """Reap a forked worker with a deadline: a child deadlocked by a
+        lock some other thread held at fork time (the classic fork+
+        threads hazard) must not stall dumps forever. Returns the exit
+        code, or -1 on timeout/kill."""
+        import signal
+
+        deadline = time.monotonic() + timeout
+        while True:
+            wpid, status = os.waitpid(pid, os.WNOHANG)
+            if wpid == pid:
+                return os.waitstatus_to_exitcode(status)
+            if time.monotonic() >= deadline:
+                self.log.error("forked worker %d hung; killing", pid)
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                await asyncio.to_thread(os.waitpid, pid, 0)
+                return -1
+            await asyncio.sleep(0.05)
 
     _ORPHAN_LOCK_TIMEOUT = 60.0
 
@@ -1587,15 +1652,50 @@ class MasterServer(Daemon):
             return
         if doc.get("version") != self.changelog.version:
             return  # mid-catch-up; compare only at equal versions
-        if doc.get("checksum") != self.meta.checksum(self.changelog.version):
-            self.log.error(
-                "shadow metadata DIVERGED from active at v%d — "
-                "re-downloading the image", self.changelog.version,
-            )
-            self._force_image_download = True
-            w = getattr(self, "_follow_writer", None)
-            if w is not None:
-                w.close()  # the follow loop reconnects and re-downloads
+        # O(1) fast path: compare incremental digests. A full
+        # recomputation (which alone can see state corrupted outside
+        # apply()) runs in a FORKED child — O(namespace) must not stall
+        # the shadow's replication loop — on mismatch and periodically
+        # (background-updater analog).
+        active_sum = doc.get("checksum")
+        self._verify_probe_n = getattr(self, "_verify_probe_n", 0) + 1
+        if (active_sum == self.meta.checksum()
+                and self._verify_probe_n % 20 != 0):
+            return  # fast-path match; deep check runs every 20th probe
+        try:
+            pid = os.fork()
+        except OSError:
+            pid = -1
+        if pid == 0:
+            code = 1
+            try:
+                code = (
+                    0 if f"{self.meta.full_digest():032x}" == active_sum
+                    else 2
+                )
+            finally:
+                os._exit(code)
+        if pid > 0:
+            rc = await self._wait_child(pid, timeout=600.0)
+        else:  # fork unavailable: recompute on the loop (degraded)
+            rc = 0 if f"{self.meta.full_digest():032x}" == active_sum else 2
+        if rc == 0:
+            if active_sum != self.meta.checksum():
+                # state matches the active; only the local incremental
+                # digest drifted — re-anchor (rare, O(namespace))
+                self.log.warning(
+                    "shadow incremental digest drift; re-anchoring"
+                )
+                self.meta.reset_digest()
+            return
+        self.log.error(
+            "shadow metadata DIVERGED from active at v%d — "
+            "re-downloading the image", self.changelog.version,
+        )
+        self._force_image_download = True
+        w = getattr(self, "_follow_writer", None)
+        if w is not None:
+            w.close()  # the follow loop reconnects and re-downloads
 
     async def _shadow_follow_once(self) -> None:
         reader, writer = await asyncio.open_connection(*self.active_addr)

@@ -322,9 +322,12 @@ async def test_shadow_detects_divergence_and_heals(tmp_path):
             await asyncio.sleep(0.05)
         assert shadow.meta.checksum() == active.meta.checksum()
 
-        # corrupt the shadow's in-memory state behind its back
+        # corrupt the shadow's in-memory state behind its back. The
+        # O(1) incremental digest cannot see out-of-band corruption —
+        # the verify probe recomputes from scratch (background-updater
+        # analog), which is what must detect it:
         shadow.meta.fs.node(1).mode = 0o123
-        assert shadow.meta.checksum() != active.meta.checksum()
+        assert f"{shadow.meta.full_digest():032x}" != active.meta.checksum()
 
         for _ in range(100):
             if shadow.meta.checksum() == active.meta.checksum():

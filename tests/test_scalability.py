@@ -1,0 +1,181 @@
+"""Master scalability mechanics at 100k+ entities.
+
+VERDICT round-1 asks (reference analogs: filesystem_checksum.cc
+incremental digest, metadata_dumper.h:37 forked dump, chunks.cc
+1807-1830 incremental health walk): with 100k+ inodes/chunks, the
+checksum probe is O(1), the image dump must not stall the event loop
+for the serialization time, and a health tick is O(budget) not
+O(all chunks).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from lizardfs_tpu.master import fs as fsmod
+from lizardfs_tpu.master.chunks import ChunkRegistry
+from lizardfs_tpu.master.fs import Node
+from lizardfs_tpu.master.metadata import MetadataStore
+from lizardfs_tpu.master.server import MasterServer
+
+N_FILES = 100_000
+
+
+def _populate(meta: MetadataStore, n_files: int = N_FILES) -> None:
+    """Bulk-load a big namespace directly (test setup only), then
+    re-anchor the incremental digest once."""
+    fs = meta.fs
+    root = fs.nodes[1]
+    for i in range(n_files):
+        inode = 10 + i
+        node = Node(
+            inode=inode, ftype=fsmod.TYPE_FILE, mode=0o644, uid=1, gid=1,
+            atime=1, mtime=1, ctime=1, goal=1, trash_time=86400, nlink=1,
+            parents=[1], length=65536, chunks=[100 + i],
+        )
+        fs.nodes[inode] = node
+        root.children[f"f{i}"] = inode
+        meta.registry.create_chunk(0, chunk_id=100 + i, version=1, copies=2)
+    fs.next_inode = 10 + n_files
+    meta.reset_digest()
+
+
+def test_checksum_probe_is_o1():
+    meta = MetadataStore()
+    _populate(meta)
+    t0 = time.perf_counter()
+    for _ in range(100):
+        meta.checksum()
+    per_probe = (time.perf_counter() - t0) / 100
+    assert per_probe < 0.001, f"checksum probe {per_probe*1e3:.2f} ms"
+    # and the incremental digest tracks ops without recomputation
+    t0 = time.perf_counter()
+    meta.apply({
+        "op": "mknode", "parent": 1, "name": "new", "inode": 5_000_000,
+        "ftype": fsmod.TYPE_FILE, "mode": 0o644, "uid": 1, "gid": 1,
+        "ts": 2, "goal": 1, "trash_time": 0,
+    })
+    per_op = time.perf_counter() - t0
+    assert per_op < 0.05, f"apply with digest {per_op*1e3:.1f} ms"
+    assert meta._digest == meta.full_digest()
+
+
+def test_health_tick_bounded():
+    meta = MetadataStore()
+    _populate(meta)
+    reg: ChunkRegistry = meta.registry
+    # a tick evaluates at most SCAN_BUDGET + endangered items
+    t0 = time.perf_counter()
+    for _ in range(10):
+        reg.health_work(limit=16)
+    per_tick = (time.perf_counter() - t0) / 10
+    assert per_tick < 0.02, f"health tick {per_tick*1e3:.1f} ms"
+    # the cursor makes progress: after enough ticks every chunk has been
+    # visited at least once (full cycle of 100k / 256 per tick)
+    ticks_for_cycle = (N_FILES // reg.SCAN_BUDGET) + 2
+    for _ in range(ticks_for_cycle):
+        reg.health_work(limit=16)
+    assert reg._scan_idx <= len(reg._scan_ids)
+
+
+def test_endangered_queue_priority_not_cursor():
+    """The endangered queue must hold only marked chunks, drain FIFO,
+    and never degenerate into a full-table scan cursor."""
+    meta = MetadataStore()
+    _populate(meta, n_files=1000)
+    reg = meta.registry
+    reg.register_server("127.0.0.1", 1, "_", 1 << 40, 0)
+    # all chunks have zero live parts -> unreadable, not endangered work
+    # items; mark three explicitly and verify they drain first, FIFO
+    for cid in (100, 500, 900):
+        reg.mark_endangered(cid)
+    assert list(reg.endangered) == [100, 500, 900]
+    reg.health_work(limit=64)
+    assert not reg.endangered  # drained, not re-queued wholesale
+    assert len(reg._endangered_set) == 0
+
+
+@pytest.mark.asyncio
+async def test_forked_dump_does_not_stall_loop(tmp_path):
+    master = MasterServer(str(tmp_path / "m"), image_interval=3600.0)
+    await master.start()
+    try:
+        _populate(master.meta, n_files=50_000)
+        # how long a synchronous serialization would block
+        t0 = time.perf_counter()
+        master.meta.to_sections()
+        sync_cost = time.perf_counter() - t0
+
+        gaps = []
+
+        async def ticker():
+            prev = time.perf_counter()
+            while True:
+                await asyncio.sleep(0.005)
+                now = time.perf_counter()
+                gaps.append(now - prev - 0.005)
+                prev = now
+
+        t = asyncio.ensure_future(ticker())
+        await asyncio.sleep(0.05)
+        await master._dump_image()
+        t.cancel()
+        worst = max(gaps)
+        # the loop may pause for the fork itself, never for the full
+        # serialization
+        assert worst < max(0.1, sync_cost / 4), (
+            f"loop stalled {worst*1e3:.0f} ms during dump "
+            f"(sync serialization would be {sync_cost*1e3:.0f} ms)"
+        )
+    finally:
+        await master.stop()
+
+
+def test_incremental_digest_tracks_every_op():
+    """After every op type the incremental digest must equal a full
+    recomputation (drift would break shadow divergence detection)."""
+    s = MetadataStore()
+    ops = [
+        {"op": "mknode", "parent": 1, "name": "d", "inode": 2,
+         "ftype": fsmod.TYPE_DIR, "mode": 0o755, "uid": 0, "gid": 0,
+         "ts": 100, "goal": 1, "trash_time": 86400},
+        {"op": "mknode", "parent": 2, "name": "f", "inode": 3,
+         "ftype": fsmod.TYPE_FILE, "mode": 0o644, "uid": 5, "gid": 5,
+         "ts": 101, "goal": 1, "trash_time": 86400},
+        {"op": "create_chunk", "chunk_id": 1, "slice_type": 0,
+         "version": 1, "copies": 2, "goal_id": 1},
+        {"op": "set_chunk", "inode": 3, "chunk_index": 0, "chunk_id": 1},
+        {"op": "set_length", "inode": 3, "length": 12345, "ts": 102,
+         "drop_chunks": False},
+        {"op": "setattr", "inode": 3, "set_mask": 1, "mode": 0o600,
+         "uid": 0, "gid": 0, "atime": 0, "mtime": 0, "ts": 103,
+         "trash_time": 0},
+        {"op": "set_xattr", "inode": 3, "name": "user.x", "value": "YWJj",
+         "ts": 105},
+        {"op": "set_quota", "kind": "user", "owner_id": 5,
+         "soft_inodes": 1, "hard_inodes": 2, "soft_bytes": 3,
+         "hard_bytes": 4, "remove": False},
+        {"op": "lock_posix", "inode": 3, "sid": 7, "token": 1, "start": 0,
+         "end": 10, "ltype": 2},
+        {"op": "lock_release_session", "sid": 7},
+        {"op": "unlink", "parent": 2, "name": "f", "ts": 106,
+         "to_trash": True},
+        {"op": "undelete", "inode": 3, "ts": 107},
+        {"op": "rename", "parent_src": 2, "name_src": "f",
+         "parent_dst": 1, "name_dst": "g", "ts": 108},
+        {"op": "link", "inode": 3, "parent": 1, "name": "hard", "ts": 109},
+        {"op": "unlink", "parent": 1, "name": "g", "ts": 110,
+         "to_trash": True},
+        {"op": "session_new", "sid": 9},
+        {"op": "bump_chunk_version", "chunk_id": 1, "version": 2},
+        {"op": "snapshot", "src_inode": 3, "dst_parent": 2,
+         "dst_name": "snap", "inode_map": {"3": 50}, "ts": 111},
+        {"op": "cow_chunk", "inode": 50, "chunk_index": 0,
+         "old_chunk_id": 1, "new_chunk_id": 2, "slice_type": 0,
+         "version": 1, "copies": 2, "goal_id": 1},
+        {"op": "purge_trash", "inode": 999},
+    ]
+    for op in ops:
+        s.apply(op)
+        assert s._digest == s.full_digest(), f"drift after {op['op']}"
