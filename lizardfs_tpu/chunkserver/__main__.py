@@ -45,6 +45,7 @@ def main() -> None:
         port=cfg.get_int("LISTEN_PORT", 0),
         label=cfg.get_str("LABEL", "_"),
         encoder_name=cfg.get_str("ENCODER", "cpu"),
+        admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
     )
     asyncio.run(server.run_forever())
 

@@ -88,8 +88,10 @@ class ChunkServer(Daemon):
         wave_timeout: float = 0.3,
         heartbeat_interval: float = 5.0,
         native_data_plane: bool = True,
+        admin_password: str | None = None,
     ):
         super().__init__(host, port)
+        self.admin_password = admin_password
         folders = [data_folder] if isinstance(data_folder, str) else list(data_folder)
         self.store = MultiStore(folders)
         # native C++ data-plane listener (network_worker_thread analog);
@@ -418,6 +420,7 @@ class ChunkServer(Daemon):
 
     async def handle_connection(self, reader, writer) -> None:
         sessions: dict[int, _WriteSession] = {}
+        admin_state: dict = {}
         # in-flight _finish_write tasks still owe status frames on this
         # writer; native streaming must not interleave with them
         pending_writes: set[asyncio.Task] = set()
@@ -428,7 +431,7 @@ class ChunkServer(Daemon):
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 if isinstance(msg, (m.AdminInfo, m.AdminCommand)):
-                    await self._serve_admin(writer, msg)
+                    await self._serve_admin(writer, msg, admin_state)
                 elif isinstance(msg, m.CltocsPrefetch):
                     # fire-and-forget page-cache warmup
                     self.spawn(asyncio.to_thread(
@@ -475,9 +478,15 @@ class ChunkServer(Daemon):
             for session in sessions.values():
                 await session.close()
 
-    async def _serve_admin(self, writer, msg) -> None:
+    async def _serve_admin(self, writer, msg, state: dict | None = None) -> None:
         import json
 
+        state = state if state is not None else {}
+        if isinstance(msg, m.AdminCommand):
+            reply = self.admin_gate(msg, state)
+            if reply is not None:
+                await framing.send_message(writer, reply)
+                return
         if isinstance(msg, m.AdminInfo):
             total, used = self.store.space()
             await framing.send_message(

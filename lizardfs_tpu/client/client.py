@@ -194,6 +194,13 @@ class Client:
 
     async def close(self) -> None:
         if self.master is not None:
+            try:
+                # clean goodbye: the master releases our locks now
+                # instead of holding them for the crash-grace window
+                await self.master.call(m.CltomaGoodbye, timeout=2.0)
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    st.StatusError):
+                pass
             await self.master.close()
 
     # --- metadata ops ---------------------------------------------------------------

@@ -55,6 +55,8 @@ async def _run(cfg: Config) -> None:
         exports=exports,
         topology=topology,
         io_limit_bps=cfg.get_int("IO_LIMIT_BPS", 0),
+        admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
+        lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0),
     )
     controller = None
     if cfg.get_str("ELECTION_ID", ""):

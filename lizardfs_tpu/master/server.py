@@ -96,8 +96,15 @@ class MasterServer(Daemon):
         exports=None,
         topology=None,
         io_limit_bps: int = 0,
+        admin_password: str | None = None,
+        lock_grace_seconds: float = 30.0,
     ):
         super().__init__(host, port)
+        self.admin_password = admin_password
+        # a briefly-disconnected client keeps its file locks for this
+        # long; reconnecting with the same session id reclaims them
+        self.lock_grace_seconds = lock_grace_seconds
+        self._lock_grace: dict[int, float] = {}  # sid -> release deadline
         self.data_dir = data_dir
         self.meta = MetadataStore()
         self.changelog = Changelog(data_dir)
@@ -170,6 +177,7 @@ class MasterServer(Daemon):
         self.add_timer(self.image_interval, self._dump_image)
         self.add_timer(10.0, self._purge_trash)
         self.add_timer(0.05, self._task_tick)
+        self.add_timer(1.0, self._lock_grace_sweep)
 
     async def _task_tick(self) -> None:
         """Run a batch of background metadata jobs (TaskManager analog:
@@ -301,6 +309,26 @@ class MasterServer(Daemon):
                 return -1
             await asyncio.sleep(0.05)
 
+    async def _lock_grace_sweep(self) -> None:
+        """Release locks of sessions whose grace window expired without
+        a reconnect (lock retention across brief disconnects)."""
+        if not self.is_active:
+            return
+        now = time.monotonic()
+        for sid, deadline in list(self._lock_grace.items()):
+            if now < deadline:
+                continue
+            if self._session_writers.get(sid) is not None:
+                # reconnected; shouldn't happen (register clears it)
+                del self._lock_grace[sid]
+                continue
+            del self._lock_grace[sid]
+            held = self.meta.locks.session_inodes(sid)
+            if held:
+                self.commit({"op": "lock_release_session", "sid": sid})
+                for inode in held:
+                    self._grant_pending_locks(inode)
+
     _ORPHAN_LOCK_TIMEOUT = 60.0
 
     async def _purge_trash(self) -> None:
@@ -329,6 +357,8 @@ class MasterServer(Daemon):
         live = set(self._session_writers)
         now_f = time.time()
         for sid in owners - live:
+            if sid in self._lock_grace:
+                continue  # the grace sweep owns this session's fate
             first_seen = self._orphan_lock_seen.setdefault(sid, now_f)
             if now_f - first_seen >= self._ORPHAN_LOCK_TIMEOUT:
                 held = self.meta.locks.session_inodes(sid)
@@ -354,13 +384,14 @@ class MasterServer(Daemon):
         elif isinstance(first, m.MltomaRegister):
             await self._shadow_loop(reader, writer, first)
         elif isinstance(first, (m.AdminInfo, m.AdminCommand)):
-            await self._admin_message(writer, first)
+            admin_state: dict = {}
+            await self._admin_message(writer, first, admin_state)
             while True:
                 try:
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
-                await self._admin_message(writer, msg)
+                await self._admin_message(writer, msg, admin_state)
         else:
             self.log.warning("unexpected first message %s", type(first).__name__)
 
@@ -413,6 +444,8 @@ class MasterServer(Daemon):
             "root": root_inode,
         }
         self._session_writers[session_id] = writer
+        # reconnect within the grace window: the session keeps its locks
+        self._lock_grace.pop(session_id, None)
         await framing.send_message(
             writer,
             m.MatoclRegister(req_id=first.req_id, status=st.OK, session_id=session_id),
@@ -446,18 +479,33 @@ class MasterServer(Daemon):
                     # the client reconnects with the same session id
                     return
                 held = self.meta.locks.session_inodes(session_id)
+                # queued (blocked) requests die with the connection —
+                # there is nobody to push the grant to
                 queued = [
                     i for i, q in self._pending_locks.items()
                     if any(p["sid"] == session_id for p in q)
                 ]
                 for q in self._pending_locks.values():
                     q[:] = [p for p in q if p["sid"] != session_id]
-                if held:
-                    self.commit(
-                        {"op": "lock_release_session", "sid": session_id}
-                    )
-                for inode in {*held, *queued}:
+                for inode in queued:
                     self._grant_pending_locks(inode)
+                if held:
+                    if self.sessions.get(session_id, {}).get("clean_close"):
+                        # clean goodbye: release now
+                        self.commit(
+                            {"op": "lock_release_session", "sid": session_id}
+                        )
+                        for inode in held:
+                            self._grant_pending_locks(inode)
+                    else:
+                        # abrupt disconnect: HELD locks get a grace
+                        # window — a client that reconnects with its
+                        # session id (network blip, failover) keeps
+                        # them; the sweep releases them if it never
+                        # comes back
+                        self._lock_grace[session_id] = (
+                            time.monotonic() + self.lock_grace_seconds
+                        )
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -663,6 +711,10 @@ class MasterServer(Daemon):
                 return self._error_reply(msg, st.EROFS)
             if not self._apply_session_view(msg, session):
                 return self._error_reply(msg, st.EACCES)
+        if isinstance(msg, m.CltomaGoodbye):
+            if session:
+                session["clean_close"] = True
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaLookup):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 1)
             node = fs.lookup(msg.parent, msg.name)
@@ -1770,7 +1822,20 @@ class MasterServer(Daemon):
 
     # --- admin ----------------------------------------------------------------------------
 
-    async def _admin_message(self, writer, msg) -> None:
+    # mutating admin surface requires challenge-response auth when an
+    # ADMIN_PASSWORD is configured (registered_admin_connection.cc)
+    ADMIN_PRIVILEGED = frozenset({
+        "tweaks-set", "save-metadata", "promote-shadow", "reload", "stop",
+        "rremove-task", "setgoal-task", "settrashtime-task",
+    })
+
+    async def _admin_message(self, writer, msg, state: dict | None = None) -> None:
+        state = state if state is not None else {}
+        if isinstance(msg, m.AdminCommand):
+            reply = self.admin_gate(msg, state)
+            if reply is not None:
+                await framing.send_message(writer, reply)
+                return
         if isinstance(msg, m.AdminInfo):
             info = {
                 "personality": self.personality,

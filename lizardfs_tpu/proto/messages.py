@@ -485,6 +485,15 @@ class CltomaGetRichAcl(Message):
     FIELDS = (("req_id", "u32"), ("inode", "u32"))
 
 
+class CltomaGoodbye(Message):
+    """Clean session end: locks release immediately. An ABRUPT
+    disconnect (no goodbye) keeps held locks for the master's grace
+    window so a reconnecting client reclaims them."""
+
+    MSG_TYPE = 1066
+    FIELDS = (("req_id", "u32"),)
+
+
 class CltomaAccess(Message):
     """Permission probe: can (uid, gid) access inode with mask r4/w2/x1?
     Evaluated against the inode's RichACL when one is set, else mode

@@ -42,6 +42,8 @@ class Daemon:
         self._stopping = asyncio.Event()
         self.metrics = Metrics()
         self.tweaks = Tweaks()
+        # challenge-response admin password (None = open admin port)
+        self.admin_password: str | None = None
         self.add_timer(1.0, self._sample_metrics)
 
     async def _sample_metrics(self) -> None:
@@ -107,6 +109,87 @@ class Daemon:
                 json=json.dumps(self.tweaks.to_dict()),
             )
         return None
+
+    # --- admin authentication (registered_admin_connection.cc analog) -------
+    #
+    # Challenge-response over the existing AdminCommand plumbing: the
+    # client asks for a nonce ("auth-challenge") and answers with
+    # HMAC-SHA256(password, nonce) ("auth"); the password itself never
+    # crosses the wire. Privileged commands on a connection that has not
+    # authenticated are refused when a password is configured.
+
+    # commands that mutate daemon/cluster state; subclasses extend
+    ADMIN_PRIVILEGED: frozenset[str] = frozenset({"tweaks-set"})
+
+    def handle_admin_auth(self, msg, state: dict) -> object | None:
+        """Handle auth-challenge / auth commands; None if not one."""
+        import hmac as hmac_mod
+        import json
+        import secrets
+
+        from lizardfs_tpu.proto import messages as m
+        from lizardfs_tpu.proto import status as st
+
+        command = getattr(msg, "command", None)
+        if command == "auth-challenge":
+            nonce = secrets.token_hex(16)
+            state["nonce"] = nonce
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({"nonce": nonce}),
+            )
+        if command == "auth":
+            nonce = state.pop("nonce", "")
+            password = getattr(self, "admin_password", None)
+            try:
+                payload = json.loads(msg.json)
+                digest = str(payload.get("digest", "")) if isinstance(
+                    payload, dict) else ""
+            except ValueError:
+                digest = ""
+            if not password:
+                # open daemon: auth trivially succeeds so ops scripts can
+                # pass --password uniformly across secured/unsecured nodes
+                state["authed"] = True
+                return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")
+            if nonce:
+                want = hmac_mod.new(
+                    password.encode(), nonce.encode(), "sha256"
+                ).hexdigest()
+                if hmac_mod.compare_digest(want, digest):
+                    state["authed"] = True
+                    return m.AdminReply(
+                        req_id=msg.req_id, status=st.OK, json="{}"
+                    )
+            return m.AdminReply(req_id=msg.req_id, status=st.EPERM, json="{}")
+        return None
+
+    def admin_refused(self, msg, state: dict) -> object | None:
+        """EPERM reply if the command is privileged and the connection
+        has not authenticated (and a password is configured)."""
+        from lizardfs_tpu.proto import messages as m
+        from lizardfs_tpu.proto import status as st
+
+        command = getattr(msg, "command", None)
+        if (
+            getattr(self, "admin_password", None)
+            and command in self.ADMIN_PRIVILEGED
+            and not state.get("authed")
+        ):
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.EPERM,
+                json='{"error": "admin authentication required"}',
+            )
+        return None
+
+    def admin_gate(self, msg, state: dict) -> object | None:
+        """Auth handshake + privilege gate in one step: returns the
+        reply to send (challenge/auth result or EPERM refusal), or None
+        when the command may proceed."""
+        reply = self.handle_admin_auth(msg, state)
+        if reply is None:
+            reply = self.admin_refused(msg, state)
+        return reply
 
     # --- lifecycle ---------------------------------------------------------
 

@@ -18,9 +18,33 @@ from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 
 
-async def _admin(addr: tuple[str, int], command: str, payload: str = "{}"):
+async def _admin(addr: tuple[str, int], command: str, payload: str = "{}",
+                 password: str | None = None):
     reader, writer = await asyncio.open_connection(*addr)
     try:
+        if password:
+            # challenge-response: the password never crosses the wire
+            import hmac
+
+            await framing.send_message(
+                writer,
+                m.AdminCommand(req_id=1, command="auth-challenge", json="{}"),
+            )
+            ch = await framing.read_message(reader)
+            nonce = json.loads(ch.json).get("nonce", "")
+            digest = hmac.new(
+                password.encode(), nonce.encode(), "sha256"
+            ).hexdigest()
+            await framing.send_message(
+                writer,
+                m.AdminCommand(
+                    req_id=2, command="auth",
+                    json=json.dumps({"digest": digest}),
+                ),
+            )
+            auth = await framing.read_message(reader)
+            if getattr(auth, "status", 1) != st.OK:
+                return auth
         if command == "info":
             await framing.send_message(writer, m.AdminInfo(req_id=1))
         else:
@@ -44,16 +68,18 @@ async def _amain(argv) -> int:
         ],
     )
     p.add_argument("extra", nargs="*", help="tweaks-set: NAME VALUE; metrics: [resolution]")
+    p.add_argument("--password", default=None,
+                   help="admin password (challenge-response)")
     args = p.parse_args(argv)
     host, _, port = args.master.rpartition(":")
     addr = (host or "127.0.0.1", int(port))
 
     cmd = args.command
     if cmd in ("list-chunkservers", "list-sessions"):
-        reply = await _admin(addr, "info")
+        reply = await _admin(addr, "info", password=args.password)
     elif cmd in ("metrics", "metrics-csv"):
         resolution = args.extra[0] if args.extra else "sec"
-        reply = await _admin(addr, cmd, json.dumps({"resolution": resolution}))
+        reply = await _admin(addr, cmd, json.dumps({"resolution": resolution}), password=args.password)
         if cmd == "metrics-csv" and reply.status == 0:
             print(json.loads(reply.json)["csv"], end="")
             return 0
@@ -62,10 +88,12 @@ async def _amain(argv) -> int:
             print("usage: tweaks-set NAME VALUE", file=sys.stderr)
             return 2
         reply = await _admin(
-            addr, cmd, json.dumps({"name": args.extra[0], "value": args.extra[1]})
+            addr, cmd,
+            json.dumps({"name": args.extra[0], "value": args.extra[1]}),
+            password=args.password,
         )
     else:
-        reply = await _admin(addr, cmd)
+        reply = await _admin(addr, cmd, password=args.password)
     if getattr(reply, "status", 1) != st.OK:
         print(f"error: {st.name(reply.status)} {getattr(reply, 'json', '')}",
               file=sys.stderr)
