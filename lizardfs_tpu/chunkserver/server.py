@@ -368,8 +368,13 @@ class ChunkServer(Daemon):
                 raise ChunkStoreError(st.NO_CHUNK, "no source for copy")
             plan = plans.plan_for_standard(nblocks * MFSBLOCKSIZE)
         else:
+            from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
+
             planner = plans.SliceReadPlanner(
-                slice_type, list(locations.keys()), encoder=self.encoder
+                slice_type, list(locations.keys()),
+                scores={p: GLOBAL_STATS.score(a)
+                        for p, (a, _) in locations.items()},
+                encoder=self.encoder,
             )
             if not planner.is_readable([target.part]):
                 raise ChunkStoreError(st.NO_CHUNK, "not enough source parts")

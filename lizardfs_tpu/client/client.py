@@ -665,17 +665,30 @@ class Client:
             coff == region_start and coff + len(piece) >= overlap_end
         )
         if overlap_end > region_start and not fully_covered:
-            # read back the stripes being partially overwritten
-            by_part = {p: (
-                (locs[0].addr.host, locs[0].addr.port), locs[0].part_id
-            ) for p, locs in copies.items()}
+            # read back the stripes being partially overwritten,
+            # preferring healthy copies (same scoring as the read path)
+            from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
+
+            def best(locs):
+                top = max(
+                    locs,
+                    key=lambda l: GLOBAL_STATS.score(
+                        (l.addr.host, l.addr.port)
+                    ),
+                )
+                return ((top.addr.host, top.addr.port), top.part_id)
+
+            by_part = {p: best(locs) for p, locs in copies.items()}
             part_sizes = {
                 p: striping.part_length(slice_type, p, chunk_len_old)
                 for p in range(slice_type.expected_parts)
             }
             wanted = [first_data + i for i in range(d)]
             planner = plans.SliceReadPlanner(
-                slice_type, list(by_part.keys()), encoder=self.encoder
+                slice_type, list(by_part.keys()),
+                scores={p: GLOBAL_STATS.score(a)
+                        for p, (a, _) in by_part.items()},
+                encoder=self.encoder,
             )
             if not planner.is_readable(wanted):
                 raise ReadError("not enough parts for read-modify-write")
@@ -1090,14 +1103,22 @@ class Client:
         if slice_type is None:
             raise ReadError("no locations for chunk")
 
-        # first attempt: the master's topology-preferred (closest) copy;
-        # retries avoid replicas that already failed this read, then
-        # randomize among what is left (a dead replica rotates off
-        # instead of being re-drawn by chance)
+        # copy choice (chunk_read_planner.cc analog): process-wide
+        # per-chunkserver health scores demote flaky/slow replicas for
+        # every read at once; topology order (the master sorts closest
+        # first) breaks ties among equally healthy copies. Retries avoid
+        # replicas that already failed THIS read, then randomize among
+        # what is left.
+        from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
+
         def pick(locs):
             good = [l for l in locs if l[0] not in (avoid or ())]
             pool = good or locs
-            return pool[0] if attempt == 0 else random.choice(pool)
+            if attempt > 0 and len(pool) > 1:
+                return random.choice(pool)
+            best = max(range(len(pool)),
+                       key=lambda i: (GLOBAL_STATS.score(pool[i][0]), -i))
+            return pool[best]
 
         by_part = {p: pick(locs) for p, locs in copies.items()}
 
@@ -1144,8 +1165,14 @@ class Client:
         hi_slot = hi_block // d
         nslots = hi_slot - lo_slot + 1
         wanted = [first_data + i for i in range(d)]
+        # per-part scores from the shared chunkserver health registry:
+        # an unhealthy holder's part drops in rank, so recovery reads
+        # prefer parts on healthy servers (read_plan_executor.cc:95)
         planner = plans.SliceReadPlanner(
-            slice_type, list(by_part.keys()), encoder=self.encoder
+            slice_type, list(by_part.keys()),
+            scores={p: GLOBAL_STATS.score(a[0])
+                    for p, a in by_part.items()},
+            encoder=self.encoder,
         )
         if not planner.is_readable(wanted):
             raise ReadError("not enough parts available")

@@ -45,8 +45,10 @@ async def read_part_range(
     """Read one range of one part from one chunkserver, verifying piece
     CRCs (ReadOperationExecutor analog). Connections come from the
     process-wide pool and are returned after a clean, fully-drained
-    exchange (ConnectionPool analog)."""
+    exchange (ConnectionPool analog). Every outcome feeds the shared
+    per-chunkserver health scores (chunkserver_stats.cc analog)."""
     from lizardfs_tpu.core.conn_pool import GLOBAL_POOL
+    from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
 
     out = into if into is not None else np.zeros(size, dtype=np.uint8)
     if size == 0:
@@ -88,6 +90,7 @@ async def read_part_range(
         )
         try:
             await asyncio.shield(fut)
+            GLOBAL_STATS.record_success(addr)
             if not scatter_direct:
                 out[into_offset : into_offset + size] = tmp
             return out
@@ -100,12 +103,15 @@ async def read_part_range(
                     pass
             raise
         except native_io.NativeIOError as e:
+            GLOBAL_STATS.record_failure(addr)
             raise ReadError(str(e)) from None
         except (OSError, ConnectionError) as e:
+            GLOBAL_STATS.record_failure(addr)
             raise ReadError(f"native read failed: {e}") from None
 
     conn = await GLOBAL_POOL.acquire(addr)
     clean = False
+    cancelled = False
     try:
         await framing.send_message(
             conn.writer,
@@ -133,18 +139,29 @@ async def read_part_range(
             elif isinstance(msg, m.CstoclReadStatus):
                 clean = True  # stream fully drained, even on error status
                 if msg.status != st.OK:
+                    GLOBAL_STATS.record_failure(addr)
                     raise ReadError(f"read failed: {st.name(msg.status)}")
                 if received < size:
+                    GLOBAL_STATS.record_failure(addr)
                     raise ReadError(
                         f"short read: {received} of {size} bytes"
                     )
+                GLOBAL_STATS.record_success(addr)
                 return out
             else:
                 raise ReadError(f"unexpected message {type(msg).__name__}")
+    except asyncio.CancelledError:
+        cancelled = True
+        raise
     finally:
         if clean:
             GLOBAL_POOL.release(addr, conn)
         else:
+            # a CANCELLED read (wave straggler made redundant, plan
+            # aborted by a different part's failure) is not this
+            # server's defect — only real failures count
+            if not cancelled:
+                GLOBAL_STATS.record_failure(addr)
             GLOBAL_POOL.discard(conn)
 
 
