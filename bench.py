@@ -30,7 +30,8 @@ NBLOCKS_PER_PART = 128  # 8 parts x 128 blocks x 64 KiB = 64 MiB data
 DATA_MIB = K * NBLOCKS_PER_PART * BLOCK / 2**20
 
 
-def tpu_throughput() -> float:
+def tpu_throughput(k: int = K, m: int = M,
+                   nblocks_per_part: int = NBLOCKS_PER_PART) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -41,10 +42,11 @@ def tpu_throughput() -> float:
         if pallas_ec.supported()
         else jax_ec.fused_encode_crc
     )
-    bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(K, M)))
+    data_mib = k * nblocks_per_part * BLOCK / 2**20
+    bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(k, m)))
     data = jax.device_put(
         np.random.default_rng(0).integers(
-            0, 256, size=(K, NBLOCKS_PER_PART * BLOCK), dtype=np.uint8
+            0, 256, size=(k, nblocks_per_part * BLOCK), dtype=np.uint8
         )
     )
 
@@ -53,7 +55,7 @@ def tpu_throughput() -> float:
         def body(i, x):
             p, dc, pc = fused(bigm, x, BLOCK)
             mix = (dc.sum(dtype=jnp.uint32) ^ pc.sum(dtype=jnp.uint32)) & 0xFF
-            x = x.at[:M, :].set(x[:M, :] ^ p)
+            x = x.at[:m, :].set(x[:m, :] ^ p)
             return x.at[0, 0].set(x[0, 0] ^ mix.astype(jnp.uint8))
 
         return jax.lax.fori_loop(0, n, body, x).sum(dtype=jnp.int32)
@@ -69,7 +71,7 @@ def tpu_throughput() -> float:
     floor = min(timed(1) for _ in range(3))
     total = min(timed(L) for _ in range(3))
     per_iter = max((total - floor) / (L - 1), 1e-9)
-    return DATA_MIB / per_iter
+    return data_mib / per_iter
 
 
 def cpu_baseline_throughput() -> float:
@@ -150,7 +152,14 @@ def cluster_throughput() -> dict:
 
 def _tpu_worker(q):
     try:
-        q.put(("ok", tpu_throughput()))
+        main_row = tpu_throughput()
+        # wide-stripe single-chip row (BASELINE config 5 precursor):
+        # bounds expected multi-chip MFU before any mesh is involved
+        try:
+            wide = tpu_throughput(k=32, m=8, nblocks_per_part=32)
+        except Exception:  # noqa: BLE001 — the headline row must land
+            wide = None
+        q.put(("ok", (main_row, wide)))
     except Exception as e:  # noqa: BLE001
         q.put(("err", str(e)[:200]))
 
@@ -178,7 +187,8 @@ def _tpu_throughput_guarded(timeout_s: int = 600):
 
 
 def main():
-    value, tpu_err = _tpu_throughput_guarded()
+    result, tpu_err = _tpu_throughput_guarded()
+    value, wide = result if result is not None else (None, None)
     baseline = cpu_baseline_throughput()
     if value is not None:
         row = {
@@ -198,6 +208,8 @@ def main():
             "vs_baseline": 1.0,
             "tpu_error": tpu_err,
         }
+    if wide is not None:
+        row["ec32_8_single_chip_MiBps"] = round(wide, 1)
     row.update(cluster_throughput())
     print(json.dumps(row))
 
