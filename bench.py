@@ -152,16 +152,18 @@ def cluster_throughput() -> dict:
 
 def _tpu_worker(q):
     try:
-        main_row = tpu_throughput()
-        # wide-stripe single-chip row (BASELINE config 5 precursor):
-        # bounds expected multi-chip MFU before any mesh is involved
-        try:
-            wide = tpu_throughput(k=32, m=8, nblocks_per_part=32)
-        except Exception:  # noqa: BLE001 — the headline row must land
-            wide = None
-        q.put(("ok", (main_row, wide)))
+        # the headline row lands on the queue FIRST so a later hang in
+        # the optional wide row can't discard it
+        q.put(("ok", tpu_throughput()))
     except Exception as e:  # noqa: BLE001
         q.put(("err", str(e)[:200]))
+        return
+    try:
+        # wide-stripe single-chip row (BASELINE config 5 precursor):
+        # bounds expected multi-chip MFU before any mesh is involved
+        q.put(("wide", tpu_throughput(k=32, m=8, nblocks_per_part=32)))
+    except Exception:  # noqa: BLE001 — optional row
+        pass
 
 
 def _tpu_throughput_guarded(timeout_s: int = 600):
@@ -178,12 +180,18 @@ def _tpu_throughput_guarded(timeout_s: int = 600):
     if p.is_alive():
         p.terminate()
         p.join(5)
-        return None, "accelerator unreachable (device init timeout)"
+    rows = []
     try:
-        kind, payload = q.get_nowait()
-    except Exception:  # noqa: BLE001
-        return None, "tpu bench crashed"
-    return (payload, None) if kind == "ok" else (None, payload)
+        while True:
+            rows.append(q.get_nowait())
+    except Exception:  # noqa: BLE001 — queue drained
+        pass
+    main_row = next((v for k, v in rows if k == "ok"), None)
+    wide = next((v for k, v in rows if k == "wide"), None)
+    err = next((v for k, v in rows if k == "err"), None)
+    if main_row is None and err is None:
+        err = "accelerator unreachable (device init timeout)"
+    return ((main_row, wide), None) if main_row is not None else (None, err)
 
 
 def main():

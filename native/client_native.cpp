@@ -1,0 +1,678 @@
+// C client library implementation (see lizardfs_client.h).
+//
+// The analog of the reference's liblizardfs-client
+// (src/mount/client/client.cc behind lizardfs_c_api.h): master control
+// RPCs speak the cltoma/matocl protocol, file data rides the native
+// bulk data plane (lz_read_part_bulk / lz_write_part* from
+// io_native.cpp, against the C++ chunkserver data-plane listener) — an
+// external consumer links this and never touches Python.
+//
+// Threading: one mutex per handle; operations serialize. Data-plane
+// sockets are pooled per address inside the handle.
+
+#include "lizardfs_client.h"
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "wire.h"
+
+extern "C" {
+int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
+                 uint32_t part_id, uint32_t offset, uint32_t size,
+                 uint8_t* out);
+int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
+                      uint32_t part_id, uint32_t offset, uint32_t size,
+                      uint8_t* out);
+int lz_write_part(int fd, uint64_t chunk_id, const uint8_t* payload,
+                  uint64_t len, uint64_t part_offset, uint32_t first_write_id);
+int lz_write_part_bulk(int fd, uint64_t chunk_id, const uint8_t* payload,
+                       uint64_t len, uint64_t part_offset, uint32_t write_id);
+}
+
+namespace {
+
+using namespace lzwire;
+
+constexpr uint32_t kBlockSize = 64 * 1024;
+constexpr uint64_t kChunkSize = 64ull * 1024 * 1024;
+
+// message types (lizardfs_tpu/proto/messages.py)
+enum : uint32_t {
+    kCltomaRegister = 1000,
+    kMatoclRegister = 1001,
+    kCltomaLookup = 1002,
+    kMatoclAttrReply = 1003,
+    kCltomaGetattr = 1004,
+    kCltomaMkdir = 1006,
+    kCltomaCreate = 1008,
+    kCltomaReaddir = 1010,
+    kMatoclReaddir = 1011,
+    kCltomaUnlink = 1012,
+    kMatoclStatusReply = 1013,
+    kCltomaRmdir = 1014,
+    kCltomaRename = 1016,
+    kCltomaReadChunk = 1020,
+    kMatoclReadChunk = 1021,
+    kCltomaWriteChunk = 1022,
+    kMatoclWriteChunk = 1023,
+    kCltomaWriteChunkEnd = 1024,
+    kCltomaTruncate = 1026,
+    kCltomaSetattr = 1028,
+    kCltomaSymlink = 1030,
+    kCltomaReadlink = 1032,
+    kMatoclReadlink = 1033,
+    kCltomaLink = 1034,
+    kCltomaAccess = 1060,
+    kCltomaGoodbye = 1066,
+    kCltocsWriteInit = 1210,
+    kCstoclWriteStatus = 1212,
+    kCltocsWriteEnd = 1213,
+};
+
+constexpr int kErrConn = -1;
+constexpr int stOK = 0;
+constexpr int stEINVAL = 5;
+constexpr int stEIO = 9;
+constexpr int stNOT_POSSIBLE = 29;
+
+struct Location {
+    std::string host;
+    uint16_t port;
+    uint32_t part_id;
+};
+
+struct ChunkGrant {
+    int status = stEIO;
+    uint64_t chunk_id = 0;
+    uint32_t version = 0;
+    uint64_t file_length = 0;
+    std::vector<Location> locations;
+};
+
+}  // namespace
+
+struct liz {
+    std::mutex mu;
+    int master_fd = -1;
+    std::string host;
+    int port = 0;
+    std::string password;
+    uint64_t session_id = 0;
+    std::atomic<uint32_t> req_id{1};
+    uint32_t uid = 0, gid = 0;
+    std::map<std::pair<std::string, uint16_t>, int> data_fds;
+    std::vector<uint8_t> payload;  // reusable reply buffer
+
+    ~liz() {
+        if (master_fd >= 0) ::close(master_fd);
+        for (auto& kv : data_fds) ::close(kv.second);
+    }
+
+    int data_fd(const std::string& h, uint16_t p) {
+        auto key = std::make_pair(h, p);
+        auto it = data_fds.find(key);
+        if (it != data_fds.end()) return it->second;
+        int fd = connect_tcp(h, p);
+        if (fd >= 0) data_fds[key] = fd;
+        return fd;
+    }
+
+    void drop_data_fd(const std::string& h, uint16_t p) {
+        auto key = std::make_pair(h, p);
+        auto it = data_fds.find(key);
+        if (it != data_fds.end()) {
+            ::close(it->second);
+            data_fds.erase(it);
+        }
+    }
+
+    // send a request and wait for its reply: the expected type, or the
+    // generic MatoclStatusReply the master uses for error fallbacks.
+    // Returns the type received (0 = connection failure). Pushed
+    // messages (lock grants) are skipped.
+    uint32_t call(Msg& msg, uint32_t expect_type) {
+        if (master_fd < 0 && !reconnect()) return 0;
+        if (!msg.send(master_fd)) {
+            if (!reconnect() || !msg.send(master_fd)) return 0;
+        }
+        for (int i = 0; i < 64; ++i) {
+            uint32_t type = recv_frame(master_fd, &payload);
+            if (type == 0) return 0;
+            if (type == expect_type || type == kMatoclStatusReply)
+                return type;
+        }
+        return 0;
+    }
+
+    bool reconnect() {
+        if (master_fd >= 0) ::close(master_fd);
+        master_fd = connect_tcp(host, static_cast<uint16_t>(port));
+        if (master_fd < 0) return false;
+        Msg reg(kCltomaRegister);
+        reg.u32(req_id++).u64(session_id).str("libclient").str(password);
+        if (!reg.send(master_fd)) return false;
+        uint32_t type = recv_frame(master_fd, &payload);
+        if (type != kMatoclRegister) return false;
+        Reader r(payload.data() + 1, payload.size() - 1);
+        r.u32();  // req_id
+        if (r.u8() != stOK) return false;
+        session_id = r.u64();
+        return true;
+    }
+};
+
+namespace {
+
+int parse_attr(Reader* r, liz_attr_t* out) {
+    // MatoclAttrReply: req_id status attr{inode ftype mode uid gid
+    // atime mtime ctime nlink length goal trash_time}
+    r->u32();
+    int status = r->u8();
+    liz_attr_t a{};
+    a.inode = r->u32();
+    a.ftype = r->u8();
+    a.mode = r->u16();
+    a.uid = r->u32();
+    a.gid = r->u32();
+    a.atime = r->u32();
+    a.mtime = r->u32();
+    a.ctime = r->u32();
+    a.nlink = r->u32();
+    a.length = r->u64();
+    a.goal = r->u8();
+    a.trash_time = r->u32();
+    if (!r->ok()) return kErrConn;
+    if (status == stOK && out != nullptr) *out = a;
+    return status;
+}
+
+int attr_call(liz_t* fs, Msg& msg, liz_attr_t* out) {
+    std::lock_guard<std::mutex> g(fs->mu);
+    uint32_t type = fs->call(msg, kMatoclAttrReply);
+    if (type == 0) return kErrConn;
+    Reader r(fs->payload.data() + 1, fs->payload.size() - 1);
+    if (type == kMatoclStatusReply) {  // error fallback reply
+        r.u32();
+        int status = r.u8();
+        return r.ok() && status != stOK ? status : kErrConn;
+    }
+    return parse_attr(&r, out);
+}
+
+int status_call(liz_t* fs, Msg& msg) {
+    std::lock_guard<std::mutex> g(fs->mu);
+    if (fs->call(msg, kMatoclStatusReply) == 0) return kErrConn;
+    Reader r(fs->payload.data() + 1, fs->payload.size() - 1);
+    r.u32();
+    int status = r.u8();
+    return r.ok() ? status : kErrConn;
+}
+
+ChunkGrant chunk_call(liz_t* fs, uint32_t type, uint32_t reply_type,
+                      uint32_t inode, uint32_t chunk_index) {
+    ChunkGrant out;
+    Msg msg(type);
+    msg.u32(fs->req_id++).u32(inode).u32(chunk_index).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    uint32_t got = fs->call(msg, reply_type);
+    if (got == 0) {
+        out.status = kErrConn;
+        return out;
+    }
+    Reader r(fs->payload.data() + 1, fs->payload.size() - 1);
+    if (got == kMatoclStatusReply) {
+        r.u32();
+        int status = r.u8();
+        out.status = r.ok() && status != stOK ? status : kErrConn;
+        return out;
+    }
+    r.u32();
+    out.status = r.u8();
+    out.chunk_id = r.u64();
+    out.version = r.u32();
+    out.file_length = r.u64();
+    uint32_t n = r.u32();
+    for (uint32_t i = 0; i < n && r.ok() && i < 256; ++i) {
+        Location loc;
+        loc.host = r.str();
+        loc.port = r.u16();
+        loc.part_id = r.u32();
+        out.locations.push_back(std::move(loc));
+    }
+    if (!r.ok()) out.status = kErrConn;
+    return out;
+}
+
+// slice geometry (core/geometry.py)
+inline int slice_type_of(uint32_t part_id) { return part_id / 64; }
+inline int part_index_of(uint32_t part_id) { return part_id % 64; }
+inline bool type_is_xor(int t) { return t >= 2 && t <= 9; }
+inline bool type_is_ec(int t) { return t >= 10 && t < 10 + 31 * 32; }
+inline int data_parts_of(int t) {
+    if (type_is_xor(t)) return t;
+    if (type_is_ec(t)) return 2 + (t - 10) / 32;
+    return 1;
+}
+
+// read [off, off+size) of one chunk into buf; range is caller-clipped
+int read_chunk_range(liz_t* fs, const ChunkGrant& g, uint64_t off,
+                     uint64_t size, uint8_t* buf) {
+    if (g.chunk_id == 0) {  // hole
+        std::memset(buf, 0, size);
+        return stOK;
+    }
+    int slice = g.locations.empty() ? 0 : slice_type_of(g.locations[0].part_id);
+    if (slice == 0) {
+        // standard: any copy serves the byte range directly
+        int last = stEIO;
+        for (const auto& loc : g.locations) {
+            int fd = fs->data_fd(loc.host, loc.port);
+            if (fd < 0) {
+                last = kErrConn;
+                continue;
+            }
+            int rc = (off % kBlockSize == 0 ? lz_read_part_bulk : lz_read_part)(
+                fd, g.chunk_id, g.version, loc.part_id,
+                static_cast<uint32_t>(off), static_cast<uint32_t>(size), buf);
+            if (rc == 0) return stOK;
+            fs->drop_data_fd(loc.host, loc.port);
+            last = rc < 0 ? kErrConn : rc;
+        }
+        return last;
+    }
+    // striped: interleave blocks from the data parts (all must be
+    // live; degraded reads need the recovery planner — FUSE path)
+    int d = data_parts_of(slice);
+    int first_data = type_is_xor(slice) ? 1 : 0;
+    std::map<int, const Location*> by_index;
+    for (const auto& loc : g.locations) {
+        int idx = part_index_of(loc.part_id);
+        if (idx >= first_data && idx < first_data + d)
+            by_index.emplace(idx - first_data, &loc);
+    }
+    if (static_cast<int>(by_index.size()) < d) return stNOT_POSSIBLE;
+    uint64_t lo_block = off / kBlockSize;
+    uint64_t hi_block = (off + size - 1) / kBlockSize;
+    uint64_t lo_slot = lo_block / d, hi_slot = hi_block / d;
+    uint32_t nslots = static_cast<uint32_t>(hi_slot - lo_slot + 1);
+    std::vector<std::vector<uint8_t>> parts(d);
+    for (int i = 0; i < d; ++i) {
+        const Location* loc = by_index[i];
+        int fd = fs->data_fd(loc->host, loc->port);
+        if (fd < 0) return kErrConn;
+        parts[i].resize(static_cast<size_t>(nslots) * kBlockSize);
+        int rc = lz_read_part_bulk(
+            fd, g.chunk_id, g.version, loc->part_id,
+            static_cast<uint32_t>(lo_slot * kBlockSize),
+            nslots * kBlockSize, parts[i].data());
+        if (rc != 0) {
+            fs->drop_data_fd(loc->host, loc->port);
+            return rc < 0 ? kErrConn : rc;
+        }
+    }
+    for (uint64_t b = lo_block; b <= hi_block; ++b) {
+        int part = static_cast<int>(b % d);
+        uint64_t slot = b / d - lo_slot;
+        uint64_t block_start = b * kBlockSize;
+        uint64_t s = std::max(off, block_start);
+        uint64_t e = std::min(off + size, block_start + kBlockSize);
+        std::memcpy(buf + (s - off),
+                    parts[part].data() + slot * kBlockSize +
+                        (s - block_start),
+                    e - s);
+    }
+    return stOK;
+}
+
+// write [off, off+size) of one chunk (standard goals only)
+int write_chunk_range(liz_t* fs, const ChunkGrant& g, uint32_t inode,
+                      uint32_t chunk_index, uint64_t off, uint64_t size,
+                      const uint8_t* buf, uint64_t new_file_length) {
+    int slice = g.locations.empty() ? -1 : slice_type_of(g.locations[0].part_id);
+    if (slice != 0) {
+        // striped writes need the parity planner (FUSE path) — but the
+        // grant already version-bumped and LOCKED the chunk; an error
+        // WriteChunkEnd releases the lock instead of leaking it 30 s
+        Msg endm(kCltomaWriteChunkEnd);
+        endm.u32(fs->req_id++).u64(g.chunk_id).u32(inode).u32(chunk_index);
+        endm.u64(g.file_length).u8(stEIO);
+        fs->call(endm, kMatoclStatusReply);
+        return stNOT_POSSIBLE;
+    }
+    // one chain through all copies (WriteExecutor analog)
+    const Location& head = g.locations[0];
+    int fd = connect_tcp(head.host, head.port);  // exclusive for the chain
+    if (fd < 0) return kErrConn;
+    int code = stEIO;
+    do {
+        Msg init(kCltocsWriteInit);
+        init.u32(1).u64(g.chunk_id).u32(g.version).u32(head.part_id);
+        init.u32(static_cast<uint32_t>(g.locations.size() - 1));
+        for (size_t i = 1; i < g.locations.size(); ++i) {
+            init.str(g.locations[i].host);
+            init.u16(g.locations[i].port);
+            init.u32(g.locations[i].part_id);
+        }
+        init.u8(0);  // create=False: the master created the parts
+        if (!init.send(fd)) {
+            code = kErrConn;
+            break;
+        }
+        std::vector<uint8_t> reply;
+        if (recv_frame(fd, &reply) != kCstoclWriteStatus) {
+            code = kErrConn;
+            break;
+        }
+        Reader r(reply.data() + 1, reply.size() - 1);
+        r.u32();
+        r.u64();
+        r.u32();
+        int st0 = r.u8();
+        if (st0 != stOK) {
+            code = st0;
+            break;
+        }
+        int rc = (off % kBlockSize == 0 ? lz_write_part_bulk : lz_write_part)(
+            fd, g.chunk_id, buf, size, off, 1);
+        if (rc != 0) {
+            code = rc < 0 ? kErrConn : rc;
+            break;
+        }
+        Msg end(kCltocsWriteEnd);
+        end.u32(0).u64(g.chunk_id);
+        if (!end.send(fd) || recv_frame(fd, &reply) != kCstoclWriteStatus) {
+            code = kErrConn;
+            break;
+        }
+        Reader re(reply.data() + 1, reply.size() - 1);
+        re.u32();
+        re.u64();
+        re.u32();
+        code = re.u8();
+    } while (false);
+    ::close(fd);
+
+    // WriteChunkEnd commits the new length and unlocks the chunk
+    Msg endm(kCltomaWriteChunkEnd);
+    endm.u32(fs->req_id++).u64(g.chunk_id).u32(inode).u32(chunk_index);
+    endm.u64(new_file_length).u8(static_cast<uint8_t>(code == stOK ? 0 : 9));
+    if (fs->call(endm, kMatoclStatusReply) == 0) return kErrConn;
+    return code;
+}
+
+}  // namespace
+
+extern "C" {
+
+liz_t* liz_init(const char* host, int port, const char* password) {
+    liz_t* fs = new liz_t();
+    fs->host = host;
+    fs->port = port;
+    fs->password = password != nullptr ? password : "";
+    if (!fs->reconnect()) {
+        delete fs;
+        return nullptr;
+    }
+    return fs;
+}
+
+void liz_destroy(liz_t* fs) {
+    if (fs == nullptr) return;
+    {
+        std::lock_guard<std::mutex> g(fs->mu);
+        if (fs->master_fd >= 0) {
+            // clean goodbye (releases our locks server-side), best effort
+            Msg bye(kCltomaGoodbye);
+            bye.u32(fs->req_id++);
+            fs->call(bye, kMatoclStatusReply);
+        }
+    }
+    delete fs;
+}
+
+void liz_set_identity(liz_t* fs, uint32_t uid, uint32_t gid) {
+    std::lock_guard<std::mutex> g(fs->mu);
+    fs->uid = uid;
+    fs->gid = gid;
+}
+
+int liz_lookup(liz_t* fs, uint32_t parent, const char* name, liz_attr_t* out) {
+    Msg msg(kCltomaLookup);
+    msg.u32(fs->req_id++).u32(parent).str(name).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return attr_call(fs, msg, out);
+}
+
+int liz_getattr(liz_t* fs, uint32_t inode, liz_attr_t* out) {
+    Msg msg(kCltomaGetattr);
+    msg.u32(fs->req_id++).u32(inode);
+    return attr_call(fs, msg, out);
+}
+
+int liz_mkdir(liz_t* fs, uint32_t parent, const char* name, uint16_t mode,
+              liz_attr_t* out) {
+    Msg msg(kCltomaMkdir);
+    msg.u32(fs->req_id++).u32(parent).str(name).u16(mode).u32(fs->uid)
+        .u32(fs->gid);
+    return attr_call(fs, msg, out);
+}
+
+int liz_create(liz_t* fs, uint32_t parent, const char* name, uint16_t mode,
+               liz_attr_t* out) {
+    Msg msg(kCltomaCreate);
+    msg.u32(fs->req_id++).u32(parent).str(name).u16(mode).u32(fs->uid)
+        .u32(fs->gid);
+    return attr_call(fs, msg, out);
+}
+
+int liz_unlink(liz_t* fs, uint32_t parent, const char* name) {
+    Msg msg(kCltomaUnlink);
+    msg.u32(fs->req_id++).u32(parent).str(name).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return status_call(fs, msg);
+}
+
+int liz_rmdir(liz_t* fs, uint32_t parent, const char* name) {
+    Msg msg(kCltomaRmdir);
+    msg.u32(fs->req_id++).u32(parent).str(name).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return status_call(fs, msg);
+}
+
+int liz_rename(liz_t* fs, uint32_t parent_src, const char* name_src,
+               uint32_t parent_dst, const char* name_dst) {
+    Msg msg(kCltomaRename);
+    msg.u32(fs->req_id++).u32(parent_src).str(name_src).u32(parent_dst)
+        .str(name_dst).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return status_call(fs, msg);
+}
+
+int liz_symlink(liz_t* fs, uint32_t parent, const char* name,
+                const char* target, liz_attr_t* out) {
+    Msg msg(kCltomaSymlink);
+    msg.u32(fs->req_id++).u32(parent).str(name).str(target).u32(fs->uid)
+        .u32(fs->gid);
+    return attr_call(fs, msg, out);
+}
+
+int liz_readlink(liz_t* fs, uint32_t inode, char* buf, uint32_t bufsize) {
+    Msg msg(kCltomaReadlink);
+    msg.u32(fs->req_id++).u32(inode);
+    std::lock_guard<std::mutex> g(fs->mu);
+    uint32_t got = fs->call(msg, kMatoclReadlink);
+    if (got == 0) return kErrConn;
+    Reader r(fs->payload.data() + 1, fs->payload.size() - 1);
+    if (got == kMatoclStatusReply) {
+        r.u32();
+        int status = r.u8();
+        return r.ok() && status != stOK ? status : kErrConn;
+    }
+    r.u32();
+    int status = r.u8();
+    std::string target = r.str();
+    if (!r.ok()) return kErrConn;
+    if (status != stOK) return status;
+    if (target.size() + 1 > bufsize) return stEINVAL;
+    std::memcpy(buf, target.c_str(), target.size() + 1);
+    return stOK;
+}
+
+int liz_link(liz_t* fs, uint32_t inode, uint32_t parent, const char* name,
+             liz_attr_t* out) {
+    Msg msg(kCltomaLink);
+    msg.u32(fs->req_id++).u32(inode).u32(parent).str(name).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return attr_call(fs, msg, out);
+}
+
+int liz_readdir(liz_t* fs, uint32_t inode, uint32_t offset,
+                liz_direntry_t* entries, uint32_t max, uint32_t* n) {
+    Msg msg(kCltomaReaddir);
+    msg.u32(fs->req_id++).u32(inode).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    std::lock_guard<std::mutex> g(fs->mu);
+    uint32_t got = fs->call(msg, kMatoclReaddir);
+    if (got == 0) return kErrConn;
+    Reader r(fs->payload.data() + 1, fs->payload.size() - 1);
+    if (got == kMatoclStatusReply) {
+        r.u32();
+        int status = r.u8();
+        return r.ok() && status != stOK ? status : kErrConn;
+    }
+    r.u32();
+    int status = r.u8();
+    uint32_t count = r.u32();
+    if (status != stOK) return status;
+    uint32_t out_n = 0;
+    for (uint32_t i = 0; i < count && r.ok(); ++i) {
+        std::string name = r.str();
+        uint32_t child = r.u32();
+        uint8_t ftype = r.u8();
+        if (i < offset || out_n >= max) continue;
+        liz_direntry_t* e = &entries[out_n++];
+        std::snprintf(e->name, sizeof(e->name), "%s", name.c_str());
+        e->inode = child;
+        e->ftype = ftype;
+    }
+    if (!r.ok()) return kErrConn;
+    *n = out_n;
+    return stOK;
+}
+
+int liz_setattr(liz_t* fs, uint32_t inode, uint8_t set_mask, uint16_t mode,
+                uint32_t uid, uint32_t gid, uint32_t atime, uint32_t mtime,
+                liz_attr_t* out) {
+    Msg msg(kCltomaSetattr);
+    msg.u32(fs->req_id++).u32(inode).u8(set_mask).u16(mode).u32(uid).u32(gid)
+        .u32(atime).u32(mtime).u32(0 /* trash_time */).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return attr_call(fs, msg, out);
+}
+
+int liz_truncate(liz_t* fs, uint32_t inode, uint64_t length) {
+    Msg msg(kCltomaTruncate);
+    msg.u32(fs->req_id++).u32(inode).u64(length).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    return attr_call(fs, msg, nullptr);
+}
+
+int liz_access(liz_t* fs, uint32_t inode, uint8_t mask) {
+    Msg msg(kCltomaAccess);
+    msg.u32(fs->req_id++).u32(inode).u32(fs->uid);
+    uint32_t gids[1] = {fs->gid};
+    msg.u32list(gids, 1);
+    msg.u8(mask);
+    return status_call(fs, msg);
+}
+
+int64_t liz_read(liz_t* fs, uint32_t inode, uint64_t offset, uint64_t size,
+                 uint8_t* buf) {
+    std::lock_guard<std::mutex> g(fs->mu);
+    uint64_t done = 0;
+    while (done < size) {
+        uint64_t pos = offset + done;
+        uint32_t ci = static_cast<uint32_t>(pos / kChunkSize);
+        ChunkGrant grant =
+            chunk_call(fs, kCltomaReadChunk, kMatoclReadChunk, inode, ci);
+        if (grant.status != stOK)
+            return done ? static_cast<int64_t>(done)
+                        : (grant.status < 0 ? kErrConn : -grant.status);
+        if (pos >= grant.file_length) break;  // EOF
+        uint64_t coff = pos % kChunkSize;
+        uint64_t chunk_len =
+            std::min<uint64_t>(grant.file_length - ci * kChunkSize, kChunkSize);
+        uint64_t take =
+            std::min({size - done, kChunkSize - coff, chunk_len - coff});
+        int rc = read_chunk_range(fs, grant, coff, take, buf + done);
+        if (rc != stOK)
+            return done ? static_cast<int64_t>(done)
+                        : (rc < 0 ? kErrConn : -rc);
+        done += take;
+    }
+    return static_cast<int64_t>(done);
+}
+
+int64_t liz_write(liz_t* fs, uint32_t inode, uint64_t offset, uint64_t size,
+                  const uint8_t* buf) {
+    std::lock_guard<std::mutex> g(fs->mu);
+    uint64_t done = 0;
+    while (done < size) {
+        uint64_t pos = offset + done;
+        uint32_t ci = static_cast<uint32_t>(pos / kChunkSize);
+        uint64_t coff = pos % kChunkSize;
+        uint64_t take = std::min(size - done, kChunkSize - coff);
+        ChunkGrant grant =
+            chunk_call(fs, kCltomaWriteChunk, kMatoclWriteChunk, inode, ci);
+        if (grant.status != stOK)
+            return done ? static_cast<int64_t>(done)
+                        : (grant.status < 0 ? kErrConn : -grant.status);
+        uint64_t new_len = std::max(grant.file_length, pos + take);
+        int rc = write_chunk_range(fs, grant, inode, ci, coff, take,
+                                   buf + done, new_len);
+        if (rc != stOK)
+            return done ? static_cast<int64_t>(done)
+                        : (rc < 0 ? kErrConn : -rc);
+        done += take;
+    }
+    return static_cast<int64_t>(done);
+}
+
+const char* liz_strerror(int code) {
+    switch (code < 0 ? -code : code) {
+        case 0: return "OK";
+        case 1: return "EPERM";
+        case 2: return "ENOENT";
+        case 3: return "EACCES";
+        case 4: return "EEXIST";
+        case 5: return "EINVAL";
+        case 6: return "ENOTDIR";
+        case 7: return "EISDIR";
+        case 8: return "ENOSPC";
+        case 9: return "EIO";
+        case 10: return "ENOTEMPTY";
+        case 16: return "NO_CHUNK";
+        case 19: return "WRONG_VERSION";
+        case 20: return "CRC_ERROR";
+        case 24: return "QUOTA_EXCEEDED";
+        case 26: return "EROFS";
+        case 29: return "NOT_POSSIBLE (striped data path: use FUSE)";
+        default: return "lizardfs error";
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+/* lizardfs_tpu C client API.
+ *
+ * The language-neutral embedding surface for external consumers (NFS
+ * gateways, language bindings, user applications) — the analog of the
+ * reference's liblizardfs-client (reference:
+ * src/mount/client/lizardfs_c_api.h:38-96). The whole client runs in
+ * C++ (native/client_native.cpp): master RPCs over the control
+ * protocol, data over the native bulk data plane — no Python anywhere.
+ *
+ * Return codes: 0 = OK; >0 = a lizardfs status code
+ * (lizardfs_tpu/proto/status.py: 2 ENOENT, 3 EACCES, 5 EINVAL, ...);
+ * -1 = connection/protocol failure. liz_read/liz_write return the byte
+ * count (>= 0) or the negated versions of the above.
+ *
+ * v1 scope: full metadata surface + standard-goal data path; striped
+ * (xor/ec) files are readable while all data parts are live. Degraded
+ * striped reads and striped writes need the recovery planner — use the
+ * FUSE mount for those.
+ */
+#ifndef LIZARDFS_CLIENT_H
+#define LIZARDFS_CLIENT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct liz liz_t;
+
+typedef struct {
+    uint32_t inode;
+    uint8_t ftype; /* 1 = file, 2 = dir, 3 = symlink */
+    uint16_t mode;
+    uint32_t uid, gid;
+    uint32_t atime, mtime, ctime;
+    uint32_t nlink;
+    uint64_t length;
+    uint8_t goal;
+    uint32_t trash_time;
+} liz_attr_t;
+
+typedef struct {
+    char name[256];
+    uint32_t inode;
+    uint8_t ftype;
+} liz_direntry_t;
+
+#define LIZ_ROOT_INODE 1u
+
+/* Connect + register a session. password may be NULL. NULL on failure. */
+liz_t* liz_init(const char* host, int port, const char* password);
+void liz_destroy(liz_t* fs);
+
+/* Caller identity attached to permission-checked operations. */
+void liz_set_identity(liz_t* fs, uint32_t uid, uint32_t gid);
+
+int liz_lookup(liz_t* fs, uint32_t parent, const char* name, liz_attr_t* out);
+int liz_getattr(liz_t* fs, uint32_t inode, liz_attr_t* out);
+int liz_mkdir(liz_t* fs, uint32_t parent, const char* name, uint16_t mode,
+              liz_attr_t* out);
+int liz_create(liz_t* fs, uint32_t parent, const char* name, uint16_t mode,
+               liz_attr_t* out);
+int liz_unlink(liz_t* fs, uint32_t parent, const char* name);
+int liz_rmdir(liz_t* fs, uint32_t parent, const char* name);
+int liz_rename(liz_t* fs, uint32_t parent_src, const char* name_src,
+               uint32_t parent_dst, const char* name_dst);
+int liz_symlink(liz_t* fs, uint32_t parent, const char* name,
+                const char* target, liz_attr_t* out);
+int liz_readlink(liz_t* fs, uint32_t inode, char* buf, uint32_t bufsize);
+int liz_link(liz_t* fs, uint32_t inode, uint32_t parent, const char* name,
+             liz_attr_t* out);
+
+/* Fills up to max entries starting at entry index offset; *n = count. */
+int liz_readdir(liz_t* fs, uint32_t inode, uint32_t offset,
+                liz_direntry_t* entries, uint32_t max, uint32_t* n);
+
+/* set_mask: 1 = mode, 2 = uid, 4 = gid, 8 = atime, 16 = mtime. */
+int liz_setattr(liz_t* fs, uint32_t inode, uint8_t set_mask, uint16_t mode,
+                uint32_t uid, uint32_t gid, uint32_t atime, uint32_t mtime,
+                liz_attr_t* out);
+int liz_truncate(liz_t* fs, uint32_t inode, uint64_t length);
+int liz_access(liz_t* fs, uint32_t inode, uint8_t mask); /* r4 w2 x1 */
+
+int64_t liz_read(liz_t* fs, uint32_t inode, uint64_t offset, uint64_t size,
+                 uint8_t* buf);
+int64_t liz_write(liz_t* fs, uint32_t inode, uint64_t offset, uint64_t size,
+                  const uint8_t* buf);
+
+const char* liz_strerror(int code);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* LIZARDFS_CLIENT_H */

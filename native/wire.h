@@ -1,0 +1,204 @@
+// Shared wire helpers for the native client/server sources.
+//
+// Frame = header(type:u32 BE, length:u32 BE) + version:u8 + body
+// (lizardfs_tpu/proto/framing.py). Strings/bytes are u32-length-
+// prefixed; lists are u32-count-prefixed (proto/codec.py).
+#pragma once
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <netdb.h>
+#include <vector>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace lzwire {
+
+constexpr uint8_t kProtoVersion = 1;
+
+inline void put16(uint8_t* p, uint16_t v) { p[0] = v >> 8; p[1] = v; }
+inline void put32(uint8_t* p, uint32_t v) {
+    p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+inline void put64(uint8_t* p, uint64_t v) {
+    put32(p, static_cast<uint32_t>(v >> 32));
+    put32(p + 4, static_cast<uint32_t>(v));
+}
+inline uint16_t get16(const uint8_t* p) {
+    return static_cast<uint16_t>((p[0] << 8) | p[1]);
+}
+inline uint32_t get32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline uint64_t get64(const uint8_t* p) {
+    return (uint64_t(get32(p)) << 32) | get32(p + 4);
+}
+
+inline bool send_all(int fd, const uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::send(fd, buf, len, MSG_NOSIGNAL);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+inline bool recv_all(int fd, uint8_t* buf, size_t len) {
+    while (len) {
+        ssize_t n = ::recv(fd, buf, len, 0);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+inline int connect_tcp(const std::string& host, uint16_t port) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[8];
+    std::snprintf(portstr, sizeof(portstr), "%u", port);
+    struct addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0) return -1;
+    int fd = -1;
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd >= 0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        int bufsz = 4 * 1024 * 1024;
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+    }
+    return fd;
+}
+
+// Growable message builder for request bodies.
+class Msg {
+  public:
+    explicit Msg(uint32_t type) : type_(type) {
+        buf_.resize(9);
+        buf_[8] = kProtoVersion;
+    }
+    Msg& u8(uint8_t v) { buf_.push_back(v); return *this; }
+    Msg& u16(uint16_t v) {
+        size_t n = buf_.size();
+        buf_.resize(n + 2);
+        put16(buf_.data() + n, v);
+        return *this;
+    }
+    Msg& u32(uint32_t v) {
+        size_t n = buf_.size();
+        buf_.resize(n + 4);
+        put32(buf_.data() + n, v);
+        return *this;
+    }
+    Msg& u64(uint64_t v) {
+        size_t n = buf_.size();
+        buf_.resize(n + 8);
+        put64(buf_.data() + n, v);
+        return *this;
+    }
+    Msg& str(const std::string& s) {
+        u32(static_cast<uint32_t>(s.size()));
+        buf_.insert(buf_.end(), s.begin(), s.end());
+        return *this;
+    }
+    Msg& u32list(const uint32_t* v, uint32_t n) {
+        u32(n);
+        for (uint32_t i = 0; i < n; ++i) u32(v[i]);
+        return *this;
+    }
+    bool send(int fd) {
+        put32(buf_.data(), type_);
+        put32(buf_.data() + 4, static_cast<uint32_t>(buf_.size() - 8));
+        return send_all(fd, buf_.data(), buf_.size());
+    }
+
+  private:
+    uint32_t type_;
+    std::vector<uint8_t> buf_;
+};
+
+// Cursor over a received payload (starts after the version byte).
+class Reader {
+  public:
+    Reader(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+    bool ok() const { return ok_; }
+    uint8_t u8() { return ok_ && need(1) ? p_[pos_++] : 0; }
+    uint16_t u16() {
+        if (!need(2)) return 0;
+        uint16_t v = get16(p_ + pos_);
+        pos_ += 2;
+        return v;
+    }
+    uint32_t u32() {
+        if (!need(4)) return 0;
+        uint32_t v = get32(p_ + pos_);
+        pos_ += 4;
+        return v;
+    }
+    uint64_t u64() {
+        if (!need(8)) return 0;
+        uint64_t v = get64(p_ + pos_);
+        pos_ += 8;
+        return v;
+    }
+    std::string str() {
+        uint32_t n = u32();
+        if (!need(n)) return "";
+        std::string s(reinterpret_cast<const char*>(p_ + pos_), n);
+        pos_ += n;
+        return s;
+    }
+
+  private:
+    bool need(size_t n) {
+        if (pos_ + n > n_) {
+            ok_ = false;
+            return false;
+        }
+        return true;
+    }
+    const uint8_t* p_;
+    size_t n_;
+    size_t pos_ = 0;
+    bool ok_ = true;
+};
+
+// Read one frame; payload (incl. version byte) lands in out. Returns
+// the message type or 0 on socket error.
+inline uint32_t recv_frame(int fd, std::vector<uint8_t>* out,
+                           size_t max = 128u << 20) {
+    uint8_t header[8];
+    if (!recv_all(fd, header, 8)) return 0;
+    uint32_t type = get32(header);
+    uint32_t length = get32(header + 4);
+    if (length < 1 || length > max) return 0;
+    out->resize(length);
+    if (!recv_all(fd, out->data(), length)) return 0;
+    if ((*out)[0] != kProtoVersion) return 0;
+    return type;
+}
+
+}  // namespace lzwire
