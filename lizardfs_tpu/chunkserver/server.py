@@ -132,16 +132,25 @@ class ChunkServer(Daemon):
             from lizardfs_tpu.chunkserver import native_serve
 
             if native_serve.available():
-                try:
-                    self.data_server = native_serve.DataPlaneServer(
-                        [s.folder for s in self.store.stores], self.host
-                    )
-                    self.log.info(
-                        "native data plane on %s:%d",
-                        self.host, self.data_server.port,
-                    )
-                except RuntimeError as e:
-                    self.log.warning("native data plane unavailable: %s", e)
+                # lz_serve_start can fail transiently (fd pressure /
+                # ephemeral-port races under heavy test load): retry
+                # before falling back to the asyncio data path
+                for attempt in range(3):
+                    try:
+                        self.data_server = native_serve.DataPlaneServer(
+                            [s.folder for s in self.store.stores], self.host
+                        )
+                        self.log.info(
+                            "native data plane on %s:%d",
+                            self.host, self.data_server.port,
+                        )
+                        break
+                    except RuntimeError as e:
+                        self.log.warning(
+                            "native data plane start failed "
+                            "(attempt %d/3): %s", attempt + 1, e,
+                        )
+                        await asyncio.sleep(0.2 * (attempt + 1))
         self.add_timer(self.heartbeat_interval, self._heartbeat)
         self.add_timer(60.0, self._test_chunks)
 

@@ -216,6 +216,11 @@ class Client:
         r = await self._call(m.CltomaGetattr, inode=inode)
         return r.attr
 
+    async def statfs(self) -> tuple[int, int]:
+        """Cluster (total_bytes, available_bytes) across chunkservers."""
+        r = await self._call(m.CltomaStatFs)
+        return r.total_space, r.avail_space
+
     async def mkdir(
         self, parent: int, name: str, mode: int = 0o755, uid: int = 0, gid: int = 0
     ) -> m.Attr:
@@ -260,9 +265,11 @@ class Client:
             **self._ident(uid, gids),
         )
 
-    async def symlink(self, parent: int, name: str, target: str) -> m.Attr:
+    async def symlink(self, parent: int, name: str, target: str,
+                      uid: int = 0, gid: int = 0) -> m.Attr:
         r = await self._call(
-            m.CltomaSymlink, parent=parent, name=name, target=target, uid=0, gid=0
+            m.CltomaSymlink, parent=parent, name=name, target=target,
+            uid=uid, gid=gid
         )
         return r.attr
 

@@ -23,6 +23,7 @@ import errno
 import stat as stat_mod
 import sys
 import threading
+import time
 
 from lizardfs_tpu.client.client import Client
 from lizardfs_tpu.constants import MFSBLOCKSIZE
@@ -423,8 +424,12 @@ class LizardFuse:
             return 0
 
         def op_symlink(target, link):
+            uid, gids = self._caller()
             parent, name = self._resolve_parent(link)
-            self._run(self.client.symlink(parent.inode, name, target.decode()))
+            self._run(self.client.symlink(
+                parent.inode, name, target.decode(), uid=uid,
+                gid=gids[0] if gids else 0,
+            ))
             return 0
 
         def op_readlink(path, buf, size):
@@ -495,13 +500,25 @@ class LizardFuse:
             )
             return 0
 
+        statfs_cache = {"t": 0.0, "v": (1 << 30 << 16, 1 << 29 << 16)}
+
         def op_statfs(path, out):
             ctypes.memset(ctypes.byref(out.contents), 0, ctypes.sizeof(StatVfs))
             out.contents.f_bsize = MFSBLOCKSIZE
             out.contents.f_frsize = MFSBLOCKSIZE
-            out.contents.f_blocks = 1 << 30
-            out.contents.f_bfree = 1 << 29
-            out.contents.f_bavail = 1 << 29
+            # desktop tools poll statvfs aggressively; one master RPC
+            # per few seconds, stale-on-error
+            now = time.monotonic()
+            if now - statfs_cache["t"] > 5.0:
+                try:
+                    statfs_cache["v"] = self._run(self.client.statfs())
+                    statfs_cache["t"] = now
+                except Exception:
+                    statfs_cache["t"] = now - 4.0  # retry soon, serve stale
+            total, avail = statfs_cache["v"]
+            out.contents.f_blocks = total // MFSBLOCKSIZE
+            out.contents.f_bfree = avail // MFSBLOCKSIZE
+            out.contents.f_bavail = avail // MFSBLOCKSIZE
             out.contents.f_namemax = 255
             return 0
 

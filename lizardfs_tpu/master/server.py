@@ -717,10 +717,26 @@ class MasterServer(Daemon):
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaLookup):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 1)
+            if msg.name in (".", ".."):
+                # NFS/FUSE path walking.  ".." clamps at the session's
+                # export root so a subtree export can't be escaped.
+                node = fs.dir_node(msg.parent)
+                sroot = session.get("root", fsmod.ROOT_INODE)
+                if msg.name == ".." and node.inode != sroot and node.parents:
+                    node = fs.node(node.parents[0])
+                return self._attr_reply(msg.req_id, node)
             node = fs.lookup(msg.parent, msg.name)
             return self._attr_reply(msg.req_id, node)
         if isinstance(msg, m.CltomaGetattr):
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaStatFs):
+            servers = self.meta.registry.connected_servers()
+            total = sum(s.total_space for s in servers)
+            avail = sum(s.free_space for s in servers)
+            return m.MatoclStatFsReply(
+                req_id=msg.req_id, status=st.OK, total_space=total,
+                avail_space=avail, inodes=len(fs.nodes),
+            )
         if isinstance(msg, m.CltomaMkdir):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
             self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)

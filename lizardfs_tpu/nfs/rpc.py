@@ -1,0 +1,267 @@
+"""ONC-RPC v2 (RFC 5531) over TCP with record marking, on asyncio.
+
+Carries the MOUNT3/NFS3 programs of the gateway. The server side is a
+program registry: ``(prog, vers) -> async handler(proc, cred, Unpacker)
+-> bytes``. AUTH_SYS (flavor 1) credentials are parsed into
+:class:`Credential` and become the per-call identity the NFS layer
+forwards to the cluster client — same role as Ganesha's op_ctx creds in
+the reference FSAL (src/nfs-ganesha/handle.c uses op_ctx->creds for
+every op).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from dataclasses import dataclass, field
+
+from lizardfs_tpu.nfs.xdr import Packer, Unpacker, XdrError
+
+log = logging.getLogger("lizardfs.nfs.rpc")
+
+RPC_VERSION = 2
+CALL, REPLY = 0, 1
+MSG_ACCEPTED, MSG_DENIED = 0, 1
+# accept_stat
+SUCCESS, PROG_UNAVAIL, PROG_MISMATCH, PROC_UNAVAIL, GARBAGE_ARGS, SYSTEM_ERR = (
+    0, 1, 2, 3, 4, 5,
+)
+# auth flavors
+AUTH_NONE, AUTH_SYS = 0, 1
+
+MAX_RECORD = 1 << 22  # 4 MiB: caps rsize/wsize plus headroom
+
+
+@dataclass
+class Credential:
+    uid: int = 0
+    gid: int = 0
+    gids: list[int] = field(default_factory=list)
+    machine: str = ""
+
+    @property
+    def all_gids(self) -> list[int]:
+        out = [self.gid] + [g for g in self.gids if g != self.gid]
+        return out
+
+
+def parse_auth_sys(body: bytes) -> Credential:
+    u = Unpacker(body)
+    u.u32()  # stamp
+    machine = u.string(255)
+    uid = u.u32()
+    gid = u.u32()
+    n = u.u32()
+    if n > 16:
+        raise XdrError(f"too many aux gids: {n}")
+    gids = [u.u32() for _ in range(n)]
+    return Credential(uid=uid, gid=gid, gids=gids, machine=machine)
+
+
+async def read_record(reader: asyncio.StreamReader) -> bytes:
+    """One RPC record: fragments with a last-fragment marker bit."""
+    chunks: list[bytes] = []
+    total = 0
+    while True:
+        hdr = await reader.readexactly(4)
+        (word,) = struct.unpack(">I", hdr)
+        last, flen = bool(word & 0x80000000), word & 0x7FFFFFFF
+        total += flen
+        if total > MAX_RECORD:
+            raise XdrError(f"RPC record too long: {total}")
+        chunks.append(await reader.readexactly(flen))
+        if last:
+            return b"".join(chunks)
+
+
+def frame_record(payload: bytes) -> bytes:
+    return struct.pack(">I", 0x80000000 | len(payload)) + payload
+
+
+def _reply_header(xid: int) -> Packer:
+    p = Packer()
+    p.u32(xid).u32(REPLY).u32(MSG_ACCEPTED)
+    p.u32(AUTH_NONE).u32(0)  # verifier
+    return p
+
+
+def accepted_reply(xid: int, result: bytes) -> bytes:
+    return _reply_header(xid).u32(SUCCESS).raw(result).bytes()
+
+
+def error_reply(xid: int, accept_stat: int) -> bytes:
+    return _reply_header(xid).u32(accept_stat).bytes()
+
+
+class RpcServer:
+    """TCP ONC-RPC server dispatching to registered program handlers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host, self.port = host, port
+        self._programs: dict[tuple[int, int], object] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def register(self, prog: int, vers: int, handler) -> None:
+        """handler: async (proc: int, cred: Credential, args: Unpacker) -> bytes.
+        Raise ProcUnavail to signal an unknown procedure."""
+        self._programs[(prog, vers)] = handler
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """NFS clients multiplex many outstanding ops on one TCP
+        connection; dispatch each record as its own task (replies may
+        reorder — xids pair them) and serialize only the writes."""
+        peer = writer.get_extra_info("peername")
+        wlock = asyncio.Lock()
+        inflight: set[asyncio.Task] = set()
+
+        async def run_one(record: bytes) -> None:
+            try:
+                reply = await self._dispatch(record)
+                if reply is None:
+                    return
+                async with wlock:
+                    writer.write(frame_record(reply))
+                    await writer.drain()
+            except (ConnectionError, OSError):
+                pass  # peer went away mid-reply
+            except XdrError as e:
+                log.warning("nfs rpc: bad record from %s: %s", peer, e)
+
+        try:
+            while True:
+                try:
+                    record = await read_record(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                task = asyncio.ensure_future(run_one(record))
+                inflight.add(task)
+                task.add_done_callback(inflight.discard)
+                if len(inflight) >= 64:  # backpressure: stop reading
+                    _, pending = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+        except XdrError as e:
+            log.warning("nfs rpc: dropping %s: %s", peer, e)
+        except Exception:
+            log.exception("nfs rpc: connection error from %s", peer)
+        finally:
+            for t in inflight:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _dispatch(self, record: bytes) -> bytes | None:
+        u = Unpacker(record)
+        xid = u.u32()
+        if u.u32() != CALL:
+            return None  # ignore stray replies
+        if u.u32() != RPC_VERSION:
+            # RPC_MISMATCH denial
+            p = Packer()
+            p.u32(xid).u32(REPLY).u32(MSG_DENIED).u32(0).u32(2).u32(2)
+            return p.bytes()
+        prog, vers, proc = u.u32(), u.u32(), u.u32()
+        cred_flavor = u.u32()
+        cred_body = u.opaque(400)
+        u.u32()  # verf flavor
+        u.opaque(400)  # verf body
+        if cred_flavor == AUTH_SYS:
+            cred = parse_auth_sys(cred_body)
+        else:
+            # no credential != root: anonymous callers run as nobody
+            cred = Credential(uid=65534, gid=65534)
+        handler = self._programs.get((prog, vers))
+        if handler is None:
+            return error_reply(xid, PROG_UNAVAIL)
+        try:
+            result = await handler(proc, cred, u)
+        except ProcUnavail:
+            return error_reply(xid, PROC_UNAVAIL)
+        except XdrError:
+            return error_reply(xid, GARBAGE_ARGS)
+        except Exception:
+            log.exception("nfs rpc: handler error prog=%d proc=%d", prog, proc)
+            return error_reply(xid, SYSTEM_ERR)
+        return accepted_reply(xid, result)
+
+
+class ProcUnavail(Exception):
+    pass
+
+
+class RpcClient:
+    """Minimal ONC-RPC TCP client (tests + in-repo tooling)."""
+
+    def __init__(self, host: str, port: int, cred: Credential | None = None):
+        self.host, self.port = host, port
+        self.cred = cred or Credential()
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._xid = 1
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None
+
+    def _cred_bytes(self) -> bytes:
+        c = Packer()
+        c.u32(0).string(self.cred.machine or "pyclient")
+        c.u32(self.cred.uid).u32(self.cred.gid)
+        c.u32(len(self.cred.gids))
+        for g in self.cred.gids:
+            c.u32(g)
+        return c.bytes()
+
+    async def call(self, prog: int, vers: int, proc: int, args: bytes) -> Unpacker:
+        assert self._writer is not None, "not connected"
+        self._xid += 1
+        xid = self._xid
+        p = Packer()
+        p.u32(xid).u32(CALL).u32(RPC_VERSION)
+        p.u32(prog).u32(vers).u32(proc)
+        p.u32(AUTH_SYS).opaque(self._cred_bytes())
+        p.u32(AUTH_NONE).u32(0)
+        p.raw(args)
+        self._writer.write(frame_record(p.bytes()))
+        await self._writer.drain()
+        record = await read_record(self._reader)
+        u = Unpacker(record)
+        rxid = u.u32()
+        if rxid != xid or u.u32() != REPLY:
+            raise XdrError("bad RPC reply header")
+        if u.u32() != MSG_ACCEPTED:
+            raise XdrError("RPC call denied")
+        u.u32()
+        u.opaque(400)  # verifier
+        stat = u.u32()
+        if stat != SUCCESS:
+            raise XdrError(f"RPC accept_stat {stat}")
+        return u

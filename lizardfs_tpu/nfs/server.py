@@ -1,0 +1,753 @@
+"""NFSv3 + MOUNT3 gateway server (RFC 1813) backed by the cluster client.
+
+Role parity with the reference's NFS-Ganesha FSAL
+(src/nfs-ganesha/main.c, handle.c, export.c ~4.2k LoC): expose the
+filesystem to standard NFS clients with per-RPC AUTH_SYS identity
+enforced by the master. Instead of plugging into an external Ganesha
+daemon, the gateway embeds the protocol server itself: one asyncio
+process, one cluster ``Client`` connection shared by all NFS consumers
+(identity travels per-call, like Ganesha's op_ctx credentials).
+
+File handles are stable ``b"LZFH" + u32 inode`` — the master's inode
+space is flat and persistent, so handles survive gateway restarts (the
+FSAL's wire-handle round-trip, src/nfs-ganesha/handle.c
+lzfs_fsal_wire_to_host analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import secrets
+import struct
+import time
+
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.nfs import rpc
+from lizardfs_tpu.nfs.xdr import Packer, Unpacker, XdrError
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+
+log = logging.getLogger("lizardfs.nfs")
+
+PROG_PORTMAP, PROG_NFS, PROG_MOUNT = 100000, 100003, 100005
+ROOT_INODE = 1
+
+# NFS3 status codes (RFC 1813 §2.6)
+NFS3_OK = 0
+NFS3ERR_PERM = 1
+NFS3ERR_NOENT = 2
+NFS3ERR_IO = 5
+NFS3ERR_NXIO = 6
+NFS3ERR_ACCES = 13
+NFS3ERR_EXIST = 17
+NFS3ERR_NOTDIR = 20
+NFS3ERR_ISDIR = 21
+NFS3ERR_INVAL = 22
+NFS3ERR_FBIG = 27
+NFS3ERR_NOSPC = 28
+NFS3ERR_ROFS = 30
+NFS3ERR_MLINK = 31
+NFS3ERR_NAMETOOLONG = 63
+NFS3ERR_NOTEMPTY = 66
+NFS3ERR_DQUOT = 69
+NFS3ERR_STALE = 70
+NFS3ERR_BADHANDLE = 10001
+NFS3ERR_NOT_SYNC = 10002
+NFS3ERR_BAD_COOKIE = 10003
+NFS3ERR_NOTSUPP = 10004
+NFS3ERR_TOOSMALL = 10005
+NFS3ERR_SERVERFAULT = 10006
+
+_STATUS_MAP = {
+    st.OK: NFS3_OK,
+    st.EPERM: NFS3ERR_PERM,
+    st.ENOENT: NFS3ERR_NOENT,
+    st.EACCES: NFS3ERR_ACCES,
+    st.EEXIST: NFS3ERR_EXIST,
+    st.EINVAL: NFS3ERR_INVAL,
+    st.ENOTDIR: NFS3ERR_NOTDIR,
+    st.EISDIR: NFS3ERR_ISDIR,
+    st.ENOSPC: NFS3ERR_NOSPC,
+    st.EIO: NFS3ERR_IO,
+    st.ENOTEMPTY: NFS3ERR_NOTEMPTY,
+    st.QUOTA_EXCEEDED: NFS3ERR_DQUOT,
+    st.NAME_TOO_LONG: NFS3ERR_NAMETOOLONG,
+    st.EROFS: NFS3ERR_ROFS,
+    st.NO_CHUNK: NFS3ERR_STALE,
+}
+
+# ftype (proto) -> NF3 type
+_NF3 = {m.FTYPE_FILE: 1, m.FTYPE_DIR: 2, m.FTYPE_SYMLINK: 5}
+
+# ACCESS3 request bits
+ACCESS3_READ = 0x01
+ACCESS3_LOOKUP = 0x02
+ACCESS3_MODIFY = 0x04
+ACCESS3_EXTEND = 0x08
+ACCESS3_DELETE = 0x10
+ACCESS3_EXECUTE = 0x20
+
+
+class _NfsError(Exception):
+    def __init__(self, code: int):
+        self.code = code
+
+
+def _nfs_code(e: st.StatusError) -> int:
+    return _STATUS_MAP.get(e.code, NFS3ERR_IO)
+
+
+def fh_pack(inode: int) -> bytes:
+    return struct.pack(">4sI", b"LZFH", inode)
+
+
+def fh_unpack(handle: bytes) -> int:
+    if len(handle) != 8 or handle[:4] != b"LZFH":
+        raise _NfsError(NFS3ERR_BADHANDLE)
+    return struct.unpack(">I", handle[4:])[0]
+
+
+def _pack_fattr3(p: Packer, a: m.Attr) -> None:
+    p.u32(_NF3.get(a.ftype, 1))
+    p.u32(a.mode & 0o7777)
+    p.u32(max(a.nlink, 1))
+    p.u32(a.uid).u32(a.gid)
+    p.u64(a.length)
+    p.u64((a.length + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE * MFSBLOCKSIZE)
+    p.u32(0).u32(0)  # rdev
+    p.u64(0x4C5A4653)  # fsid ("LZFS")
+    p.u64(a.inode)
+    p.u32(a.atime).u32(0)
+    p.u32(a.mtime).u32(0)
+    p.u32(a.ctime).u32(0)
+
+
+def _post_op_attr(p: Packer, a: m.Attr | None) -> None:
+    if a is None:
+        p.boolean(False)
+    else:
+        p.boolean(True)
+        _pack_fattr3(p, a)
+
+
+def _wcc_data(p: Packer, post: m.Attr | None) -> None:
+    p.boolean(False)  # pre_op_attr: not tracked
+    _post_op_attr(p, post)
+
+
+class _Sattr3:
+    """Decoded sattr3: which attributes a SETATTR/CREATE wants to set."""
+
+    def __init__(self, u: Unpacker):
+        self.mode = u.u32() if u.boolean() else None
+        self.uid = u.u32() if u.boolean() else None
+        self.gid = u.u32() if u.boolean() else None
+        self.size = u.u64() if u.boolean() else None
+        how = u.u32()  # atime
+        self.atime = None
+        if how == 1:
+            self.atime = int(time.time())
+        elif how == 2:
+            self.atime = u.u32()
+            u.u32()
+        how = u.u32()  # mtime
+        self.mtime = None
+        if how == 1:
+            self.mtime = int(time.time())
+        elif how == 2:
+            self.mtime = u.u32()
+            u.u32()
+
+    def set_mask(self) -> tuple[int, dict]:
+        mask, kw = 0, {}
+        if self.mode is not None:
+            mask |= 1
+            kw["mode"] = self.mode & 0o7777
+        if self.uid is not None:
+            mask |= 2
+            kw["uid"] = self.uid
+        if self.gid is not None:
+            mask |= 4
+            kw["gid"] = self.gid
+        if self.atime is not None:
+            mask |= 8
+            kw["atime"] = self.atime
+        if self.mtime is not None:
+            mask |= 16
+            kw["mtime"] = self.mtime
+        return mask, kw
+
+
+class NfsGateway:
+    """One process serving MOUNT3 + NFS3 (and a local portmapper view).
+
+    ``exports`` maps export path -> cluster path ("/" by default). The
+    master still enforces its own exports/session ACLs on every op via
+    the per-RPC AUTH_SYS identity.
+    """
+
+    def __init__(
+        self,
+        master_host: str,
+        master_port: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        exports: dict[str, str] | None = None,
+    ) -> None:
+        self.client = Client(master_host, master_port)
+        self.rpc = rpc.RpcServer(host, port)
+        self.exports = exports or {"/": "/"}
+        self.write_verf = secrets.token_bytes(8)
+        self._mounts: set[tuple[str, str]] = set()
+        # export-root inodes, resolved at MNT time: ".." clamps here so
+        # a mount can't walk above its export (master-side subtree
+        # sessions clamp too when the gateway session itself is rooted).
+        # Like knfsd's default no_subtree_check, handles *guessed* for
+        # inodes outside an export are not rejected — use master-side
+        # subtree exports for hard isolation.
+        self._export_roots: set[int] = set()
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    async def start(self) -> None:
+        await self.client.connect(info="nfs-gateway")
+        for target in self.exports.values():
+            # pre-resolve export roots: clients reusing cached handles
+            # after a gateway restart never re-MNT
+            try:
+                self._export_roots.add((await self.client.resolve(target)).inode)
+            except st.StatusError:
+                pass  # export target may be created later; MNT re-resolves
+        self.rpc.register(PROG_MOUNT, 3, self._mount_dispatch)
+        self.rpc.register(PROG_NFS, 3, self._nfs_dispatch)
+        self.rpc.register(PROG_PORTMAP, 2, self._portmap_dispatch)
+        await self.rpc.start()
+        log.info("nfs gateway on port %d", self.port)
+
+    async def stop(self) -> None:
+        await self.rpc.stop()
+        await self.client.close()
+
+    # --- portmapper (RFC 1833 v2): just enough for clients probing us ----
+
+    async def _portmap_dispatch(
+        self, proc: int, cred: rpc.Credential, u: Unpacker
+    ) -> bytes:
+        if proc == 0:
+            return b""
+        if proc == 3:  # GETPORT
+            prog, _vers = u.u32(), u.u32()
+            port = self.port if prog in (PROG_NFS, PROG_MOUNT) else 0
+            return Packer().u32(port).bytes()
+        raise rpc.ProcUnavail
+
+    # --- MOUNT3 ----------------------------------------------------------
+
+    async def _mount_dispatch(
+        self, proc: int, cred: rpc.Credential, u: Unpacker
+    ) -> bytes:
+        if proc == 0:  # NULL
+            return b""
+        if proc == 1:  # MNT
+            path = u.string()
+            target = self.exports.get(path) or self.exports.get(
+                path.rstrip("/") or "/"
+            )
+            p = Packer()
+            if target is None:
+                return p.u32(NFS3ERR_NOENT).bytes()
+            try:
+                attr = await self.client.resolve(target)
+            except st.StatusError as e:
+                return p.u32(_nfs_code(e)).bytes()
+            self._mounts.add((cred.machine, path))
+            self._export_roots.add(attr.inode)
+            p.u32(NFS3_OK).opaque(fh_pack(attr.inode))
+            p.u32(1).u32(rpc.AUTH_SYS)  # auth flavors
+            return p.bytes()
+        if proc == 3:  # UMNT
+            path = u.string()
+            self._mounts.discard((cred.machine, path))
+            return b""
+        if proc == 4:  # UMNTALL
+            self._mounts = {mt for mt in self._mounts if mt[0] != cred.machine}
+            return b""
+        if proc == 5:  # EXPORT
+            p = Packer()
+            for path in self.exports:
+                p.boolean(True).string(path).boolean(False)  # no group list
+            p.boolean(False)
+            return p.bytes()
+        raise rpc.ProcUnavail
+
+    # --- NFS3 ------------------------------------------------------------
+
+    async def _nfs_dispatch(
+        self, proc: int, cred: rpc.Credential, u: Unpacker
+    ) -> bytes:
+        handler = self._PROCS.get(proc)
+        if handler is None:
+            raise rpc.ProcUnavail
+        try:
+            return await handler(self, cred, u)
+        except _NfsError as e:
+            return self._plain_error(proc, e.code)
+        except st.StatusError as e:
+            return self._plain_error(proc, _nfs_code(e))
+
+    def _plain_error(self, proc: int, code: int) -> bytes:
+        """Error reply with empty/absent optional attr fields, shaped per
+        procedure class (most carry post_op_attr; dir-modifying ops carry
+        wcc_data; RENAME/LINK carry two)."""
+        p = Packer().u32(code)
+        if proc in (7, 8, 9, 10, 11, 12, 13, 21):  # wcc_data
+            _wcc_data(p, None)
+        elif proc == 14:  # RENAME: two wcc_data
+            _wcc_data(p, None)
+            _wcc_data(p, None)
+        elif proc == 15:  # LINK: post_op_attr + wcc_data
+            p.boolean(False)
+            _wcc_data(p, None)
+        elif proc != 0:
+            p.boolean(False)  # post_op_attr absent
+        return p.bytes()
+
+    async def _attr(self, inode: int) -> m.Attr:
+        return await self.client.getattr(inode)
+
+    async def _attr_opt(self, inode: int) -> m.Attr | None:
+        try:
+            return await self.client.getattr(inode)
+        except st.StatusError:
+            return None
+
+    # Each proc_* returns the XDR result body (success or mapped error).
+
+    async def _proc_null(self, cred, u) -> bytes:
+        return b""
+
+    async def _proc_getattr(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        try:
+            attr = await self._attr(inode)
+        except st.StatusError as e:
+            return Packer().u32(_nfs_code(e)).bytes()
+        p = Packer().u32(NFS3_OK)
+        _pack_fattr3(p, attr)
+        return p.bytes()
+
+    async def _proc_setattr(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        sattr = _Sattr3(u)
+        if u.boolean():  # sattrguard3: compare-and-set on ctime
+            guard_ctime = u.u32()
+            u.u32()  # nsec (server ctimes are whole seconds)
+            current = await self._attr(inode)
+            if current.ctime != guard_ctime:
+                p = Packer().u32(NFS3ERR_NOT_SYNC)
+                _wcc_data(p, current)
+                return p.bytes()
+        if sattr.size is not None:
+            await self.client.truncate(
+                inode, sattr.size, uid=cred.uid, gids=cred.all_gids
+            )
+        mask, kw = sattr.set_mask()
+        attr = None
+        if mask:
+            attr = await self.client.setattr(
+                inode, mask, caller_uid=cred.uid,
+                caller_gids=cred.all_gids, **kw,
+            )
+        else:
+            attr = await self._attr_opt(inode)
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, attr)
+        return p.bytes()
+
+    async def _proc_lookup(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        p = Packer()
+        try:
+            if name == "." or (name == ".." and parent in self._export_roots):
+                # ".." clamps at the export root: no walking above a mount
+                attr = await self._attr(parent)
+            elif name == "..":
+                # the master resolves ".." itself (session-root aware)
+                attr = await self.client.lookup(
+                    parent, "..", uid=cred.uid, gids=cred.all_gids
+                )
+            else:
+                attr = await self.client.lookup(
+                    parent, name, uid=cred.uid, gids=cred.all_gids
+                )
+        except st.StatusError as e:
+            p.u32(_nfs_code(e))
+            _post_op_attr(p, await self._attr_opt(parent))
+            return p.bytes()
+        p.u32(NFS3_OK).opaque(fh_pack(attr.inode))
+        _post_op_attr(p, attr)
+        _post_op_attr(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_access(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        want = u.u32()
+        attr = await self._attr(inode)
+        granted = 0
+        checks = (
+            (ACCESS3_READ, 4),
+            (ACCESS3_LOOKUP | ACCESS3_EXECUTE, 1),
+            (ACCESS3_MODIFY | ACCESS3_EXTEND | ACCESS3_DELETE, 2),
+        )
+        for bits, mask in checks:
+            if want & bits and await self.client.access(
+                inode, cred.uid, cred.all_gids, mask
+            ):
+                granted |= want & bits
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, attr)
+        p.u32(granted)
+        return p.bytes()
+
+    async def _proc_readlink(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        target = await self.client.readlink(inode)
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, await self._attr_opt(inode))
+        p.string(target)
+        return p.bytes()
+
+    async def _proc_read(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        offset, count = u.u64(), u.u32()
+        count = min(count, 1 << 20)
+        attr = await self._attr(inode)
+        if attr.ftype == m.FTYPE_DIR:
+            raise _NfsError(NFS3ERR_ISDIR)
+        if not await self.client.access(inode, cred.uid, cred.all_gids, 4):
+            raise _NfsError(NFS3ERR_ACCES)
+        data = await self.client.read_file(inode, offset, count)
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, attr)
+        p.u32(len(data))
+        p.boolean(offset + len(data) >= attr.length)  # eof
+        p.opaque(data)
+        return p.bytes()
+
+    async def _proc_write(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        offset, count = u.u64(), u.u32()
+        u.u32()  # stable_how: we always write through (FILE_SYNC)
+        data = u.opaque(1 << 22)[:count]
+        if not await self.client.access(inode, cred.uid, cred.all_gids, 2):
+            raise _NfsError(NFS3ERR_ACCES)
+        await self.client.pwrite(inode, offset, data)
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, await self._attr_opt(inode))
+        p.u32(len(data))
+        p.u32(2)  # committed = FILE_SYNC
+        p.fixed(self.write_verf)
+        return p.bytes()
+
+    async def _proc_create(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        how = u.u32()  # 0 UNCHECKED, 1 GUARDED, 2 EXCLUSIVE
+        verf = None
+        if how in (0, 1):
+            sattr = _Sattr3(u)
+            mode = sattr.mode if sattr.mode is not None else 0o644
+        else:
+            # EXCLUSIVE: stash the verifier in atime/mtime (RFC 1813
+            # §3.3.8) so a retransmitted create is recognized as ours
+            verf = struct.unpack(">II", u.fixed(8))
+            mode = 0o644
+        try:
+            attr = await self.client.create(
+                parent, name, mode=mode, uid=cred.uid, gid=cred.gid
+            )
+            if verf is not None:
+                attr = await self.client.setattr(
+                    attr.inode, 8 | 16, atime=verf[0], mtime=verf[1],
+                    caller_uid=cred.uid, caller_gids=cred.all_gids,
+                )
+        except st.StatusError as e:
+            retryable = False
+            if e.code == st.EEXIST and how != 1:
+                attr = await self.client.lookup(
+                    parent, name, uid=cred.uid, gids=cred.all_gids
+                )
+                retryable = (
+                    how == 0
+                    or (attr.atime, attr.mtime) == verf  # our retransmit
+                )
+            if not retryable:
+                p = Packer().u32(_nfs_code(e))
+                _wcc_data(p, await self._attr_opt(parent))
+                return p.bytes()
+        p = Packer().u32(NFS3_OK)
+        p.boolean(True).opaque(fh_pack(attr.inode))
+        _post_op_attr(p, attr)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_mkdir(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        sattr = _Sattr3(u)
+        mode = sattr.mode if sattr.mode is not None else 0o755
+        try:
+            attr = await self.client.mkdir(
+                parent, name, mode=mode, uid=cred.uid, gid=cred.gid
+            )
+        except st.StatusError as e:
+            p = Packer().u32(_nfs_code(e))
+            _wcc_data(p, await self._attr_opt(parent))
+            return p.bytes()
+        p = Packer().u32(NFS3_OK)
+        p.boolean(True).opaque(fh_pack(attr.inode))
+        _post_op_attr(p, attr)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_symlink(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        _Sattr3(u)  # symlink attrs: mode is fixed 0777
+        target = u.string(4096)
+        attr = await self.client.symlink(
+            parent, name, target, uid=cred.uid, gid=cred.gid
+        )
+        p = Packer().u32(NFS3_OK)
+        p.boolean(True).opaque(fh_pack(attr.inode))
+        _post_op_attr(p, attr)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_mknod(self, cred, u) -> bytes:
+        raise _NfsError(NFS3ERR_NOTSUPP)
+
+    async def _proc_remove(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        await self.client.unlink(parent, name, uid=cred.uid, gids=cred.all_gids)
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_rmdir(self, cred, u) -> bytes:
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        await self.client.rmdir(parent, name, uid=cred.uid, gids=cred.all_gids)
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _proc_rename(self, cred, u) -> bytes:
+        psrc = fh_unpack(u.opaque(64))
+        nsrc = u.string(255)
+        pdst = fh_unpack(u.opaque(64))
+        ndst = u.string(255)
+        await self.client.rename(
+            psrc, nsrc, pdst, ndst, uid=cred.uid, gids=cred.all_gids
+        )
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, await self._attr_opt(psrc))
+        _wcc_data(p, await self._attr_opt(pdst))
+        return p.bytes()
+
+    async def _proc_link(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        parent = fh_unpack(u.opaque(64))
+        name = u.string(255)
+        attr = await self.client.link(
+            inode, parent, name, uid=cred.uid, gids=cred.all_gids
+        )
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, attr)
+        _wcc_data(p, await self._attr_opt(parent))
+        return p.bytes()
+
+    async def _readdir_common(self, cred, u, plus: bool) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        cookie = u.u64()
+        client_verf = u.fixed(8)
+        if plus:
+            u.u32()  # dircount
+        maxcount = min(u.u32(), 1 << 20)
+        entries = await self.client.readdir(
+            inode, uid=cred.uid, gids=cred.all_gids
+        )
+        dir_attr = await self._attr_opt(inode)
+        if inode in self._export_roots:
+            dotdot: tuple[int, m.Attr | None] = (inode, dir_attr)
+        else:
+            try:
+                parent = await self.client.lookup(
+                    inode, "..", uid=cred.uid, gids=cred.all_gids
+                )
+                dotdot = (parent.inode, parent)
+            except st.StatusError:
+                dotdot = (inode, dir_attr)
+        listing: list[tuple[str, int, m.Attr | None]] = [
+            (".", inode, dir_attr),
+            ("..", *dotdot),
+        ]
+        for e in sorted(entries, key=lambda e: e.name):
+            listing.append((e.name, e.inode, None))
+        # cookieverf = digest of the listing: cookies are positions in
+        # this snapshot, so a changed directory invalidates them
+        # (RFC 1813 BAD_COOKIE) instead of silently skipping entries
+        h = hashlib.blake2b(digest_size=8)
+        for name, ino, _ in listing:
+            h.update(name.encode("utf-8", "surrogateescape"))
+            h.update(struct.pack(">I", ino))
+        verf = h.digest()
+        start = int(cookie)
+        if start and client_verf != verf:
+            raise _NfsError(NFS3ERR_BAD_COOKIE)
+        if plus and start < len(listing):
+            # batch the per-entry attrs this window could need (bounded
+            # by what maxcount can fit: >= 44 bytes/entry on the wire)
+            window = listing[start : start + max(maxcount // 44, 1)]
+            fetched = await asyncio.gather(
+                *(self._attr_opt(ino) for _, ino, attr in window
+                  if attr is None)
+            )
+            it = iter(fetched)
+            listing[start : start + len(window)] = [
+                (name, ino, attr if attr is not None else next(it))
+                for name, ino, attr in window
+            ]
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, dir_attr)
+        p.fixed(verf)  # cookieverf
+        body = Packer()
+        used, i, budget = 0, start, maxcount - 64
+        while i < len(listing):
+            name, ino, attr = listing[i]
+            e = Packer()
+            e.boolean(True).u64(ino).string(name).u64(i + 1)
+            if plus:
+                _post_op_attr(e, attr)
+                e.boolean(True).opaque(fh_pack(ino))
+            chunk = e.bytes()
+            if used + len(chunk) > budget:
+                break  # window full; zero progress -> TOOSMALL below
+            used += len(chunk)
+            body.raw(chunk)
+            i += 1
+        if i == start and start < len(listing):
+            raise _NfsError(NFS3ERR_TOOSMALL)
+        body.boolean(False)  # no more entries in this reply
+        body.boolean(i >= len(listing))  # eof
+        p.raw(body.bytes())
+        return p.bytes()
+
+    async def _proc_readdir(self, cred, u) -> bytes:
+        return await self._readdir_common(cred, u, plus=False)
+
+    async def _proc_readdirplus(self, cred, u) -> bytes:
+        return await self._readdir_common(cred, u, plus=True)
+
+    async def _proc_fsstat(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        total, avail = await self.client.statfs()
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, await self._attr_opt(inode))
+        p.u64(total).u64(avail).u64(avail)
+        p.u64(1 << 31).u64(1 << 31).u64(1 << 31)  # file slots: unbounded
+        p.u32(0)  # invarsec
+        return p.bytes()
+
+    async def _proc_fsinfo(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, await self._attr_opt(inode))
+        p.u32(1 << 20).u32(1 << 20).u32(MFSBLOCKSIZE)  # rtmax/rtpref/rtmult
+        p.u32(1 << 20).u32(1 << 20).u32(MFSBLOCKSIZE)  # wtmax/wtpref/wtmult
+        p.u32(1 << 16)  # dtpref
+        p.u64((1 << 63) - 1)  # maxfilesize
+        p.u32(0).u32(1)  # time_delta
+        p.u32(0x1 | 0x2 | 0x8 | 0x10)  # LINK|SYMLINK|HOMOGENEOUS|CANSETTIME
+        return p.bytes()
+
+    async def _proc_pathconf(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        p = Packer().u32(NFS3_OK)
+        _post_op_attr(p, await self._attr_opt(inode))
+        p.u32(65535)  # linkmax
+        p.u32(255)  # name_max
+        p.boolean(True)  # no_trunc
+        p.boolean(True)  # chown_restricted
+        p.boolean(False)  # case_insensitive
+        p.boolean(True)  # case_preserving
+        return p.bytes()
+
+    async def _proc_commit(self, cred, u) -> bytes:
+        inode = fh_unpack(u.opaque(64))
+        u.u64()
+        u.u32()  # offset, count: writes are already durable
+        p = Packer().u32(NFS3_OK)
+        _wcc_data(p, await self._attr_opt(inode))
+        p.fixed(self.write_verf)
+        return p.bytes()
+
+    _PROCS = {
+        0: _proc_null,
+        1: _proc_getattr,
+        2: _proc_setattr,
+        3: _proc_lookup,
+        4: _proc_access,
+        5: _proc_readlink,
+        6: _proc_read,
+        7: _proc_write,
+        8: _proc_create,
+        9: _proc_mkdir,
+        10: _proc_symlink,
+        11: _proc_mknod,
+        12: _proc_remove,
+        13: _proc_rmdir,
+        14: _proc_rename,
+        15: _proc_link,
+        16: _proc_readdir,
+        17: _proc_readdirplus,
+        18: _proc_fsstat,
+        19: _proc_fsinfo,
+        20: _proc_pathconf,
+        21: _proc_commit,
+    }
+
+
+async def main(argv: list[str] | None = None) -> None:
+    """``python -m lizardfs_tpu.nfs.server HOST:PORT [--port N]``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="LizardFS-TPU NFSv3 gateway")
+    ap.add_argument("master", help="master HOST:PORT")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=2049)
+    ap.add_argument("--export", action="append", default=None,
+                    help="EXPORT=CLUSTERPATH (repeatable; default /=/)")
+    args = ap.parse_args(argv)
+    mhost, mport = args.master.rsplit(":", 1)
+    exports = {"/": "/"}
+    if args.export:
+        exports = dict(e.split("=", 1) for e in args.export)
+    gw = NfsGateway(mhost, int(mport), host=args.host, port=args.port,
+                    exports=exports)
+    await gw.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await gw.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

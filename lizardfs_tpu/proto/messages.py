@@ -402,6 +402,25 @@ class CltomaSetQuota(Message):
     )
 
 
+class CltomaStatFs(Message):
+    """Cluster-wide space totals (statfs(2) backing; ref CLTOMA_FUSE_STATFS
+    in src/protocol/MFSCommunication.h)."""
+
+    MSG_TYPE = 1005
+    FIELDS = (("req_id", "u32"),)
+
+
+class MatoclStatFsReply(Message):
+    MSG_TYPE = 1007
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("total_space", "u64"),
+        ("avail_space", "u64"),
+        ("inodes", "u32"),
+    )
+
+
 class CltomaGetQuota(Message):
     MSG_TYPE = 1046
     FIELDS = (("req_id", "u32"), ("uid", "u32"), ("gids", "list:u32"))

@@ -50,6 +50,10 @@ async def test_flaky_chunkserver_demoted(tmp_path):
         addrs = [(pl.addr.host, pl.addr.port) for pl in loc.locations]
         assert len(addrs) == 2
 
+        for cs in cluster.chunkservers:
+            assert cs.data_server is not None, \
+                "native data plane failed to start (see chunkserver log)"
+
         def served_bytes():
             return {
                 cs.data_server.port: cs.data_server.stats()["bytes_read"]

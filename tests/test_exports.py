@@ -70,6 +70,13 @@ async def test_readonly_and_subtree_exports(tmp_path):
         got = await ro.lookup(1, "readme")  # 1 == exported /pub
         assert got.inode == f.inode
         assert (await ro.read_file(got.inode)) == b"public data"
+        # ".." at the export root must clamp to the export root, not
+        # escape to the real parent (NFS path-walking jail)
+        dotdot = await ro.lookup(1, "..")
+        assert dotdot.inode == pub.inode
+        # while a rw (/) session resolves the true parent
+        real = await rw.lookup(pub.inode, "..")
+        assert real.inode == 1
         with pytest.raises(st.StatusError) as e:
             await ro.create(1, "nope")
         assert e.value.code == st.EROFS
