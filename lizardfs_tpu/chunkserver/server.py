@@ -125,6 +125,10 @@ class ChunkServer(Daemon):
     # --- lifecycle -----------------------------------------------------------
 
     async def setup(self) -> None:
+        # standing derived chart (charts.cc "total traffic" analog)
+        self.metrics.counter("bytes_read")
+        self.metrics.counter("bytes_written")
+        self.metrics.define("bytes_total", "bytes_read bytes_written ADD")
         await asyncio.to_thread(self.store.scan)
         for folder in self.store.damaged_folders:
             self.log.warning("data folder %s is damaged; skipping", folder)

@@ -189,6 +189,12 @@ class MasterServer(Daemon):
 
     async def start(self) -> None:
         await super().start()
+        # standing derived chart: average chunk density across the fleet
+        self.metrics.gauge("chunks")
+        self.metrics.gauge("chunkservers_connected")
+        self.metrics.define(
+            "chunks_per_server", "chunks chunkservers_connected DIV"
+        )
         if self.personality == "shadow":
             if self.active_addr is None:
                 raise ValueError("shadow personality needs active_addr")

@@ -63,8 +63,10 @@ class Daemon:
                 payload = json.loads(msg.json) if msg.json else {}
             except ValueError:
                 payload = {}
+            from lizardfs_tpu.runtime.metrics import RESOLUTION_NAMES
+
             resolution = payload.get("resolution", "sec")
-            if resolution not in ("sec", "min", "hour"):
+            if resolution not in RESOLUTION_NAMES:
                 return m.AdminReply(
                     req_id=msg.req_id, status=st.EINVAL, json="{}"
                 )
@@ -91,6 +93,33 @@ class Daemon:
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps({"csv": "\n".join(rows) + "\n"}),
+            )
+        if command in ("metrics-derive", "metrics-define"):
+            # charts.h calc-op analog: evaluate (or register) an RPN
+            # expression over this daemon's series
+            from lizardfs_tpu.runtime.metrics import RESOLUTION_NAMES
+
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+                expr = str(payload["expr"])
+                resolution = payload.get("resolution", "sec")
+                if resolution not in RESOLUTION_NAMES:
+                    raise ValueError(resolution)
+                if command == "metrics-define":
+                    self.metrics.define(str(payload["name"]), expr)
+                    doc = {"defined": str(payload["name"]), "expr": expr}
+                else:
+                    doc = {
+                        "expr": expr, "resolution": resolution,
+                        "points": self.metrics.eval_rpn(expr, resolution),
+                    }
+            except (ValueError, KeyError) as e:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json=json.dumps({"error": str(e)}),
+                )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if getattr(msg, "command", None) == "tweaks":
             return m.AdminReply(
@@ -119,7 +148,9 @@ class Daemon:
     # authenticated are refused when a password is configured.
 
     # commands that mutate daemon/cluster state; subclasses extend
-    ADMIN_PRIVILEGED: frozenset[str] = frozenset({"tweaks-set"})
+    ADMIN_PRIVILEGED: frozenset[str] = frozenset(
+        {"tweaks-set", "metrics-define"}
+    )
 
     def handle_admin_auth(self, msg, state: dict) -> object | None:
         """Handle auth-challenge / auth commands; None if not one."""

@@ -3,9 +3,16 @@
 The reference keeps RRD-like fixed-range in-memory series per daemon and
 renders them to GIF/CSV over the admin protocol (reference:
 src/common/charts.cc, chartsdata.cc registrations). Same data model
-here — counters and gauges sampled into fixed-size rings at three
-resolutions (seconds/minutes/hours) — exported as JSON over the admin
-link instead of server-rendered images.
+here — counters and gauges sampled into fixed-size rings at five
+resolutions spanning two minutes to three months — exported as JSON over
+the admin link instead of server-rendered images.
+
+Derived series reproduce the reference's chart calc ops (reference:
+src/common/charts.h:26-42 CHARTS_CALC / ADD/SUB/MIN/MAX/MUL/DIV and
+charts.cc get_dataf): an RPN expression over series names and constants,
+evaluated elementwise at any resolution, either ad hoc
+(:meth:`Metrics.eval_rpn`) or registered by name
+(:meth:`Metrics.define`) so it exports like a first-class series.
 """
 
 from __future__ import annotations
@@ -13,8 +20,20 @@ from __future__ import annotations
 import time
 from collections import deque
 
+# (name, sampling period s, ring length) — spans: 2 min, 3 h, 1 day,
+# 1 week, 3 months (the reference's short/medium/long/verylong ranges,
+# charts.cc RANGE sampling)
+RESOLUTIONS = (
+    ("sec", 1.0, 120),
+    ("min", 60.0, 180),
+    ("tenmin", 600.0, 144),
+    ("hour", 3600.0, 168),
+    ("day", 86400.0, 92),
+)
 
-RESOLUTIONS = (("sec", 1.0, 120), ("min", 60.0, 120), ("hour", 3600.0, 120))
+RESOLUTION_NAMES = tuple(r[0] for r in RESOLUTIONS)
+
+RPN_OPS = ("ADD", "SUB", "MUL", "DIV", "MIN", "MAX")
 
 
 class Series:
@@ -58,6 +77,7 @@ class Series:
 class Metrics:
     def __init__(self):
         self.series: dict[str, Series] = {}
+        self.derived: dict[str, str] = {}  # name -> RPN expression
 
     def counter(self, name: str) -> Series:
         s = self.series.get(name)
@@ -71,12 +91,108 @@ class Metrics:
             s = self.series[name] = Series(name, "gauge")
         return s
 
+    def define(self, name: str, expr: str) -> None:
+        """Register a derived series: RPN over series names/constants,
+        e.g. ``"bytes_read bytes_written ADD"``. Validated eagerly by a
+        full evaluation (shape errors, unknown names, nesting depth)."""
+        if name in self.series:
+            raise ValueError(f"{name!r} is an existing series")
+        self.eval_rpn(expr)  # raises ValueError on malformed exprs
+        self.derived[name] = expr
+
     def sample_all(self, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
         for s in self.series.values():
             s.sample(now)
 
+    # --- derived-series evaluation (charts.h calc ops) -------------------
+
+    def _parse_rpn(self, expr: str) -> list[str]:
+        tokens = expr.split()
+        if not tokens:
+            raise ValueError("empty RPN expression")
+        depth = 0
+        for t in tokens:
+            if t in RPN_OPS:
+                if depth < 2:
+                    raise ValueError(f"RPN stack underflow at {t!r}")
+                depth -= 1
+            else:
+                if t not in self.series and t not in self.derived:
+                    try:
+                        float(t)
+                    except ValueError:
+                        raise ValueError(f"unknown series {t!r}") from None
+                depth += 1
+        if depth != 1:
+            raise ValueError(f"RPN leaves {depth} values on the stack")
+        return tokens
+
+    def eval_rpn(self, expr: str, resolution: str = "sec",
+                 _depth: int = 0) -> list[float]:
+        """Evaluate an RPN expression elementwise at one resolution.
+
+        Series are right-aligned (most recent sample last); a shorter
+        operand is padded with leading zeros. DIV by zero yields 0,
+        matching the reference's chart division semantics."""
+        if _depth > 8:
+            # catches definition cycles too (a cycle can only arise via
+            # redefinition; to_dict degrades that series to an error)
+            raise ValueError("derived series nested too deeply")
+        # stack entries: (is_constant, points) — only true constants
+        # broadcast; a series that happens to hold one sample right-
+        # aligns and zero-pads like any other series
+        stack: list[tuple[bool, list[float]]] = []
+        for t in self._parse_rpn(expr):
+            if t in RPN_OPS:
+                (cb, b), (ca, a) = stack.pop(), stack.pop()
+                n = max(len(a), len(b))
+                a = a * n if ca and n > 1 else [0.0] * (n - len(a)) + a
+                b = b * n if cb and n > 1 else [0.0] * (n - len(b)) + b
+                if t == "ADD":
+                    r = [x + y for x, y in zip(a, b)]
+                elif t == "SUB":
+                    r = [x - y for x, y in zip(a, b)]
+                elif t == "MUL":
+                    r = [x * y for x, y in zip(a, b)]
+                elif t == "DIV":
+                    r = [x / y if y else 0.0 for x, y in zip(a, b)]
+                elif t == "MIN":
+                    r = [min(x, y) for x, y in zip(a, b)]
+                else:  # MAX
+                    r = [max(x, y) for x, y in zip(a, b)]
+                stack.append((ca and cb, r))
+            elif t in self.series:
+                stack.append(
+                    (False,
+                     [float(v) for v in self.series[t]._rings[resolution]])
+                )
+            elif t in self.derived:
+                stack.append(
+                    (False,
+                     self.eval_rpn(self.derived[t], resolution, _depth + 1))
+                )
+            else:
+                stack.append((True, [float(t)]))
+        return stack[0][1]
+
     def to_dict(self, resolution: str = "sec") -> dict:
-        return {
-            name: s.to_dict(resolution) for name, s in sorted(self.series.items())
+        out = {
+            name: s.to_dict(resolution)
+            for name, s in sorted(self.series.items())
         }
+        for name, expr in sorted(self.derived.items()):
+            try:
+                points = self.eval_rpn(expr, resolution)
+                err = None
+            except ValueError as e:
+                # a bad redefinition must not poison the whole export
+                points, err = [], str(e)
+            out[name] = {
+                "name": name, "kind": "derived", "expr": expr,
+                "total": points[-1] if points else 0.0,
+                "resolution": resolution, "points": points,
+            }
+            if err is not None:
+                out[name]["error"] = err
+        return out

@@ -49,10 +49,18 @@ PAGE = """<!doctype html>
 <th>used / total GiB</th></tr>{servers}</table>
 <h2>metadata ops (last 120 s)</h2>
 <pre>{ops}</pre>
-<h2>charts (last 120 s)</h2>
+<h2>charts &mdash; range: {range_links} (showing {span})</h2>
 {charts}
+<h2>chunkserver charts ({span})</h2>
+{cs_charts}
 </body></html>
 """
+
+# resolution -> human span of the full ring (runtime.metrics.RESOLUTIONS)
+SPANS = {
+    "sec": "2 min", "min": "3 h", "tenmin": "1 day",
+    "hour": "1 week", "day": "3 months",
+}
 
 
 def sparkline(points, width=480, height=60, color="#8ab4f8"):
@@ -118,7 +126,31 @@ class Dashboard:
             ).json
         )
 
-    def render(self) -> str:
+    def cs_metrics_all(self, addrs: list[tuple[str, int]],
+                       resolution: str = "sec") -> list[dict | None]:
+        """Fetch every chunkserver's metrics concurrently; a slow or
+        dead CS yields None after a short timeout instead of stalling
+        the whole page render."""
+
+        async def one(addr):
+            try:
+                reply = await asyncio.wait_for(
+                    _admin(addr, m.AdminCommand(
+                        req_id=1, command="metrics",
+                        json=json.dumps({"resolution": resolution}),
+                    )),
+                    timeout=3.0,
+                )
+                return json.loads(reply.json)
+            except Exception:  # noqa: BLE001
+                return None
+
+        fut = asyncio.run_coroutine_threadsafe(
+            asyncio.gather(*(one(a) for a in addrs)), self.loop
+        )
+        return fut.result(10)
+
+    def render(self, res: str = "sec") -> str:
         info = self.info()
         health = self.health()
         rows = []
@@ -132,9 +164,12 @@ class Dashboard:
                 f"<td>{s['label']}</td><td>{state}</td>"
                 f"<td>{s['used_space']/2**30:.1f} / {s['total_space']/2**30:.1f}</td></tr>"
             )
-        metrics = self.metrics()
+        if res not in SPANS:
+            res = "sec"
+        metrics = self.metrics(res)
+        sec_metrics = metrics if res == "sec" else self.metrics("sec")
         ops_lines = []
-        for name, series in metrics.items():
+        for name, series in sec_metrics.items():
             if name.startswith("op.") or name == "metadata_ops":
                 pts = series["points"][-60:]
                 ops_lines.append(
@@ -142,12 +177,38 @@ class Dashboard:
                     f"last120s={sum(pts):.0f}"
                 )
         charts_html = []
-        for name in ("metadata_ops", "chunks", "chunkservers_connected"):
+        for name in ("metadata_ops", "chunks", "chunkservers_connected",
+                     "chunks_per_server"):
             series = metrics.get(name)
             if series:
+                tag = " (derived)" if series.get("kind") == "derived" else ""
                 charts_html.append(
-                    f"<div><b>{name}</b><br>{sparkline(series['points'])}</div>"
+                    f"<div><b>{name}</b>{tag}<br>"
+                    f"{sparkline(series['points'])}</div>"
                 )
+        cs_charts = []
+        live = [s for s in info.get("chunkservers", []) if s["connected"]]
+        fetched = self.cs_metrics_all(
+            [(s["host"], s["port"]) for s in live], res
+        )
+        for s, csm in zip(live, fetched):
+            if csm is None:
+                continue
+            row = []
+            for name in ("bytes_read", "bytes_written", "bytes_total"):
+                series = csm.get(name)
+                if series:
+                    row.append(
+                        f"<div style='display:inline-block;margin-right:1em'>"
+                        f"<b>cs{s['cs_id']} {name}</b><br>"
+                        f"{sparkline(series['points'], width=300)}</div>"
+                    )
+            cs_charts.append("<div>" + "".join(row) + "</div>")
+        range_links = " | ".join(
+            (f"<b>[{r}]</b>" if r == res
+             else f'<a style="color:#8ab4f8" href="/?res={r}">{r}</a>')
+            for r in SPANS
+        )
         return PAGE.format(
             personality=info.get("personality", "?"),
             version=info.get("version", 0),
@@ -162,6 +223,9 @@ class Dashboard:
             servers="".join(rows) or "<tr><td colspan=5>none</td></tr>",
             ops="\n".join(sorted(ops_lines)) or "(no ops yet)",
             charts="".join(charts_html) or "(no series yet)",
+            cs_charts="".join(cs_charts) or "(no chunkservers)",
+            range_links=range_links,
+            span=SPANS[res],
         )
 
 
@@ -188,7 +252,10 @@ def make_handler(dash: Dashboard):
                     res = self.path.rpartition("=")[2] if "=" in self.path else "sec"
                     self._send(json.dumps(dash.metrics(res)), "application/json")
                 else:
-                    self._send(dash.render())
+                    res = "sec"
+                    if "res=" in self.path:
+                        res = self.path.rpartition("res=")[2].split("&")[0]
+                    self._send(dash.render(res))
             except Exception as e:  # noqa: BLE001
                 self.send_error(502, f"master unreachable: {e}")
 
