@@ -169,15 +169,202 @@ def block_crcs(blocks: jnp.ndarray, block_size: int = MFSBLOCKSIZE) -> jnp.ndarr
     return (crc ^ jnp.uint32(k_const))[:b]
 
 
-@functools.partial(jax.jit, static_argnames=("block_size",))
+# ---------------------------------------------------------------------------
+# single-pass fused encode + CRC
+#
+# One pallas_call per column chunk: the data tile is read from HBM once;
+# parity is computed on the MXU and written out; CRC partial registers
+# for BOTH the data rows and the fresh parity rows are computed and
+# folded to one 32-bit register per (row, chunk) while everything is
+# still in VMEM. Only the registers (32 ints per row per chunk — ~0.1%
+# of the data volume) leave the kernel; a tiny XLA epilogue combines the
+# per-chunk registers of each 64 KiB block and applies the affine
+# constant. Semantics match the reference's encode + per-block mycrc32
+# (src/common/reed_solomon.h:134-155, crc.cc:49-64).
+
+CRC_SUB = 128  # sub-block bytes = one full vreg lane width
+
+
+def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
+    rows = k + m
+    sc = tile // CRC_SUB
+    return (
+        2 * k * tile            # data in (x2 pipeline)
+        + 2 * m * tile          # parity out (x2 pipeline)
+        + 16 * k * tile         # unpacked bits, bf16
+        + 32 * m * tile         # encode accumulator, f32
+        + m * tile              # packed parity bytes
+        + 2 * rows * sc * 32 * 6  # crc planes + partial acc (bf16+f32)
+        + sc * 32 * 32 * 2      # fold matrix, bf16
+    )
+
+
+def _chunk_registers(x, csub_ref, fold_ref):
+    """(rows, T) uint8 tile -> (rows, 32) GF(2) CRC registers.
+
+    Stage 1 (MXU): per-128-byte sub-block partial registers, batched
+    over rows*Sc sub-blocks. Stage 2 (MXU): fold the Sc partials of
+    each row with the position-shift matrix F — still in VMEM, so no
+    partial-register round trip through HBM (the round-1 bottleneck).
+    """
+    rows, t = x.shape
+    sc = t // CRC_SUB
+    subs = x.reshape(rows * sc, CRC_SUB)
+    acc = jnp.zeros((rows * sc, 32), jnp.float32)
+    for b in range(8):
+        plane = ((subs & jnp.uint8(1 << b)) != 0).astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            plane, csub_ref[b],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    pbits = (acc.astype(jnp.int32) & 1).astype(jnp.bfloat16)
+    q = pbits.reshape(rows, sc * 32)
+    reg = jax.lax.dot_general(
+        q, fold_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # exact: sums <= sc*32 < 2^24
+    return reg.astype(jnp.int32) & 1
+
+
+def _fused_kernel(bigm_ref, csub_ref, fold_ref, data_ref,
+                  parity_ref, dreg_ref, preg_ref):
+    data = data_ref[:]
+    bits = _unpack_tile(data)  # (8k, T)
+    acc = jax.lax.dot_general(
+        bigm_ref[:], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    pbits = acc.astype(jnp.int32) & 1
+    m8, t = pbits.shape
+    mm = m8 // 8
+    weights = jax.lax.broadcasted_iota(jnp.int32, (mm, 8, t), 1)
+    parity = (pbits.reshape(mm, 8, t) << weights).sum(axis=1).astype(jnp.uint8)
+    parity_ref[:] = parity
+    dreg_ref[:] = _chunk_registers(data, csub_ref, fold_ref)
+    preg_ref[:] = _chunk_registers(parity, csub_ref, fold_ref)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "tile", "interpret")
+)
 def fused_encode_crc(
-    bigm: jnp.ndarray, data: jnp.ndarray, block_size: int = MFSBLOCKSIZE
+    bigm: jnp.ndarray,
+    data: jnp.ndarray,
+    block_size: int = MFSBLOCKSIZE,
+    tile: int = 16384,
+    interpret: bool | None = None,
 ):
-    """Pallas analog of jax_ec.fused_encode_crc: parity + all block CRCs."""
+    """Single-pass fused RS encode + per-block CRC32.
+
+    (k, N) uint8 -> (parity (m, N) uint8, dcrc (k, nb) u32, pcrc (m, nb)
+    u32), byte-identical to jax_ec.fused_encode_crc / the golden codec.
+    """
+    if interpret is None:
+        interpret = not supported()  # CPU backend: interpret mode
     k, n = data.shape
     m = bigm.shape[0] // 8
+    rows = k + m
+    while tile > 2 * CRC_SUB and (
+        _fused_vmem_bytes(k, m, tile) > 10 * 2**20 or block_size % tile
+    ):
+        tile //= 2
+    if n % tile:
+        raise ValueError(f"N={n} not a multiple of tile={tile}")
+    if block_size % tile:
+        raise ValueError(f"tile={tile} must divide block_size={block_size}")
+    sc = tile // CRC_SUB
+    nchunks = n // tile
+    cpb = block_size // tile  # chunks per 64 KiB block
     nb = n // block_size
-    parity = encode(bigm, data)
-    dcrc = block_crcs(data.reshape(k * nb, block_size), block_size)
-    pcrc = block_crcs(parity.reshape(m * nb, block_size), block_size)
-    return parity, dcrc.reshape(k, nb), pcrc.reshape(m, nb)
+
+    c_sub, _levels, k_const = crc_host.block_crc_matrices(block_size, CRC_SUB)
+    csub_t = np.asarray(c_sub.T, dtype=np.float32)
+    csub_planes = np.stack([csub_t[bb::8, :] for bb in range(8)])
+    # F: per-sub-block-position shift matrices, stacked so the fold is
+    # one (rows, sc*32) x (sc*32, 32) matmul
+    fold = np.zeros((sc * 32, 32), dtype=np.float32)
+    for j in range(sc):
+        fold[j * 32:(j + 1) * 32, :] = \
+            crc_host.shift_matrix(CRC_SUB * (sc - 1 - j)).T
+    # G: combines the cpb chunk registers of one block in XLA (tiny)
+    comb = np.zeros((cpb * 32, 32), dtype=np.int32)
+    for c in range(cpb):
+        comb[c * 32:(c + 1) * 32, :] = \
+            crc_host.shift_matrix(tile * (cpb - 1 - c)).T
+
+    parity, dreg, preg = pl.pallas_call(
+        _fused_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), jnp.uint8),
+            jax.ShapeDtypeStruct((nchunks * k, 32), jnp.int32),
+            jax.ShapeDtypeStruct((nchunks * m, 32), jnp.int32),
+        ),
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(csub_planes.shape, lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((sc * 32, 32), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((m, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 32), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 32), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(
+        bigm.astype(jnp.bfloat16),
+        jnp.asarray(csub_planes, dtype=jnp.bfloat16),
+        jnp.asarray(fold, dtype=jnp.bfloat16),
+        data,
+    )
+
+    def finalize(regs, nrows):
+        # (nchunks*nrows, 32) -> (nrows, nb) final CRC values
+        r = regs.reshape(nb, cpb, nrows, 32).transpose(2, 0, 1, 3)
+        r = r.reshape(nrows, nb, cpb * 32)
+        folded = jax.lax.dot_general(
+            r, jnp.asarray(comb),
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ) & 1  # (nrows, nb, 32)
+        w = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+        crc = (folded.astype(jnp.uint32) * w).sum(axis=2, dtype=jnp.uint32)
+        return crc ^ jnp.uint32(k_const)
+
+    return parity, finalize(dreg, k), finalize(preg, m)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "interpret")
+)
+def fused_decode_verify(
+    bigm_rec: jnp.ndarray,
+    survivors: jnp.ndarray,
+    expected_crcs: jnp.ndarray,
+    block_size: int = MFSBLOCKSIZE,
+    interpret: bool | None = None,
+):
+    """Fused reconstruct + CRC verify of the recovered parts.
+
+    ``bigm_rec`` is the (8r, 8k) recovery matrix mapping survivor rows
+    to the r missing parts (gf256.recovery matrix via the encoder
+    boundary); returns (recovered (r, N) uint8, crcs (r, nb) u32,
+    ok (r, nb) bool) where ok compares against ``expected_crcs`` — the
+    stored per-block CRCs of the lost parts (ReadPlanExecutor's
+    post-recovery verify, reference read_plan_executor.cc + crc.cc).
+    """
+    recovered, _scrc, rcrc = fused_encode_crc(
+        bigm_rec, survivors, block_size, interpret=interpret
+    )
+    return recovered, rcrc, rcrc == expected_crcs.astype(jnp.uint32)

@@ -59,3 +59,47 @@ def test_pallas_fused_byte_identical():
     np.testing.assert_array_equal(np.asarray(p), wp)
     np.testing.assert_array_equal(np.asarray(dc), wd)
     np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+def test_pallas_fused_multichunk_blocks():
+    """Blocks wider than one kernel tile: the XLA epilogue combines
+    per-chunk registers with shift matrices — exercise cpb > 1."""
+    rng = np.random.default_rng(5)
+    k, m, bs, nb = 3, 2, 65536, 3
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    p, dc, pc = pe.fused_encode_crc(bigm, data, bs)  # tile < bs here
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(p), wp)
+    np.testing.assert_array_equal(np.asarray(dc), wd)
+    np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+def test_pallas_fused_decode_verify():
+    """Reconstruct lost parts and CRC-verify them in the same pass."""
+    from lizardfs_tpu.ops import gf256
+
+    rng = np.random.default_rng(6)
+    k, m, bs, nb = 4, 2, 8192, 2
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    parity, dcrc, _pcrc = pe.fused_encode_crc(bigm, data, bs)
+    allparts = np.concatenate([data, np.asarray(parity)], axis=0)
+    lost = [1, 3]
+    have = [i for i in range(k + m) if i not in lost]
+    used, _ = gf256.recovery_selection(k, m, have, lost)
+    big_rec = jax_ec.recovery_bitmatrix(k, m, tuple(used), tuple(lost))
+    survivors = allparts[list(used)]
+    want_crcs = np.asarray(dcrc)[lost]
+    rec, crcs, ok = pe.fused_decode_verify(
+        np.asarray(big_rec), survivors, want_crcs, bs
+    )
+    np.testing.assert_array_equal(np.asarray(rec), data[lost])
+    assert bool(np.all(np.asarray(ok)))
+    # corrupt expectation -> verify trips
+    bad = want_crcs.copy()
+    bad[0, 0] ^= 1
+    _, _, ok2 = pe.fused_decode_verify(
+        np.asarray(big_rec), survivors, bad, bs
+    )
+    assert not bool(np.asarray(ok2)[0, 0]) and bool(np.asarray(ok2)[1, 1])
