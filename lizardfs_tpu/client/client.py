@@ -216,6 +216,13 @@ class Client:
         r = await self._call(m.CltomaGetattr, inode=inode)
         return r.attr
 
+    async def tape_info(self, inode: int) -> dict:
+        """Tape-copy state: {"wanted", "pending", "copies", "fresh"}."""
+        import json as _json
+
+        r = await self._call(m.CltomaTapeInfo, inode=inode)
+        return _json.loads(r.json)
+
     async def statfs(self) -> tuple[int, int]:
         """Cluster (total_bytes, available_bytes) across chunkservers."""
         r = await self._call(m.CltomaStatFs)

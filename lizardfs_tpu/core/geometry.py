@@ -259,11 +259,38 @@ class Goal:
     slices: tuple[Slice, ...]
 
     def expected_copies(self) -> int:
+        """Chunkserver copies the goal wants (disk slices only — tape
+        copies are whole-file archives, not chunk placements)."""
         total = 0
         for s in self.slices:
+            if s.type.is_tape:
+                continue
             for part in s.part_labels:
                 total += sum(c for _, c in part)
         return total
+
+    def disk_slice(self) -> "Slice | None":
+        """The slice that places chunk parts on chunkservers."""
+        for s in self.slices:
+            if not s.type.is_tape:
+                return s
+        return None
+
+    def tape_copies(self) -> int:
+        """Archival copies requested from tape servers (goal.h tape
+        labels; served by the matotsserv analog)."""
+        return len(self.tape_labels())
+
+    def tape_labels(self) -> list[str]:
+        """One entry per requested tape copy: a named label means a
+        server with that label; the wildcard means any tape server."""
+        out: list[str] = []
+        for s in self.slices:
+            if s.type.is_tape:
+                for part in s.part_labels:
+                    for lab, c in part:
+                        out.extend([lab] * c)
+        return out
 
 
 def default_goals() -> dict[int, Goal]:
@@ -283,10 +310,14 @@ class GoalConfigError(ValueError):
 
 
 def parse_goal_line(line: str) -> tuple[int, Goal] | None:
-    """Parse one mfsgoals.cfg line: ``id name : [$type] [{ labels }] | labels``.
+    """Parse one mfsgoals.cfg line: ``id name : slice [| slice ...]``
+    where a slice is ``[$type[(k,m)]] [{ labels } | labels]``.
 
-    Grammar per doc/mfsgoals.cfg.5.txt:47-98. Returns None for blank or
-    comment lines.
+    Grammar per doc/mfsgoals.cfg.5.txt:47-98, extended with the
+    reference's multi-slice goals (goal.h Goal = set of slices): a
+    ``$tape`` slice after ``|`` requests archival copies from tape
+    servers (matotsserv.cc) in addition to the disk slice. Returns None
+    for blank or comment lines.
     """
     line = line.split("#", 1)[0].strip()
     if not line:
@@ -302,6 +333,23 @@ def parse_goal_line(line: str) -> tuple[int, Goal] | None:
     if not _NAME_RE.match(name):
         raise GoalConfigError(f"invalid goal name {name!r}")
 
+    slices = tuple(
+        _parse_slice_segment(seg.strip(), line) for seg in rest.split("|")
+    )
+    disk = [s for s in slices if not s.type.is_tape]
+    tape = [s for s in slices if s.type.is_tape]
+    if len(disk) != 1:
+        raise GoalConfigError(
+            f"goal needs exactly one disk slice (std/xor/ec): {line!r}"
+        )
+    if len(tape) > 1:
+        raise GoalConfigError(f"at most one $tape slice per goal: {line!r}")
+    if tape and slices[0].type.is_tape:
+        raise GoalConfigError(f"disk slice must come first: {line!r}")
+    return gid, Goal(name, slices)
+
+
+def _parse_slice_segment(rest: str, line: str) -> Slice:
     type_ = SliceType(STANDARD)
     labels_str = rest
     tm = re.match(r"^\$(\w+)(?:\(\s*(\d+)\s*,\s*(\d+)\s*\))?\s*(.*)$", rest)
@@ -309,6 +357,8 @@ def parse_goal_line(line: str) -> tuple[int, Goal] | None:
         tname = tm.group(1)
         if tname == "std":
             type_ = SliceType(STANDARD)
+        elif tname == "tape":
+            type_ = SliceType(TAPE)
         elif tname.startswith("xor"):
             try:
                 type_ = xor_type(int(tname[3:]))
@@ -337,23 +387,32 @@ def parse_goal_line(line: str) -> tuple[int, Goal] | None:
     if len(labels) > MAX_LABELS_PER_SLICE:
         raise GoalConfigError("too many labels (max 40)")
 
-    if type_.is_standard:
+    if type_.is_standard or type_.is_tape:
+        # tape: each label = one archival copy on a matching tape server
         counts: dict[str, int] = {}
         for lab in labels or [WILDCARD_LABEL]:
             counts[lab] = counts.get(lab, 0) + 1
-        slice_ = Slice.make(type_, [counts])
-    else:
-        nparts = type_.expected_parts
-        if labels and len(labels) > nparts:
-            raise GoalConfigError(
-                f"{type_.to_string()} takes at most {nparts} labels, got {len(labels)}"
-            )
-        per_part = []
-        for i in range(nparts):
-            lab = labels[i] if i < len(labels) else WILDCARD_LABEL
-            per_part.append({lab: 1})
-        slice_ = Slice.make(type_, per_part)
-    return gid, Goal(name, (slice_,))
+        if type_.is_tape:
+            # copies are recorded per server label, so a repeated NAMED
+            # label could never be satisfied; wildcards may repeat
+            # (distinct servers carry distinct labels)
+            dup = [lab for lab, c in counts.items()
+                   if lab != WILDCARD_LABEL and c > 1]
+            if dup:
+                raise GoalConfigError(
+                    f"repeated tape label {dup[0]!r}: {line!r}"
+                )
+        return Slice.make(type_, [counts])
+    nparts = type_.expected_parts
+    if labels and len(labels) > nparts:
+        raise GoalConfigError(
+            f"{type_.to_string()} takes at most {nparts} labels, got {len(labels)}"
+        )
+    per_part = []
+    for i in range(nparts):
+        lab = labels[i] if i < len(labels) else WILDCARD_LABEL
+        per_part.append({lab: 1})
+    return Slice.make(type_, per_part)
 
 
 def load_goal_config(text: str) -> dict[int, Goal]:

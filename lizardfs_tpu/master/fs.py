@@ -190,6 +190,29 @@ class FsTree:
             return 1, n.length
         return 1, 0
 
+    def path_of(self, inode: int) -> str:
+        """Best-effort absolute path (first hardlink); operator-facing
+        (tape archive naming, diagnostics) — not a lookup primitive."""
+        parts: list[str] = []
+        cur = inode
+        for _ in range(4096):  # corrupt parent chain guard
+            if cur == ROOT_INODE:
+                return "/" + "/".join(reversed(parts))
+            n = self.nodes.get(cur)
+            if n is None or not n.parents:
+                break
+            parent = self.nodes.get(n.parents[0])
+            if parent is None or parent.ftype != TYPE_DIR:
+                break
+            name = next(
+                (nm for nm, ch in parent.children.items() if ch == cur), None
+            )
+            if name is None:
+                break
+            parts.append(name)
+            cur = parent.inode
+        return f"/.inode/{inode}"
+
     def lookup(self, parent: int, name: str) -> Node:
         p = self.dir_node(parent)
         inode = p.children.get(name)

@@ -29,6 +29,15 @@ class MetadataStore:
         # re-issues an id whose locks are still held (sessions.mfs
         # analog for the id space; live connection state stays local)
         self.next_session = 1
+        # tape-copy records (matotsserv analog): inode -> list of
+        # {"label","length","mtime","gen","ts"} archival copies;
+        # replicated through the changelog and persisted in the image
+        self.tape_copies: dict[int, list[dict]] = {}
+        # per-inode content generation: bumped by every content op, it
+        # stamps tape copies so a same-second same-length rewrite still
+        # reads as stale. Deterministic from the op stream (shadows
+        # converge), so excluded from the digest like next_inode.
+        self.content_gen: dict[int, int] = {}
         # incremental metadata digest (see checksum())
         self._digest = 0
         self.reset_digest()
@@ -127,6 +136,8 @@ class MetadataStore:
         self.quotas.charge(node.uid, node.gid, 0, delta)
         for cid in removed:
             self.registry.release_chunk(cid)
+        self.content_gen[op["inode"]] = \
+            self.content_gen.get(op["inode"], 0) + 1
 
     def _op_create_chunk(self, op):
         self.registry.create_chunk(
@@ -136,6 +147,8 @@ class MetadataStore:
 
     def _op_set_chunk(self, op):
         self.fs.apply_set_chunk(op["inode"], op["chunk_index"], op["chunk_id"])
+        self.content_gen[op["inode"]] = \
+            self.content_gen.get(op["inode"], 0) + 1
 
     def _op_bump_chunk_version(self, op):
         self.registry.chunk(op["chunk_id"]).version = op["version"]
@@ -151,6 +164,8 @@ class MetadataStore:
                 if cid:
                     self.registry.release_chunk(cid)
         self.fs.apply_purge_trash(op["inode"])
+        if op["inode"] not in self.fs.nodes:
+            self.content_gen.pop(op["inode"], None)
 
     def _op_undelete(self, op):
         self.fs.apply_undelete(op["inode"], op["ts"])
@@ -233,6 +248,8 @@ class MetadataStore:
             },
             "quotas": self.quotas.to_dict(),
             "next_session": self.next_session,
+            "tape": {str(i): c for i, c in self.tape_copies.items() if c},
+            "tape_gen": {str(i): g for i, g in self.content_gen.items()},
             "locks": {
                 kind: {
                     str(inode): [
@@ -264,6 +281,12 @@ class MetadataStore:
         self.quotas = QuotaDatabase.from_dict(doc.get("quotas", {}))
         self.locks = LockManager()
         self.next_session = int(doc.get("next_session", 1))
+        self.tape_copies = {
+            int(i): list(c) for i, c in doc.get("tape", {}).items()
+        }
+        self.content_gen = {
+            int(i): int(g) for i, g in doc.get("tape_gen", {}).items()
+        }
         from lizardfs_tpu.master.locks import FileLocks, Owner, Range
 
         for kind, table in (
@@ -356,6 +379,15 @@ class MetadataStore:
                 (r.start, r.end, r.ltype, r.owner.session_id, r.owner.token)
                 for r in fl.ranges
             ])
+        if kind == "tape":
+            copies = self.tape_copies.get(key[1])
+            if not copies:
+                return 0
+            return self._h("tape", key[1], [
+                (c["label"], c["length"], c["mtime"], c.get("gen", 0),
+                 c["ts"])
+                for c in copies
+            ])
         if kind == "misc":
             # next_inode / next_chunk_id are EXCLUDED: the server
             # pre-reserves them outside apply() (alloc_inode, chunk-id
@@ -363,6 +395,20 @@ class MetadataStore:
             # max(), so shadows converge on them from the ops alone
             return self._h("misc", self.next_session)
         raise ValueError(f"unknown entity kind {kind!r}")
+
+    def _op_tape_copy(self, op):
+        copies = self.tape_copies.setdefault(op["inode"], [])
+        # one copy per tape-server label; a fresh copy replaces a stale
+        # one from the same label
+        copies[:] = [c for c in copies if c["label"] != op["label"]]
+        copies.append({
+            "label": op["label"], "length": op["length"],
+            "mtime": op["mtime"], "gen": op.get("gen", 0), "ts": op["ts"],
+        })
+
+    def _op_tape_drop(self, op):
+        self.tape_copies.pop(op["inode"], None)
+        self.content_gen.pop(op["inode"], None)
 
     def _touched(self, op: dict) -> set[tuple]:
         """Entities whose state the op may change — evaluated against
@@ -441,6 +487,8 @@ class MetadataStore:
                         for name, child in pn.children.items():
                             if child == op["inode"]:
                                 out.add(("edge", p, name))
+        elif t in ("tape_copy", "tape_drop"):
+            out.add(("tape", op["inode"]))
         elif t == "set_quota":
             out.add(("quota", op["kind"], op["owner_id"]))
         elif t == "snapshot":
@@ -491,6 +539,8 @@ class MetadataStore:
                              ("flock", self.locks.flock_files)):
             for inode in table:
                 d ^= self._entity_hash(("locks", lkind, inode))
+        for inode in self.tape_copies:
+            d ^= self._entity_hash(("tape", inode))
         return d
 
     def checksum(self, cache_key: int | None = None) -> str:

@@ -110,6 +110,14 @@ class MasterServer(Daemon):
         self.changelog = Changelog(data_dir)
         self.goals = goals or geometry.default_goals()
         self.cs_links: dict[int, _CsLink] = {}
+        # tape server links (matotsserv.cc analog): ts_id -> writer/label
+        self.ts_links: dict[int, dict] = {}
+        self._next_ts_id = 1
+        # inodes whose tape copies are missing/stale: inode -> (length,
+        # mtime, gen) content stamp at enqueue; live-master queue
+        # (rebuilt by a scan when a tape server registers)
+        self.tape_pending: dict[int, tuple[int, int, int]] = {}
+        self._tape_inflight: set[int] = set()
         self.shadow_writers: list[asyncio.StreamWriter] = []
         self.sessions: dict[int, dict] = {}
         # orphaned lock owners (no live connection) first seen at ts;
@@ -178,6 +186,7 @@ class MasterServer(Daemon):
         self.add_timer(10.0, self._purge_trash)
         self.add_timer(0.05, self._task_tick)
         self.add_timer(1.0, self._lock_grace_sweep)
+        self.add_timer(1.0, self._tape_drain)
 
     async def _task_tick(self) -> None:
         """Run a batch of background metadata jobs (TaskManager analog:
@@ -232,6 +241,7 @@ class MasterServer(Daemon):
                     dead.append(w)
             for w in dead:
                 self.shadow_writers.remove(w)
+        self._tape_mark(op)
         return version
 
     async def _dump_image(self) -> None:
@@ -387,6 +397,8 @@ class MasterServer(Daemon):
             await self._client_loop(reader, writer, first)
         elif isinstance(first, m.CstomaRegister):
             await self._cs_loop(reader, writer, first)
+        elif isinstance(first, m.TstomaRegister):
+            await self._ts_loop(reader, writer, first)
         elif isinstance(first, m.MltomaRegister):
             await self._shadow_loop(reader, writer, first)
         elif isinstance(first, (m.AdminInfo, m.AdminCommand)):
@@ -735,6 +747,22 @@ class MasterServer(Daemon):
             return self._attr_reply(msg.req_id, node)
         if isinstance(msg, m.CltomaGetattr):
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaTapeInfo):
+            node = fs.node(msg.inode)
+            want_stamp = self._content_stamp(msg.inode, node)
+            stamp_fresh = [
+                c for c in self.meta.tape_copies.get(msg.inode, [])
+                if (c["length"], c["mtime"], c.get("gen", 0)) == want_stamp
+            ]
+            doc = {
+                "wanted": self._goal_tape_copies(node.goal),
+                "pending": msg.inode in self.tape_pending,
+                "copies": self.meta.tape_copies.get(msg.inode, []),
+                "fresh": len(stamp_fresh),
+            }
+            return m.MatoclTapeInfoReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
+            )
         if isinstance(msg, m.CltomaStatFs):
             servers = self.meta.registry.connected_servers()
             total = sum(s.total_space for s in servers)
@@ -1303,18 +1331,19 @@ class MasterServer(Daemon):
 
     def _slice_type_for_goal(self, goal_id: int) -> geometry.SliceType:
         goal = self.goals.get(goal_id)
-        if goal is None or not goal.slices:
+        s = goal.disk_slice() if goal is not None else None
+        if s is None:
             return geometry.SliceType(geometry.STANDARD)
-        return goal.slices[0].type
+        return s.type
 
     def _labels_for_goal(
         self, goal_id: int, t: geometry.SliceType, part_list: list[int]
     ) -> list[str]:
         """Per-slot placement labels from the goal definition."""
         goal = self.goals.get(goal_id)
-        if goal is None or not goal.slices:
+        s = goal.disk_slice() if goal is not None else None
+        if s is None:
             return ["_"] * len(part_list)
-        s = goal.slices[0]
         if t.is_standard:
             out: list[str] = []
             for label, count in sorted(s.labels_of_part(0).items()):
@@ -1418,13 +1447,17 @@ class MasterServer(Daemon):
                 delta = msg.file_length - node.length
                 parent = node.parents[0] if node.parents else fsmod.ROOT_INODE
                 self._check_quota(parent, node.uid, node.gid, 0, delta)
-                # write-path grow: never drop chunks — a concurrent
-                # write may have attached a higher chunk index already
-                self.commit({
-                    "op": "set_length", "inode": msg.inode,
-                    "length": msg.file_length, "ts": int(time.time()),
-                    "drop_chunks": False,
-                })
+            # journal every completed write (the reference logs a
+            # LENGTH/WRITE line per write too): updates mtime and the
+            # content generation, so tape staleness and shadow replay
+            # see in-place overwrites, not just growth.
+            # write-path grow: never drop chunks — a concurrent write
+            # may have attached a higher chunk index already
+            self.commit({
+                "op": "set_length", "inode": msg.inode,
+                "length": max(msg.file_length, node.length),
+                "ts": int(time.time()), "drop_chunks": False,
+            })
         return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
 
     # --- chunkserver service (matocsserv analog) --------------------------------------
@@ -1508,6 +1541,198 @@ class MasterServer(Daemon):
             )
         except (ConnectionError, asyncio.TimeoutError):
             pass
+
+    # --- tape server service (matotsserv.cc analog) -----------------------------------
+
+    async def _ts_loop(self, reader, writer, first: m.TstomaRegister) -> None:
+        if not self.is_active:
+            await framing.send_message(
+                writer, m.MatotsRegisterReply(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, ts_id=0
+                ),
+            )
+            return
+        link = _CsLink(self, reader, writer)
+        ts_id = self._next_ts_id
+        self._next_ts_id += 1
+        label = first.label or "_"
+        self.ts_links[ts_id] = {"link": link, "label": label}
+        await framing.send_message(
+            writer, m.MatotsRegisterReply(
+                req_id=first.req_id, status=st.OK, ts_id=ts_id
+            ),
+        )
+        self.log.info("tape server %d registered (label %s)", ts_id, label)
+        self._tape_rescan()
+        try:
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if isinstance(msg, m.TstomaPutDone):
+                    link.dispatch_ack(msg)
+        finally:
+            self.ts_links.pop(ts_id, None)
+            link.fail_all()
+            self.log.info("tape server %d disconnected", ts_id)
+
+    def _goal_tape_copies(self, goal_id: int) -> int:
+        g = self.goals.get(goal_id)
+        return g.tape_copies() if g is not None else 0
+
+    def _content_stamp(self, inode: int, node) -> tuple[int, int, int]:
+        return (node.length, node.mtime,
+                self.meta.content_gen.get(inode, 0))
+
+    def _tape_missing_labels(self, inode: int, node) -> list[str]:
+        """Goal tape labels not yet covered by a fresh copy. A named
+        label needs a server with that label; a wildcard is satisfied by
+        any fresh copy not already claimed by a named label."""
+        goal = self.goals.get(node.goal)
+        labels = goal.tape_labels() if goal is not None else []
+        if not labels:
+            return []
+        stamp = self._content_stamp(inode, node)
+        fresh = {
+            c["label"] for c in self.meta.tape_copies.get(inode, [])
+            if (c["length"], c["mtime"], c.get("gen", 0)) == stamp
+        }
+        named = [l for l in labels if l != geometry.WILDCARD_LABEL]
+        missing = [l for l in named if l not in fresh]
+        wild = len(labels) - len(named)
+        spare_fresh = len(fresh - set(named))
+        missing += [geometry.WILDCARD_LABEL] * max(wild - spare_fresh, 0)
+        return missing
+
+    def _tape_rescan_sync(self, inodes: list[int]) -> None:
+        for inode in inodes:
+            node = self.meta.fs.nodes.get(inode)
+            if (node is not None and node.ftype == fsmod.TYPE_FILE
+                    and self._tape_missing_labels(inode, node)):
+                self.tape_pending.setdefault(
+                    inode, self._content_stamp(inode, node)
+                )
+
+    def _tape_rescan(self) -> None:
+        """Requeue files whose tape coverage is missing or stale — run
+        when a tape server registers (startup recovery; runtime marking
+        is incremental via _tape_mark). Walks the namespace in slices
+        off the hot path so a reconnect never stalls the loop."""
+
+        async def walk():
+            inodes = list(self.meta.fs.nodes)
+            for i in range(0, len(inodes), 10_000):
+                self._tape_rescan_sync(inodes[i:i + 10_000])
+                await asyncio.sleep(0)
+
+        self.spawn(walk())
+
+    def _tape_mark(self, op: dict) -> None:
+        """Incremental tape-dirty marking, called after every commit."""
+        t = op["op"]
+        if t in ("set_length", "set_chunk", "setgoal", "mknode", "undelete"):
+            inodes = [op["inode"]]
+        elif t == "snapshot":
+            inodes = list(op.get("inode_map", {}).values())
+        elif t == "purge_trash":
+            inode = op["inode"]
+            self.tape_pending.pop(inode, None)
+            if (inode not in self.meta.fs.nodes
+                    and inode in self.meta.tape_copies):
+                self.commit({"op": "tape_drop", "inode": inode})
+                for e in self.ts_links.values():
+                    # reclaim all archived versions of the dead file
+                    try:
+                        framing.write_message(
+                            e["link"].writer, m.MatotsDeleteFile(
+                                req_id=0, inode=inode,
+                                keep_mtime=0, keep_length=0,
+                            ),
+                        )
+                    except (ConnectionError, RuntimeError):
+                        pass
+            return
+        else:
+            return
+        for inode in inodes:
+            node = self.meta.fs.nodes.get(inode)
+            if node is None or node.ftype != fsmod.TYPE_FILE:
+                continue
+            if self._goal_tape_copies(node.goal) > 0:
+                self.tape_pending[inode] = self._content_stamp(inode, node)
+            else:
+                self.tape_pending.pop(inode, None)
+
+    async def _tape_drain(self) -> None:
+        if not (self.is_active and self.ts_links and self.tape_pending):
+            return
+        batch = [i for i in list(self.tape_pending)
+                 if i not in self._tape_inflight][:64]
+        for inode in batch:
+            node = self.meta.fs.nodes.get(inode)
+            if node is None:
+                self.tape_pending.pop(inode, None)
+                continue
+            stamp = self._content_stamp(inode, node)
+            self.tape_pending[inode] = stamp
+            missing = self._tape_missing_labels(inode, node)
+            if not missing:
+                self.tape_pending.pop(inode, None)
+                continue
+            fresh = {
+                c["label"] for c in self.meta.tape_copies.get(inode, [])
+                if (c["length"], c["mtime"], c.get("gen", 0)) == stamp
+            }
+            entry = None
+            for e in self.ts_links.values():
+                if e["label"] in missing or (
+                    geometry.WILDCARD_LABEL in missing
+                    and e["label"] not in fresh
+                ):
+                    entry = e
+                    break
+            if entry is None:
+                # no connected server can satisfy THIS inode's labels;
+                # others behind it may still be placeable
+                continue
+            self._tape_inflight.add(inode)
+            self.spawn(self._tape_put(entry, inode, node, stamp))
+
+    async def _tape_put(self, entry: dict, inode: int, node, stamp) -> None:
+        try:
+            done = await entry["link"].command(
+                m.MatotsPutFile, inode=inode,
+                path=self.meta.fs.path_of(inode),
+                length=node.length, mtime=node.mtime, timeout=60.0,
+            )
+            if (done.status == st.OK
+                    and (done.length, done.mtime) == stamp[:2]
+                    and self.tape_pending.get(inode) == stamp):
+                cur = self.meta.fs.nodes.get(inode)
+                if cur is not None and \
+                        self._content_stamp(inode, cur) == stamp:
+                    self.commit({
+                        "op": "tape_copy", "inode": inode,
+                        "label": entry["label"], "length": stamp[0],
+                        "mtime": stamp[1], "gen": stamp[2],
+                        "ts": int(time.time()),
+                    })
+                    # reclaim stale archive versions on that server
+                    # (fire-and-forget; re-sent on the next fresh copy)
+                    try:
+                        framing.write_message(
+                            entry["link"].writer, m.MatotsDeleteFile(
+                                req_id=0, inode=inode,
+                                keep_mtime=stamp[1], keep_length=stamp[0],
+                            ),
+                        )
+                    except (ConnectionError, RuntimeError):
+                        pass
+        except (ConnectionError, asyncio.TimeoutError, st.StatusError):
+            pass  # stays pending; next drain retries
+        finally:
+            self._tape_inflight.discard(inode)
 
     # --- health loop (ChunkWorker analog) ----------------------------------------------
 

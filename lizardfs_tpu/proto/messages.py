@@ -421,6 +421,18 @@ class MatoclStatFsReply(Message):
     )
 
 
+class CltomaTapeInfo(Message):
+    """Tape-copy state of a file (matotsserv.cc / tape goal support)."""
+
+    MSG_TYPE = 1009
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclTapeInfoReply(Message):
+    MSG_TYPE = 1015
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
 class CltomaGetQuota(Message):
     MSG_TYPE = 1046
     FIELDS = (("req_id", "u32"), ("uid", "u32"), ("gids", "list:u32"))
@@ -903,3 +915,58 @@ class AdminCommand(Message):
 class AdminReply(Message):
     MSG_TYPE = 1403
     FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+# --------------------------------------------------------------------------
+# tape server link (matotsserv.cc analog): tape servers register with
+# the master and archive whole files for goals carrying a $tape slice
+
+
+class TstomaRegister(Message):
+    MSG_TYPE = 1500
+    FIELDS = (("req_id", "u32"), ("label", "str"), ("capacity", "u64"))
+
+
+class MatotsRegisterReply(Message):
+    MSG_TYPE = 1501
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("ts_id", "u32"))
+
+
+class MatotsPutFile(Message):
+    """Master -> tape server: archive this file's current content.
+    ``length``/``mtime`` stamp the content version; the ack echoes them
+    so the master can detect a concurrent modification."""
+
+    MSG_TYPE = 1502
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("path", "str"),
+        ("length", "u64"),
+        ("mtime", "u32"),
+    )
+
+
+class TstomaPutDone(Message):
+    MSG_TYPE = 1503
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("status", "u8"),
+        ("length", "u64"),
+        ("mtime", "u32"),
+    )
+
+
+class MatotsDeleteFile(Message):
+    """Master -> tape server: reclaim archives of ``inode``. A zero
+    (keep_mtime, keep_length) deletes every version; otherwise the
+    matching archive is kept and stale versions are removed."""
+
+    MSG_TYPE = 1504
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("keep_mtime", "u32"),
+        ("keep_length", "u64"),
+    )

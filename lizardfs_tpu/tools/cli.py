@@ -202,6 +202,17 @@ async def cmd_fileinfo(c: Client, args) -> int:
     a = await c.resolve(args.path)
     nchunks = (a.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE
     print(f"{args.path}: {a.length} bytes, {nchunks} chunk(s)")
+    tape = await c.tape_info(a.inode)
+    if tape["wanted"] or tape["copies"]:
+        state = "pending" if tape["pending"] else "in sync"
+        print(
+            f"  tape: {tape['fresh']}/{tape['wanted']} fresh copies"
+            f" ({state})"
+        )
+        for cp in tape["copies"]:
+            stale = "" if (cp["length"], cp["mtime"]) == \
+                (a.length, a.mtime) else " [stale]"
+            print(f"    label {cp['label']}: {cp['length']} bytes{stale}")
     for i in range(nchunks):
         info = await c.chunk_info(a.inode, i)
         if info.chunk_id == 0:

@@ -145,10 +145,10 @@ class Dashboard:
             except Exception:  # noqa: BLE001
                 return None
 
-        fut = asyncio.run_coroutine_threadsafe(
-            asyncio.gather(*(one(a) for a in addrs)), self.loop
-        )
-        return fut.result(10)
+        async def all_():
+            return await asyncio.gather(*(one(a) for a in addrs))
+
+        return asyncio.run_coroutine_threadsafe(all_(), self.loop).result(10)
 
     def render(self, res: str = "sec") -> str:
         info = self.info()

@@ -1,0 +1,227 @@
+"""Tape server support (matotsserv.cc analog): goals with a $tape slice
+get archival whole-file copies on registered tape servers."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.tapeserver.server import TapeServer
+
+from tests.test_cluster import Cluster, make_goals
+
+pytestmark = pytest.mark.asyncio
+
+TAPE_GOAL = 12
+
+
+def goals_with_tape():
+    goals = make_goals()
+    goals[TAPE_GOAL] = geometry.parse_goal_line(
+        f"{TAPE_GOAL} archived : _ _ | $tape"
+    )[1]
+    return goals
+
+
+def test_tape_goal_parsing():
+    gid, g = geometry.parse_goal_line("12 archived : _ _ | $tape")
+    assert gid == 12
+    assert g.disk_slice().type.is_standard
+    assert g.tape_copies() == 1
+    # two tape copies on labeled tape servers
+    _, g2 = geometry.parse_goal_line(
+        "13 vault : $ec(3,2) | $tape { vaultA vaultB }"
+    )
+    assert g2.disk_slice().type.is_ec and g2.tape_copies() == 2
+    assert g2.tape_labels() == ["vaultA", "vaultB"]
+    # invalid combinations
+    for bad in (
+        "14 x : $tape",                 # no disk slice
+        "14 x : $tape | _ _",           # tape before disk
+        "14 x : _ | $tape | $tape",     # two tape slices
+        "14 x : _ _ | $xor3",           # two disk slices
+        "14 x : _ | $tape { a a }",     # repeated named tape label
+    ):
+        with pytest.raises(geometry.GoalConfigError):
+            geometry.parse_goal_line(bad)
+    # repeated wildcards are fine (two copies on any two servers)
+    _, g3 = geometry.parse_goal_line("15 x : _ | $tape { _ _ }")
+    assert g3.tape_copies() == 2
+
+
+async def _wait_for(cond, timeout=8.0, interval=0.1):
+    for _ in range(int(timeout / interval)):
+        if await cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def test_tape_archive_and_fileinfo(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    cluster_goals = goals_with_tape()
+    # Cluster.start builds its own goals; patch before start
+    import tests.test_cluster as tc
+    orig = tc.make_goals
+    tc.make_goals = goals_with_tape
+    try:
+        await cluster.start()
+    finally:
+        tc.make_goals = orig
+    ts = TapeServer(
+        str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port),
+        label="vault",
+    )
+    await ts.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "precious.dat")
+        await c.setgoal(f.inode, TAPE_GOAL)
+        payload = os.urandom(200_000)
+        await c.write_file(f.inode, payload)
+
+        # the master marks, drains, and records the archival copy
+        async def archived():
+            info = await c.tape_info(f.inode)
+            return info["fresh"] >= 1 and not info["pending"]
+
+        assert await _wait_for(archived), await c.tape_info(f.inode)
+        info = await c.tape_info(f.inode)
+        assert info["wanted"] == 1
+        assert info["copies"][0]["label"] == "vault"
+
+        # archive holds the exact bytes + metadata sidecar
+        a = await c.getattr(f.inode)
+        dest = tmp_path / "tape" / f"{f.inode}_{a.mtime}_{a.length}.tape"
+        assert dest.read_bytes() == payload
+        with open(str(dest) + ".json") as fmeta:
+            meta = json.load(fmeta)
+        assert meta["path"] == "/precious.dat"
+
+        # rewriting the file makes the copy stale and re-archives
+        await c.pwrite(f.inode, 0, b"NEWCONTENT")
+        async def rearchived():
+            i = await c.tape_info(f.inode)
+            return i["fresh"] >= 1 and not i["pending"]
+        assert await _wait_for(rearchived)
+        a2 = await c.getattr(f.inode)
+        dest2 = tmp_path / "tape" / f"{f.inode}_{a2.mtime}_{a2.length}.tape"
+        assert dest2.read_bytes()[:10] == b"NEWCONTENT"
+
+        # stale archive versions are reclaimed after the fresh copy
+        async def reclaimed():
+            tapes = [p for p in os.listdir(tmp_path / "tape")
+                     if p.startswith(f"{f.inode}_") and p.endswith(".tape")]
+            return tapes == [dest2.name]
+        assert await _wait_for(reclaimed), os.listdir(tmp_path / "tape")
+
+        # files without a tape goal are untouched
+        g = await c.create(1, "plain.dat")
+        await c.write_file(g.inode, b"xyz")
+        await asyncio.sleep(1.5)
+        info = await c.tape_info(g.inode)
+        assert info["wanted"] == 0 and not info["copies"]
+    finally:
+        await ts.stop()
+        await cluster.stop()
+
+
+async def test_tape_label_matching(tmp_path):
+    """A named tape label only accepts a server carrying that label; a
+    non-matching server must not absorb the copy (and must not stall
+    other placeable files behind it)."""
+    import tests.test_cluster as tc
+
+    def goals():
+        g = make_goals()
+        g[12] = geometry.parse_goal_line("12 vaulted : _ _ | $tape { vaultA }")[1]
+        g[13] = geometry.parse_goal_line("13 anytape : _ _ | $tape")[1]
+        return g
+
+    cluster = Cluster(tmp_path, n_cs=2)
+    orig = tc.make_goals
+    tc.make_goals = goals
+    try:
+        await cluster.start()
+    finally:
+        tc.make_goals = orig
+    scratch = TapeServer(
+        str(tmp_path / "scratch"), ("127.0.0.1", cluster.master.port),
+        label="scratch",
+    )
+    await scratch.start()
+    vault = None
+    try:
+        c = await cluster.client()
+        f_vault = await c.create(1, "vaulted.dat")
+        await c.setgoal(f_vault.inode, 12)
+        await c.write_file(f_vault.inode, b"v" * 1000)
+        f_any = await c.create(1, "anytape.dat")
+        await c.setgoal(f_any.inode, 13)
+        await c.write_file(f_any.inode, b"a" * 1000)
+
+        # the wildcard file archives on the scratch server even while
+        # the vault file (queued first) has no eligible server
+        async def any_done():
+            i = await c.tape_info(f_any.inode)
+            return i["fresh"] >= 1
+        assert await _wait_for(any_done)
+        info = await c.tape_info(f_vault.inode)
+        assert info["pending"] and info["fresh"] == 0
+
+        # a matching server arrives -> the vault copy lands on it
+        vault = TapeServer(
+            str(tmp_path / "vault"), ("127.0.0.1", cluster.master.port),
+            label="vaultA",
+        )
+        await vault.start()
+
+        async def vault_done():
+            i = await c.tape_info(f_vault.inode)
+            return i["fresh"] >= 1
+        assert await _wait_for(vault_done)
+        info = await c.tape_info(f_vault.inode)
+        assert info["copies"][0]["label"] == "vaultA"
+    finally:
+        await scratch.stop()
+        if vault is not None:
+            await vault.stop()
+        await cluster.stop()
+
+
+async def test_tape_registration_rescan(tmp_path):
+    """Files written BEFORE any tape server exists are archived once one
+    registers (startup recovery scan)."""
+    import tests.test_cluster as tc
+    cluster = Cluster(tmp_path, n_cs=3)
+    orig = tc.make_goals
+    tc.make_goals = goals_with_tape
+    try:
+        await cluster.start()
+    finally:
+        tc.make_goals = orig
+    ts = None
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "early.dat")
+        await c.setgoal(f.inode, TAPE_GOAL)
+        await c.write_file(f.inode, b"before tape server" * 100)
+        info = await c.tape_info(f.inode)
+        assert info["pending"] and info["fresh"] == 0
+
+        ts = TapeServer(
+            str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port)
+        )
+        await ts.start()
+
+        async def archived():
+            i = await c.tape_info(f.inode)
+            return i["fresh"] >= 1
+        assert await _wait_for(archived)
+    finally:
+        if ts is not None:
+            await ts.stop()
+        await cluster.stop()
