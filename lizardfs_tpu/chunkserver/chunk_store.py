@@ -2,8 +2,8 @@
 
 Disk layout mirrors the reference's header-file format (reference:
 src/chunkserver/chunk.h:154-176 MooseFSChunk): each chunk part is one
-file named ``chunk_<id:016X>_<version:08X>.liz`` inside 256 hash
-subfolders (``<low byte of id:02X>/``), containing:
+file named ``chunk_<id:016X>_P<part:08X>_<version:08X>.liz`` inside 256
+hash subfolders (``<low byte of id:02X>/``), containing:
 
   [1 KiB signature block][4 KiB CRC table][block data...]
 
@@ -64,22 +64,30 @@ class ChunkStoreError(Exception):
         super().__init__(f"{st.name(code)}{(': ' + msg) if msg else ''}")
 
 
-def chunk_filename(chunk_id: int, version: int) -> str:
-    return f"chunk_{chunk_id:016X}_{version:08X}.liz"
+def chunk_filename(chunk_id: int, part_id: int, version: int) -> str:
+    """The part id is IN the name: a server may legitimately hold
+    several parts of one chunk (more parts than servers, rebalancing),
+    and omitting it made them collide on one path (data loss)."""
+    return f"chunk_{chunk_id:016X}_P{part_id:08X}_{version:08X}.liz"
 
 
 def parse_chunk_filename(name: str):
-    """-> (chunk_id, version) or None."""
+    """-> (chunk_id, part_id, version) or None. part_id is None for a
+    legacy (pre-part-in-name) file — the scan migrates those using the
+    part id stored in the signature."""
     if not (name.startswith("chunk_") and name.endswith(".liz")):
         return None
-    base = name[6:-4]
-    parts = base.split("_")
-    if len(parts) != 2 or len(parts[0]) != 16 or len(parts[1]) != 8:
-        return None
+    parts = name[6:-4].split("_")
     try:
-        return int(parts[0], 16), int(parts[1], 16)
+        if (len(parts) == 3 and len(parts[0]) == 16
+                and parts[1][:1] == "P" and len(parts[1]) == 9
+                and len(parts[2]) == 8):
+            return int(parts[0], 16), int(parts[1][1:], 16), int(parts[2], 16)
+        if len(parts) == 2 and len(parts[0]) == 16 and len(parts[1]) == 8:
+            return int(parts[0], 16), None, int(parts[1], 16)
     except ValueError:
-        return None
+        pass
+    return None
 
 
 class ChunkFile:
@@ -131,7 +139,7 @@ class ChunkStore:
                 parsed = parse_chunk_filename(name)
                 if parsed is None:
                     continue
-                chunk_id, version = parsed
+                chunk_id, name_part, version = parsed
                 path = os.path.join(subdir, name)
                 try:
                     with open(path, "rb") as f:
@@ -139,6 +147,21 @@ class ChunkStore:
                     magic, sid, sver, part_id = _SIG.unpack(sig)
                     if magic != MAGIC or sid != chunk_id or sver != version:
                         continue  # damaged signature: skip (reported later)
+                    if name_part is None:
+                        # legacy name without the part id: migrate; if
+                        # the rename fails (read-only folder), keep
+                        # serving under the old path rather than
+                        # dropping a healthy part
+                        new_path = os.path.join(
+                            subdir, chunk_filename(chunk_id, part_id, version)
+                        )
+                        try:
+                            os.rename(path, new_path)
+                            path = new_path
+                        except OSError:
+                            pass
+                    elif name_part != part_id:
+                        continue  # name/signature disagree: damaged
                 except (OSError, struct.error):
                     continue
                 cf = ChunkFile(chunk_id, version, part_id, path)
@@ -176,10 +199,10 @@ class ChunkStore:
         with self._lock:
             return list(self._chunks.values())
 
-    def _path_for(self, chunk_id: int, version: int) -> str:
+    def _path_for(self, chunk_id: int, part_id: int, version: int) -> str:
         subdir = os.path.join(self.folder, f"{chunk_id & 0xFF:02X}")
         os.makedirs(subdir, exist_ok=True)
-        return os.path.join(subdir, chunk_filename(chunk_id, version))
+        return os.path.join(subdir, chunk_filename(chunk_id, part_id, version))
 
     # --- chunk ops (hddspacemgr.h:153-161) -----------------------------------
 
@@ -188,7 +211,7 @@ class ChunkStore:
         with self._lock:
             if key in self._chunks:
                 raise ChunkStoreError(st.EEXIST, f"chunk {chunk_id:016X}:{part_id}")
-        path = self._path_for(chunk_id, version)
+        path = self._path_for(chunk_id, part_id, version)
         with open(path, "wb") as f:
             f.write(_SIG.pack(MAGIC, chunk_id, version, part_id))
             f.write(b"\0" * (SIGNATURE_SIZE - _SIG.size))
@@ -218,7 +241,7 @@ class ChunkStore:
         with self._lock:
             if key in self._chunks:
                 raise ChunkStoreError(st.EEXIST, f"chunk {new_chunk_id:016X}")
-        new_path = self._path_for(new_chunk_id, new_version)
+        new_path = self._path_for(new_chunk_id, part_id, new_version)
         with src.lock, open(src.path, "rb") as fin, open(new_path, "wb") as fout:
             fin.seek(SIGNATURE_SIZE)
             fout.write(_SIG.pack(MAGIC, new_chunk_id, new_version, part_id))
@@ -237,7 +260,7 @@ class ChunkStore:
                     part_id: int) -> ChunkFile:
         cf = self.require(chunk_id, old_version, part_id)
         with cf.lock:
-            new_path = self._path_for(chunk_id, new_version)
+            new_path = self._path_for(chunk_id, part_id, new_version)
             with open(cf.path, "r+b") as f:
                 f.write(_SIG.pack(MAGIC, chunk_id, new_version, part_id))
             os.rename(cf.path, new_path)

@@ -94,6 +94,14 @@ struct ChunkGrant {
     std::vector<Location> locations;
 };
 
+// Bound every receive so a hung/partitioned master degrades into an
+// error instead of blocking the embedding application forever.
+static void set_recv_timeout(int fd, int seconds) {
+    struct timeval tv {};
+    tv.tv_sec = seconds;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 }  // namespace
 
 struct liz {
@@ -118,7 +126,10 @@ struct liz {
         auto it = data_fds.find(key);
         if (it != data_fds.end()) return it->second;
         int fd = connect_tcp(h, p);
-        if (fd >= 0) data_fds[key] = fd;
+        if (fd >= 0) {
+            set_recv_timeout(fd, 30);
+            data_fds[key] = fd;
+        }
         return fd;
     }
 
@@ -153,6 +164,7 @@ struct liz {
         if (master_fd >= 0) ::close(master_fd);
         master_fd = connect_tcp(host, static_cast<uint16_t>(port));
         if (master_fd < 0) return false;
+        set_recv_timeout(master_fd, 30);
         Msg reg(kCltomaRegister);
         reg.u32(req_id++).u64(session_id).str("libclient").str(password);
         if (!reg.send(master_fd)) return false;
@@ -427,10 +439,16 @@ void liz_destroy(liz_t* fs) {
     {
         std::lock_guard<std::mutex> g(fs->mu);
         if (fs->master_fd >= 0) {
-            // clean goodbye (releases our locks server-side), best effort
+            // clean goodbye (releases our locks server-side), best
+            // effort with a short bound so destroy can never hang:
+            // one send + one recv on the EXISTING fd — never call()
+            // (it would reconnect, blocking in connect with no bound)
+            set_recv_timeout(fs->master_fd, 2);
             Msg bye(kCltomaGoodbye);
             bye.u32(fs->req_id++);
-            fs->call(bye, kMatoclStatusReply);
+            if (bye.send(fs->master_fd)) {
+                recv_frame(fs->master_fd, &fs->payload);
+            }
         }
     }
     delete fs;

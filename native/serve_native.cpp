@@ -195,25 +195,28 @@ inline int blocks_in_part(uint32_t part_id) {
 // --- chunk files ----------------------------------------------------------
 
 std::string chunk_path(const std::string& folder, uint64_t chunk_id,
-                       uint32_t version) {
+                       uint32_t part_id, uint32_t version) {
+    // part id is part of the name: one server can hold several parts
+    // of the same chunk (chunk_store.py chunk_filename)
     char buf[64];
-    std::snprintf(buf, sizeof(buf), "%02X/chunk_%016lX_%08X.liz",
+    std::snprintf(buf, sizeof(buf), "%02X/chunk_%016lX_P%08X_%08X.liz",
                   static_cast<unsigned>(chunk_id & 0xFF),
-                  static_cast<unsigned long>(chunk_id), version);
+                  static_cast<unsigned long>(chunk_id), part_id, version);
     return folder + "/" + buf;
 }
 
 // find the part's file across folders: 0 = found (path set);
 // stWRONG_VERSION if another version of the chunk exists; stNO_CHUNK.
 uint8_t resolve_chunk(const std::vector<std::string>& folders,
-                      uint64_t chunk_id, uint32_t version,
+                      uint64_t chunk_id, uint32_t part_id, uint32_t version,
                       std::string* path) {
-    char prefix[40];
-    std::snprintf(prefix, sizeof(prefix), "chunk_%016lX_",
-                  static_cast<unsigned long>(chunk_id));
+    char prefix[48];
+    std::snprintf(prefix, sizeof(prefix), "chunk_%016lX_P%08X_",
+                  static_cast<unsigned long>(chunk_id), part_id);
+    size_t plen = std::strlen(prefix);
     bool other_version = false;
     for (const auto& folder : folders) {
-        std::string p = chunk_path(folder, chunk_id, version);
+        std::string p = chunk_path(folder, chunk_id, part_id, version);
         if (::access(p.c_str(), F_OK) == 0) {
             *path = std::move(p);
             return stOK;
@@ -224,7 +227,7 @@ uint8_t resolve_chunk(const std::vector<std::string>& folders,
         DIR* d = ::opendir((folder + sub).c_str());
         if (d != nullptr) {
             while (struct dirent* e = ::readdir(d)) {
-                if (std::strncmp(e->d_name, prefix, 23) == 0) {
+                if (std::strncmp(e->d_name, prefix, plen) == 0) {
                     other_version = true;
                     break;
                 }
@@ -349,7 +352,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     if (size == 0 || offset + static_cast<uint64_t>(size) > max_bytes) {
         code = stEINVAL;
     } else {
-        code = resolve_chunk(srv.folders, chunk_id, version, &path);
+        code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
     }
     if (code == stOK) {
         fd = open_chunk(path, /*rw=*/false, &sig);
@@ -535,7 +538,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
         offset + static_cast<uint64_t>(size) > max_bytes) {
         code = stEINVAL;
     } else {
-        code = resolve_chunk(srv.folders, chunk_id, version, &path);
+        code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
     }
     if (code == stOK) {
         fd = open_chunk(path, /*rw=*/false, &sig);
@@ -773,7 +776,7 @@ uint8_t create_chunk_file(const std::string& folder, uint64_t chunk_id,
                   static_cast<unsigned>(chunk_id & 0xFF));
     std::string subdir = folder + sub;
     ::mkdir(subdir.c_str(), 0755);
-    std::string p = chunk_path(folder, chunk_id, version);
+    std::string p = chunk_path(folder, chunk_id, part_id, version);
     int fd = ::open(p.c_str(), O_CREAT | O_EXCL | O_WRONLY, 0644);
     if (fd < 0) return errno == EEXIST ? stOK : stEIO;
     std::vector<uint8_t> header(kHeaderSize, 0);
@@ -849,7 +852,7 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
 
     uint8_t code = stOK;
     std::string path;
-    code = resolve_chunk(srv.folders, chunk_id, version, &path);
+    code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
     if (code == stNO_CHUNK && create) {
         // place on the emptiest folder (MultiStore._emptiest analog)
         const std::string* best = nullptr;
@@ -869,7 +872,7 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
                    : stEIO;
         if (code == stOK && path.empty()) {
             // EEXIST race: someone else created it; resolve again
-            code = resolve_chunk(srv.folders, chunk_id, version, &path);
+            code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
         }
     }
     std::unique_ptr<WriteSession> s(new WriteSession);
@@ -1248,10 +1251,12 @@ void connection_loop(Server& srv, int cfd) {
         } else if (type == kTypePrefetch && blen >= 28) {
             uint64_t chunk_id = get64(body + 4);
             uint32_t version = get32(body + 12);
+            uint32_t part_id = get32(body + 16);
             uint32_t offset = get32(body + 20);
             uint32_t size = get32(body + 24);
             std::string path;
-            if (resolve_chunk(srv.folders, chunk_id, version, &path) == stOK) {
+            if (resolve_chunk(srv.folders, chunk_id, part_id, version,
+                              &path) == stOK) {
                 Sig sig{};
                 int fd = open_chunk(path, /*rw=*/false, &sig);
                 if (fd >= 0) {

@@ -24,8 +24,11 @@ PART = geometry.ChunkPartType(geometry.ec_type(3, 2), 1).id
 
 
 def test_filename_roundtrip():
-    name = chunk_filename(0xDEADBEEF12345678, 7)
-    assert parse_chunk_filename(name) == (0xDEADBEEF12345678, 7)
+    name = chunk_filename(0xDEADBEEF12345678, PART, 7)
+    assert parse_chunk_filename(name) == (0xDEADBEEF12345678, PART, 7)
+    # legacy (pre-part-in-name) files parse with part None for migration
+    legacy = f"chunk_{0xDEADBEEF12345678:016X}_{7:08X}.liz"
+    assert parse_chunk_filename(legacy) == (0xDEADBEEF12345678, None, 7)
     assert parse_chunk_filename("chunk_zz_7.liz") is None
     assert parse_chunk_filename("foo.liz") is None
 
@@ -94,9 +97,9 @@ def test_store_scan_and_version_gc(tmp_path):
     store.create(2, 1, PART)
     store.set_version(2, 1, 2, PART)
     # stale version left behind manually
-    stale = os.path.join(str(tmp_path), "01", chunk_filename(1, 0))
+    stale = os.path.join(str(tmp_path), "01", chunk_filename(1, PART, 0))
     os.makedirs(os.path.dirname(stale), exist_ok=True)
-    with open(os.path.join(str(tmp_path), "02", chunk_filename(2, 2)), "rb") as f:
+    with open(os.path.join(str(tmp_path), "02", chunk_filename(2, PART, 2)), "rb") as f:
         header = f.read()
     # a second store scans the same folder from scratch
     store2 = ChunkStore(str(tmp_path))
@@ -305,3 +308,44 @@ async def test_multidisk_chunkserver_e2e(tmp_path):
         for cs in servers:
             await cs.stop()
         await master.stop()
+
+
+def test_store_multiple_parts_of_one_chunk(tmp_path):
+    """A server may hold several parts of the same chunk (more parts
+    than servers, rebalancing). Regression: the part id was missing
+    from the filename and the parts truncated each other."""
+    store = ChunkStore(str(tmp_path))
+    p1 = geometry.ChunkPartType(geometry.ec_type(8, 4), 1).id
+    p2 = geometry.ChunkPartType(geometry.ec_type(8, 4), 9).id
+    store.create(5, 1, p1)
+    store.create(5, 1, p2)
+    blk1 = bytes([0x11]) * 65536
+    blk2 = bytes([0x22]) * 65536
+    store.write(5, 1, p1, 0, 0, blk1, crc_mod.crc32(blk1))
+    store.write(5, 1, p2, 0, 0, blk2, crc_mod.crc32(blk2))
+    [(_, d1, _c1)] = store.read(5, 1, p1, 0, 65536)
+    [(_, d2, _c2)] = store.read(5, 1, p2, 0, 65536)
+    assert d1[:1] == b"\x11" and d2[:1] == b"\x22"
+    # both survive a rescan as distinct files
+    store2 = ChunkStore(str(tmp_path))
+    parts = {(c.chunk_id, c.part_id) for c in store2.scan()}
+    assert parts == {(5, p1), (5, p2)}
+
+
+def test_store_legacy_filename_migration(tmp_path):
+    """Old-format files (no part id in the name) are renamed in place
+    during the scan using the signature's part id."""
+    store = ChunkStore(str(tmp_path))
+    cf = store.create(9, 3, PART)
+    blk = bytes([0x7A]) * 65536
+    store.write(9, 3, PART, 0, 0, blk, crc_mod.crc32(blk))
+    legacy = os.path.join(
+        os.path.dirname(cf.path), f"chunk_{9:016X}_{3:08X}.liz"
+    )
+    os.rename(cf.path, legacy)
+    store2 = ChunkStore(str(tmp_path))
+    [found] = store2.scan()
+    assert found.part_id == PART and found.path != legacy
+    assert os.path.basename(found.path) == chunk_filename(9, PART, 3)
+    [(_, data, _c)] = store2.read(9, 3, PART, 0, 65536)
+    assert data[:1] == b"\x7a"

@@ -19,6 +19,7 @@ from lizardfs_tpu.utils import data_generator
 
 EC_GOAL = 10
 XOR_GOAL = 11
+WIDE_EC_GOAL = 13
 STD2_GOAL = 2
 
 
@@ -26,6 +27,9 @@ def make_goals():
     goals = geometry.default_goals()
     goals[EC_GOAL] = geometry.parse_goal_line(f"{EC_GOAL} ectest : $ec(3,2)")[1]
     goals[XOR_GOAL] = geometry.parse_goal_line(f"{XOR_GOAL} xortest : $xor3")[1]
+    goals[WIDE_EC_GOAL] = geometry.parse_goal_line(
+        f"{WIDE_EC_GOAL} widetest : $ec(8,4)"
+    )[1]
     return goals
 
 
@@ -291,5 +295,37 @@ async def test_concurrent_clients_create_distinct_chunks(tmp_path):
         assert len(cluster.master.meta.registry.chunks) == 2
         assert (await c1.read_file(f1.inode)) == p1
         assert (await c2.read_file(f2.inode)) == p2
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_wide_ec_more_parts_than_servers(tmp_path):
+    """ec(8,4) = 12 parts on 6 chunkservers: every server holds two
+    parts of the SAME chunk. Regression: the on-disk filename lacked
+    the part id, so sibling parts collided on one path and truncated
+    each other (data loss at exactly this geometry)."""
+    cluster = Cluster(tmp_path, n_cs=6)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "wide.bin")
+        await c.setgoal(f.inode, WIDE_EC_GOAL)
+        payload = data_generator.generate(7, 3_000_000).tobytes()
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        assert (await c.read_file(f.inode)) == payload
+        # every data/parity part must exist somewhere
+        loc = await c.chunk_info(f.inode, 0)
+        parts = {geometry.ChunkPartType.from_id(pl.part_id).part
+                 for pl in loc.locations}
+        assert parts == set(range(12))
+        # degraded read still works after losing one doubled-up server
+        kill_port = loc.locations[0].addr.port
+        for cs in cluster.chunkservers:
+            if cs.port == kill_port:
+                await cs.stop()
+        c.cache.invalidate(f.inode)
+        assert (await c.read_file(f.inode)) == payload
     finally:
         await cluster.stop()
