@@ -27,6 +27,7 @@ import asyncio
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -459,12 +460,20 @@ class ChunkServer(Daemon):
                 elif isinstance(msg, m.CltocsRead):
                     # native streaming needs exclusive use of the socket;
                     # in-flight pipelined writes still owe status frames
+                    t0 = time.perf_counter()
                     await self._serve_read(
                         writer, msg,
                         native_ok=not sessions and not pending_writes,
                     )
+                    self.metrics.timing("read").record(
+                        time.perf_counter() - t0
+                    )
                 elif isinstance(msg, m.CltocsReadBulk):
+                    t0 = time.perf_counter()
                     await self._serve_read_bulk(writer, msg)
+                    self.metrics.timing("read_bulk").record(
+                        time.perf_counter() - t0
+                    )
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteData):

@@ -5,11 +5,20 @@ runs promote/demote helper scripts): on winning an election, a shadow
 master is promoted in-process; on losing leadership while active, the
 daemon logs and keeps serving reads only (full demotion = restart, same
 operational rule as the reference).
+
+``promote_exec`` / ``demote_exec`` are the floating-IP glue of the
+reference's lizardfs-uraft-helper (lizardfs-uraft-helper.in:81-101
+``ip addr add/del`` + arping): shell commands run on every leadership
+transition with LIZ_NODE_ID/LIZ_ROLE in the environment, so operators
+move a service IP, update DNS, or poke a load balancer without patching
+the daemon.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
+import os
 
 from lizardfs_tpu.ha.election import ElectionNode
 
@@ -21,9 +30,17 @@ class FailoverController:
         node_id: str,
         listen: tuple[str, int],
         peers: dict[str, tuple[str, int]],
+        promote_exec: str | None = None,
+        demote_exec: str | None = None,
         **election_kwargs,
     ):
         self.master = master
+        self.node_id = node_id
+        self.promote_exec = promote_exec
+        self.demote_exec = demote_exec
+        # serialize hooks: during flapping, a stale demote finishing
+        # after a fresh promote would strip the new leader's service IP
+        self._hook_lock = asyncio.Lock()
         self.log = logging.getLogger(f"failover[{node_id}]")
         self.node = ElectionNode(
             node_id,
@@ -35,6 +52,26 @@ class FailoverController:
             **election_kwargs,
         )
 
+    async def _run_hook(self, cmd: str | None, role: str) -> None:
+        if not cmd:
+            return
+        env = dict(os.environ, LIZ_NODE_ID=self.node_id, LIZ_ROLE=role)
+        async with self._hook_lock:
+            proc = None
+            try:
+                proc = await asyncio.create_subprocess_shell(cmd, env=env)
+                rc = await asyncio.wait_for(proc.wait(), timeout=30.0)
+                if rc != 0:
+                    self.log.warning("%s hook exited %d: %s", role, rc, cmd)
+            except asyncio.TimeoutError:
+                # a hung hook must not linger: it could mutate network
+                # state (e.g. re-add a floating IP) minutes later
+                self.log.warning("%s hook timed out; killing: %s", role, cmd)
+                proc.kill()
+                await proc.wait()
+            except OSError as e:
+                self.log.warning("%s hook failed: %s", role, e)
+
     async def start(self) -> None:
         await self.node.start()
 
@@ -45,6 +82,7 @@ class FailoverController:
         if self.master.personality != "master":
             self.log.info("election won — promoting shadow")
             self.master.promote()
+            await self._run_hook(self.promote_exec, "master")
 
     async def _on_follower(self, leader_id: str) -> None:
         if self.master.personality == "master":
@@ -55,3 +93,4 @@ class FailoverController:
                 leader_id,
             )
             self.master.personality = "shadow"
+            await self._run_hook(self.demote_exec, "shadow")

@@ -5,7 +5,9 @@ LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), LOG_LEVEL,
 HEALTH_INTERVAL, IMAGE_INTERVAL, PERSONALITY (master|shadow),
 ACTIVE_MASTER (host:port, required for shadow), and optional election:
 ELECTION_ID, ELECTION_LISTEN (host:port), ELECTION_PEERS
-(id=host:port,id=host:port,...).
+(id=host:port,id=host:port,...), PROMOTE_EXEC / DEMOTE_EXEC (shell
+commands run on leadership transitions with LIZ_NODE_ID/LIZ_ROLE set —
+the floating-IP helper glue).
 """
 
 import asyncio
@@ -72,6 +74,8 @@ async def _run(cfg: Config) -> None:
             cfg.get_str("ELECTION_ID"),
             _hostport(cfg.get_str("ELECTION_LISTEN", "127.0.0.1:0")),
             peers,
+            promote_exec=cfg.get_str("PROMOTE_EXEC", "") or None,
+            demote_exec=cfg.get_str("DEMOTE_EXEC", "") or None,
         )
     if controller is not None:
         await controller.start()

@@ -474,6 +474,7 @@ class MasterServer(Daemon):
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                t0 = time.perf_counter()
                 try:
                     reply = await self._handle_client(msg, session_id)
                 except fsmod.FsError as e:
@@ -481,6 +482,10 @@ class MasterServer(Daemon):
                 except Exception:
                     self.log.exception("client op %s failed", type(msg).__name__)
                     reply = self._error_reply(msg, st.EIO)
+                # request_log.h analog: per-op-type latency histograms
+                self.metrics.timing(type(msg).__name__).record(
+                    time.perf_counter() - t0
+                )
                 if reply is not None:
                     await framing.send_message(writer, reply)
         finally:

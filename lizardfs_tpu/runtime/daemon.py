@@ -45,8 +45,35 @@ class Daemon:
         # challenge-response admin password (None = open admin port)
         self.admin_password: str | None = None
         self.add_timer(1.0, self._sample_metrics)
+        # event-loop stall watchdog (loop_watchdog.h analog): a blocked
+        # loop is THE latency failure mode of an asyncio daemon — the
+        # reference aborts on a stuck poll loop; here a stall is logged
+        # with its duration and charted so operators see it
+        self.watchdog_warn_s = 0.25
+        self._wd_last = 0.0
+        self._wd_max_lag = 0.0  # worst lag since the last metrics sample
+        self.add_timer(0.1, self._watchdog_tick)
+
+    async def _watchdog_tick(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if self._wd_last:
+            lag = max(now - self._wd_last - 0.1, 0.0)
+            if lag > self.watchdog_warn_s:
+                self.log.warning(
+                    "event loop stalled for %.0f ms "
+                    "(blocking call on the loop thread?)", lag * 1000,
+                )
+                self.metrics.counter("loop_stalls").inc()
+            # hold the WORST lag until the 1 Hz sampler reads it —
+            # a transient stall must not be erased by the next tick
+            self._wd_max_lag = max(self._wd_max_lag, lag)
+        self._wd_last = now
 
     async def _sample_metrics(self) -> None:
+        self.metrics.gauge("loop_lag_ms").set(self._wd_max_lag * 1000)
+        self._wd_max_lag = 0.0
         self.metrics.sample_all()
 
     def handle_admin_basics(self, msg) -> object | None:
@@ -79,12 +106,14 @@ class Daemon:
             # first; series younger than the window get EMPTY leading
             # cells (a fabricated 0 would read as a real zero sample)
             width = max(
-                (len(s["points"]) for s in doc.values()), default=0
+                (len(s.get("points", ())) for s in doc.values()), default=0
             )
             rows = ["series," + ",".join(
                 f"t-{i}" for i in range(width, 0, -1)
             )]
             for name, series in doc.items():
+                if "points" not in series:
+                    continue  # timing histograms export via JSON only
                 points = series["points"]
                 padded = [""] * (width - len(points)) + [
                     str(v) for v in points

@@ -74,10 +74,53 @@ class Series:
         }
 
 
+class Timing:
+    """Latency histogram with log2 buckets (request_log.h scope-timing
+    analog): record() costs one int_log2 + two adds; export gives
+    count/sum/max plus per-bucket counts for percentile estimates."""
+
+    # bucket i covers [2^i, 2^(i+1)) microseconds; 20 buckets = 1us..1s+
+    NBUCKETS = 20
+
+    __slots__ = ("name", "count", "total_us", "max_us", "buckets")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_us = 0.0
+        self.max_us = 0.0
+        self.buckets = [0] * self.NBUCKETS
+
+    def record(self, seconds: float) -> None:
+        us = seconds * 1e6
+        self.count += 1
+        self.total_us += us
+        if us > self.max_us:
+            self.max_us = us
+        b = max(int(us), 1).bit_length() - 1
+        self.buckets[min(b, self.NBUCKETS - 1)] += 1
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "kind": "timing", "count": self.count,
+            "avg_us": round(self.total_us / self.count, 1) if self.count
+            else 0.0,
+            "max_us": round(self.max_us, 1),
+            "buckets_us_log2": list(self.buckets),
+        }
+
+
 class Metrics:
     def __init__(self):
         self.series: dict[str, Series] = {}
         self.derived: dict[str, str] = {}  # name -> RPN expression
+        self.timings: dict[str, Timing] = {}
+
+    def timing(self, name: str) -> Timing:
+        t = self.timings.get(name)
+        if t is None:
+            t = self.timings[name] = Timing(name)
+        return t
 
     def counter(self, name: str) -> Series:
         s = self.series.get(name)
@@ -195,4 +238,6 @@ class Metrics:
             }
             if err is not None:
                 out[name]["error"] = err
+        for name, t in sorted(self.timings.items()):
+            out[f"timing.{name}"] = t.to_dict()
         return out

@@ -27,6 +27,16 @@ async def admin(port, command):
     return reply
 
 
+def _free_udp_port() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
 @pytest.mark.asyncio
 async def test_shadow_follows_and_promotes(tmp_path):
     active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
@@ -184,16 +194,7 @@ async def test_metalogger_archives(tmp_path):
 @pytest.mark.asyncio
 async def test_election_three_nodes(tmp_path):
     """3-node election: one leader; kill it; a new leader emerges."""
-    import socket
-
-    def free_port():
-        s = socket.socket(socket.SOCK_DGRAM and socket.AF_INET, socket.SOCK_DGRAM)
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
-    ports = {f"n{i}": free_port() for i in range(3)}
+    ports = {f"n{i}": _free_udp_port() for i in range(3)}
     leaders: dict[str, bool] = {}
     nodes = {}
 
@@ -337,3 +338,68 @@ async def test_shadow_detects_divergence_and_heals(tmp_path):
     finally:
         await shadow.stop()
         await active.stop()
+
+
+@pytest.mark.asyncio
+async def test_failover_controller_exec_hooks(tmp_path):
+    """Leadership transitions run the operator's promote/demote
+    commands (lizardfs-uraft-helper floating-IP glue analog)."""
+    from lizardfs_tpu.ha.controller import FailoverController
+
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+
+    pa, pb, pw = _free_udp_port(), _free_udp_port(), _free_udp_port()
+    addrs = {"na": ("127.0.0.1", pa), "nb": ("127.0.0.1", pb),
+             "nw": ("127.0.0.1", pw)}
+
+    def peers_of(nid):
+        return {k: v for k, v in addrs.items() if k != nid}
+
+    marker = tmp_path / "promoted.marker"
+    ctrl_shadow = FailoverController(
+        shadow, "nb", addrs["nb"], peers_of("nb"),
+        promote_exec=f"echo $LIZ_NODE_ID:$LIZ_ROLE > {marker}",
+        election_timeout=(0.2, 0.4),
+    )
+    ctrl_active = FailoverController(
+        active, "na", addrs["na"], peers_of("na"),
+        election_timeout=(0.2, 0.4),
+    )
+    # witness/arbiter node: quorum without a third master (uraft
+    # deployments run an odd node count the same way)
+    async def _noop():
+        pass
+    witness = ElectionNode(
+        "nw", addrs["nw"], peers_of("nw"),
+        get_version=lambda: -1, on_leader=_noop,
+        election_timeout=(9.0, 9.9),  # never seeks leadership itself
+    )
+    await ctrl_active.start()
+    await ctrl_shadow.start()
+    await witness.start()
+    try:
+        # active wins the first election (higher version or tie-break);
+        # then dies — the shadow must win, promote, and run the hook
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if ctrl_active.node.state == LEADER or \
+                    ctrl_shadow.node.state == LEADER:
+                break
+        await ctrl_active.stop()
+        await active.stop()
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            if shadow.personality == "master" and marker.exists():
+                break
+        assert shadow.personality == "master"
+        assert marker.read_text().strip() == "nb:master"
+    finally:
+        await witness.stop()
+        await ctrl_shadow.stop()
+        await shadow.stop()

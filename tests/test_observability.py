@@ -85,6 +85,42 @@ def test_derived_series_rpn():
             mt.define("x", bad)
 
 
+def test_timing_histogram():
+    """request_log.h analog: log2-bucket latency histograms."""
+    mt = Metrics()
+    t = mt.timing("op")
+    for us in (1, 3, 100, 5000, 5000, 2_000_000):
+        t.record(us / 1e6)
+    d = mt.to_dict()["timing.op"]
+    assert d["count"] == 6
+    assert d["max_us"] == 2_000_000
+    assert 300_000 < d["avg_us"] < 400_000
+    # 1us -> bucket 0; 3us -> 1; 100 -> 6; 5000 -> 12 (x2); 2e6 -> 19
+    b = d["buckets_us_log2"]
+    assert b[0] == 1 and b[1] == 1 and b[6] == 1 and b[12] == 2
+    assert b[19] == 1 and sum(b) == 6
+
+
+@pytest.mark.asyncio
+async def test_loop_watchdog_detects_stall(tmp_path):
+    """loop_watchdog.h analog: a blocking call on the loop thread is
+    detected, logged, and counted."""
+    import time as _time
+
+    from lizardfs_tpu.runtime.daemon import Daemon
+
+    d = Daemon()
+    await d.start()
+    try:
+        await asyncio.sleep(0.3)  # watchdog baseline ticks
+        _time.sleep(0.6)  # blocks the loop: the stall under test
+        await asyncio.sleep(0.3)  # let the watchdog observe it
+        assert d.metrics.counter("loop_stalls").total >= 1
+        assert d.metrics.gauge("loop_lag_ms").value >= 0.0
+    finally:
+        await d.stop()
+
+
 def test_tweaks_types():
     tw = Tweaks()
     t_int = tw.register("limit", 0)
@@ -158,6 +194,12 @@ async def test_admin_metrics_and_tweaks(tmp_path):
             json.dumps({"expr": "nope ADD"}),
         )
         assert reply.status != 0
+
+        # per-op latency histograms (request_log.h analog)
+        reply = await admin(cluster.master.port, "metrics")
+        doc2 = json.loads(reply.json)
+        assert doc2["timing.CltomaCreate"]["count"] >= 1
+        assert doc2["timing.CltomaCreate"]["avg_us"] > 0
 
         # chunkserver metrics over its serving port
         cs = cluster.chunkservers[0]
