@@ -1103,28 +1103,53 @@ class Client:
         attempt: int = 0, avoid: set[tuple[str, int]] | None = None,
         into: np.ndarray | None = None, into_offset: int = 0,
     ) -> np.ndarray | None:
-        import random
-
-        # available parts: part index -> list of (addr, wire part id) copies
-        copies: dict[int, list[tuple[tuple[str, int], int]]] = {}
-        slice_type = None
-        for pl in loc.locations:
-            cpt = geometry.ChunkPartType.from_id(pl.part_id)
-            slice_type = cpt.type if slice_type is None else slice_type
-            copies.setdefault(cpt.part, []).append(
-                ((pl.addr.host, pl.addr.port), pl.part_id)
-            )
-        if slice_type is None:
-            raise ReadError("no locations for chunk")
-
-        # copy choice (chunk_read_planner.cc analog): process-wide
-        # per-chunkserver health scores demote flaky/slow replicas for
-        # every read at once; topology order (the master sorts closest
-        # first) breaks ties among equally healthy copies. Retries avoid
-        # replicas that already failed THIS read, then randomize among
-        # what is left.
+        from lizardfs_tpu.core import chunk_planner
         from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
 
+        # whole-chunk planning (chunk_read_planner.cc analog): a chunk
+        # may have several representations at once (std copy + ec parts
+        # mid-conversion); rank them by viability/health/cost and fall
+        # through to the next on failure
+        cands = chunk_planner.candidates(
+            loc.locations, GLOBAL_STATS.score, avoid or set()
+        )
+        if not cands:
+            raise ReadError("no locations for chunk")
+        last: Exception | None = None
+        failed_addrs: list[tuple[str, int]] = []
+        for cand in cands:
+            try:
+                return await self._read_slice(
+                    cand.type, cand.copies, loc, chunk_index, off, size,
+                    file_length, attempt=attempt, avoid=avoid,
+                    into=into, into_offset=into_offset,
+                )
+            except (ReadError, ConnectionError, OSError) as e:
+                # aggregate every candidate's failed replicas so the
+                # caller's blacklist learns them all, not just the
+                # last slice's
+                failed_addrs.extend(getattr(e, "used_addrs", ()))
+                last = e
+        if last is None:
+            raise ReadError("unreachable")
+        if failed_addrs:
+            last.used_addrs = failed_addrs
+        raise last
+
+    async def _read_slice(
+        self, slice_type, copies, loc, chunk_index: int, off: int,
+        size: int, file_length: int, attempt: int = 0,
+        avoid: set[tuple[str, int]] | None = None,
+        into: np.ndarray | None = None, into_offset: int = 0,
+    ) -> np.ndarray | None:
+        import random
+
+        from lizardfs_tpu.core.cs_stats import GLOBAL_STATS
+
+        # copy choice within the slice: health scores demote flaky/slow
+        # replicas; topology order (master sorts closest first) breaks
+        # ties. Retries avoid replicas that already failed THIS read,
+        # then randomize among what is left.
         def pick(locs):
             good = [l for l in locs if l[0] not in (avoid or ())]
             pool = good or locs

@@ -270,11 +270,6 @@ class ChunkRegistry:
         slot_labels = list(labels) if labels else ["_"] * count
         if len(slot_labels) < count:
             slot_labels += ["_"] * (count - len(slot_labels))
-        # fill constrained slots first so labeled servers aren't used up
-        # by wildcard slots
-        order = sorted(range(count), key=lambda i: slot_labels[i] == "_")
-        chosen: dict[int, ChunkServerInfo] = {}
-        used: set[int] = set()
 
         def pick_from(pool: list[ChunkServerInfo]) -> ChunkServerInfo | None:
             if not pool:
@@ -282,6 +277,23 @@ class ChunkRegistry:
             weights = [max(s.free_space, 1) for s in pool]
             return pool[self._rng.choices(range(len(pool)), weights=weights)[0]]
 
+        if count <= len(candidates):
+            # one optimal distinct assignment: greedy label matching can
+            # strand a constrained slot that a different pairing would
+            # satisfy (linear_assignment_optimizer.h)
+            from lizardfs_tpu.master import assignment
+
+            idx = assignment.assign_slots(
+                slot_labels[:count], candidates,
+                jitter=lambda i, j: self._rng.randrange(100),
+            )
+            return [candidates[j] for j in idx]
+
+        # fewer servers than slots: repeats are unavoidable — fill
+        # constrained slots first, weighted-random by free space
+        chosen: dict[int, ChunkServerInfo] = {}
+        used: set[int] = set()
+        order = sorted(range(count), key=lambda i: slot_labels[i] == "_")
         for i in order:
             want = slot_labels[i]
             labeled = [

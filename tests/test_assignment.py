@@ -1,0 +1,94 @@
+"""Linear-assignment label placement (linear_assignment_optimizer.h
+analog): optimal matching where greedy strands constrained slots."""
+
+import random
+from dataclasses import dataclass
+
+from lizardfs_tpu.master import assignment
+
+
+def test_hungarian_known_optimum():
+    cost = [
+        [4, 1, 3],
+        [2, 0, 5],
+        [3, 2, 2],
+    ]
+    sol = assignment.solve(cost)
+    total = sum(cost[i][sol[i]] for i in range(3))
+    assert sorted(sol) == [0, 1, 2]
+    assert total == 5  # 1 + 2 + 2
+
+
+def test_hungarian_rectangular_leaves_columns_free():
+    cost = [[10, 1, 10, 10], [1, 10, 10, 2]]
+    sol = assignment.solve(cost)
+    assert sol == [1, 0]
+
+
+@dataclass
+class Srv:
+    label: str
+    free_space: int
+
+
+def test_labels_never_stranded_by_wildcards():
+    """Slots {A, _} on servers {s0:A, s1:B}: the optimizer must give A
+    its only matching server, sending the wildcard to B — a free-space
+    greedy would grab s0 (more space) for the wildcard."""
+    servers = [Srv("A", 1000), Srv("B", 10)]
+    idx = assignment.assign_slots(
+        ["A", "_"], servers, jitter=lambda i, j: 0
+    )
+    assert servers[idx[0]].label == "A"
+    assert idx[1] != idx[0]
+
+
+def test_two_constrained_slots_cross_assignment():
+    """Slots {A, B} with servers {s0:B, s1:A}: needs the crossing."""
+    servers = [Srv("B", 500), Srv("A", 500)]
+    idx = assignment.assign_slots(["A", "B"], servers, lambda i, j: 0)
+    assert [servers[j].label for j in idx] == ["A", "B"]
+
+
+def test_mismatch_only_when_unavoidable():
+    servers = [Srv("X", 100), Srv("X", 100), Srv("A", 100)]
+    idx = assignment.assign_slots(["A", "A", "_"], servers, lambda i, j: 0)
+    labels = [servers[j].label for j in idx]
+    assert labels.count("A") == 1  # the one A server serves one A slot
+    assert len(set(idx)) == 3  # all distinct
+
+
+def test_free_space_preference_within_labels():
+    servers = [Srv("_", 10), Srv("_", 10_000), Srv("_", 10)]
+    counts = [0, 0, 0]
+    rng = random.Random(7)
+    for _ in range(50):
+        idx = assignment.assign_slots(
+            ["_"], servers, jitter=lambda i, j: rng.randrange(100)
+        )
+        counts[idx[0]] += 1
+    assert counts[1] > 40  # the empty server wins almost always
+
+
+def test_choose_servers_uses_optimizer(monkeypatch):
+    """choose_servers satisfies a tight label pattern that a greedy
+    wildcard-first ordering could strand."""
+    from lizardfs_tpu.master.chunks import ChunkRegistry
+
+    reg = ChunkRegistry()
+    a = reg.register_server("h1", 1, "ssd", 10**12, 0)
+    b = reg.register_server("h2", 2, "hdd", 10**12, 10**11)
+    got = reg.choose_servers(2, labels=["ssd", "_"])
+    assert got[0].cs_id == a.cs_id
+    assert got[1].cs_id == b.cs_id
+
+
+def test_choose_servers_overlong_labels(monkeypatch):
+    """More labels than slots must not crash the optimizer gate."""
+    from lizardfs_tpu.master.chunks import ChunkRegistry
+
+    reg = ChunkRegistry()
+    reg.register_server("h1", 1, "ssd", 10**12, 0)
+    reg.register_server("h2", 2, "hdd", 10**12, 0)
+    got = reg.choose_servers(2, labels=["ssd", "hdd", "ssd"])
+    assert len(got) == 2

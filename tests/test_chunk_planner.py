@@ -1,0 +1,79 @@
+"""Whole-chunk read planner: ranking among slice representations."""
+
+from lizardfs_tpu.core import chunk_planner, geometry
+from lizardfs_tpu.proto import messages as m
+
+
+def _loc(host, port, type_, part):
+    return m.PartLocation(
+        addr=m.Addr(host=host, port=port),
+        part_id=geometry.ChunkPartType(type_, part).id,
+    )
+
+
+STD = geometry.SliceType(geometry.STANDARD)
+EC32 = geometry.ec_type(3, 2)
+XOR3 = geometry.xor_type(3)
+
+
+def test_prefers_complete_std_over_striped():
+    locs = (
+        [_loc("h1", 1, STD, 0)]
+        + [_loc(f"h{i+2}", i + 2, EC32, i) for i in range(5)]
+    )
+    cands = chunk_planner.candidates(locs, lambda a: 1.0)
+    assert [c.type for c in cands] == [STD, EC32]
+    assert all(c.complete for c in cands)
+
+
+def test_unhealthy_std_loses_to_healthy_striped():
+    locs = (
+        [_loc("sick", 1, STD, 0)]
+        + [_loc(f"h{i+2}", i + 2, EC32, i) for i in range(5)]
+    )
+    scores = {("sick", 1): 0.05}
+    cands = chunk_planner.candidates(locs, lambda a: scores.get(a, 1.0))
+    assert cands[0].type == EC32
+
+
+def test_degraded_slice_ranks_below_complete():
+    # ec(3,2) missing one data part (recoverable) vs complete xor3
+    locs = (
+        [_loc(f"e{i}", 10 + i, EC32, i) for i in (0, 2, 3, 4)]  # part 1 lost
+        + [_loc(f"x{i}", 20 + i, XOR3, i) for i in range(4)]
+    )
+    cands = chunk_planner.candidates(locs, lambda a: 1.0)
+    assert cands[0].type == XOR3 and cands[0].complete
+    assert cands[1].type == EC32 and not cands[1].complete
+    assert cands[1].recovery_parts == 1
+
+
+def test_nonviable_slices_are_dropped():
+    # ec(3,2) with only 2 parts cannot serve; std viable
+    locs = (
+        [_loc("e0", 10, EC32, 0), _loc("e1", 11, EC32, 1)]
+        + [_loc("s", 1, STD, 0)]
+    )
+    cands = chunk_planner.candidates(locs, lambda a: 1.0)
+    assert [c.type for c in cands] == [STD]
+    # nothing viable at all -> empty
+    assert chunk_planner.candidates(
+        [_loc("e0", 10, EC32, 0)], lambda a: 1.0
+    ) == []
+
+
+def test_blacklist_desperation_pass():
+    locs = [_loc("only", 1, STD, 0)]
+    # the sole replica is blacklisted: desperation pass still offers it
+    cands = chunk_planner.candidates(locs, lambda a: 1.0, {("only", 1)})
+    assert len(cands) == 1 and cands[0].type == STD
+
+
+def test_xor_parity_only_not_viable():
+    # xor3 parity + one data part: 2 of 3 data parts missing
+    locs = [_loc("p", 1, XOR3, 0), _loc("d1", 2, XOR3, 1)]
+    assert chunk_planner.candidates(locs, lambda a: 1.0) == []
+    # all three data parts but no parity: viable and complete=False
+    locs = [_loc(f"d{i}", i, XOR3, i) for i in (1, 2, 3)]
+    [c] = chunk_planner.candidates(locs, lambda a: 1.0)
+    assert c.type == XOR3 and not c.complete
