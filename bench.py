@@ -143,8 +143,12 @@ def cluster_throughput() -> dict:
                 r["goal"].replace(" ", "_").replace("(", "").replace(")", "")
                 .replace(",", "_")
             )
-            out[f"cluster_{key}_write_MBps"] = r["write_MBps"]
-            out[f"cluster_{key}_read_MBps"] = r["read_MBps"]
+            if "write_MBps" in r:
+                out[f"cluster_{key}_write_MBps"] = r["write_MBps"]
+                out[f"cluster_{key}_read_MBps"] = r["read_MBps"]
+            elif "native_read_us" in r:
+                out["cluster_4k_read_native_us"] = r["native_read_us"]
+                out["cluster_4k_read_loop_us"] = r["loop_read_us"]
         return out
     except Exception as e:  # noqa: BLE001 — bench must still emit a line
         return {"cluster_error": str(e)[:200]}

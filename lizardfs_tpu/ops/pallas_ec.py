@@ -188,29 +188,38 @@ CRC_SUB = 128  # sub-block bytes = one full vreg lane width
 def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
     rows = k + m
     sc = tile // CRC_SUB
+    kp, mp = -(-k // 8) * 8, -(-m // 8) * 8
     return (
         2 * k * tile            # data in (x2 pipeline)
         + 2 * m * tile          # parity out (x2 pipeline)
         + 16 * k * tile         # unpacked bits, bf16
         + 32 * m * tile         # encode accumulator, f32
         + m * tile              # packed parity bytes
-        + 2 * rows * sc * 32 * 6  # crc planes + partial acc (bf16+f32)
-        + sc * 32 * 32 * 2      # fold matrix, bf16
+        + rows * sc * 32 * 10   # crc planes (bf16) + acc (f32) + scan g (i32)
+        + (kp * k + mp * m) * sc * 2  # selection matrices, bf16
+        + 16 * 32 * 32 * 2      # scan shift stack, bf16
     )
 
 
-def _chunk_registers(x, csub_ref, fold_ref):
-    """(rows, T) uint8 tile -> (rows, 32) GF(2) CRC registers.
+def _chunk_registers(x, csub_ref, shifts_ref, sel_ref):
+    """(rows, T) uint8 tile -> (rp, 32) GF(2) CRC registers (rp = rows
+    padded to x8 by the selection matrix).
 
     Stage 1 (MXU): per-128-byte sub-block partial registers, batched
-    over rows*Sc sub-blocks. Stage 2 (MXU): fold the Sc partials of
-    each row with the position-shift matrix F — still in VMEM, so no
-    partial-register round trip through HBM (the round-1 bottleneck).
+    over rows*Sc sub-blocks. Stage 2: Hillis-Steele suffix scan over
+    each row's Sc consecutive sub-registers — level l combines spans of
+    2^l sub-blocks with ONE shared 32x32 shift matmul plus a sublane
+    roll and an iota mask (no lane/sublane shape casts, which Mosaic
+    cannot lower). Stage 3 (MXU): a 0/1 selection matmul extracts each
+    row's j=0 register straight into the padded output layout. All in
+    VMEM: no partial-register round trip through HBM (the round-1
+    bottleneck).
     """
     rows, t = x.shape
     sc = t // CRC_SUB
-    subs = x.reshape(rows * sc, CRC_SUB)
-    acc = jnp.zeros((rows * sc, 32), jnp.float32)
+    n = rows * sc
+    subs = x.reshape(n, CRC_SUB)
+    acc = jnp.zeros((n, 32), jnp.float32)
     for b in range(8):
         plane = ((subs & jnp.uint8(1 << b)) != 0).astype(jnp.bfloat16)
         acc += jax.lax.dot_general(
@@ -218,18 +227,30 @@ def _chunk_registers(x, csub_ref, fold_ref):
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-    pbits = (acc.astype(jnp.int32) & 1).astype(jnp.bfloat16)
-    q = pbits.reshape(rows, sc * 32)
+    g = acc.astype(jnp.int32) & 1  # (n, 32) sub-block registers
+    j = jax.lax.broadcasted_iota(jnp.int32, (n, 32), 0) & (sc - 1)
+    levels = sc.bit_length() - 1
+    for l in range(levels):
+        h = 1 << l
+        # g'_j = g_j @ S^(128h bytes)  ^  g_{j+h}   (0 past the row end)
+        shifted = jax.lax.dot_general(
+            g.astype(jnp.bfloat16), shifts_ref[l],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32) & 1
+        nxt = pltpu.roll(g, n - h, axis=0)  # g[i+h] lands at i
+        nxt = jnp.where(j < sc - h, nxt, 0)
+        g = shifted ^ nxt
     reg = jax.lax.dot_general(
-        q, fold_ref[:],
+        sel_ref[:], g.astype(jnp.bfloat16),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # exact: sums <= sc*32 < 2^24
+    )  # (rp, 32); exact: one 1 per selection row
     return reg.astype(jnp.int32) & 1
 
 
-def _fused_kernel(bigm_ref, csub_ref, fold_ref, data_ref,
-                  parity_ref, dreg_ref, preg_ref):
+def _fused_kernel(bigm_ref, csub_ref, shifts_ref, seld_ref, selp_ref,
+                  data_ref, parity_ref, dreg_ref, preg_ref):
     data = data_ref[:]
     bits = _unpack_tile(data)  # (8k, T)
     acc = jax.lax.dot_general(
@@ -243,8 +264,8 @@ def _fused_kernel(bigm_ref, csub_ref, fold_ref, data_ref,
     weights = jax.lax.broadcasted_iota(jnp.int32, (mm, 8, t), 1)
     parity = (pbits.reshape(mm, 8, t) << weights).sum(axis=1).astype(jnp.uint8)
     parity_ref[:] = parity
-    dreg_ref[:] = _chunk_registers(data, csub_ref, fold_ref)
-    preg_ref[:] = _chunk_registers(parity, csub_ref, fold_ref)
+    dreg_ref[:] = _chunk_registers(data, csub_ref, shifts_ref, seld_ref)
+    preg_ref[:] = _chunk_registers(parity, csub_ref, shifts_ref, selp_ref)
 
 
 @functools.partial(
@@ -276,6 +297,11 @@ def fused_encode_crc(
     if block_size % tile:
         raise ValueError(f"tile={tile} must divide block_size={block_size}")
     sc = tile // CRC_SUB
+    if sc & (sc - 1):
+        raise ValueError(
+            f"tile={tile} must give a power-of-two sub-block count "
+            f"(the CRC scan doubles span lengths per level)"
+        )
     nchunks = n // tile
     cpb = block_size // tile  # chunks per 64 KiB block
     nb = n // block_size
@@ -283,12 +309,19 @@ def fused_encode_crc(
     c_sub, _levels, k_const = crc_host.block_crc_matrices(block_size, CRC_SUB)
     csub_t = np.asarray(c_sub.T, dtype=np.float32)
     csub_planes = np.stack([csub_t[bb::8, :] for bb in range(8)])
-    # F: per-sub-block-position shift matrices, stacked so the fold is
-    # one (rows, sc*32) x (sc*32, 32) matmul
-    fold = np.zeros((sc * 32, 32), dtype=np.float32)
-    for j in range(sc):
-        fold[j * 32:(j + 1) * 32, :] = \
-            crc_host.shift_matrix(CRC_SUB * (sc - 1 - j)).T
+    # scan shift matrices: level l combines spans of 2^l sub-blocks, so
+    # every row uses the SAME shift(128 * 2^l) matrix at that level
+    levels = sc.bit_length() - 1
+    shifts = np.zeros((max(levels, 1), 32, 32), dtype=np.float32)
+    for l in range(levels):
+        shifts[l] = crc_host.shift_matrix(CRC_SUB * (1 << l)).T
+    kp, mp = -(-k // 8) * 8, -(-m // 8) * 8  # register rows padded to x8
+    # 0/1 selection matrices: row r of the padded output takes the
+    # scanned register at sub-row r*sc (row r's full-span register)
+    seld = np.zeros((kp, k * sc), dtype=np.float32)
+    seld[np.arange(k), np.arange(k) * sc] = 1.0
+    selp = np.zeros((mp, m * sc), dtype=np.float32)
+    selp[np.arange(m), np.arange(m) * sc] = 1.0
     # G: combines the cpb chunk registers of one block in XLA (tiny)
     comb = np.zeros((cpb * 32, 32), dtype=np.int32)
     for c in range(cpb):
@@ -299,8 +332,8 @@ def fused_encode_crc(
         _fused_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((m, n), jnp.uint8),
-            jax.ShapeDtypeStruct((nchunks * k, 32), jnp.int32),
-            jax.ShapeDtypeStruct((nchunks * m, 32), jnp.int32),
+            jax.ShapeDtypeStruct((nchunks * kp, 32), jnp.int32),
+            jax.ShapeDtypeStruct((nchunks * mp, 32), jnp.int32),
         ),
         grid=(nchunks,),
         in_specs=[
@@ -308,7 +341,11 @@ def fused_encode_crc(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(csub_planes.shape, lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((sc * 32, 32), lambda i: (0, 0),
+            pl.BlockSpec(shifts.shape, lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(seld.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(selp.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((k, tile), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
@@ -316,22 +353,25 @@ def fused_encode_crc(
         out_specs=(
             pl.BlockSpec((m, tile), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, 32), lambda i: (i, 0),
+            pl.BlockSpec((kp, 32), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((m, 32), lambda i: (i, 0),
+            pl.BlockSpec((mp, 32), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
     )(
         bigm.astype(jnp.bfloat16),
         jnp.asarray(csub_planes, dtype=jnp.bfloat16),
-        jnp.asarray(fold, dtype=jnp.bfloat16),
+        jnp.asarray(shifts, dtype=jnp.bfloat16),
+        jnp.asarray(seld, dtype=jnp.bfloat16),
+        jnp.asarray(selp, dtype=jnp.bfloat16),
         data,
     )
 
-    def finalize(regs, nrows):
-        # (nchunks*nrows, 32) -> (nrows, nb) final CRC values
-        r = regs.reshape(nb, cpb, nrows, 32).transpose(2, 0, 1, 3)
+    def finalize(regs, nrows, npad):
+        # (nchunks*npad, 32) -> (nrows, nb) final CRC values
+        r = regs.reshape(nb, cpb, npad, 32)[:, :, :nrows, :]
+        r = r.transpose(2, 0, 1, 3)
         r = r.reshape(nrows, nb, cpb * 32)
         folded = jax.lax.dot_general(
             r, jnp.asarray(comb),
@@ -342,7 +382,7 @@ def fused_encode_crc(
         crc = (folded.astype(jnp.uint32) * w).sum(axis=2, dtype=jnp.uint32)
         return crc ^ jnp.uint32(k_const)
 
-    return parity, finalize(dreg, k), finalize(preg, m)
+    return parity, finalize(dreg, k, kp), finalize(preg, m, mp)
 
 
 @functools.partial(
