@@ -68,10 +68,18 @@ def tpu_throughput(k: int = K, m: int = M,
     L = 16
     timed(1)  # compile L=1
     timed(L)  # compile L=16
-    floor = min(timed(1) for _ in range(3))
-    total = min(timed(L) for _ in range(3))
-    per_iter = max((total - floor) / (L - 1), 1e-9)
-    return data_mib / per_iter
+    best = 0.0
+    # several measurement rounds: the first reads low until clocks and
+    # the axon tunnel warm up, so report the best sustained round;
+    # rounds where the L-iter run beats its own dispatch floor are
+    # timing noise and are discarded (not clamped into the max)
+    for _ in range(4):
+        floor = min(timed(1) for _ in range(3))
+        total = min(timed(L) for _ in range(3))
+        if total <= floor:
+            continue
+        best = max(best, data_mib / ((total - floor) / (L - 1)))
+    return best
 
 
 def cpu_baseline_throughput() -> float:

@@ -3,8 +3,8 @@
 Why Pallas here: the XLA bit-plane path materializes the 8x bit
 expansion in HBM (512 MiB of int8 bits per 64 MiB chunk) and pays for
 small-matmul launches; these kernels unpack bits **inside VMEM**, run
-the GF(2) matmuls on the MXU in bf16 (0/1 values: exact in bf16 with
-f32 accumulation up to 2^24), and write only real bytes back — HBM
+the GF(2) matmuls on the MXU as s8 x s8 -> s32 (0/1 values: exact, and
+int8 runs at twice the bf16 rate), and write only real bytes back — HBM
 traffic collapses to data-in + parity-out.
 
 Kernels:
@@ -47,22 +47,22 @@ def supported() -> bool:
 
 
 def _unpack_tile(bytes_tile: jnp.ndarray) -> jnp.ndarray:
-    """(r, T) uint8 -> (8r, T) bf16 bit planes; row j*8+b = bit b."""
+    """(r, T) uint8 -> (8r, T) int8 bit planes; row j*8+b = bit b."""
     r, t = bytes_tile.shape
     x = bytes_tile.astype(jnp.int32)
     shifts = jax.lax.broadcasted_iota(jnp.int32, (r, 8, t), 1)
     bits = (x[:, None, :] >> shifts) & 1
-    return bits.reshape(8 * r, t).astype(jnp.bfloat16)
+    return bits.reshape(8 * r, t).astype(jnp.int8)
 
 
 def _encode_kernel(bigm_ref, data_ref, parity_ref):
-    bits = _unpack_tile(data_ref[:])  # (8k, T)
+    bits = _unpack_tile(data_ref[:])  # (8k, T) int8
     acc = jax.lax.dot_general(
         bigm_ref[:], bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (8m, T) exact integer sums
-    pbits = acc.astype(jnp.int32) & 1
+        preferred_element_type=jnp.int32,
+    )  # (8m, T) exact integer sums, s8 MXU
+    pbits = acc & 1
     m8, t = pbits.shape
     m = m8 // 8
     weights = jax.lax.broadcasted_iota(jnp.int32, (m, 8, t), 1)
@@ -74,7 +74,7 @@ def _encode_kernel(bigm_ref, data_ref, parity_ref):
 def encode(bigm: jnp.ndarray, data: jnp.ndarray, tile: int = 16384) -> jnp.ndarray:
     """Fused bit-plane RS encode: (k, N) uint8 -> (m, N) uint8 parity.
 
-    ``bigm`` is the (8m, 8k) expanded generator/recovery matrix as bf16.
+    ``bigm`` is the (8m, 8k) expanded generator/recovery matrix.
     Serves both encode and recover (the matrix decides).
     """
     k, n = data.shape
@@ -94,7 +94,7 @@ def encode(bigm: jnp.ndarray, data: jnp.ndarray, tile: int = 16384) -> jnp.ndarr
             pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
-    )(bigm.astype(jnp.bfloat16), data)
+    )(bigm.astype(jnp.int8), data)
 
 
 CRC_BLOCKS_PER_STEP = 16
@@ -187,85 +187,119 @@ CRC_SUB = 128  # sub-block bytes = one full vreg lane width
 
 def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
     rows = k + m
-    sc = tile // CRC_SUB
     kp, mp = -(-k // 8) * 8, -(-m // 8) * 8
+    sg = max(tile // CRC_GROUP, 1)
     return (
         2 * k * tile            # data in (x2 pipeline)
         + 2 * m * tile          # parity out (x2 pipeline)
-        + 16 * k * tile         # unpacked bits, bf16
-        + 32 * m * tile         # encode accumulator, f32
+        + 8 * k * tile          # unpacked bits, int8 (q-stacked: same)
+        + 32 * m * tile         # encode accumulator, int32
         + m * tile              # packed parity bytes
-        + rows * sc * 32 * 10   # crc planes (bf16) + acc (f32) + scan g (i32)
-        + (kp * k + mp * m) * sc * 2  # selection matrices, bf16
-        + 16 * 32 * 32 * 2      # scan shift stack, bf16
+        + 8 * rows * tile       # crc stacked bit planes, int8
+        + rows * sg * 32 * 8    # crc acc + scan registers, int32
+        + (kp * k + mp * m) * sg      # selection matrices, int8
+        + 16 * 32 * 32 + 16 * 16 * k * m  # shift stack + bigm_q, int8
     )
 
 
-def _chunk_registers(x, csub_ref, shifts_ref, sel_ref):
+CRC_GROUP = 512  # stage-1 group bytes: M = rows*T/512 fills MXU sublanes
+_ENC_STACK_MAX = 128  # cap on q*8m when stacking column quarters
+
+
+def _chunk_registers(x, w_ref, shifts_ref, sel_ref, group: int):
     """(rows, T) uint8 tile -> (rp, 32) GF(2) CRC registers (rp = rows
     padded to x8 by the selection matrix).
 
-    Stage 1 (MXU): per-128-byte sub-block partial registers, batched
-    over rows*Sc sub-blocks. Stage 2: Hillis-Steele suffix scan over
-    each row's Sc consecutive sub-registers — level l combines spans of
-    2^l sub-blocks with ONE shared 32x32 shift matmul plus a sublane
-    roll and an iota mask (no lane/sublane shape casts, which Mosaic
-    cannot lower). Stage 3 (MXU): a 0/1 selection matmul extracts each
-    row's j=0 register straight into the padded output layout. All in
-    VMEM: no partial-register round trip through HBM (the round-1
-    bottleneck).
+    Stage 1 (MXU): one matmul computes the CRC register of every
+    ``group``-byte span: the 8 bit planes are concatenated along the
+    contraction dim and W has the per-byte-position shift matrices
+    folded in, so (rows*Sc, 8G) @ (8G, 32) runs at full M and K
+    utilisation (vs. 8 thin matmuls + a long fold in earlier
+    revisions). Stage 2: Hillis-Steele suffix scan over each row's Sc
+    group registers — level l combines spans of 2^l groups with one
+    shared 32x32 shift matmul plus a sublane roll and an iota mask (no
+    lane/sublane shape casts, which Mosaic cannot lower). Stage 3
+    (MXU): a 0/1 selection matmul extracts each row's j=0 register
+    straight into the padded output layout. All in VMEM: no
+    partial-register round trip through HBM (the round-1 bottleneck).
     """
     rows, t = x.shape
-    sc = t // CRC_SUB
+    sc = t // group
     n = rows * sc
-    subs = x.reshape(n, CRC_SUB)
-    acc = jnp.zeros((n, 32), jnp.float32)
-    for b in range(8):
-        plane = ((subs & jnp.uint8(1 << b)) != 0).astype(jnp.bfloat16)
-        acc += jax.lax.dot_general(
-            plane, csub_ref[b],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-    g = acc.astype(jnp.int32) & 1  # (n, 32) sub-block registers
+    groups = x.reshape(n, group)
+    planes = jnp.concatenate(
+        [((groups & jnp.uint8(1 << b)) != 0).astype(jnp.int8)
+         for b in range(8)],
+        axis=1,
+    )  # (n, 8G), plane-major along lanes (W rows match this order)
+    acc = jax.lax.dot_general(
+        planes, w_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # s8 x s8 -> s32 MXU: exact, 2x the bf16 rate, half the VMEM
+    g = acc & 1  # (n, 32) group registers (i32: pltpu.roll needs 32-bit)
     j = jax.lax.broadcasted_iota(jnp.int32, (n, 32), 0) & (sc - 1)
     levels = sc.bit_length() - 1
     for l in range(levels):
         h = 1 << l
-        # g'_j = g_j @ S^(128h bytes)  ^  g_{j+h}   (0 past the row end)
+        # g'_j = g_j @ S^(G*h bytes)  ^  g_{j+h}   (0 past the row end)
         shifted = jax.lax.dot_general(
-            g.astype(jnp.bfloat16), shifts_ref[l],
+            g.astype(jnp.int8), shifts_ref[l],
             dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32) & 1
+            preferred_element_type=jnp.int32,
+        ) & 1
         nxt = pltpu.roll(g, n - h, axis=0)  # g[i+h] lands at i
         nxt = jnp.where(j < sc - h, nxt, 0)
         g = shifted ^ nxt
     reg = jax.lax.dot_general(
-        sel_ref[:], g.astype(jnp.bfloat16),
+        sel_ref[:], g.astype(jnp.int8),
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.int32,
     )  # (rp, 32); exact: one 1 per selection row
-    return reg.astype(jnp.int32) & 1
+    return reg & 1
 
 
-def _fused_kernel(bigm_ref, csub_ref, shifts_ref, seld_ref, selp_ref,
-                  data_ref, parity_ref, dreg_ref, preg_ref):
-    data = data_ref[:]
-    bits = _unpack_tile(data)  # (8k, T)
+def _encode_tile(bigm_ref, data, m: int, q: int):
+    """RS-encode one (k, T) tile -> (m, T) parity bytes.
+
+    ``q`` column quarters are stacked along the contraction dim against
+    a block-diagonal generator (q*8m, q*8k): the parity matmul's M dim
+    grows from 8m (as low as 8) to q*8m ~ 128, filling the MXU's output
+    tile instead of wasting 7/8 of it.
+    """
+    k, t = data.shape
+    tq = t // q
+    if q == 1:
+        bits = _unpack_tile(data)
+    else:
+        bits = jnp.concatenate(
+            [_unpack_tile(data[:, i * tq:(i + 1) * tq]) for i in range(q)],
+            axis=0,
+        )  # (q*8k, Tq)
     acc = jax.lax.dot_general(
         bigm_ref[:], bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    pbits = acc.astype(jnp.int32) & 1
-    m8, t = pbits.shape
-    mm = m8 // 8
-    weights = jax.lax.broadcasted_iota(jnp.int32, (mm, 8, t), 1)
-    parity = (pbits.reshape(mm, 8, t) << weights).sum(axis=1).astype(jnp.uint8)
+        preferred_element_type=jnp.int32,
+    )  # (q*8m, Tq)
+    pbits = acc & 1
+    weights = jax.lax.broadcasted_iota(jnp.int32, (q * m, 8, tq), 1)
+    packed = (pbits.reshape(q * m, 8, tq) << weights).sum(axis=1)
+    packed = packed.astype(jnp.uint8)  # (q*m, Tq), quarter-major rows
+    if q == 1:
+        return packed
+    return jnp.concatenate(
+        [packed[i * m:(i + 1) * m, :] for i in range(q)], axis=1
+    )  # (m, T)
+
+
+def _fused_kernel(bigm_ref, w_ref, shifts_ref, seld_ref, selp_ref,
+                  data_ref, parity_ref, dreg_ref, preg_ref,
+                  *, m: int, q: int, group: int):
+    data = data_ref[:]
+    parity = _encode_tile(bigm_ref, data, m, q)
     parity_ref[:] = parity
-    dreg_ref[:] = _chunk_registers(data, csub_ref, shifts_ref, seld_ref)
-    preg_ref[:] = _chunk_registers(parity, csub_ref, shifts_ref, selp_ref)
+    dreg_ref[:] = _chunk_registers(data, w_ref, shifts_ref, seld_ref, group)
+    preg_ref[:] = _chunk_registers(parity, w_ref, shifts_ref, selp_ref, group)
 
 
 @functools.partial(
@@ -275,7 +309,7 @@ def fused_encode_crc(
     bigm: jnp.ndarray,
     data: jnp.ndarray,
     block_size: int = MFSBLOCKSIZE,
-    tile: int = 16384,
+    tile: int = 32768,
     interpret: bool | None = None,
 ):
     """Single-pass fused RS encode + per-block CRC32.
@@ -289,47 +323,66 @@ def fused_encode_crc(
     m = bigm.shape[0] // 8
     rows = k + m
     while tile > 2 * CRC_SUB and (
-        _fused_vmem_bytes(k, m, tile) > 10 * 2**20 or block_size % tile
+        _fused_vmem_bytes(k, m, tile) > 24 * 2**20 or block_size % tile
     ):
         tile //= 2
     if n % tile:
         raise ValueError(f"N={n} not a multiple of tile={tile}")
     if block_size % tile:
         raise ValueError(f"tile={tile} must divide block_size={block_size}")
-    sc = tile // CRC_SUB
-    if sc & (sc - 1):
+    if tile & (tile - 1):
         raise ValueError(
-            f"tile={tile} must give a power-of-two sub-block count "
-            f"(the CRC scan doubles span lengths per level)"
+            f"tile={tile} must be a power of two (the CRC scan doubles "
+            f"span lengths per level and quarters must stay lane-aligned)"
         )
     nchunks = n // tile
     cpb = block_size // tile  # chunks per 64 KiB block
     nb = n // block_size
 
-    c_sub, _levels, k_const = crc_host.block_crc_matrices(block_size, CRC_SUB)
-    csub_t = np.asarray(c_sub.T, dtype=np.float32)
-    csub_planes = np.stack([csub_t[bb::8, :] for bb in range(8)])
-    # scan shift matrices: level l combines spans of 2^l sub-blocks, so
-    # every row uses the SAME shift(128 * 2^l) matrix at that level
-    levels = sc.bit_length() - 1
+    group = min(CRC_GROUP, tile)
+    sg = tile // group  # group registers per row per tile
+    c_sub, _levels, k_const = crc_host.block_crc_matrices(block_size, group)
+    # W rows match the kernel's plane-major lane concat: row b*G+p = bit
+    # b of byte position p (row 8p+b of C_G^T)
+    ct = np.asarray(c_sub.T, dtype=np.float32)  # (8G, 32), rows 8p+b
+    w = np.concatenate([ct[b::8, :] for b in range(8)], axis=0)
+    # scan shift matrices: level l combines spans of 2^l groups, so
+    # every row uses the SAME shift(G * 2^l) matrix at that level
+    levels = sg.bit_length() - 1
     shifts = np.zeros((max(levels, 1), 32, 32), dtype=np.float32)
     for l in range(levels):
-        shifts[l] = crc_host.shift_matrix(CRC_SUB * (1 << l)).T
+        shifts[l] = crc_host.shift_matrix(group * (1 << l)).T
     kp, mp = -(-k // 8) * 8, -(-m // 8) * 8  # register rows padded to x8
     # 0/1 selection matrices: row r of the padded output takes the
-    # scanned register at sub-row r*sc (row r's full-span register)
-    seld = np.zeros((kp, k * sc), dtype=np.float32)
-    seld[np.arange(k), np.arange(k) * sc] = 1.0
-    selp = np.zeros((mp, m * sc), dtype=np.float32)
-    selp[np.arange(m), np.arange(m) * sc] = 1.0
+    # scanned register at sub-row r*sg (row r's full-span register)
+    seld = np.zeros((kp, k * sg), dtype=np.float32)
+    seld[np.arange(k), np.arange(k) * sg] = 1.0
+    selp = np.zeros((mp, m * sg), dtype=np.float32)
+    selp[np.arange(m), np.arange(m) * sg] = 1.0
+    # q column quarters stacked along K against a block-diagonal
+    # generator: lifts the parity matmul's M dim to ~128 (see
+    # _encode_tile); q must keep quarters lane-aligned
+    q = 1
+    while (
+        2 * q * 8 * m <= _ENC_STACK_MAX
+        and tile % (2 * q * 128) == 0
+        and 2 * q <= sg
+    ):
+        q *= 2
+    bigm_q = jnp.zeros((q * 8 * m, q * 8 * k), dtype=jnp.int8)
+    for i in range(q):
+        bigm_q = bigm_q.at[
+            i * 8 * m:(i + 1) * 8 * m, i * 8 * k:(i + 1) * 8 * k
+        ].set(bigm.astype(jnp.int8))
     # G: combines the cpb chunk registers of one block in XLA (tiny)
     comb = np.zeros((cpb * 32, 32), dtype=np.int32)
     for c in range(cpb):
         comb[c * 32:(c + 1) * 32, :] = \
             crc_host.shift_matrix(tile * (cpb - 1 - c)).T
 
+    kernel = functools.partial(_fused_kernel, m=m, q=q, group=group)
     parity, dreg, preg = pl.pallas_call(
-        _fused_kernel,
+        kernel,
         out_shape=(
             jax.ShapeDtypeStruct((m, n), jnp.uint8),
             jax.ShapeDtypeStruct((nchunks * kp, 32), jnp.int32),
@@ -337,9 +390,9 @@ def fused_encode_crc(
         ),
         grid=(nchunks,),
         in_specs=[
-            pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0),
+            pl.BlockSpec(bigm_q.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(csub_planes.shape, lambda i: (0, 0, 0),
+            pl.BlockSpec(w.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(shifts.shape, lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -360,11 +413,11 @@ def fused_encode_crc(
         ),
         interpret=interpret,
     )(
-        bigm.astype(jnp.bfloat16),
-        jnp.asarray(csub_planes, dtype=jnp.bfloat16),
-        jnp.asarray(shifts, dtype=jnp.bfloat16),
-        jnp.asarray(seld, dtype=jnp.bfloat16),
-        jnp.asarray(selp, dtype=jnp.bfloat16),
+        bigm_q,
+        jnp.asarray(w, dtype=jnp.int8),
+        jnp.asarray(shifts, dtype=jnp.int8),
+        jnp.asarray(seld, dtype=jnp.int8),
+        jnp.asarray(selp, dtype=jnp.int8),
         data,
     )
 
