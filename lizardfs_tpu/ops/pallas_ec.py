@@ -55,19 +55,29 @@ def _unpack_tile(bytes_tile: jnp.ndarray) -> jnp.ndarray:
     return bits.reshape(8 * r, t).astype(jnp.int8)
 
 
-def _encode_kernel(bigm_ref, data_ref, parity_ref):
-    bits = _unpack_tile(data_ref[:])  # (8k, T) int8
-    acc = jax.lax.dot_general(
-        bigm_ref[:], bits,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    )  # (8m, T) exact integer sums, s8 MXU
-    pbits = acc & 1
-    m8, t = pbits.shape
-    m = m8 // 8
-    weights = jax.lax.broadcasted_iota(jnp.int32, (m, 8, t), 1)
-    parity = (pbits.reshape(m, 8, t) << weights).sum(axis=1)
-    parity_ref[:] = parity.astype(jnp.uint8)
+def _stack_generator(bigm, k: int, m: int, tile: int, max_groups: int):
+    """Pick the column-stacking factor q and build the block-diagonal
+    generator (see _encode_tile). q doubles while the stacked matmul's
+    M dim stays within _ENC_STACK_MAX, quarters stay lane-aligned, and
+    q stays within ``max_groups`` (the fused kernel also caps q by its
+    CRC group count so both see the same quarters)."""
+    q = 1
+    while (
+        2 * q * 8 * m <= _ENC_STACK_MAX
+        and tile % (2 * q * 128) == 0
+        and 2 * q <= max_groups
+    ):
+        q *= 2
+    bigm_q = jnp.zeros((q * 8 * m, q * 8 * k), dtype=jnp.int8)
+    for i in range(q):
+        bigm_q = bigm_q.at[
+            i * 8 * m:(i + 1) * 8 * m, i * 8 * k:(i + 1) * 8 * k
+        ].set(bigm.astype(jnp.int8))
+    return q, bigm_q
+
+
+def _encode_kernel(bigm_ref, data_ref, parity_ref, *, m: int, q: int):
+    parity_ref[:] = _encode_tile(bigm_ref, data_ref[:], m, q)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -79,22 +89,25 @@ def encode(bigm: jnp.ndarray, data: jnp.ndarray, tile: int = 16384) -> jnp.ndarr
     """
     k, n = data.shape
     m = bigm.shape[0] // 8
-    # keep bits + accumulator + tiles within a conservative VMEM budget
-    while tile > 512 and (8 * k * 2 + 8 * m * 4 + k + m) * tile > 8 * 2**20:
+    # keep bits (int8) + accumulator (int32) + tiles within a
+    # conservative VMEM budget
+    while tile > 512 and (9 * k + 33 * m) * tile > 8 * 2**20:
         tile //= 2
     if n % tile:
         raise ValueError(f"N={n} not a multiple of tile={tile}")
+    q, bigm_q = _stack_generator(bigm, k, m, tile, max_groups=tile // 128)
     grid = (n // tile,)
     return pl.pallas_call(
-        _encode_kernel,
+        functools.partial(_encode_kernel, m=m, q=q),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.uint8),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((8 * m, 8 * k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((q * 8 * m, q * 8 * k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
-    )(bigm.astype(jnp.int8), data)
+    )(bigm_q, data)
 
 
 CRC_BLOCKS_PER_STEP = 16
@@ -359,21 +372,7 @@ def fused_encode_crc(
     seld[np.arange(k), np.arange(k) * sg] = 1.0
     selp = np.zeros((mp, m * sg), dtype=np.float32)
     selp[np.arange(m), np.arange(m) * sg] = 1.0
-    # q column quarters stacked along K against a block-diagonal
-    # generator: lifts the parity matmul's M dim to ~128 (see
-    # _encode_tile); q must keep quarters lane-aligned
-    q = 1
-    while (
-        2 * q * 8 * m <= _ENC_STACK_MAX
-        and tile % (2 * q * 128) == 0
-        and 2 * q <= sg
-    ):
-        q *= 2
-    bigm_q = jnp.zeros((q * 8 * m, q * 8 * k), dtype=jnp.int8)
-    for i in range(q):
-        bigm_q = bigm_q.at[
-            i * 8 * m:(i + 1) * 8 * m, i * 8 * k:(i + 1) * 8 * k
-        ].set(bigm.astype(jnp.int8))
+    q, bigm_q = _stack_generator(bigm, k, m, tile, max_groups=sg)
     # G: combines the cpb chunk registers of one block in XLA (tiny)
     comb = np.zeros((cpb * 32, 32), dtype=np.int32)
     for c in range(cpb):

@@ -1,0 +1,125 @@
+"""Regenerate the on-disk format compatibility fixture (tests/data/golden).
+
+The golden tree is the analog of the reference's cross-version upgrade
+suites (reference tests/tools/lizardfsXX.sh install old-version daemons
+and mount their data with the current build): a frozen master data dir
+(metadata image + changelog) and chunkserver data dirs written by the
+CURRENT format, committed to the repo. ``tests/test_upgrade.py`` boots
+today's daemons on a copy of that tree and must read everything back.
+
+Run this ONLY on a deliberate format bump (IMAGE_FORMAT, chunk magic,
+changelog grammar), together with a migration note in doc/migration.md:
+
+    python tests/make_golden_fixture.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.utils import data_generator
+
+GOLDEN = Path(__file__).parent / "data" / "golden"
+
+EC_GOAL = 10
+
+
+def make_goals():
+    goals = geometry.default_goals()
+    goals[EC_GOAL] = geometry.parse_goal_line(f"{EC_GOAL} ecgold : $ec(3,2)")[1]
+    return goals
+
+
+async def build(tmp: Path) -> dict:
+    master = MasterServer(str(tmp / "master"), goals=make_goals(),
+                          health_interval=0.2)
+    await master.start()
+    servers = []
+    for i in range(3):
+        cs = ChunkServer(str(tmp / f"cs{i}"),
+                         master_addr=("127.0.0.1", master.port))
+        await cs.start()
+        servers.append(cs)
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+
+    expect: dict = {"files": {}}
+    d = await c.mkdir(1, "docs", mode=0o750)
+    sub = await c.mkdir(d.inode, "inner")
+
+    # plain replicated file (goal 2 default)
+    data_a = data_generator.generate(1, 100 * 1024).tobytes()
+    fa = await c.create(d.inode, "a.bin")
+    await c.write_file(fa.inode, data_a)
+    expect["files"]["docs/a.bin"] = hashlib.sha256(data_a).hexdigest()
+
+    # EC-striped file
+    data_b = data_generator.generate(2, 200 * 1024).tobytes()
+    fb = await c.create(sub.inode, "b.bin")
+    await c.setgoal(fb.inode, EC_GOAL)
+    await c.write_file(fb.inode, data_b)
+    expect["files"]["docs/inner/b.bin"] = hashlib.sha256(data_b).hexdigest()
+
+    # namespace features: symlink, hardlink, xattr, quota, trash
+    await c.symlink(d.inode, "lnk", "inner/b.bin")
+    await c.link(fa.inode, d.inode, "a_hard.bin")
+    await c.set_xattr(fa.inode, "user.color", b"teal")
+    await c.set_quota("user", 1000, soft_inodes=100, hard_inodes=200)
+    ftr = await c.create(1, "doomed.bin")
+    await c.write_file(ftr.inode, b"trash me")
+    await c.unlink(1, "doomed.bin")  # lands in trash
+    expect["trash_inode"] = ftr.inode
+    expect["symlink_target"] = "inner/b.bin"
+    expect["xattr"] = {"inode_path": "docs/a.bin", "name": "user.color",
+                       "value": "teal"}
+    expect["quota"] = {"uid": 1000, "soft_inodes": 100, "hard_inodes": 200}
+
+    # force an image dump so metadata.liz exists alongside the changelog
+    await master._dump_image()
+    await c.close()
+    for cs in servers:
+        await cs.stop()
+    await master.stop()
+    return expect
+
+
+def main() -> int:
+    import tempfile
+
+    tmp = Path(tempfile.mkdtemp(prefix="lizgolden"))
+    expect = asyncio.run(build(tmp))
+
+    if GOLDEN.exists():
+        shutil.rmtree(GOLDEN)
+    GOLDEN.mkdir(parents=True)
+    # keep only the format-bearing state: master metadata + chunk files
+    shutil.copytree(tmp / "master", GOLDEN / "master")
+    for i in range(3):
+        src = tmp / f"cs{i}"
+        dst = GOLDEN / f"cs{i}"
+        dst.mkdir()
+        for root, _dirs, files in os.walk(src):
+            for fn in files:
+                rel = Path(root).relative_to(src)
+                (dst / rel).mkdir(parents=True, exist_ok=True)
+                shutil.copy2(Path(root) / fn, dst / rel / fn)
+    (GOLDEN / "expect.json").write_text(json.dumps(expect, indent=1))
+    total = sum(f.stat().st_size for f in GOLDEN.rglob("*") if f.is_file())
+    print(f"golden fixture written to {GOLDEN} ({total/1024:.0f} KiB)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

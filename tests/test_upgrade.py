@@ -1,0 +1,153 @@
+"""On-disk format compatibility ("upgrade") tests.
+
+The analog of the reference's cross-version upgrade suites (reference
+tests/tools/lizardfsXX.sh + tests/test_suites/*/test_upgrade_*: old
+daemons write data, the current build must serve it). We have one
+lineage, so the contract is pinned with a committed golden data tree
+(tests/data/golden, produced by tests/make_golden_fixture.py): today's
+daemons boot on a copy of it and must read every namespace feature and
+every byte back. An accidental change to the metadata image format,
+changelog grammar, chunk file layout, or part filename scheme fails
+here first — turning a silent corruption into a deliberate format bump
+(regenerate the fixture + document migration in doc/migration.md).
+"""
+
+import asyncio
+import hashlib
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master.changelog import load_image
+from lizardfs_tpu.master.server import MasterServer
+
+GOLDEN = Path(__file__).parent / "data" / "golden"
+
+EC_GOAL = 10
+
+
+def golden_goals():
+    goals = geometry.default_goals()
+    goals[EC_GOAL] = geometry.parse_goal_line(f"{EC_GOAL} ecgold : $ec(3,2)")[1]
+    return goals
+
+
+def expectations() -> dict:
+    return json.loads((GOLDEN / "expect.json").read_text())
+
+
+class GoldenCluster:
+    """Today's daemons booted on a copy of the golden data tree."""
+
+    def __init__(self, tmp_path: Path):
+        self.tmp = tmp_path
+        shutil.copytree(GOLDEN / "master", tmp_path / "master")
+        for i in range(3):
+            shutil.copytree(GOLDEN / f"cs{i}", tmp_path / f"cs{i}")
+        self.master = None
+        self.servers = []
+        self.client = None
+
+    async def __aenter__(self):
+        self.master = MasterServer(str(self.tmp / "master"),
+                                   goals=golden_goals(),
+                                   health_interval=0.2)
+        await self.master.start()
+        for i in range(3):
+            cs = ChunkServer(str(self.tmp / f"cs{i}"),
+                             master_addr=("127.0.0.1", self.master.port))
+            await cs.start()
+            self.servers.append(cs)
+        self.client = Client("127.0.0.1", self.master.port)
+        await self.client.connect()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        for cs in self.servers:
+            await cs.stop()
+        await self.master.stop()
+
+    async def resolve(self, path: str) -> int:
+        inode = 1
+        for name in path.split("/"):
+            inode = (await self.client.lookup(inode, name)).inode
+        return inode
+
+
+@pytest.mark.asyncio
+async def test_golden_tree_serves_all_features(tmp_path):
+    exp = expectations()
+    async with GoldenCluster(tmp_path) as g:
+        c = g.client
+        # file payloads, replicated and EC-striped
+        for path, want_sha in exp["files"].items():
+            inode = await g.resolve(path)
+            attr = await c.getattr(inode)
+            data = await c.read_file(inode, 0, attr.length)
+            assert hashlib.sha256(bytes(data)).hexdigest() == want_sha, path
+        # symlink
+        lnk = await g.resolve("docs/lnk")
+        assert await c.readlink(lnk) == exp["symlink_target"]
+        # hardlink: same inode, nlink 2
+        a = await g.resolve("docs/a.bin")
+        hard = await g.resolve("docs/a_hard.bin")
+        assert a == hard
+        assert (await c.getattr(a)).nlink == 2
+        # xattr
+        val = await c.get_xattr(a, exp["xattr"]["name"])
+        assert bytes(val) == exp["xattr"]["value"].encode()
+        # quota
+        rows = await c.get_quota()
+        q = exp["quota"]
+        assert any(
+            r.get("kind") == "user"
+            and r.get("id") == q["uid"]
+            and r.get("soft_inodes") == q["soft_inodes"]
+            and r.get("hard_inodes") == q["hard_inodes"]
+            for r in rows
+        ), rows
+        # trash entry survives the image/changelog round trip
+        trash = await c.trash_list()
+        assert any(t.get("inode") == exp["trash_inode"] for t in trash), trash
+
+
+@pytest.mark.asyncio
+async def test_unknown_image_format_is_rejected(tmp_path):
+    shutil.copytree(GOLDEN / "master", tmp_path / "master")
+    img = tmp_path / "master" / "metadata.liz"
+    doc = json.loads(img.read_text())
+    doc["format"] = "lizardfs-tpu-metadata-999"
+    img.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="format"):
+        load_image(str(tmp_path / "master"))
+    # the daemon start path must surface the same failure, not boot an
+    # empty namespace over good data
+    master = MasterServer(str(tmp_path / "master"), goals=golden_goals())
+    with pytest.raises(ValueError, match="format"):
+        await master.start()
+
+
+@pytest.mark.asyncio
+async def test_corrupt_chunk_signature_is_quarantined(tmp_path):
+    """A bad chunk magic must degrade (part skipped, EC recovers), not
+    crash the scan or serve wrong bytes."""
+    exp = expectations()
+    # corrupt one EC part's signature on cs0
+    victim = next((GOLDEN / "cs0").rglob("chunk_*.liz"))
+    g = GoldenCluster(tmp_path)
+    bad = tmp_path / "cs0" / victim.relative_to(GOLDEN / "cs0")
+    raw = bytearray(bad.read_bytes())
+    raw[:8] = b"NOTLIZRD"
+    bad.write_bytes(bytes(raw))
+    async with g:
+        inode = await g.resolve("docs/inner/b.bin")
+        attr = await g.client.getattr(inode)
+        data = await g.client.read_file(inode, 0, attr.length)
+        want = exp["files"]["docs/inner/b.bin"]
+        assert hashlib.sha256(bytes(data)).hexdigest() == want
