@@ -39,6 +39,25 @@ from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.runtime.daemon import Daemon
 
+
+def _fork_safe() -> bool:
+    """CoW-fork is only safe from an effectively single-threaded
+    process. The reference forks its dumper from a single-threaded
+    event loop (metadata_dumper.h:37); a process that has loaded a
+    thread-heavy native runtime (XLA/torch spawn pools whose mutexes a
+    forked child inherits locked) must not fork, or the child can
+    deadlock before it ever reaches Python. The master itself never
+    imports jax (tests/test_fork_safety.py pins this), so production
+    masters always take the fast CoW path; colocated/test processes
+    that did import jax fall back to on-loop serialization."""
+    if not hasattr(os, "fork"):
+        return False
+    import sys
+
+    return not any(
+        mod in sys.modules for mod in ("jax", "jaxlib", "torch")
+    )
+
 CHUNK_LOCK_SECONDS = 30.0
 
 
@@ -264,7 +283,7 @@ class MasterServer(Daemon):
         # here, so the snapshot is consistent with `version`.
         ok = False
         try:
-            pid = os.fork()
+            pid = os.fork() if _fork_safe() else -1
         except OSError:
             pid = -1
         inc_digest = self.meta._digest
@@ -284,24 +303,43 @@ class MasterServer(Daemon):
             rc = await self._wait_child(pid, timeout=600.0)
             ok = rc in (0, 3)
             if rc == 3:
-                self.log.error(
-                    "incremental metadata digest drift detected (v%d); "
-                    "re-anchoring", version,
-                )
-                self.metrics.counter("digest_drift").inc()
-                self.meta.reset_digest()
+                self._handle_digest_drift(version)
             elif not ok:
                 self.log.error("forked metadata dump failed (v%d)", version)
         else:
-            # no fork (exotic platform): serialize on the loop thread's
-            # snapshot, write off-loop
+            # no fork (jax/torch threads live, or exotic platform):
+            # serialize on the loop thread's snapshot, write off-loop.
+            # The digest-drift verification the forked child performs
+            # runs here too, at the same consistent point as the
+            # serialization — but only on every Nth fallback dump: the
+            # full recompute is a second O(namespace) stall on top of
+            # to_sections(), and this path never serves production
+            # masters (which stay jax-free and fork).
             sections = self.meta.to_sections()
             sections["sessions"] = sessions_section
+            self._fallback_dump_n = getattr(self, "_fallback_dump_n", 0) + 1
+            drifted = (
+                self._fallback_dump_n % 8 == 1
+                and self.meta.full_digest() != inc_digest
+            )
             await asyncio.to_thread(save_image, self.data_dir, version, sections)
             ok = True
+            if drifted:
+                self._handle_digest_drift(version)
         if ok:
             self.changelog.rotate()
             self.changelog.open()
+
+    def _handle_digest_drift(self, version: int) -> None:
+        """Incremental digest no longer matches a full recompute: state
+        was corrupted outside apply() or the incremental update has a
+        bug. Log, count, and re-anchor to the full value."""
+        self.log.error(
+            "incremental metadata digest drift detected (v%d); "
+            "re-anchoring", version,
+        )
+        self.metrics.counter("digest_drift").inc()
+        self.meta.reset_digest()
 
     async def _wait_child(self, pid: int, timeout: float) -> int:
         """Reap a forked worker with a deadline: a child deadlocked by a
@@ -1967,7 +2005,7 @@ class MasterServer(Daemon):
                 and self._verify_probe_n % 20 != 0):
             return  # fast-path match; deep check runs every 20th probe
         try:
-            pid = os.fork()
+            pid = os.fork() if _fork_safe() else -1
         except OSError:
             pid = -1
         if pid == 0:

@@ -55,12 +55,13 @@ def _unpack_tile(bytes_tile: jnp.ndarray) -> jnp.ndarray:
     return bits.reshape(8 * r, t).astype(jnp.int8)
 
 
-def _stack_generator(bigm, k: int, m: int, tile: int, max_groups: int):
-    """Pick the column-stacking factor q and build the block-diagonal
-    generator (see _encode_tile). q doubles while the stacked matmul's
-    M dim stays within _ENC_STACK_MAX, quarters stay lane-aligned, and
-    q stays within ``max_groups`` (the fused kernel also caps q by its
-    CRC group count so both see the same quarters)."""
+def _stack_q(m: int, tile: int, max_groups: int) -> int:
+    """Column-stacking factor q (see _encode_tile): doubles while the
+    stacked matmul's M dim stays within _ENC_STACK_MAX, quarters stay
+    lane-aligned, and q stays within ``max_groups`` (the fused kernel
+    also caps q by its CRC group count so both see the same quarters).
+    Pure in (m, tile, max_groups) so the VMEM budget can price the
+    stacked generator before committing to a tile size."""
     q = 1
     while (
         2 * q * 8 * m <= _ENC_STACK_MAX
@@ -68,6 +69,13 @@ def _stack_generator(bigm, k: int, m: int, tile: int, max_groups: int):
         and 2 * q <= max_groups
     ):
         q *= 2
+    return q
+
+
+def _stack_generator(bigm, k: int, m: int, tile: int, max_groups: int):
+    """Build the block-diagonal (q*8m, q*8k) generator for q column
+    quarters stacked along the contraction dim."""
+    q = _stack_q(m, tile, max_groups)
     bigm_q = jnp.zeros((q * 8 * m, q * 8 * k), dtype=jnp.int8)
     for i in range(q):
         bigm_q = bigm_q.at[
@@ -202,6 +210,7 @@ def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
     rows = k + m
     kp, mp = -(-k // 8) * 8, -(-m // 8) * 8
     sg = max(tile // CRC_GROUP, 1)
+    q = _stack_q(m, tile, max_groups=sg)
     return (
         2 * k * tile            # data in (x2 pipeline)
         + 2 * m * tile          # parity out (x2 pipeline)
@@ -211,7 +220,8 @@ def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
         + 8 * rows * tile       # crc stacked bit planes, int8
         + rows * sg * 32 * 8    # crc acc + scan registers, int32
         + (kp * k + mp * m) * sg      # selection matrices, int8
-        + 16 * 32 * 32 + 16 * 16 * k * m  # shift stack + bigm_q, int8
+        + 16 * 32 * 32          # shift stack, int8
+        + 64 * q * q * k * m    # block-diagonal bigm_q (q*8m x q*8k int8)
     )
 
 
@@ -315,15 +325,19 @@ def _fused_kernel(bigm_ref, w_ref, shifts_ref, seld_ref, selp_ref,
     preg_ref[:] = _chunk_registers(parity, w_ref, shifts_ref, selp_ref, group)
 
 
+_FUSED_VMEM_BUDGET = 10 * 2**20  # conservative vs ~16 MiB physical VMEM
+
+
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "tile", "interpret")
+    jax.jit, static_argnames=("block_size", "tile", "interpret", "vmem_budget")
 )
 def fused_encode_crc(
     bigm: jnp.ndarray,
     data: jnp.ndarray,
     block_size: int = MFSBLOCKSIZE,
-    tile: int = 32768,
+    tile: int = 16384,
     interpret: bool | None = None,
+    vmem_budget: int = _FUSED_VMEM_BUDGET,
 ):
     """Single-pass fused RS encode + per-block CRC32.
 
@@ -336,7 +350,7 @@ def fused_encode_crc(
     m = bigm.shape[0] // 8
     rows = k + m
     while tile > 2 * CRC_SUB and (
-        _fused_vmem_bytes(k, m, tile) > 24 * 2**20 or block_size % tile
+        _fused_vmem_bytes(k, m, tile) > vmem_budget or block_size % tile
     ):
         tile //= 2
     if n % tile:

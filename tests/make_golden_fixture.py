@@ -59,7 +59,8 @@ async def build(tmp: Path) -> dict:
     d = await c.mkdir(1, "docs", mode=0o750)
     sub = await c.mkdir(d.inode, "inner")
 
-    # plain replicated file (goal 2 default)
+    # plain (non-striped) file at the default goal 1: a single std copy
+    # on one chunkserver — pins the std read path, not multi-copy
     data_a = data_generator.generate(1, 100 * 1024).tobytes()
     fa = await c.create(d.inode, "a.bin")
     await c.write_file(fa.inode, data_a)

@@ -138,10 +138,18 @@ async def test_corrupt_chunk_signature_is_quarantined(tmp_path):
     """A bad chunk magic must degrade (part skipped, EC recovers), not
     crash the scan or serve wrong bytes."""
     exp = expectations()
-    # corrupt one EC part's signature on cs0
-    victim = next((GOLDEN / "cs0").rglob("chunk_*.liz"))
+    # corrupt one EC part of the b.bin chunk specifically (not just the
+    # first chunk file on cs0): a regenerated fixture with different
+    # placement must not silently turn this into a no-op or corrupt the
+    # sole copy of a goal-1 file
+    victim = next(
+        p
+        for cs in sorted(GOLDEN.glob("cs*"))
+        for p in sorted(cs.rglob("chunk_0000000000000002_P*AC*.liz"))
+    )
+    cs_name = victim.relative_to(GOLDEN).parts[0]
     g = GoldenCluster(tmp_path)
-    bad = tmp_path / "cs0" / victim.relative_to(GOLDEN / "cs0")
+    bad = tmp_path / cs_name / victim.relative_to(GOLDEN / cs_name)
     raw = bytearray(bad.read_bytes())
     raw[:8] = b"NOTLIZRD"
     bad.write_bytes(bytes(raw))
