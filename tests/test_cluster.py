@@ -329,3 +329,105 @@ async def test_wide_ec_more_parts_than_servers(tmp_path):
         assert (await c.read_file(f.inode)) == payload
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_mixed_goals_kill_audit_and_degraded_reads(tmp_path):
+    """Mirror of the operator smoke scenario: three files at ec(3,2),
+    xor3, and 2-copy std on 5 servers; one server killed. Every file
+    must stay readable, the registry's per-server part index must stay
+    consistent with chunk.parts through write/kill/repair, and the
+    repair loop must converge (no endless replicate-failure churn)."""
+    import os
+
+    cluster = Cluster(tmp_path, n_cs=5)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "v")
+        payloads = {}
+        for name, goal in (("ec.bin", EC_GOAL), ("xor.bin", XOR_GOAL),
+                           ("std.bin", 2)):
+            f = await c.create(d.inode, name)
+            await c.setgoal(f.inode, goal)
+            p = os.urandom(1024 * 1024)
+            await c.write_file(f.inode, p)
+            payloads[name] = (f.inode, p)
+        reg = cluster.master.meta.registry
+        assert reg.audit_index() == []
+
+        victim = cluster.chunkservers[0]
+        await victim.stop()
+        await asyncio.sleep(0.5)
+        assert reg.audit_index() == []
+        for name, (inode, p) in payloads.items():
+            c.cache.invalidate(inode)
+            back = await c.read_file(inode)
+            assert bytes(back) == p, f"degraded mismatch {name}"
+
+        # repair must converge: every chunk healthy again, index clean
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            if all(not reg.evaluate(ch).needs_work
+                   for ch in reg.chunks.values()):
+                break
+        assert all(not reg.evaluate(ch).needs_work
+                   for ch in reg.chunks.values()), "repair did not converge"
+        assert reg.audit_index() == []
+        for name, (inode, p) in payloads.items():
+            c.cache.invalidate(inode)
+            back = await c.read_file(inode)
+            assert bytes(back) == p, f"post-repair mismatch {name}"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_emergency_doubled_part_migrates_when_server_joins(tmp_path):
+    """ec(3,2) on exactly 5 servers: when one dies, the missing part can
+    only be repaired by doubling up on a survivor (degraded but better
+    than endangered). Once a replacement server joins, the doubled part
+    must migrate off so fault tolerance returns to one-part-per-server."""
+    import os
+
+    cluster = Cluster(tmp_path, n_cs=5)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "e.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = os.urandom(1024 * 1024)
+        await c.write_file(f.inode, payload)
+        reg = cluster.master.meta.registry
+
+        await cluster.chunkservers[0].stop()
+        # repair converges by doubling up on a survivor
+        chunk = next(ch for ch in reg.chunks.values() if ch.slice_type != 0)
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            if not reg.evaluate(chunk).missing_parts:
+                break
+        state = reg.evaluate(chunk)
+        assert not state.missing_parts, "repair did not converge"
+        assert state.crowded, "expected a doubled-up emergency placement"
+
+        # replacement capacity joins; the doubled part must migrate off
+        newcs = ChunkServer(
+            str(tmp_path / "cs_new"),
+            master_addr=("127.0.0.1", cluster.master.port),
+            wave_timeout=0.2,
+        )
+        await newcs.start()
+        cluster.chunkservers.append(newcs)
+        for _ in range(150):
+            await asyncio.sleep(0.1)
+            state = reg.evaluate(chunk)
+            if not state.crowded and not state.needs_work:
+                break
+        assert not state.crowded, "doubled part did not migrate off"
+        assert state.is_safe
+        assert reg.audit_index() == []
+        c.cache.invalidate(f.inode)
+        assert bytes(await c.read_file(f.inode)) == payload
+    finally:
+        await cluster.stop()
