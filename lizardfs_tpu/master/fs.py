@@ -36,8 +36,13 @@ class FsError(Exception):
         super().__init__(f"{st.name(code)}{(': ' + msg) if msg else ''}")
 
 
-@dataclass
+@dataclass(slots=True)
 class Node:
+    """One inode. ``slots=True`` drops the per-instance __dict__: at
+    1M synthetic files the master costs ~620 bytes/inode vs ~740
+    without slots (see doc/migration.md "master RAM"), and attribute
+    typos fail loudly instead of growing the namespace."""
+
     inode: int
     ftype: int
     mode: int = 0o644

@@ -241,3 +241,33 @@ def test_part_index_stays_consistent_through_lifecycle():
     assert not reg._server_parts[s2.cs_id]
     # disconnect with an empty index is a no-op
     assert reg.server_disconnected(s1.cs_id) == []
+
+
+def test_bytes_per_inode_budget():
+    """Master RAM per inode stays within budget (doc/migration.md "BDB
+    name storage" rationale): ~620 B/inode measured with slots=True at
+    1M files; the test uses 200k files and an 800 B ceiling so noise
+    and allocator variance don't flake it. If this fails after a Node
+    change, re-measure and update migration.md."""
+    import gc
+    import tracemalloc
+
+    n_files = 200_000
+    gc.collect()
+    tracemalloc.start()
+    meta = MetadataStore()
+    fs = meta.fs
+    root = fs.nodes[1]
+    for i in range(n_files):
+        inode = 10 + i
+        node = Node(
+            inode=inode, ftype=fsmod.TYPE_FILE, mode=0o644, uid=1, gid=1,
+            atime=1, mtime=1, ctime=1, goal=1, trash_time=86400, nlink=1,
+            parents=[1], length=65536, chunks=[100 + i],
+        )
+        fs.nodes[inode] = node
+        root.children[f"file_with_a_realistic_name_{i:07d}.dat"] = inode
+    cur, _ = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    per_inode = cur / n_files
+    assert per_inode < 800, f"{per_inode:.0f} bytes/inode exceeds budget"
