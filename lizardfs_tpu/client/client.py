@@ -721,7 +721,9 @@ class Client:
         region[coff - region_start : coff - region_start + len(piece)] = piece
 
         # recompute the affected stripes' parity and rewrite all parts
-        parts = striping.split_chunk(region, slice_type, self.encoder)
+        parts = await asyncio.to_thread(
+            striping.split_chunk, region, slice_type, self.encoder
+        )
         sends = []
         for part_idx, locs in copies.items():
             stream = parts.get(part_idx)
@@ -769,8 +771,13 @@ class Client:
             by_part.setdefault(cpt.part, []).append(loc)
         if slice_type is None:
             raise st.StatusError(st.NO_CHUNK_SERVERS, "no locations granted")
-        # client-side parity (chunk_writer.cc computeParityBlock analog)
-        parts = striping.split_chunk(chunk_data, slice_type, self.encoder)
+        # client-side parity (chunk_writer.cc computeParityBlock analog),
+        # off-loop: the stripe scatter + SIMD encode release the GIL, so
+        # chunk N+1's parity overlaps chunk N's wire transfer instead of
+        # stalling the event loop for hundreds of ms
+        parts = await asyncio.to_thread(
+            striping.split_chunk, chunk_data, slice_type, self.encoder
+        )
         sends = []
         for part_idx, locs in by_part.items():
             payload = parts.get(part_idx)
@@ -1040,10 +1047,19 @@ class Client:
                 bad_addrs.update(getattr(e, "used_addrs", ()))
                 log.info("read retry %d for chunk %d: %s", attempt + 1, loc.chunk_id, e)
                 continue
-            if not bulk and data is not None:
+            if not bulk:
+                # data is None when the bytes landed directly in `into`
+                # (zero-copy scatter) — cache from there in that case
+                src = (
+                    data if data is not None
+                    else into[into_offset : into_offset + size]
+                )
+                src_base = aligned_off if data is not None else off
                 for b in range(lo_b, aligned_end // MFSBLOCKSIZE + 1):
-                    s = b * MFSBLOCKSIZE - aligned_off
-                    blk = data[s : s + MFSBLOCKSIZE]
+                    s = b * MFSBLOCKSIZE - src_base
+                    if s < 0:
+                        continue
+                    blk = src[s : s + MFSBLOCKSIZE]
                     if len(blk):
                         self.cache.put(inode, chunk_index, b, blk.tobytes())
             if extra > 0 and aligned_end < chunk_len:
@@ -1204,6 +1220,54 @@ class Client:
         hi_slot = hi_block // d
         nslots = hi_slot - lo_slot + 1
         wanted = [first_data + i for i in range(d)]
+
+        # whole-stripe fast path: all data parts healthy, the request is
+        # exactly a slot-aligned region, and the caller gave us a
+        # contiguous destination — ONE native call reads every part over
+        # polled sockets and de-interleaves in C (no per-part thread
+        # dispatch, no separate gather pass). Any failure falls through
+        # to the wave executor below, which handles recovery.
+        from lizardfs_tpu.core import native_io
+
+        region_blocks = hi_block - lo_block + 1
+        if (
+            native_io.parts_gather_available()
+            and into is not None
+            and off == lo_slot * d * MFSBLOCKSIZE
+            and size == region_blocks * MFSBLOCKSIZE
+            and into.flags.c_contiguous and into.dtype == np.uint8
+            and all(p in by_part for p in wanted)
+            and attempt == 0
+        ):
+            import functools as _ft
+
+            cell: dict = {}
+            fut = asyncio.get_running_loop().run_in_executor(
+                native_io.EXECUTOR,
+                _ft.partial(
+                    native_io.read_parts_gather_blocking,
+                    [by_part[p][0] for p in wanted],
+                    loc.chunk_id, loc.version,
+                    [by_part[p][1] for p in wanted],
+                    lo_slot * MFSBLOCKSIZE, region_blocks,
+                    into[into_offset : into_offset + size],
+                    cell,
+                ),
+            )
+            try:
+                await asyncio.shield(fut)
+                for p in wanted:
+                    GLOBAL_STATS.record_success(by_part[p][0])
+                return None
+            except asyncio.CancelledError:
+                native_io.abort_parts_gather(cell)
+                try:
+                    await asyncio.wait_for(asyncio.shield(fut), 10.0)
+                except (Exception, asyncio.CancelledError):
+                    pass
+                raise
+            except (native_io.NativeIOError, OSError, ConnectionError):
+                pass  # degrade to the plan path (waves + recovery)
         # per-part scores from the shared chunkserver health registry:
         # an unhealthy holder's part drops in rank, so recovery reads
         # prefer parts on healthy servers (read_plan_executor.cc:95)
@@ -1223,13 +1287,27 @@ class Client:
             plan, loc.chunk_id, loc.version, by_part,
             wave_timeout=self.wave_timeout,
         )
-        # reassemble the stripes we read, then slice the requested bytes
+        # reassemble the stripes we read, then slice the requested bytes.
+        # The gather runs off-loop (native stripe_gather releases the
+        # GIL) — at 64 MiB chunks an on-loop de-interleave serialized
+        # every concurrent read behind ~40 ms of memcpy.
         bps = nslots * MFSBLOCKSIZE
         data_parts = {
             wanted[i]: buf[i * bps : (i + 1) * bps] for i in range(len(wanted))
         }
-        region = striping.assemble_chunk(
-            data_parts, slice_type, d * bps  # bytes covered by these stripes
-        )
         rel = off - lo_slot * d * MFSBLOCKSIZE
+        if (
+            into is not None and rel == 0
+            and into.flags.c_contiguous and into.dtype == np.uint8
+        ):
+            # zero-copy: de-interleave straight into the caller's buffer
+            await asyncio.to_thread(
+                striping.assemble_chunk, data_parts, slice_type, size,
+                into[into_offset : into_offset + size],
+            )
+            return None
+        region = await asyncio.to_thread(
+            striping.assemble_chunk, data_parts, slice_type,
+            d * bps,  # bytes covered by these stripes
+        )
         return np.asarray(region[rel : rel + size])

@@ -44,6 +44,20 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.lz_crc32_blocks.restype = None
+        try:
+            lib.lz_stripe_scatter.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.lz_stripe_scatter.restype = None
+            lib.lz_stripe_gather.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.lz_stripe_gather.restype = None
+        except AttributeError:
+            pass  # stale .so without the stripe helpers: numpy fallback
         return lib
     return None
 
@@ -90,6 +104,44 @@ def crc32(data: bytes | np.ndarray, crc: int = 0) -> int:
             crc, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.size
         )
     )
+
+
+def stripe_helpers_available() -> bool:
+    return _lib is not None and hasattr(_lib, "lz_stripe_scatter")
+
+
+def stripe_scatter(
+    data: np.ndarray, d: int, blocks_per_part: int
+) -> np.ndarray:
+    """(nbytes,) chunk bytes -> (d, part_len) zero-padded part streams
+    in one contiguous buffer, via the GIL-free native kernel."""
+    assert stripe_helpers_available()
+    part_len = blocks_per_part * MFSBLOCKSIZE
+    out = np.empty((d, part_len), dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    _lib.lz_stripe_scatter(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        data.shape[0], d, blocks_per_part,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def stripe_gather(
+    parts: list[np.ndarray], nbytes: int, out: np.ndarray | None = None
+) -> np.ndarray:
+    """d part streams (each contiguous, long enough to cover its share
+    of ``nbytes``) -> (nbytes,) chunk bytes, no intermediate stacking."""
+    assert stripe_helpers_available()
+    srcs = [np.ascontiguousarray(p, dtype=np.uint8) for p in parts]
+    if out is None:
+        out = np.empty(nbytes, dtype=np.uint8)
+    assert out.flags.c_contiguous and out.shape[0] >= nbytes
+    _lib.lz_stripe_gather(
+        _ptr_array(srcs), len(srcs), nbytes,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
 
 
 def crc32_blocks(blocks: np.ndarray) -> np.ndarray:

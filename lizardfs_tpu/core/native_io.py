@@ -65,6 +65,15 @@ if _lib is not None:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
         ]
         _lib.lz_stream_read.restype = ctypes.c_int
+        try:
+            _lib.lz_read_parts_gather.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint32,
+            ]
+            _lib.lz_read_parts_gather.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: the whole-stripe fast path stays off
     except AttributeError:
         _lib = None
 
@@ -362,3 +371,95 @@ def stream_read_blocking(
         )
     finally:
         os.close(sock_fd)
+
+
+class _PartReq(ctypes.Structure):
+    _fields_ = [
+        ("fd", ctypes.c_int),
+        ("chunk_id", ctypes.c_uint64),
+        ("version", ctypes.c_uint32),
+        ("part_id", ctypes.c_uint32),
+        ("rc", ctypes.c_int32),
+    ]
+
+
+def parts_gather_available() -> bool:
+    return _lib is not None and hasattr(_lib, "lz_read_parts_gather")
+
+
+def read_parts_gather_blocking(
+    addrs: list[tuple[str, int]],
+    chunk_id: int,
+    version: int,
+    part_ids: list[int],
+    offset: int,
+    region_blocks: int,
+    out: np.ndarray,
+    cell: dict | None = None,
+) -> None:
+    """Read ``region_blocks`` 64 KiB chunk blocks spread over d data
+    parts (all starting at part-local ``offset``) in ONE poll-driven
+    native exchange, de-interleaving straight into ``out`` (block j of
+    part i -> out[(j*d+i)*64Ki : ...]). The whole-chunk EC read fast
+    path: one executor thread and one C call replace d of each. Raises
+    NativeIOError with the first failing part's code; the caller falls
+    back to the wave executor (which handles recovery)."""
+    from lizardfs_tpu.constants import MFSBLOCKSIZE
+
+    d = len(addrs)
+    assert d == len(part_ids) and out.flags.c_contiguous
+    assert out.nbytes >= region_blocks * MFSBLOCKSIZE
+    # attempt 0 uses pooled sockets; a socket-level failure (-1) retries
+    # once with fresh dials — the pool may hold connections staled by a
+    # server restart (mirrors read_part_blocking's retry)
+    for attempt in (0, 1):
+        reqs = (_PartReq * d)()
+        socks = []
+        try:
+            for i, addr in enumerate(addrs):
+                s = (POOL.acquire(addr) if attempt == 0
+                     else _blocking_socket(addr, 30.0))
+                socks.append((addr, s))
+                reqs[i].fd = s.fileno()
+                reqs[i].chunk_id = chunk_id
+                reqs[i].version = version
+                reqs[i].part_id = part_ids[i]
+                reqs[i].rc = 0
+            if cell is not None:
+                cell["socks"] = [s for _, s in socks]
+                if cell.get("aborted"):
+                    raise NativeIOError(-1, "parts gather (aborted)")
+            rc = _lib.lz_read_parts_gather(
+                ctypes.cast(reqs, ctypes.c_void_p), d, offset,
+                region_blocks,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                120_000,
+            )
+            if cell is not None:
+                cell.pop("socks", None)
+            if rc == 0:
+                for addr, s in socks:
+                    POOL.release(addr, s)
+                socks.clear()
+                return
+            bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+            if (
+                attempt == 0 and bad == -1
+                and not (cell is not None and cell.get("aborted"))
+            ):
+                continue  # stale pooled socket: redial everything once
+            raise NativeIOError(bad, "parts gather")
+        finally:
+            for _, s in socks:
+                POOL.discard(s)
+
+
+def abort_parts_gather(cell: dict) -> None:
+    """Kill an in-flight read_parts_gather_blocking from another thread
+    (socket shutdowns make its recvs fail immediately)."""
+    cell["aborted"] = True
+    for sock in cell.get("socks", ()):
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass

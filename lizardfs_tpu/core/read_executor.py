@@ -59,13 +59,15 @@ async def read_part_range(
     from lizardfs_tpu.core import native_io
 
     if native_io.available() and size >= native_io.NATIVE_READ_THRESHOLD:
-        # the executor thread is uninterruptible: by default it scatters
-        # into a PRIVATE buffer so a cancelled straggler can't keep
-        # writing the shared plan buffer while recovery post-processing
-        # reads it; single-op plans (`direct`) have no stragglers and
-        # skip the extra copy
+        # scatter straight into the caller's buffer whenever it is
+        # contiguous: each op owns a disjoint region, and the cancel
+        # path below aborts the socket and JOINS the executor thread, so
+        # by the time execute_plan's finally finishes (it gathers every
+        # cancelled task) no thread can still be writing the plan buffer
+        # that post-processing reads. This removes a private-buffer
+        # allocation + an on-loop memcpy per part (64 MiB per EC chunk).
         scatter_direct = (
-            direct and into is not None and out.flags.c_contiguous
+            into is not None and out.flags.c_contiguous
             and out.dtype == np.uint8
         )
         if scatter_direct:

@@ -1273,15 +1273,18 @@ class MasterServer(Daemon):
                 ),
             ))
         ok_holders: list[tuple[int, int]] = []
-        for cs_id, part, coro in acks:
-            if coro is None:
-                continue
-            try:
-                reply = await coro
-                if reply.status == st.OK:
-                    ok_holders.append((cs_id, part))
-            except (ConnectionError, asyncio.TimeoutError):
-                pass
+        live = [(cs_id, part, coro) for cs_id, part, coro in acks
+                if coro is not None]
+        replies = await asyncio.gather(
+            *(coro for _, _, coro in live), return_exceptions=True
+        )
+        for (cs_id, part, _), reply in zip(live, replies):
+            if isinstance(reply, (ConnectionError, asyncio.TimeoutError)):
+                continue  # missed the bump: dropped as stale below
+            if isinstance(reply, BaseException):
+                raise reply  # protocol/programming error: surface it
+            if reply.status == st.OK:
+                ok_holders.append((cs_id, part))
         if not ok_holders:
             return m.MatoclWriteChunk(
                 req_id=msg.req_id, status=st.NO_CHUNK_SERVERS, chunk_id=0,
@@ -1439,13 +1442,16 @@ class MasterServer(Daemon):
                 ),
             ))
         created: list[tuple[int, ChunkServerInfo]] = []
-        for part, srv, coro in acks:
-            try:
-                reply = await coro
-                if reply.status == st.OK:
-                    created.append((part, srv))
-            except (ConnectionError, asyncio.TimeoutError):
-                pass
+        replies = await asyncio.gather(
+            *(coro for _, _, coro in acks), return_exceptions=True
+        )
+        for (part, srv, _), reply in zip(acks, replies):
+            if isinstance(reply, (ConnectionError, asyncio.TimeoutError)):
+                continue  # that server just doesn't get the part
+            if isinstance(reply, BaseException):
+                raise reply  # protocol/programming error: surface it
+            if reply.status == st.OK:
+                created.append((part, srv))
         if len(created) < nparts:
             # roll back whatever was created
             for part, srv in created:

@@ -30,20 +30,26 @@ def _padded_data_parts(
     """Split chunk bytes into d zero-padded equal part streams.
 
     Returns (parts, part_len) where part_len covers ceil(blocks/d) blocks.
+    One native (GIL-free) or vectorized-numpy pass — this runs on every
+    EC/xor chunk write, so a per-block Python loop here throttled the
+    whole write pipeline.
     """
     nbytes = data.shape[0]
     nblocks = (nbytes + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
     blocks_per_part = (nblocks + d - 1) // d
     part_len = blocks_per_part * MFSBLOCKSIZE
-    # scatter: block i -> part i%d, slot i//d
+    from lizardfs_tpu.core import native
+
+    if native.stripe_helpers_available():
+        stacked = native.stripe_scatter(data, d, blocks_per_part)
+        return list(stacked), part_len
+    # numpy fallback: pad to the full stripe grid, then one strided copy
+    # block i -> part i%d, slot i//d
     full = np.zeros(d * blocks_per_part * MFSBLOCKSIZE, dtype=np.uint8)
     full[:nbytes] = data
-    blocks = full.reshape(blocks_per_part * d, MFSBLOCKSIZE)[: nblocks]
-    parts = [np.zeros(part_len, dtype=np.uint8) for _ in range(d)]
-    for i in range(nblocks):
-        p, slot = i % d, i // d
-        parts[p][slot * MFSBLOCKSIZE : (slot + 1) * MFSBLOCKSIZE] = blocks[i]
-    return parts, part_len
+    grid = full.reshape(blocks_per_part, d, MFSBLOCKSIZE)
+    stacked = np.ascontiguousarray(grid.transpose(1, 0, 2))
+    return [stacked[p].reshape(part_len) for p in range(d)], part_len
 
 
 def split_chunk(
@@ -89,19 +95,64 @@ def assemble_chunk(
     data_parts: dict[int, np.ndarray],
     slice_type: geometry.SliceType,
     chunk_length: int,
+    out: np.ndarray | None = None,
 ) -> np.ndarray:
     """Reassemble chunk bytes from *data* part streams (inverse of
-    split_chunk for the data portion)."""
+    split_chunk for the data portion). ``out``, when given, receives the
+    bytes directly (must be C-contiguous uint8 of >= chunk_length)."""
     if slice_type.is_standard or slice_type.is_tape:
-        return np.asarray(data_parts[0][:chunk_length])
+        piece = np.asarray(data_parts[0][:chunk_length])
+        if out is None:
+            return piece
+        out[:chunk_length] = piece
+        return out[:chunk_length]
     d = slice_type.data_parts
     first_data = 1 if slice_type.is_xor else 0
     nblocks = (chunk_length + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
-    out = np.zeros(nblocks * MFSBLOCKSIZE, dtype=np.uint8)
-    for i in range(nblocks):
-        p, slot = i % d, i // d
+    blocks_per_part = (nblocks + d - 1) // d
+    part_len = blocks_per_part * MFSBLOCKSIZE
+    from lizardfs_tpu.core import native
+
+    # each part must cover the slots the gather reads from it: part p's
+    # last-used block is the largest i < nblocks with i % d == p
+    def _covered(p: int) -> int:
+        if nblocks <= p:
+            return 0
+        last_i = nblocks - 1 - ((nblocks - 1 - p) % d)
+        slot = last_i // d
+        tail = (
+            chunk_length - last_i * MFSBLOCKSIZE
+            if last_i == nblocks - 1
+            else MFSBLOCKSIZE
+        )
+        return slot * MFSBLOCKSIZE + tail
+
+    if (
+        native.stripe_helpers_available()
+        and out is not None
+        and out.flags.c_contiguous
+        and out.dtype == np.uint8
+        and out.shape[0] >= chunk_length
+        and all(
+            data_parts[first_data + p].shape[0] >= _covered(p)
+            and data_parts[first_data + p].flags.c_contiguous
+            for p in range(d)
+        )
+    ):
+        native.stripe_gather(
+            [data_parts[first_data + p] for p in range(d)],
+            chunk_length, out=out,
+        )
+        return out[:chunk_length]
+    # numpy path: stack (d, slots, B), transpose to (slots, d, B) = block
+    # order, flatten
+    stacked = np.zeros((d, part_len), dtype=np.uint8)
+    for p in range(d):
         src = data_parts[first_data + p]
-        out[i * MFSBLOCKSIZE : (i + 1) * MFSBLOCKSIZE] = src[
-            slot * MFSBLOCKSIZE : (slot + 1) * MFSBLOCKSIZE
-        ]
-    return out[:chunk_length]
+        stacked[p, : min(part_len, src.shape[0])] = src[:part_len]
+    grid = stacked.reshape(d, blocks_per_part, MFSBLOCKSIZE)
+    flat = np.ascontiguousarray(grid.transpose(1, 0, 2)).reshape(-1)
+    if out is not None:
+        out[:chunk_length] = flat[:chunk_length]
+        return out[:chunk_length]
+    return flat[:chunk_length]
