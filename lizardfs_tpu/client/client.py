@@ -177,6 +177,9 @@ class Client:
                 self.current_master_addr = addr  # failover moves this
                 self.session_id = reply.session_id
                 conn.on_push(m.MatoclLockGranted, self._on_lock_granted)
+                conn.on_push(
+                    m.MatoclCacheInvalidate, self._on_cache_invalidate
+                )
                 return
             except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
                 last = e
@@ -490,6 +493,14 @@ class Client:
         q = self._lock_grants.get((push.inode, push.token))
         if q is not None:
             q.put_nowait(True)
+
+    async def _on_cache_invalidate(self, push) -> None:
+        """Master push: another session mutated this file — drop its
+        cached blocks (reference: matoclserv.cc data-cache
+        invalidation to mounts)."""
+        ci = None if push.chunk_index == 0xFFFFFFFF else push.chunk_index
+        self.cache.invalidate(push.inode, ci)
+        self._record("cache_invalidate", inode=push.inode)
 
     async def _lock(self, inode, op, token, start, end, ltype, wait, timeout):
         key = (inode, token)
@@ -1025,6 +1036,11 @@ class Client:
                 m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
                 **self._ident(None, None),
             )
+            # revalidate cached blocks against the chunk identity this
+            # locate returned: a rewrite bumps the version, a truncate+
+            # regrow swaps the chunk_id — either way stale blocks drop
+            chunk_tag = (loc.chunk_id, loc.version)
+            self.cache.note_version(inode, chunk_index, chunk_tag)
             if loc.chunk_id == 0:
                 if into is not None:
                     into[into_offset : into_offset + size] = 0
@@ -1061,7 +1077,10 @@ class Client:
                         continue
                     blk = src[s : s + MFSBLOCKSIZE]
                     if len(blk):
-                        self.cache.put(inode, chunk_index, b, blk.tobytes())
+                        self.cache.put(
+                            inode, chunk_index, b, blk.tobytes(),
+                            version=chunk_tag,
+                        )
             if extra > 0 and aligned_end < chunk_len:
                 # sequential stream detected: warm the chunkservers' page
                 # cache for the region after this one (PREFETCH analog)

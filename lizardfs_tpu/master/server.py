@@ -148,6 +148,10 @@ class MasterServer(Daemon):
         # locks live in self.meta.locks (changelog-replicated)
         self._pending_locks: dict[int, list[dict]] = {}
         self._session_writers: dict[int, asyncio.StreamWriter] = {}
+        # data-cache invalidation (matoclserv.cc analog): which sessions
+        # located chunks of an inode recently; mutations push
+        # MatoclCacheInvalidate to them. inode -> {sid -> last locate}
+        self._read_watchers: dict[int, dict[int, float]] = {}
         from lizardfs_tpu.master.exports import Exports, Topology
 
         self.exports = exports if exports is not None else Exports()
@@ -209,6 +213,7 @@ class MasterServer(Daemon):
         self.add_timer(10.0, self._purge_trash)
         self.add_timer(0.05, self._task_tick)
         self.add_timer(1.0, self._lock_grace_sweep)
+        self.add_timer(30.0, self._read_watcher_sweep)
         self.add_timer(1.0, self._tape_drain)
 
     async def _task_tick(self) -> None:
@@ -926,14 +931,25 @@ class MasterServer(Daemon):
             self._check_perm(fs.file_node(msg.inode), msg.uid, list(msg.gids), 2)
             self.commit({"op": "set_length", "inode": msg.inode,
                          "length": msg.length, "ts": now})
+            self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaReadChunk):
-            return await self._read_chunk(msg, session.get("ip"))
+            return await self._read_chunk(msg, session.get("ip"), session_id)
         if isinstance(msg, m.CltomaWriteChunk):
             return await self._write_chunk(msg)
         if isinstance(msg, m.CltomaWriteChunkEnd):
+            # invalidate FIRST and unconditionally: even a failed write
+            # (non-OK status, or quota raise below) may have overwritten
+            # chunkserver blocks already — a spurious push only costs
+            # the readers a refetch
+            self._invalidate_client_caches(
+                msg.inode, msg.chunk_index, exclude_sid=session_id
+            )
             return await self._write_chunk_end(msg)
         if isinstance(msg, m.CltomaSnapshot):
+            # no invalidation needed: a snapshot lands on a NEW inode
+            # (apply_snapshot raises EEXIST on an existing name), so no
+            # client can hold cached blocks for it
             return await self._snapshot(msg, now)
         if isinstance(msg, m.CltomaSetXattr):
             import base64
@@ -1215,9 +1231,74 @@ class MasterServer(Daemon):
             for part, _, srv in rows
         ]
 
-    async def _read_chunk(self, msg: m.CltomaReadChunk, client_ip: str | None = None):
+    # how long a locate keeps a session subscribed to invalidations;
+    # must exceed the client cache TTL (3 s) so every cache fast-path
+    # hit is covered by a still-live watch
+    CACHE_WATCH_TTL = 60.0
+
+    async def _read_watcher_sweep(self) -> None:
+        """Expire idle watch subscriptions — without this, one dict
+        entry per inode ever read would accumulate for the master's
+        lifetime."""
+        now = time.monotonic()
+        for inode in list(self._read_watchers):
+            watchers = self._read_watchers[inode]
+            for sid in [
+                s for s, ts in watchers.items()
+                if now - ts > self.CACHE_WATCH_TTL
+                or s not in self._session_writers
+            ]:
+                del watchers[sid]
+            if not watchers:
+                del self._read_watchers[inode]
+
+    def _invalidate_client_caches(
+        self, inode: int, chunk_index: int = 0xFFFFFFFF,
+        exclude_sid: int | None = None,
+    ) -> None:
+        """Push MatoclCacheInvalidate to every session that recently
+        located chunks of ``inode``, except the mutator (its own cache
+        was already updated client-side). Reference analog:
+        src/master/matoclserv.cc data-cache invalidation."""
+        watchers = self._read_watchers.get(inode)
+        if not watchers:
+            return
+        now = time.monotonic()
+        dead = []
+        for sid, ts in watchers.items():
+            if now - ts > self.CACHE_WATCH_TTL:
+                dead.append(sid)
+                continue
+            if sid == exclude_sid:
+                continue
+            w = self._session_writers.get(sid)
+            if w is None:
+                dead.append(sid)
+                continue
+            try:
+                framing.write_message(
+                    w,
+                    m.MatoclCacheInvalidate(
+                        inode=inode, chunk_index=chunk_index
+                    ),
+                )
+            except (ConnectionError, RuntimeError):
+                dead.append(sid)
+        for sid in dead:
+            watchers.pop(sid, None)
+        if not watchers:
+            self._read_watchers.pop(inode, None)
+
+    async def _read_chunk(
+        self, msg: m.CltomaReadChunk, client_ip: str | None = None,
+        session_id: int = 0,
+    ):
         node = self.meta.fs.file_node(msg.inode)
         self._check_perm(node, msg.uid, list(msg.gids), 4)
+        if session_id:
+            self._read_watchers.setdefault(msg.inode, {})[session_id] = (
+                time.monotonic()
+            )
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
         )

@@ -471,6 +471,18 @@ class MatoclLockGranted(Message):
     FIELDS = (("inode", "u32"), ("token", "u64"))
 
 
+class MatoclCacheInvalidate(Message):
+    """Push: another session mutated this file — drop cached blocks.
+
+    ``chunk_index == 0xFFFFFFFF`` means the whole inode. Analog of the
+    reference master's data-cache invalidation to mounts (reference:
+    src/master/matoclserv.cc client service; mounts revalidate via the
+    fs_readchunk version, src/mount/mastercomm.h:67)."""
+
+    MSG_TYPE = 1067
+    FIELDS = (("inode", "u32"), ("chunk_index", "u32"))
+
+
 class CltomaSetAcl(Message):
     """Set/clear POSIX ACLs; json = {"access": {...}|null,
     "default": {...}|null} (see master/acl.py dict shape). Only the

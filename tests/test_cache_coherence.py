@@ -1,0 +1,143 @@
+"""Cross-session client data-cache coherence.
+
+The reference master invalidates mount data caches on mutation
+(reference: src/master/matoclserv.cc client service) and mounts
+revalidate cached chunk data against the version returned by
+fs_readchunk (reference: src/mount/chunk_locator.h,
+src/mount/mastercomm.h:67). These tests pin both layers plus the
+last-resort TTL:
+
+1. master push: B rewrites -> A's cached blocks drop well inside the TTL;
+2. version revalidation: even with pushes suppressed, the next locate A
+   performs drops blocks cached under the old (chunk_id, version);
+3. BlockCache unit semantics for the version tagging.
+"""
+
+import asyncio
+
+import pytest
+
+from lizardfs_tpu.client.cache import BlockCache
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.proto import messages as m
+
+from tests.test_cluster import Cluster
+
+pytestmark = pytest.mark.asyncio
+
+
+async def test_cross_session_write_invalidates_reader_cache(tmp_path):
+    """Client A reads (cache fills), client B rewrites, client A re-reads
+    within 1 s and must see the new bytes — the 3 s TTL alone would
+    serve stale data here."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        b = await cluster.client()
+        f = await a.create(1, "shared.dat")
+        old = b"A" * (2 * MFSBLOCKSIZE)
+        await a.write_file(f.inode, old)
+
+        # A reads -> fills its block cache (small read, below bulk bypass)
+        got = await a.read_file(f.inode, 0, 4096)
+        assert got == old[:4096]
+        # the fast path really is armed: a repeat read hits the cache
+        hits_before = a.cache.hits
+        await a.read_file(f.inode, 0, 4096)
+        assert a.cache.hits > hits_before
+
+        # B rewrites through a different session
+        await b.pwrite(f.inode, 0, b"FRESHBYTES")
+        # one scheduler breath for the push task; far below the 3 s TTL
+        await asyncio.sleep(0.2)
+        got = await a.read_file(f.inode, 0, 10)
+        assert got == b"FRESHBYTES"
+        assert a.op_counters.get("cache_invalidate", 0) >= 1
+    finally:
+        await cluster.stop()
+
+
+async def test_version_revalidation_catches_missed_push(tmp_path):
+    """If the invalidation push is lost (handler suppressed here), the
+    next locate A performs — for ANY range of the chunk — drops blocks
+    cached under the old (chunk_id, version) tag."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        b = await cluster.client()
+        f = await a.create(1, "unpushed.dat")
+        old = bytes(range(256)) * ((4 * MFSBLOCKSIZE) // 256)
+        await a.write_file(f.inode, old)
+
+        # A caches block 0
+        assert await a.read_file(f.inode, 0, 4096) == old[:4096]
+        # simulate a lost push: drop A's handler registration
+        a.master._push_handlers.pop(m.MatoclCacheInvalidate, None)
+
+        await b.pwrite(f.inode, 0, b"NEWDATA!")
+        await asyncio.sleep(0.2)
+
+        # A reads a DIFFERENT block -> miss -> locate -> note_version
+        # sees the bumped chunk version and drops the stale block 0
+        await a.read_file(f.inode, 3 * MFSBLOCKSIZE, 4096)
+        # re-read of block 0 within the TTL must now miss and refetch
+        assert (await a.read_file(f.inode, 0, 8)) == b"NEWDATA!"
+    finally:
+        await cluster.stop()
+
+
+def test_blockcache_version_tagging():
+    # call order mirrors the client: every locate note_version()s BEFORE
+    # any put() of the blocks it fetched
+    c = BlockCache(max_age=1000.0)
+    c.note_version(7, 0, (11, 1))
+    c.put(7, 0, 0, b"x" * 100, version=(11, 1))
+    c.put(7, 0, 1, b"y" * 100, version=(11, 1))
+    c.note_version(7, 1, (12, 1))
+    c.put(7, 1, 0, b"z" * 100, version=(12, 1))  # other chunk untouched
+    assert c.get(7, 0, 0) == b"x" * 100
+
+    # same identity re-noted: nothing drops
+    c.note_version(7, 0, (11, 1))
+    assert c.get(7, 0, 1) == b"y" * 100
+
+    # version bump drops only that chunk's blocks
+    c.note_version(7, 0, (11, 2))
+    assert c.get(7, 0, 0) is None and c.get(7, 0, 1) is None
+    assert c.get(7, 1, 0) == b"z" * 100
+
+    # chunk_id swap (truncate + regrow) also invalidates
+    c.note_version(7, 1, (99, 1))
+    assert c.get(7, 1, 0) is None
+
+
+def test_blockcache_put_refuses_revoked_version():
+    """An in-flight read finishing after an invalidation must not
+    re-insert blocks under the revoked version tag — that would
+    resurrect exactly the staleness the push removed."""
+    c = BlockCache(max_age=1000.0)
+    c.note_version(7, 2, (50, 1))
+    # invalidation push lands while a read (tagged (50,1)) is in flight
+    c.invalidate(7, 2)
+    c.put(7, 2, 0, b"stale" * 20, version=(50, 1))  # late arrival
+    assert c.get(7, 2, 0) is None
+    # a put under a tag superseded by a newer locate is refused too
+    c.note_version(7, 2, (50, 2))
+    c.put(7, 2, 0, b"old" * 30, version=(50, 1))
+    assert c.get(7, 2, 0) is None
+    # the current tag caches normally
+    c.put(7, 2, 0, b"new" * 30, version=(50, 2))
+    assert c.get(7, 2, 0) == b"new" * 30
+
+
+def test_blockcache_version_notes_bounded():
+    c = BlockCache(max_age=1000.0)
+    c.max_version_notes = 16
+    for ino in range(100):
+        c.note_version(ino, 0, (ino, 1))
+    assert len(c._versions) == 16
+    # an evicted note only costs a skipped fill, never a wrong read
+    c.put(0, 0, 0, b"q" * 10, version=(0, 1))
+    assert c.get(0, 0, 0) is None
