@@ -30,18 +30,35 @@ NBLOCKS_PER_PART = 128  # 8 parts x 128 blocks x 64 KiB = 64 MiB data
 DATA_MIB = K * NBLOCKS_PER_PART * BLOCK / 2**20
 
 
+def _fused_encode():
+    """The fused encode+CRC entry point for this backend (Pallas on a
+    real chip, jax fallback elsewhere)."""
+    from lizardfs_tpu.ops import jax_ec, pallas_ec
+
+    return (
+        pallas_ec.fused_encode_crc
+        if pallas_ec.supported()
+        else jax_ec.fused_encode_crc
+    )
+
+
+def _cpu_encoder():
+    """Best CPU encoder: native SIMD codec when built, numpy golden
+    otherwise."""
+    from lizardfs_tpu.core import native
+    from lizardfs_tpu.core.encoder import CpuChunkEncoder
+
+    return native.CppChunkEncoder() if native.available() else CpuChunkEncoder()
+
+
 def tpu_throughput(k: int = K, m: int = M,
                    nblocks_per_part: int = NBLOCKS_PER_PART) -> float:
     import jax
     import jax.numpy as jnp
 
-    from lizardfs_tpu.ops import jax_ec, pallas_ec
+    from lizardfs_tpu.ops import jax_ec
 
-    fused = (
-        pallas_ec.fused_encode_crc
-        if pallas_ec.supported()
-        else jax_ec.fused_encode_crc
-    )
+    fused = _fused_encode()
     data_mib = k * nblocks_per_part * BLOCK / 2**20
     bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(k, m)))
     data = jax.device_put(
@@ -141,6 +158,101 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def tpu_reconstruct_latency_ms() -> float:
+    """BASELINE config 4: single-shard reconstruct latency of a 64 MiB
+    ec(8,4) chunk (part 0 lost, rebuilt from 8 survivors), including the
+    host fetch of the rebuilt 8 MiB part — that transfer IS part of a
+    real repair (reference: src/common/ec_read_plan.h:113-146 recovery +
+    src/chunkserver/chunk_replicator.cc:139-197 writes the part back)."""
+    import statistics
+
+    import jax
+
+    from lizardfs_tpu.ops import gf256, jax_ec
+
+    fused = _fused_encode()
+    lost = [0]
+    avail = [i for i in range(K + M) if i not in lost]
+    used, _ = gf256.recovery_selection(K, M, avail, lost)
+    bigm = jax.device_put(np.asarray(
+        jax_ec.recovery_bitmatrix(K, M, tuple(used), tuple(lost))
+    ))
+    survivors = jax.device_put(
+        np.random.default_rng(1).integers(
+            0, 256, size=(len(used), NBLOCKS_PER_PART * BLOCK), dtype=np.uint8
+        )
+    )
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        rec, _dc, _rc = fused(bigm, survivors, BLOCK)
+        np.asarray(rec)  # force device->host of the rebuilt part
+        return (time.perf_counter() - t0) * 1e3
+
+    once()
+    once()  # compile, then warm
+    return statistics.median(once() for _ in range(7))
+
+
+def cpu_reconstruct_ms() -> float:
+    """CPU reference for config 4: same repair through the encoder
+    boundary."""
+    enc = _cpu_encoder()
+    n = NBLOCKS_PER_PART * BLOCK
+    rng = np.random.default_rng(1)
+    parts = {
+        i: rng.integers(0, 256, size=n, dtype=np.uint8)
+        for i in range(1, K + M)
+    }
+    enc.recover(K, M, parts, [0])  # warm
+    return min(
+        _timed(lambda: enc.recover(K, M, parts, [0])) for _ in range(3)
+    ) * 1e3
+
+
+def tpu_ec82_batch1_us() -> float:
+    """BASELINE config 2: ec(8,2) encode+CRC of ONE stripe (8 x 64 KiB
+    blocks). batch=1 is a latency row — it exposes the dispatch floor a
+    single-stripe write pays, which the batch=128 headline amortizes."""
+    import statistics
+
+    import jax
+
+    from lizardfs_tpu.ops import jax_ec
+
+    fused = _fused_encode()
+    bigm = jax.device_put(np.asarray(jax_ec.encoding_bitmatrix(8, 2)))
+    data = jax.device_put(
+        np.random.default_rng(2).integers(
+            0, 256, size=(8, BLOCK), dtype=np.uint8
+        )
+    )
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        # ONE combined fetch: three sequential np.asarray()s would pay
+        # three ~65 ms tunnel round trips and measure the tunnel, not
+        # the dispatch floor this row is about
+        jax.device_get(fused(bigm, data, BLOCK))
+        return (time.perf_counter() - t0) * 1e6
+
+    once()
+    once()
+    return statistics.median(once() for _ in range(9))
+
+
+def cpu_ec82_batch1_us() -> float:
+    enc = _cpu_encoder()
+    data = np.random.default_rng(2).integers(
+        0, 256, size=(8, BLOCK), dtype=np.uint8
+    )
+    enc.encode_with_checksums(8, 2, data, block_size=BLOCK)  # warm
+    return min(
+        _timed(lambda: enc.encode_with_checksums(8, 2, data, block_size=BLOCK))
+        for _ in range(5)
+    ) * 1e6
+
+
 def cluster_throughput() -> dict:
     """Whole-system localhost bench: 12-chunkserver cluster (native C++
     data plane), 128 MiB dd-style write + cold read per goal. Returns
@@ -163,9 +275,15 @@ def cluster_throughput() -> dict:
             if "write_MBps" in r:
                 out[f"cluster_{key}_write_MBps"] = r["write_MBps"]
                 out[f"cluster_{key}_read_MBps"] = r["read_MBps"]
+                out[f"cluster_{key}_spread_pct"] = max(
+                    r.get("write_spread_pct", 0), r.get("read_spread_pct", 0)
+                )
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]
+                out["cluster_4k_spread_pct"] = max(
+                    r.get("native_spread_pct", 0), r.get("loop_spread_pct", 0)
+                )
         return out
     except Exception as e:  # noqa: BLE001 — bench must still emit a line
         return {"cluster_error": str(e)[:200]}
@@ -174,50 +292,98 @@ def cluster_throughput() -> dict:
 def _tpu_worker(q):
     try:
         # the headline row lands on the queue FIRST so a later hang in
-        # the optional wide row can't discard it
+        # the optional rows can't discard it
         q.put(("ok", tpu_throughput()))
     except Exception as e:  # noqa: BLE001
         q.put(("err", str(e)[:200]))
         return
-    try:
+    for key, fn in (
         # wide-stripe single-chip row (BASELINE config 5 precursor):
         # bounds expected multi-chip MFU before any mesh is involved
-        q.put(("wide", tpu_throughput(k=32, m=8, nblocks_per_part=32)))
-    except Exception:  # noqa: BLE001 — optional row
-        pass
+        ("wide", lambda: tpu_throughput(k=32, m=8, nblocks_per_part=32)),
+        ("rec", tpu_reconstruct_latency_ms),   # BASELINE config 4
+        ("ec82", tpu_ec82_batch1_us),          # BASELINE config 2
+    ):
+        try:
+            q.put((key, fn()))
+        except Exception:  # noqa: BLE001 — optional rows
+            pass
 
 
-def _tpu_throughput_guarded(timeout_s: int = 600):
-    """tpu_throughput in a subprocess with a hard deadline: a dead
-    accelerator tunnel hangs device init inside native code (no signal
-    can interrupt it), and the bench must still emit its JSON line."""
+def _tpu_throughput_guarded(
+    attempt_delays=(0, 300, 600), timeout_s: int = 420
+):
+    """TPU rows in a spawn subprocess with a hard deadline per attempt:
+    a dead accelerator tunnel hangs device init inside native code (no
+    signal can interrupt it), and the bench must still emit its JSON
+    line. Makes one attempt per entry of ``attempt_delays`` (seconds
+    from bench start) until one succeeds, and logs a wall-clock stamp +
+    outcome per attempt so the record distinguishes "tunnel dead all
+    round" from "flaky at bench time"."""
+    import datetime
     import multiprocessing as mp
 
     ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    p = ctx.Process(target=_tpu_worker, args=(q,), daemon=True)
-    p.start()
-    p.join(timeout_s)
-    if p.is_alive():
-        p.terminate()
-        p.join(5)
+    t_start = time.monotonic()
+    attempts = []
     rows = []
-    try:
-        while True:
-            rows.append(q.get_nowait())
-    except Exception:  # noqa: BLE001 — queue drained
-        pass
-    main_row = next((v for k, v in rows if k == "ok"), None)
-    wide = next((v for k, v in rows if k == "wide"), None)
+    for delay in attempt_delays:
+        wait = t_start + delay - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        )
+        q = ctx.Queue()
+        p = ctx.Process(target=_tpu_worker, args=(q,), daemon=True)
+        t0 = time.monotonic()
+        p.start()
+        p.join(timeout_s)
+        if p.is_alive():
+            p.terminate()
+            p.join(5)
+            if p.is_alive():
+                p.kill()
+                p.join(5)
+        rows = []
+        try:
+            while True:
+                rows.append(q.get_nowait())
+        except Exception:  # noqa: BLE001 — queue drained
+            pass
+        err = next((v for k, v in rows if k == "err"), None)
+        ok = any(k == "ok" for k, _ in rows)
+        took = time.monotonic() - t0
+        if ok:
+            # name the rows that landed: a slow-but-healthy tunnel can
+            # hit the deadline mid optional row, and a bare "ok" would
+            # hide that the rec/ec82 rows are missing
+            outcome = "ok: " + ",".join(k for k, _ in rows)
+        elif err is not None:
+            outcome = f"err: {err}"
+        elif took < timeout_s - 5 and p.exitcode is not None:
+            # child died fast without reporting (OOM kill, bootstrap
+            # failure) — that is NOT a device-init timeout
+            outcome = f"child exited rc={p.exitcode} after {round(took, 1)}s"
+        else:
+            outcome = "device init timeout"
+        attempts.append({
+            "t": stamp,
+            "took_s": round(took, 1),
+            "outcome": outcome,
+        })
+        if ok:
+            break
+    result = {k: v for k, v in rows if k != "err"}
     err = next((v for k, v in rows if k == "err"), None)
-    if main_row is None and err is None:
+    if "ok" not in result and err is None:
         err = "accelerator unreachable (device init timeout)"
-    return ((main_row, wide), None) if main_row is not None else (None, err)
+    return result, (None if "ok" in result else err), attempts
 
 
 def main():
-    result, tpu_err = _tpu_throughput_guarded()
-    value, wide = result if result is not None else (None, None)
+    tpu_rows, tpu_err, attempts = _tpu_throughput_guarded()
+    value = tpu_rows.get("ok")
     baseline = cpu_baseline_throughput()
     if value is not None:
         row = {
@@ -237,8 +403,29 @@ def main():
             "vs_baseline": 1.0,
             "tpu_error": tpu_err,
         }
-    if wide is not None:
-        row["ec32_8_single_chip_MiBps"] = round(wide, 1)
+    row["tpu_attempts"] = attempts
+    if "wide" in tpu_rows:
+        row["ec32_8_single_chip_MiBps"] = round(tpu_rows["wide"], 1)
+    # BASELINE config 4: reconstruct-1-shard latency. CPU row always
+    # lands; the TPU row joins automatically when the tunnel is up.
+    # Guarded: the one JSON line must survive a broken native codec.
+    try:
+        cpu_rec = cpu_reconstruct_ms()
+        row["reconstruct_1shard_cpu_ms"] = round(cpu_rec, 2)
+        if "rec" in tpu_rows:
+            row["reconstruct_1shard_ms"] = round(tpu_rows["rec"], 2)
+            row["reconstruct_vs_cpu"] = round(cpu_rec / tpu_rows["rec"], 2)
+    except Exception as e:  # noqa: BLE001
+        row["reconstruct_error"] = str(e)[:200]
+    # BASELINE config 2: ec(8,2) single-stripe encode latency
+    try:
+        cpu82 = cpu_ec82_batch1_us()
+        row["ec8_2_batch1_cpu_us"] = round(cpu82, 1)
+        if "ec82" in tpu_rows:
+            row["ec8_2_batch1_us"] = round(tpu_rows["ec82"], 1)
+            row["ec8_2_batch1_vs_cpu"] = round(cpu82 / tpu_rows["ec82"], 2)
+    except Exception as e:  # noqa: BLE001
+        row["ec8_2_error"] = str(e)[:200]
     row.update(cluster_throughput())
     print(json.dumps(row))
 
