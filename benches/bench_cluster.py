@@ -74,7 +74,11 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
     await client.connect()
     import numpy as np
 
-    payload = data_generator.generate(0, size_mb * 2**20).tobytes()
+    # off-loop: a 128 MiB generate/compare holds the GIL long enough to
+    # stall every in-process daemon loop (watchdog-visible)
+    payload = await asyncio.to_thread(
+        lambda: data_generator.generate(0, size_mb * 2**20).tobytes()
+    )
     payload_arr = np.frombuffer(payload, dtype=np.uint8)
     back = np.empty(len(payload), dtype=np.uint8)
     rows = []
@@ -91,13 +95,15 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 await client.write_file(f.inode, payload)
                 wts.append(time.perf_counter() - t0)
                 client.cache.invalidate(f.inode)  # cold read
-                back[:] = 0
+                await asyncio.to_thread(back.fill, 0)
                 t0 = time.perf_counter()
                 n = await client.read_file_into(f.inode, 0, back)
                 rts.append(time.perf_counter() - t0)
                 assert n == len(payload)
-                assert np.array_equal(back, payload_arr), \
-                    f"corruption at goal {label}"
+                equal = await asyncio.to_thread(
+                    np.array_equal, back, payload_arr
+                )
+                assert equal, f"corruption at goal {label}"
             w_med, w_spread = _median_spread([size_mb / t for t in wts])
             r_med, r_spread = _median_spread([size_mb / t for t in rts])
             rows.append({

@@ -161,6 +161,12 @@ class ChunkServer(Daemon):
 
     async def start(self) -> None:
         await super().start()
+        from lizardfs_tpu.core import native_io
+
+        if native_io.available():
+            # see native_io.prestart_executors: lazy thread spawn inside
+            # submit() can block the loop under GIL pressure
+            native_io.prestart_executors()
         if self.master_addr is not None:  # None = standalone (tests)
             await self._connect_master()
 

@@ -87,6 +87,11 @@ class Client:
         # write journal, writedata.cc)
         # (inode, chunk) -> [asyncio.Lock, refcount]; see _pwrite_chunk
         self._chunk_write_locks: dict[tuple[int, int], list] = {}
+        # reusable stripe-scatter staging buffers, keyed (d, part_len):
+        # a fresh 64 MiB allocation pays its page faults inside the
+        # scatter copy (~2x measured cost); the write window keeps at
+        # most 2 chunks in flight, so 2 buffers per shape suffice
+        self._stage_buffers: dict[tuple[int, int], list[np.ndarray]] = {}
         # waiting lock requests: (inode, token) -> grant queue
         self._lock_grants: dict[tuple[int, int], asyncio.Queue] = {}
         # identity attached to permission-checked ops when the caller
@@ -165,6 +170,13 @@ class Client:
     async def connect(self, info: str = "pyclient", password: str = "") -> None:
         self._info = info
         self._password = password
+        # spawn the native-IO pool threads while the process is quiet:
+        # lazy spawn inside submit() blocks the event loop under GIL
+        # pressure (measured 150-600 ms during EC write fan-out)
+        from lizardfs_tpu.core import native_io
+
+        if native_io.available():
+            native_io.prestart_executors()
         last: Exception | None = None
         for addr in self.master_addrs:
             try:
@@ -782,27 +794,91 @@ class Client:
             by_part.setdefault(cpt.part, []).append(loc)
         if slice_type is None:
             raise st.StatusError(st.NO_CHUNK_SERVERS, "no locations granted")
-        # client-side parity (chunk_writer.cc computeParityBlock analog),
-        # off-loop: the stripe scatter + SIMD encode release the GIL, so
-        # chunk N+1's parity overlaps chunk N's wire transfer instead of
-        # stalling the event loop for hundreds of ms
-        parts = await asyncio.to_thread(
-            striping.split_chunk, chunk_data, slice_type, self.encoder
-        )
-        sends = []
-        for part_idx, locs in by_part.items():
-            payload = parts.get(part_idx)
-            if payload is None:
-                continue
+
+        def send_of(part_idx: int, payload: np.ndarray):
             length = striping.part_length(
                 slice_type, part_idx, len(chunk_data)
             )
-            sends.append(
-                self._write_part(
-                    grant.chunk_id, grant.version, locs, payload, length
-                )
+            return self._write_part(
+                grant.chunk_id, grant.version, by_part[part_idx],
+                payload, length,
             )
-        await asyncio.gather(*sends)
+
+        if slice_type.is_standard or slice_type.is_tape:
+            # whole-chunk copies: stream the caller's buffer directly
+            # (_write_part only reads it) — no 64 MiB staging copy
+            await asyncio.gather(
+                *(send_of(p, chunk_data) for p in by_part)
+            )
+            return
+        # striped slices: scatter first (cheap memcpy), then stream the
+        # DATA parts while the parity encode (the expensive phase,
+        # ~40% of a serial chunk write) runs concurrently off-loop —
+        # chunk_writer.cc computes parity inline per stripe; here the
+        # whole-chunk encode overlaps the data transfer instead
+        d = slice_type.data_parts
+        nblocks = -(-len(chunk_data) // MFSBLOCKSIZE)
+        part_len = -(-nblocks // d) * MFSBLOCKSIZE
+        stage = self._stage_acquire(d, part_len)
+        stacked, _ = await asyncio.to_thread(
+            striping.padded_data_parts, chunk_data, d, stage
+        )
+        first = 1 if slice_type.is_xor else 0
+        full_chunk = len(chunk_data) == MFSCHUNKSIZE
+
+        async def parity_parts() -> dict[int, np.ndarray]:
+            if slice_type.is_xor:
+                par = await asyncio.to_thread(self.encoder.xor_parity, stacked)
+                return {0: par}
+            par = await asyncio.to_thread(
+                self.encoder.encode, d, slice_type.parity_parts, list(stacked)
+            )
+            return {d + j: p for j, p in enumerate(par)}
+
+        par_task = asyncio.ensure_future(parity_parts())
+        tasks = [
+            asyncio.ensure_future(send_of(first + i, stacked[i]))
+            for i in range(d)
+            if first + i in by_part
+        ]
+        try:
+            par = await par_task
+            tasks += [
+                asyncio.ensure_future(send_of(p, payload))
+                for p, payload in par.items()
+                if p in by_part
+            ]
+            for t in tasks:
+                await t
+        finally:
+            par_task.cancel()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(par_task, *tasks, return_exceptions=True)
+            # all senders are done — the staging buffer is reusable
+            self._stage_release(stage, poolable=full_chunk)
+
+    def _stage_acquire(self, d: int, part_len: int) -> np.ndarray | None:
+        # stage buffers only serve the native scatter; the numpy
+        # fallback ignores out= and would pool never-written memory
+        from lizardfs_tpu.core import native
+
+        if not native.stripe_helpers_available():
+            return None
+        bucket = self._stage_buffers.get((d, part_len))
+        if bucket:
+            return bucket.pop()
+        return np.empty((d, part_len), dtype=np.uint8)
+
+    def _stage_release(self, buf: np.ndarray | None, poolable: bool) -> None:
+        # pool ONLY the full-chunk shape: tail chunks produce one shape
+        # per distinct file length, and keeping 2 buffers per shape
+        # forever would grow without bound on a long-lived mount
+        if buf is None or not poolable:
+            return
+        bucket = self._stage_buffers.setdefault(buf.shape, [])
+        if len(bucket) < 2:
+            bucket.append(buf)
 
     async def _write_part(
         self,

@@ -58,6 +58,17 @@ def _load() -> ctypes.CDLL | None:
             lib.lz_stripe_gather.restype = None
         except AttributeError:
             pass  # stale .so without the stripe helpers: numpy fallback
+        try:
+            lib.lz_ec_encode_mt.argtypes = [
+                ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int,
+            ]
+            lib.lz_ec_encode_mt.restype = None
+        except AttributeError:
+            pass  # stale .so: single-threaded encode only
         return lib
     return None
 
@@ -76,7 +87,15 @@ def _ptr_array(arrays: list[np.ndarray]) -> ctypes.Array:
     return ptrs
 
 
-def apply_matrix(matrix: np.ndarray, parts: list[np.ndarray]) -> list[np.ndarray]:
+# worker threads for whole-chunk encodes (the C side stays single-
+# threaded below 1 MiB, where spawn cost would dominate); bounded so
+# encode never crowds out the network/serve thread pools
+ENCODE_THREADS = max(1, min(4, (os.cpu_count() or 2) // 2))
+
+
+def apply_matrix(
+    matrix: np.ndarray, parts: list[np.ndarray], threads: int | None = None
+) -> list[np.ndarray]:
     """out[i] = XOR_j matrix[i,j] * parts[j] via the SIMD kernel."""
     assert _lib is not None
     rows, k = matrix.shape
@@ -87,6 +106,16 @@ def apply_matrix(matrix: np.ndarray, parts: list[np.ndarray]) -> list[np.ndarray
         return out
     mat = np.ascontiguousarray(matrix, dtype=np.uint8)
     srcs = [np.ascontiguousarray(p, dtype=np.uint8) for p in parts]
+    nthreads = ENCODE_THREADS if threads is None else threads
+    if nthreads > 1 and hasattr(_lib, "lz_ec_encode_mt"):
+        _lib.lz_ec_encode_mt(
+            size, k, rows,
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            _ptr_array(srcs),
+            _ptr_array(out),
+            nthreads,
+        )
+        return out
     _lib.lz_ec_encode(
         size, k, rows,
         mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -111,13 +140,21 @@ def stripe_helpers_available() -> bool:
 
 
 def stripe_scatter(
-    data: np.ndarray, d: int, blocks_per_part: int
+    data: np.ndarray, d: int, blocks_per_part: int,
+    out: np.ndarray | None = None,
 ) -> np.ndarray:
     """(nbytes,) chunk bytes -> (d, part_len) zero-padded part streams
-    in one contiguous buffer, via the GIL-free native kernel."""
+    in one contiguous buffer, via the GIL-free native kernel. ``out``
+    lets hot paths reuse a staging buffer (a fresh 64 MiB allocation
+    pays its page faults inside the copy)."""
     assert stripe_helpers_available()
     part_len = blocks_per_part * MFSBLOCKSIZE
-    out = np.empty((d, part_len), dtype=np.uint8)
+    if out is None:
+        out = np.empty((d, part_len), dtype=np.uint8)
+    assert (
+        out.flags.c_contiguous and out.dtype == np.uint8
+        and out.shape == (d, part_len)
+    )
     data = np.ascontiguousarray(data, dtype=np.uint8)
     _lib.lz_stripe_scatter(
         data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

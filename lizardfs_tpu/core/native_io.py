@@ -132,6 +132,51 @@ EXECUTOR = ThreadPoolExecutor(max_workers=32, thread_name_prefix="native-io")
 SERVE_EXECUTOR = ThreadPoolExecutor(
     max_workers=16, thread_name_prefix="native-serve"
 )
+
+
+_prestarted = False
+
+
+def prestart_executors() -> None:
+    """Spawn every pool thread up front. ThreadPoolExecutor creates
+    threads lazily inside submit(), and Thread.start() BLOCKS until the
+    new thread's bootstrap runs — under GIL pressure (busy encode/IO
+    threads) that wait was measured at 150-600 ms ON THE EVENT LOOP
+    during EC write fan-out. Pre-started threads make submit() a pure
+    enqueue.
+
+    Runs once per process, at the FIRST daemon/client startup (while
+    the pools are quiet — parking tasks in an already-busy shared pool
+    would queue behind live work and head-of-line-block it); later
+    callers no-op."""
+    global _prestarted
+    if _prestarted:
+        return
+    _prestarted = True
+    import threading
+
+    for pool in (EXECUTOR, SERVE_EXECUTOR):
+        # park one task per worker: a parked thread is not idle, so
+        # every submit() spawns a fresh thread until the pool is full
+        release = threading.Event()
+        started = threading.Semaphore(0)
+
+        def _parked(started=started, release=release):
+            started.release()
+            release.wait(10.0)
+
+        try:
+            futs = [
+                pool.submit(_parked)
+                for _ in range(pool._max_workers)  # noqa: SLF001
+            ]
+        except RuntimeError:
+            continue  # pool already shut down
+        deadline_ok = all(started.acquire(timeout=2.0) for _ in futs)
+        release.set()
+        if not deadline_ok:
+            # partial spawn (loaded box): fine — whatever started stays
+            return
 # native serves in flight above this fall back to the asyncio path, so
 # stalled slow-draining clients (which may legally pin a serve thread
 # until their deadline) cannot head-of-line-block healthy readers. The

@@ -24,15 +24,16 @@ from lizardfs_tpu.core import geometry
 from lizardfs_tpu.core.encoder import ChunkEncoder, get_encoder
 
 
-def _padded_data_parts(
-    data: np.ndarray, d: int
+def padded_data_parts(
+    data: np.ndarray, d: int, out: np.ndarray | None = None
 ) -> tuple[list[np.ndarray], int]:
     """Split chunk bytes into d zero-padded equal part streams.
 
     Returns (parts, part_len) where part_len covers ceil(blocks/d) blocks.
     One native (GIL-free) or vectorized-numpy pass — this runs on every
     EC/xor chunk write, so a per-block Python loop here throttled the
-    whole write pipeline.
+    whole write pipeline. ``out`` (shape (d, part_len)) reuses a staging
+    buffer on the native path.
     """
     nbytes = data.shape[0]
     nblocks = (nbytes + MFSBLOCKSIZE - 1) // MFSBLOCKSIZE
@@ -41,7 +42,7 @@ def _padded_data_parts(
     from lizardfs_tpu.core import native
 
     if native.stripe_helpers_available():
-        stacked = native.stripe_scatter(data, d, blocks_per_part)
+        stacked = native.stripe_scatter(data, d, blocks_per_part, out=out)
         return list(stacked), part_len
     # numpy fallback: pad to the full stripe grid, then one strided copy
     # block i -> part i%d, slot i//d
@@ -67,7 +68,7 @@ def split_chunk(
     if slice_type.is_standard or slice_type.is_tape:
         return {0: data.copy()}
     d = slice_type.data_parts
-    parts, _ = _padded_data_parts(data, d)
+    parts, _ = padded_data_parts(data, d)
     if slice_type.is_xor:
         parity = enc.xor_parity(parts)
         out = {0: parity}

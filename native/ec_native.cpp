@@ -17,8 +17,11 @@
 //   void lz_crc32_blocks(const uint8_t* data, size_t nblocks,
 //                        size_t block_size, uint32_t* out);
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -315,6 +318,40 @@ void lz_ec_encode(size_t len, int k, int rows, const uint8_t* matrix,
     encode_scalar(len, k, rows, src, dst, tbls);
 }
 
+// Threaded encode: splits the column range into ~equal 64-byte-aligned
+// slices, one thread each (the GF multiply is purely columnwise, so
+// slices are independent). Each worker reuses lz_ec_encode, whose
+// nibble-table scratch is thread_local. Small inputs stay single-
+// threaded — thread spawn would dominate.
+void lz_ec_encode_mt(size_t len, int k, int rows, const uint8_t* matrix,
+                     const uint8_t* const* src, uint8_t* const* dst,
+                     int nthreads) {
+    if (nthreads <= 1 || len < (size_t{1} << 20)) {
+        lz_ec_encode(len, k, rows, matrix, src, dst);
+        return;
+    }
+    // ceil-divide BEFORE aligning up: floor division here dropped the
+    // last len % nthreads bytes whenever len/nthreads was already
+    // 64-aligned (silent parity corruption on unaligned lengths)
+    const size_t per = (len + static_cast<size_t>(nthreads) - 1) /
+                       static_cast<size_t>(nthreads);
+    const size_t slice = (per + 63) & ~size_t{63};
+    std::vector<std::thread> workers;
+    for (int t = 0; t < nthreads; ++t) {
+        const size_t off = static_cast<size_t>(t) * slice;
+        if (off >= len) break;
+        const size_t n = std::min(slice, len - off);
+        workers.emplace_back([=]() {
+            std::vector<const uint8_t*> s(static_cast<size_t>(k));
+            std::vector<uint8_t*> d(static_cast<size_t>(rows));
+            for (int j = 0; j < k; ++j) s[static_cast<size_t>(j)] = src[j] + off;
+            for (int r = 0; r < rows; ++r) d[static_cast<size_t>(r)] = dst[r] + off;
+            lz_ec_encode(n, k, rows, matrix, s.data(), d.data());
+        });
+    }
+    for (auto& w : workers) w.join();
+}
+
 uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len) {
     const auto& T = crc_tables().t;
     crc ^= 0xFFFFFFFFu;
@@ -365,7 +402,22 @@ void lz_stripe_scatter(const uint8_t* data, uint64_t nbytes, uint32_t d,
     const uint64_t B = 64 * 1024;
     const uint64_t part_len = static_cast<uint64_t>(blocks_per_part) * B;
     const uint64_t nblocks = (nbytes + B - 1) / B;
-    std::memset(out, 0, static_cast<size_t>(part_len) * d);
+    // zero ONLY the pad tail of each part: a full-buffer memset doubled
+    // the memory traffic of the whole scatter (64 MiB extra per chunk)
+    for (uint32_t p = 0; p < d; ++p) {
+        // blocks landing in part p: indices i < nblocks with i % d == p
+        const uint64_t count =
+            (p < nblocks) ? (nblocks - 1 - p) / d + 1 : 0;
+        uint64_t covered = count * B;
+        if (count > 0 && (count - 1) * d + p == nblocks - 1 &&
+            nbytes % B != 0) {
+            covered = (count - 1) * B + nbytes % B;  // partial last block
+        }
+        if (covered < part_len) {
+            std::memset(out + p * part_len + covered, 0,
+                        static_cast<size_t>(part_len - covered));
+        }
+    }
     for (uint64_t i = 0; i < nblocks; ++i) {
         const uint64_t src_off = i * B;
         const uint64_t len = (src_off + B <= nbytes) ? B : (nbytes - src_off);
