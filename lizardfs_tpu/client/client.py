@@ -1353,6 +1353,10 @@ class Client:
                 await asyncio.shield(fut)
                 for p in wanted:
                     GLOBAL_STATS.record_success(by_part[p][0])
+                # counted so tests/operators can see the fast path is
+                # actually taken (a silent precondition miss would
+                # quietly forfeit the 3x read win)
+                self._record("stripe_gather_fast")
                 return None
             except asyncio.CancelledError:
                 native_io.abort_parts_gather(cell)
@@ -1362,7 +1366,8 @@ class Client:
                     pass
                 raise
             except (native_io.NativeIOError, OSError, ConnectionError):
-                pass  # degrade to the plan path (waves + recovery)
+                self._record("stripe_gather_fallback")
+                # degrade to the plan path (waves + recovery)
         # per-part scores from the shared chunkserver health registry:
         # an unhealthy holder's part drops in rank, so recovery reads
         # prefer parts on healthy servers (read_plan_executor.cc:95)

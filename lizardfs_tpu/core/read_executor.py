@@ -40,7 +40,6 @@ async def read_part_range(
     size: int,
     into: np.ndarray | None = None,
     into_offset: int = 0,
-    direct: bool = False,
 ) -> np.ndarray:
     """Read one range of one part from one chunkserver, verifying piece
     CRCs (ReadOperationExecutor analog). Connections come from the
@@ -195,8 +194,6 @@ async def execute_plan(
     deadline = loop.time() + total_timeout
     current_wave = -1
 
-    single_op = len(plan.read_operations) == 1
-
     def start_wave(w: int):
         for op in plan.read_operations:
             if op.wave != w:
@@ -215,7 +212,6 @@ async def execute_plan(
                     op.request_size,
                     into=buffer,
                     into_offset=op.buffer_offset,
-                    direct=single_op,
                 )
             )
             pending[task] = op.part
