@@ -113,6 +113,56 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 "write_spread_pct": w_spread,
                 "read_spread_pct": r_spread,
             })
+        # NFS gateway throughput: the wire-level analog of mounting the
+        # gateway and running dd (no kernel nfs module in the image, so
+        # the RFC 1813 client is the e2e path). One gateway process ==
+        # the documented scale-out unit (doc/migration.md "NFS
+        # scale-out": add gateways for aggregate bandwidth).
+        try:
+            from lizardfs_tpu.nfs.client import Nfs3Client
+            from lizardfs_tpu.nfs.server import NfsGateway
+
+            gw = NfsGateway("127.0.0.1", master.port)
+            await gw.start()
+            try:
+                nfs_mb = min(size_mb, 32)  # 64 KiB wsize: keep runtime sane
+                blob = payload[: nfs_mb * 2**20]
+                wts, rts = [], []
+                for rep in range(REPS):
+                    async with Nfs3Client("127.0.0.1", gw.port) as nc:
+                        root = await nc.mnt("/")
+                        _, fh = await nc.create(root, f"nfs_{rep}.bin")
+                        t0 = time.perf_counter()
+                        for off in range(0, len(blob), 65536):
+                            await nc.write(fh, off, blob[off : off + 65536])
+                        wts.append(time.perf_counter() - t0)
+                        t0 = time.perf_counter()
+                        got = bytearray()
+                        off = 0
+                        while off < len(blob):
+                            piece, _eof = await nc.read(fh, off, 65536)
+                            got += piece
+                            off += len(piece)
+                        rts.append(time.perf_counter() - t0)
+                        assert bytes(got) == blob, "nfs read mismatch"
+                w_med, w_spread = _median_spread([nfs_mb / t for t in wts])
+                r_med, r_spread = _median_spread([nfs_mb / t for t in rts])
+                rows.append({
+                    "goal": "nfs gateway",
+                    "write_MBps": w_med,
+                    "read_MBps": r_med,
+                    "write_spread_pct": w_spread,
+                    "read_spread_pct": r_spread,
+                })
+            finally:
+                await gw.stop()
+        except AssertionError:
+            raise  # data corruption must fail the bench, like the goal rows
+        except Exception:  # noqa: BLE001 — infra failure must not kill it
+            import logging
+
+            logging.getLogger("bench").exception("nfs bench row failed")
+
         # small-read latency: the FUSE-path comparison — direct C call
         # (liz_read on the caller thread) vs asyncio planner path
         from lizardfs_tpu.client import native_client

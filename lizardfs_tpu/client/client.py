@@ -38,6 +38,16 @@ from lizardfs_tpu.utils import striping
 
 log = logging.getLogger("client")
 
+# the pid whose cgroup classifies the current IO for limit-group
+# throttling; FUSE sets it per operation from the kernel caller's
+# context (reference: src/mount/io_limit_group.cc reads the fuse ctx
+# pid the same way). None = this process itself.
+import contextvars  # noqa: E402
+
+IO_CALLER_PID: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "io_caller_pid", default=None
+)
+
 # status codes worth retrying a write for (infrastructure trouble);
 # everything else (quota, permissions, invalid args) is permanent
 _TRANSIENT = {
@@ -98,39 +108,75 @@ class Client:
         # doesn't supply one (FUSE passes the kernel caller's context)
         self.default_uid = 0
         self.default_gids = [0]
-        # cluster-wide QoS (LimiterProxy analog): a TokenBucket paced by
-        # master-granted bandwidth shares; None until the master says a
-        # limit applies
-        from lizardfs_tpu.runtime.limiter import TokenBucket
+        # cluster-wide QoS (LimiterProxy analog): per limit-group
+        # TokenBuckets paced by master-granted shares. Callers are
+        # classified into cgroup limit groups (reference:
+        # src/mount/io_limit_group.cc) — FUSE sets IO_CALLER_PID so a
+        # mount shared by several containers throttles each container
+        # under its own group's budget; other consumers fall under this
+        # process's own cgroup.
+        from lizardfs_tpu.client.io_limit_group import GroupCache
 
-        self._io_bucket: TokenBucket | None = None
-        self._io_limit_next_renew = 0.0
+        # group -> {"bucket": TokenBucket|None, "next_renew": float}
+        self._io_groups: dict[str, dict] = {}
+        self._io_subsystem = ""  # learned from master replies
+        self._io_group_cache = GroupCache("")
+        # True while the master has ANY limit configured: unthrottled
+        # fast paths (FUSE native read pool) must stand down so every
+        # byte passes _throttle (the fast path cannot classify or pace)
+        self.io_limits_active = False
+
+    def _io_group_of_caller(self) -> str:
+        import os
+
+        pid = IO_CALLER_PID.get()
+        return self._io_group_cache.classify(
+            pid if pid is not None else os.getpid()
+        )
 
     async def _throttle(self, nbytes: int) -> None:
-        """Apply the master-coordinated IO limit to a data transfer."""
+        """Apply the master-coordinated IO limit to a data transfer,
+        under the calling process's limit group."""
         import time as _time
 
+        group = self._io_group_of_caller()
+        state = self._io_groups.setdefault(
+            group, {"bucket": None, "next_renew": 0.0}
+        )
         now = _time.monotonic()
-        if now >= self._io_limit_next_renew:
-            self._io_limit_next_renew = now + 1.0
+        if now >= state["next_renew"]:
+            state["next_renew"] = now + 1.0
             try:
-                r = await self.master.call(m.CltomaIoLimitRequest, timeout=5.0)
+                r = await self.master.call(
+                    m.CltomaIoLimitRequest, group=group, timeout=5.0
+                )
                 rate = float(r.bytes_per_sec)
-                self._io_limit_next_renew = now + r.renew_ms / 1000.0
+                state["next_renew"] = now + r.renew_ms / 1000.0
+                self.io_limits_active = bool(
+                    getattr(r, "limits_active", 0)
+                )
+                if r.subsystem != self._io_subsystem:
+                    # master names the cgroup hierarchy to classify in;
+                    # reclassify everyone under it from now on
+                    from lizardfs_tpu.client.io_limit_group import GroupCache
+
+                    self._io_subsystem = r.subsystem
+                    self._io_group_cache = GroupCache(r.subsystem)
                 if rate <= 0:
-                    self._io_bucket = None
-                elif self._io_bucket is None:
+                    state["bucket"] = None
+                elif state["bucket"] is None:
                     from lizardfs_tpu.runtime.limiter import TokenBucket
 
-                    self._io_bucket = TokenBucket(rate, burst=rate)
-                    self._io_bucket._tokens = 0.0  # pace from the start
+                    bucket = TokenBucket(rate, burst=rate)
+                    bucket._tokens = 0.0  # pace from the start
+                    state["bucket"] = bucket
                 else:
-                    self._io_bucket.rate = rate
-                    self._io_bucket.burst = rate
+                    state["bucket"].rate = rate
+                    state["bucket"].burst = rate
             except (ConnectionError, asyncio.TimeoutError, st.StatusError):
                 pass  # keep the previous allocation
-        if self._io_bucket is not None:
-            await self._io_bucket.acquire(nbytes)
+        if state["bucket"] is not None:
+            await state["bucket"].acquire(nbytes)
 
     def _uid(self, uid) -> int:
         return self.default_uid if uid is None else uid
@@ -192,6 +238,18 @@ class Client:
                 conn.on_push(
                     m.MatoclCacheInvalidate, self._on_cache_invalidate
                 )
+                # one-shot probe: fast paths (FUSE native reads) need to
+                # know AT MOUNT TIME whether any IO limit is configured
+                # — a read-only workload would otherwise never learn
+                try:
+                    r = await conn.call(
+                        m.CltomaIoLimitRequest, group="", timeout=5.0
+                    )
+                    self.io_limits_active = bool(
+                        getattr(r, "limits_active", 0)
+                    )
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
                 return
             except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
                 last = e

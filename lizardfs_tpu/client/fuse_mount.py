@@ -216,7 +216,35 @@ class LizardFuse:
         self._run(self.proxy.start())
 
     def _run(self, coro, timeout: float = 60.0):
-        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+        # capture the kernel caller's pid HERE (fuse_get_context is only
+        # valid on the callback thread) and carry it into the coroutine:
+        # the client throttles IO under the caller's cgroup limit group
+        # (reference: src/mount/io_limit_group.cc classification)
+        pid = self._caller_pid()
+
+        async def _with_caller():
+            from lizardfs_tpu.client.client import IO_CALLER_PID
+
+            token = IO_CALLER_PID.set(pid)
+            try:
+                return await coro
+            finally:
+                IO_CALLER_PID.reset(token)
+
+        return asyncio.run_coroutine_threadsafe(
+            _with_caller(), self.loop
+        ).result(timeout)
+
+    def _caller_pid(self) -> int | None:
+        if self.libfuse is None:
+            return None
+        try:
+            ctx = self.libfuse.fuse_get_context()
+            if ctx:
+                return int(ctx.contents.pid) or None
+        except Exception:  # noqa: BLE001
+            pass
+        return None
 
     # --- helpers ----------------------------------------------------------
 
@@ -459,7 +487,13 @@ class LizardFuse:
                 return len(piece)
             inode = fi.contents.fh or self._resolve(path).inode
             data = None
-            if self._native_reads is not None:
+            # the native pool cannot classify callers or pace, so it
+            # stands down while ANY cluster IO limit is active — every
+            # byte must pass the client's group throttle
+            if (
+                self._native_reads is not None
+                and not self.client.io_limits_active
+            ):
                 data = self._native_reads.read(inode, offset, size)
             if data is None:  # striped/degraded or pool busy: planner path
                 data = self._run(self.client.read_file(inode, offset, size))

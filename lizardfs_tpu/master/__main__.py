@@ -1,7 +1,9 @@
 """Run the master daemon: python -m lizardfs_tpu.master [config]
 
 Config keys (KEY = VALUE, mfsmaster.cfg analog): DATA_PATH, LISTEN_HOST,
-LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), LOG_LEVEL,
+LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), IO_LIMIT_BPS
+(global bytes/s budget), IO_LIMITS_CFG (mfsiolimits.cfg-style per-cgroup
+budgets: `subsystem X` + `limit <group> <bps>` lines), LOG_LEVEL,
 HEALTH_INTERVAL, IMAGE_INTERVAL, PERSONALITY (master|shadow),
 ACTIVE_MASTER (host:port, required for shadow), and optional election:
 ELECTION_ID, ELECTION_LISTEN (host:port), ELECTION_PEERS
@@ -45,6 +47,14 @@ async def _run(cfg: Config) -> None:
 
         with open(topology_path) as f:
             topology = Topology.load(f.read())
+    # per-cgroup IO limits (mfsiolimits.cfg analog)
+    io_limit_subsystem, io_limits = "", None
+    iolimits_path = cfg.get_str("IO_LIMITS_CFG", "")
+    if iolimits_path:
+        from lizardfs_tpu.utils.io_limits import parse_limits_cfg
+
+        with open(iolimits_path) as f:
+            io_limit_subsystem, io_limits = parse_limits_cfg(f.read())
     server = MasterServer(
         data_dir=cfg.get_str("DATA_PATH", "./master-data"),
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
@@ -57,6 +67,8 @@ async def _run(cfg: Config) -> None:
         exports=exports,
         topology=topology,
         io_limit_bps=cfg.get_int("IO_LIMIT_BPS", 0),
+        io_limit_subsystem=io_limit_subsystem,
+        io_limits=io_limits,
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
         lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0),
     )

@@ -115,6 +115,8 @@ class MasterServer(Daemon):
         exports=None,
         topology=None,
         io_limit_bps: int = 0,
+        io_limits: dict[str, int] | None = None,
+        io_limit_subsystem: str = "",
         admin_password: str | None = None,
         lock_grace_seconds: float = 30.0,
     ):
@@ -169,7 +171,14 @@ class MasterServer(Daemon):
         # global IO budget (bytes/s, 0 = unlimited) divided among the
         # sessions that renewed an allocation recently
         self.io_limit_bps = io_limit_bps
-        self._io_limited_sessions: dict[int, float] = {}  # sid -> last renew
+        # per-cgroup budgets (mfsiolimits.cfg analog, reference
+        # src/mount/io_limit_group.cc + globaliolimits): group path ->
+        # bytes/s; each group's budget is divided among the sessions
+        # renewing UNDER that group. Takes precedence over io_limit_bps.
+        self.io_limits = dict(io_limits or {})
+        self.io_limit_subsystem = io_limit_subsystem
+        # (sid, resolved group) -> last renew  (legacy global: group "")
+        self._io_limited_sessions: dict[tuple[int, str], float] = {}
         # personality: "master" (active) or "shadow" (applies the
         # changelog stream from active_addr; promotable at runtime)
         # (src/master/personality.h:25-69 analog)
@@ -611,6 +620,18 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=code, attr=_null_attr()
             )
         return m.MatoclStatusReply(req_id=msg.req_id, status=code)
+
+    def _io_limit_share(self, session_id: int, group: str, bps: int) -> int:
+        """Equal share of ``group``'s budget among its sessions that
+        renewed in the last 5 s (globaliolimits allocation model)."""
+        mono = time.monotonic()
+        self._io_limited_sessions[(session_id, group)] = mono
+        self._io_limited_sessions = {
+            k: ts for k, ts in self._io_limited_sessions.items()
+            if mono - ts < 5.0
+        }
+        n = sum(1 for (_sid, g) in self._io_limited_sessions if g == group)
+        return bps // max(n, 1)
 
     def _check_quota(self, dir_inode: int, uid: int, gid: int,
                      d_inodes: int, d_bytes: int) -> None:
@@ -1090,26 +1111,39 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK if ok else st.EACCES
             )
         if isinstance(msg, m.CltomaIoLimitRequest):
+            active = 1 if (self.io_limits or self.io_limit_bps > 0) else 0
+            if self.io_limits:
+                # per-cgroup budgets: resolve the claimed group to its
+                # closest configured ancestor, then share that group's
+                # budget among the sessions renewing under it
+                from lizardfs_tpu.utils.io_limits import (
+                    UNCLASSIFIED, resolve_limit,
+                )
+
+                key, bps = resolve_limit(
+                    msg.group or UNCLASSIFIED, self.io_limits
+                )
+                if bps <= 0:
+                    return m.MatoclIoLimitReply(
+                        req_id=msg.req_id, status=st.OK, bytes_per_sec=0,
+                        renew_ms=10_000, subsystem=self.io_limit_subsystem,
+                        limits_active=active,
+                    )
+                share = self._io_limit_share(session_id, key, bps)
+                return m.MatoclIoLimitReply(
+                    req_id=msg.req_id, status=st.OK, bytes_per_sec=share,
+                    renew_ms=1000, subsystem=self.io_limit_subsystem,
+                    limits_active=active,
+                )
             if self.io_limit_bps <= 0:
                 return m.MatoclIoLimitReply(
                     req_id=msg.req_id, status=st.OK, bytes_per_sec=0,
-                    renew_ms=10_000,
+                    renew_ms=10_000, subsystem="", limits_active=0,
                 )
-            mono = time.monotonic()
-            self._io_limited_sessions[session_id] = mono
-            # equal shares among sessions that renewed in the last 5 s
-            live = {
-                sid for sid, ts in self._io_limited_sessions.items()
-                if mono - ts < 5.0
-            }
-            self._io_limited_sessions = {
-                sid: ts for sid, ts in self._io_limited_sessions.items()
-                if sid in live
-            }
-            share = self.io_limit_bps // max(len(live), 1)
+            share = self._io_limit_share(session_id, "", self.io_limit_bps)
             return m.MatoclIoLimitReply(
                 req_id=msg.req_id, status=st.OK, bytes_per_sec=share,
-                renew_ms=1000,
+                renew_ms=1000, subsystem="", limits_active=1,
             )
         if isinstance(msg, m.CltomaTrashList):
             rows = [

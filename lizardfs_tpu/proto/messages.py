@@ -554,19 +554,35 @@ class CltomaAccess(Message):
 
 class CltomaIoLimitRequest(Message):
     """Request/renew a bandwidth allocation (globaliolimits analog:
-    the master divides the cluster budget among limited sessions)."""
+    the master divides the cluster budget among limited sessions).
+
+    ``group`` is the requester's cgroup limit group (reference:
+    src/mount/io_limit_group.cc classification); "" means
+    unclassified. With per-group limits configured, the master matches
+    the group against its configured prefixes and divides that group's
+    budget among the sessions renewing under it."""
 
     MSG_TYPE = 1062
-    FIELDS = (("req_id", "u32"),)
+    FIELDS = (("req_id", "u32"), ("group", "str"))
 
 
 class MatoclIoLimitReply(Message):
+    """``subsystem`` tells clients which cgroup hierarchy to classify
+    callers with ("" = v2 unified / classification off) — served from
+    master config so mounts need no local limits file."""
+
     MSG_TYPE = 1063
     FIELDS = (
         ("req_id", "u32"),
         ("status", "u8"),
-        ("bytes_per_sec", "u64"),  # 0 = unlimited
+        ("bytes_per_sec", "u64"),  # 0 = unlimited (for THIS group)
         ("renew_ms", "u32"),
+        ("subsystem", "str"),
+        # 1 if ANY limit is configured cluster-wide: consumers with
+        # unthrottled fast paths (FUSE native read pool) must route
+        # through the throttled path whenever this is set — their own
+        # group being unlimited says nothing about their callers'
+        ("limits_active", "u8"),
     )
 
 

@@ -1,0 +1,155 @@
+"""cgroup IO limit groups (reference: src/mount/io_limit_group.cc
+classification + src/common/io_limits_config_loader.cc config +
+globaliolimits allocation): callers are classified by cgroup path and
+throttled under per-group budgets the master divides among sessions."""
+
+import asyncio
+
+import pytest
+
+from lizardfs_tpu.client import io_limit_group as ilg
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import IO_CALLER_PID, Client
+from lizardfs_tpu.master.server import MasterServer
+
+pytestmark = pytest.mark.asyncio
+
+
+def _write_proc(tmp_path, pid, content):
+    d = tmp_path / str(pid)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "cgroup").write_text(content)
+
+
+def test_read_cgroup_v2_and_v1(tmp_path):
+    _write_proc(tmp_path, 100, "0::/containers/web\n")
+    _write_proc(
+        tmp_path, 101,
+        "12:blkio:/batch/jobs\n11:cpu,cpuacct:/other\n0::/unified\n",
+    )
+    _write_proc(tmp_path, 102, "garbage\n")
+    root = str(tmp_path)
+    assert ilg.read_cgroup(100, "", root) == "/containers/web"
+    assert ilg.read_cgroup(101, "blkio", root) == "/batch/jobs"
+    assert ilg.read_cgroup(101, "cpu", root) == "/other"
+    assert ilg.read_cgroup(101, "", root) == "/unified"
+    assert ilg.read_cgroup(102, "", root) == ilg.UNCLASSIFIED
+    assert ilg.read_cgroup(99999, "", root) == ilg.UNCLASSIFIED  # no /proc
+
+
+def test_group_cache_ttl_and_recycling(tmp_path):
+    _write_proc(tmp_path, 200, "0::/a\n")
+    cache = ilg.GroupCache("", ttl=1000.0, proc_root=str(tmp_path))
+    assert cache.classify(200) == "/a"
+    # classification is cached: a changed file is NOT re-read inside ttl
+    _write_proc(tmp_path, 200, "0::/b\n")
+    assert cache.classify(200) == "/a"
+    cache._cache[200] = ("/a", 0.0)  # force expiry
+    assert cache.classify(200) == "/b"
+
+
+def test_parse_limits_cfg():
+    sub, limits = ilg.parse_limits_cfg(
+        "# comment\nsubsystem blkio\nlimit unclassified 1024\n"
+        "limit /containers/web 10240\n\n"
+    )
+    assert sub == "blkio"
+    assert limits == {"unclassified": 1024, "/containers/web": 10240}
+    with pytest.raises(ValueError):
+        ilg.parse_limits_cfg("limit too many fields here\n")
+
+
+def test_resolve_limit_ancestor_walk():
+    limits = {"/containers": 100, "unclassified": 7}
+    assert ilg.resolve_limit("/containers/web/a", limits) == ("/containers", 100)
+    assert ilg.resolve_limit("/containers", limits) == ("/containers", 100)
+    assert ilg.resolve_limit("/elsewhere", limits) == ("unclassified", 7)
+    assert ilg.resolve_limit("unclassified", limits) == ("unclassified", 7)
+    # no unclassified entry -> unlimited
+    assert ilg.resolve_limit("/x", {"/y": 5}) == ("unclassified", 0)
+
+
+async def test_per_group_budgets_enforced(tmp_path, monkeypatch):
+    """Two clients in different (faked) cgroups each get their own
+    group's budget — not shares of one global pool."""
+    master = MasterServer(
+        str(tmp_path / "m"),
+        io_limits={"/fast": 50_000_000, "/slow": 1_000_000},
+        io_limit_subsystem="",
+    )
+    await master.start()
+    cs = ChunkServer(str(tmp_path / "cs"),
+                     master_addr=("127.0.0.1", master.port))
+    await cs.start()
+
+    def classify_as(group):
+        class _Fake:
+            def classify(self, pid):
+                return group
+        return _Fake()
+
+    a = Client("127.0.0.1", master.port)
+    b = Client("127.0.0.1", master.port)
+    await a.connect("fast-client")
+    await b.connect("slow-client")
+    a._io_group_cache = classify_as("/fast")
+    b._io_group_cache = classify_as("/slow")
+    try:
+        fa = await a.create(1, "fast.bin")
+        fb = await b.create(1, "slow.bin")
+        payload = b"q" * 500_000
+
+        import time
+        t0 = time.monotonic()
+        await a.write_file(fa.inode, payload)
+        fast_t = time.monotonic() - t0
+        t0 = time.monotonic()
+        await b.write_file(fb.inode, payload)
+        slow_t = time.monotonic() - t0
+        # 500 KB at 1 MB/s >= 0.25s; at 50 MB/s it is wire-bound (<2s
+        # even on a loaded box). The ORDER is the assertion, not the
+        # absolute times.
+        assert slow_t >= 0.25, f"slow group not throttled ({slow_t:.2f}s)"
+        assert fast_t < slow_t, (fast_t, slow_t)
+        # both buckets exist independently with their group's rate
+        rates = sorted(
+            s["bucket"].rate
+            for c in (a, b)
+            for s in c._io_groups.values()
+            if s["bucket"] is not None
+        )
+        assert rates == [1_000_000, 50_000_000]
+    finally:
+        await a.close()
+        await b.close()
+        await cs.stop()
+        await master.stop()
+
+
+async def test_caller_pid_contextvar_routes_group(tmp_path):
+    """IO_CALLER_PID (set by FUSE per kernel caller) selects the group
+    the throttle classifies under."""
+    _write_proc(tmp_path, 7777, "0::/tenant-a\n")
+    master = MasterServer(
+        str(tmp_path / "m"), io_limits={"/tenant-a": 2_000_000},
+    )
+    await master.start()
+    cs = ChunkServer(str(tmp_path / "cs"),
+                     master_addr=("127.0.0.1", master.port))
+    await cs.start()
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    c._io_group_cache = ilg.GroupCache("", proc_root=str(tmp_path))
+    try:
+        f = await c.create(1, "t.bin")
+        token = IO_CALLER_PID.set(7777)
+        try:
+            await c.write_file(f.inode, b"x" * 100_000)
+        finally:
+            IO_CALLER_PID.reset(token)
+        assert "/tenant-a" in c._io_groups
+        assert c._io_groups["/tenant-a"]["bucket"].rate == 2_000_000
+    finally:
+        await c.close()
+        await cs.stop()
+        await master.stop()

@@ -10,154 +10,13 @@ import struct
 
 import pytest
 
-from lizardfs_tpu.nfs import rpc
 from lizardfs_tpu.nfs import server as nfs
+from lizardfs_tpu.nfs.client import Nfs3Client
 from lizardfs_tpu.nfs.xdr import Packer
 
 from tests.test_cluster import Cluster
 
 pytestmark = pytest.mark.asyncio
-
-
-class Nfs3Client:
-    """Minimal NFS3 wire client for the tests."""
-
-    def __init__(self, host: str, port: int, uid: int = 0, gid: int = 0):
-        self.rpc = rpc.RpcClient(
-            host, port, rpc.Credential(uid=uid, gid=gid, machine="test")
-        )
-
-    async def __aenter__(self):
-        await self.rpc.connect()
-        return self
-
-    async def __aexit__(self, *exc):
-        await self.rpc.close()
-
-    async def mnt(self, path: str = "/") -> bytes:
-        u = await self.rpc.call(nfs.PROG_MOUNT, 3, 1, Packer().string(path).bytes())
-        assert u.u32() == nfs.NFS3_OK
-        fh = u.opaque(64)
-        nflavors = u.u32()
-        flavors = [u.u32() for _ in range(nflavors)]
-        assert rpc.AUTH_SYS in flavors
-        return fh
-
-    async def call(self, proc: int, args: bytes):
-        return await self.rpc.call(nfs.PROG_NFS, 3, proc, args)
-
-    @staticmethod
-    def skip_post_op(u):
-        if u.boolean():
-            u.fixed(84)
-
-    @staticmethod
-    def read_fattr(u) -> dict:
-        ftype, mode, nlink, uid, gid = (u.u32() for _ in range(5))
-        size, used = u.u64(), u.u64()
-        u.u32(), u.u32(), u.u64()
-        fileid = u.u64()
-        times = [(u.u32(), u.u32()) for _ in range(3)]
-        return dict(ftype=ftype, mode=mode, nlink=nlink, uid=uid, gid=gid,
-                    size=size, fileid=fileid, times=times)
-
-    @staticmethod
-    def skip_wcc(u):
-        if u.boolean():
-            u.fixed(24)
-        Nfs3Client.skip_post_op(u)
-
-    async def lookup(self, dirfh: bytes, name: str):
-        u = await self.call(3, Packer().opaque(dirfh).string(name).bytes())
-        code = u.u32()
-        if code != nfs.NFS3_OK:
-            return code, None, None
-        fh = u.opaque(64)
-        attr = None
-        if u.boolean():
-            attr = self.read_fattr(u)
-        return nfs.NFS3_OK, fh, attr
-
-    async def getattr(self, fh: bytes) -> dict:
-        u = await self.call(1, Packer().opaque(fh).bytes())
-        assert u.u32() == nfs.NFS3_OK
-        return self.read_fattr(u)
-
-    async def mkdir(self, dirfh: bytes, name: str, mode: int = 0o755) -> bytes:
-        args = (Packer().opaque(dirfh).string(name)
-                .boolean(True).u32(mode)  # mode
-                .boolean(False).boolean(False).boolean(False)  # uid/gid/size
-                .u32(0).u32(0)  # atime/mtime: don't change
-                .bytes())
-        u = await self.call(9, args)
-        assert u.u32() == nfs.NFS3_OK
-        assert u.boolean()
-        return u.opaque(64)
-
-    async def create(self, dirfh: bytes, name: str, mode: int = 0o644,
-                     how: int = 0, verf: bytes = b"\x00" * 8):
-        p = Packer().opaque(dirfh).string(name).u32(how)
-        if how == 2:
-            p.fixed(verf)
-        else:
-            (p.boolean(True).u32(mode)
-             .boolean(False).boolean(False).boolean(False)
-             .u32(0).u32(0))
-        u = await self.call(8, p.bytes())
-        code = u.u32()
-        if code != nfs.NFS3_OK:
-            return code, None
-        assert u.boolean()
-        return nfs.NFS3_OK, u.opaque(64)
-
-    async def write(self, fh: bytes, offset: int, data: bytes,
-                    expect=nfs.NFS3_OK) -> int:
-        args = (Packer().opaque(fh).u64(offset).u32(len(data)).u32(2)
-                .opaque(data).bytes())
-        u = await self.call(7, args)
-        code = u.u32()
-        assert code == expect, f"WRITE -> {code}"
-        if code != nfs.NFS3_OK:
-            return 0
-        self.skip_wcc(u)
-        n = u.u32()
-        assert u.u32() == 2  # FILE_SYNC
-        return n
-
-    async def read(self, fh: bytes, offset: int, count: int) -> tuple[bytes, bool]:
-        u = await self.call(6, Packer().opaque(fh).u64(offset).u32(count).bytes())
-        assert u.u32() == nfs.NFS3_OK
-        self.skip_post_op(u)
-        n = u.u32()
-        eof = u.boolean()
-        data = u.opaque(1 << 22)
-        assert len(data) == n
-        return data, eof
-
-    async def readdir(self, dirfh: bytes, plus: bool = False,
-                      maxcount: int = 4096) -> list[str]:
-        names, cookie, verf = [], 0, b"\x00" * 8
-        while True:
-            p = Packer().opaque(dirfh).u64(cookie).fixed(verf)
-            if plus:
-                p.u32(1 << 16)
-            p.u32(maxcount)
-            u = await self.call(17 if plus else 16, p.bytes())
-            assert u.u32() == nfs.NFS3_OK
-            self.skip_post_op(u)
-            verf = u.fixed(8)  # cookieverf
-            got = 0
-            while u.boolean():
-                u.u64()  # fileid
-                names.append(u.string(255))
-                cookie = u.u64()
-                if plus:
-                    self.skip_post_op(u)
-                    if u.boolean():
-                        u.opaque(64)
-                got += 1
-            if u.boolean() or got == 0:  # eof
-                return names
 
 
 async def gateway_cluster(tmp_path):
@@ -388,4 +247,43 @@ async def test_nfs_symlink_link_and_errors(tmp_path):
             assert code == nfs.NFS3ERR_EXIST
     finally:
         await gw.stop()
+        await cluster.stop()
+
+
+async def test_nfs_multi_gateway_coherence(tmp_path):
+    """The documented NFS scale-out model: N stateless gateways over one
+    cluster. A write through gateway A must be visible through gateway B
+    well inside the client-cache TTL (the master pushes invalidations to
+    every gateway session — doc/migration.md "NFS scale-out")."""
+    import asyncio
+
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    gw_a = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    gw_b = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw_a.start()
+    await gw_b.start()
+    try:
+        async with Nfs3Client("127.0.0.1", gw_a.port) as a, \
+                Nfs3Client("127.0.0.1", gw_b.port) as b:
+            root_a = await a.mnt("/")
+            root_b = await b.mnt("/")
+            code, fh_a = await a.create(root_a, "shared.txt")
+            assert code == nfs.NFS3_OK
+            await a.write(fh_a, 0, b"from-gateway-A!!" * 4096)  # 64 KiB
+            # B sees the file and its content
+            code, fh_b, _ = await b.lookup(root_b, "shared.txt")
+            assert code == nfs.NFS3_OK
+            got, _ = await b.read(fh_b, 0, 16)
+            assert got == b"from-gateway-A!!"
+            # B rewrites; A re-reads within 1 s and must see fresh bytes
+            # (before master-push invalidation, A could serve stale
+            # cached blocks for the full 3 s TTL)
+            await b.write(fh_b, 0, b"B-OVERWROTE-THIS")
+            await asyncio.sleep(0.3)
+            got, _ = await a.read(fh_a, 0, 16)
+            assert got == b"B-OVERWROTE-THIS"
+    finally:
+        await gw_a.stop()
+        await gw_b.stop()
         await cluster.stop()

@@ -259,7 +259,10 @@ async def test_global_io_limits(tmp_path):
         await c.write_file(f.inode, payload)
         elapsed = time.monotonic() - t0
         assert elapsed >= 0.25, f"write not throttled ({elapsed:.2f}s)"
-        assert c._io_bucket is not None and c._io_bucket.rate == 2_000_000
+        bucket = next(
+            s["bucket"] for s in c._io_groups.values() if s["bucket"]
+        )
+        assert bucket.rate == 2_000_000
     finally:
         await c.close()
         for cs in servers:
