@@ -67,15 +67,34 @@ def tpu_throughput(k: int = K, m: int = M,
         )
     )
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def loop(bigm, x, n):
-        def body(i, x):
-            p, dc, pc = fused(bigm, x, BLOCK)
-            mix = (dc.sum(dtype=jnp.uint32) ^ pc.sum(dtype=jnp.uint32)) & 0xFF
-            x = x.at[:m, :].set(x[:m, :] ^ p)
-            return x.at[0, 0].set(x[0, 0] ^ mix.astype(jnp.uint8))
+    def make_loop(fused_call):
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def loop(bigm, x, n):
+            def body(i, x):
+                p, dc, pc = fused_call(bigm, x, BLOCK)
+                mix = (
+                    dc.sum(dtype=jnp.uint32) ^ pc.sum(dtype=jnp.uint32)
+                ) & 0xFF
+                x = x.at[:m, :].set(x[:m, :] ^ p)
+                return x.at[0, 0].set(x[0, 0] ^ mix.astype(jnp.uint8))
 
-        return jax.lax.fori_loop(0, n, body, x).sum(dtype=jnp.int32)
+            return jax.lax.fori_loop(0, n, body, x).sum(dtype=jnp.int32)
+
+        return loop
+
+    # try the grid-step-halving residency first (ROOFLINE #1); its VMEM
+    # model is unverified on silicon, so a compile failure downgrades —
+    # LOUDLY and tagged — to the r01-verified default config
+    global KERNEL_CONFIG_USED
+    if fused is jax_ec.fused_encode_crc:
+        big = None  # CPU fallback path has no tile knob
+        KERNEL_CONFIG_USED = "jax-cpu"
+    else:
+        from lizardfs_tpu.ops.pallas_ec import BIG_TILE_CONFIG
+
+        big = functools.partial(fused, **BIG_TILE_CONFIG)
+        KERNEL_CONFIG_USED = "big-tile-64K/11.5M"
+    loop = make_loop(big if big is not None else fused)
 
     def timed(n):
         t0 = time.perf_counter()
@@ -85,7 +104,21 @@ def tpu_throughput(k: int = K, m: int = M,
     import statistics
 
     L = 16
-    timed(1)  # compile L=1
+    try:
+        timed(1)  # compile L=1
+    except Exception as e:  # noqa: BLE001 — Mosaic VMEM overrun fails fast
+        if big is None:
+            raise  # no alternate config to try — real error
+        import sys
+
+        print(
+            f"big-tile kernel config failed to compile ({str(e)[:160]}); "
+            "falling back to verified 16K/10M",
+            file=sys.stderr,
+        )
+        KERNEL_CONFIG_USED = "verified-16K/10M (big-tile fallback)"
+        loop = make_loop(fused)
+        timed(1)
     timed(L)  # compile L=16
     vals, totals = [], []
     # several measurement rounds: the first reads low until clocks and
@@ -289,11 +322,15 @@ def cluster_throughput() -> dict:
         return {"cluster_error": str(e)[:200]}
 
 
+KERNEL_CONFIG_USED = ""  # set by tpu_throughput; shipped via the queue
+
+
 def _tpu_worker(q):
     try:
         # the headline row lands on the queue FIRST so a later hang in
         # the optional rows can't discard it
         q.put(("ok", tpu_throughput()))
+        q.put(("cfg", KERNEL_CONFIG_USED))
     except Exception as e:  # noqa: BLE001
         q.put(("err", str(e)[:200]))
         return
@@ -404,6 +441,10 @@ def main():
             "tpu_error": tpu_err,
         }
     row["tpu_attempts"] = attempts
+    if "cfg" in tpu_rows:
+        # which kernel residency actually compiled (ROOFLINE #1): a
+        # fallback here means the big-tile config overran real VMEM
+        row["kernel_config"] = tpu_rows["cfg"]
     if "wide" in tpu_rows:
         row["ec32_8_single_chip_MiBps"] = round(tpu_rows["wide"], 1)
     # BASELINE config 4: reconstruct-1-shard latency. CPU row always

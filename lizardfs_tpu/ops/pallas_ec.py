@@ -325,7 +325,15 @@ def _fused_kernel(bigm_ref, w_ref, shifts_ref, seld_ref, selp_ref,
     preg_ref[:] = _chunk_registers(parity, w_ref, shifts_ref, selp_ref, group)
 
 
-_FUSED_VMEM_BUDGET = 10 * 2**20  # conservative vs ~16 MiB physical VMEM
+# Silicon-verified default (r01). The bigger-tile/bigger-budget config
+# below halves per-chunk grid steps (benches/ROOFLINE.md #1) but its
+# VMEM model is unverified on hardware, so production callers keep the
+# proven residency; bench.py opts into BIG_TILE_CONFIG first and tags
+# its JSON with whichever config actually compiled.
+_FUSED_VMEM_BUDGET = 10 * 2**20
+# 11.5 MiB of ~16 MiB physical: ec(8,4) fits tile=32 KiB (10.1 MiB ->
+# 256 steps/chunk, 2x fewer), ec(3,2) a full 64 KiB block
+BIG_TILE_CONFIG = {"tile": 65536, "vmem_budget": 11_534_336}
 
 
 @functools.partial(
@@ -343,6 +351,12 @@ def fused_encode_crc(
 
     (k, N) uint8 -> (parity (m, N) uint8, dcrc (k, nb) u32, pcrc (m, nb)
     u32), byte-identical to jax_ec.fused_encode_crc / the golden codec.
+
+    ``tile`` shrinks until it fits the VMEM budget, divides the block
+    size, and divides N. Defaults are the silicon-verified residency;
+    pass ``**BIG_TILE_CONFIG`` to halve per-chunk grid steps (the
+    measured cost in benches/ROOFLINE.md #1) once a live chip can
+    verify the bigger footprint.
     """
     if interpret is None:
         interpret = not supported()  # CPU backend: interpret mode
@@ -350,7 +364,8 @@ def fused_encode_crc(
     m = bigm.shape[0] // 8
     rows = k + m
     while tile > 2 * CRC_SUB and (
-        _fused_vmem_bytes(k, m, tile) > vmem_budget or block_size % tile
+        _fused_vmem_bytes(k, m, tile) > vmem_budget
+        or block_size % tile or n % tile
     ):
         tile //= 2
     if n % tile:

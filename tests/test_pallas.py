@@ -103,3 +103,35 @@ def test_pallas_fused_decode_verify():
         np.asarray(big_rec), survivors, bad, bs
     )
     assert not bool(np.asarray(ok2)[0, 0]) and bool(np.asarray(ok2)[1, 1])
+
+
+@pytest.mark.parametrize("tile", [32768, 65536])
+def test_pallas_fused_large_tiles_byte_identical(tile):
+    """The grid-step reduction (benches/ROOFLINE.md #1) runs the same
+    kernel at 32/64 KiB tiles — bytes must not depend on tile size."""
+    rng = np.random.default_rng(7)
+    k, m, bs = 8, 4, 65536
+    data = rng.integers(0, 256, size=(k, 2 * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    p, dc, pc = pe.fused_encode_crc(
+        bigm, data, bs, tile=tile, vmem_budget=64 * 2**20
+    )
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(p), wp)
+    np.testing.assert_array_equal(np.asarray(dc), wd)
+    np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+def test_pallas_default_tile_shrinks_to_fit():
+    """Default args must keep working for every supported geometry and
+    for N smaller than the starting tile (the shrink loop now also
+    respects N-divisibility)."""
+    rng = np.random.default_rng(8)
+    for k, m, bs, nb in ((8, 4, 16384, 2), (3, 2, 8192, 3), (8, 2, 65536, 1)):
+        data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+        bigm = jax_ec.encoding_bitmatrix(k, m)
+        p, dc, pc = pe.fused_encode_crc(bigm, data, bs)
+        wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+        np.testing.assert_array_equal(np.asarray(p), wp)
+        np.testing.assert_array_equal(np.asarray(dc), wd)
+        np.testing.assert_array_equal(np.asarray(pc), wpc)
