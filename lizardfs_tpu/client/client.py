@@ -853,14 +853,57 @@ class Client:
         if slice_type is None:
             raise st.StatusError(st.NO_CHUNK_SERVERS, "no locations granted")
 
-        def send_of(part_idx: int, payload: np.ndarray):
+        def send_of(part_idx: int, payload: np.ndarray,
+                    skip_throttle: bool = False):
             length = striping.part_length(
                 slice_type, part_idx, len(chunk_data)
             )
             return self._write_part(
                 grant.chunk_id, grant.version, by_part[part_idx],
-                payload, length,
+                payload, length, skip_throttle=skip_throttle,
             )
+
+        async def send_batch(items: list[tuple[int, np.ndarray]]) -> None:
+            """Write several whole parts: ONE native poll-driven call
+            when every part has a single holder (no relay chain),
+            per-part sends otherwise or on native failure."""
+            from lizardfs_tpu.core import native_io
+
+            items = [(p, pay) for p, pay in items if p in by_part]
+            if not items:
+                return
+            if (
+                native_io.parts_scatter_available()
+                and len(items) > 1
+                and all(len(by_part[p]) == 1 for p, _ in items)
+            ):
+                lengths = [
+                    striping.part_length(slice_type, p, len(chunk_data))
+                    for p, _ in items
+                ]
+                await self._throttle(sum(lengths))
+                try:
+                    await native_io.run(
+                        native_io.write_parts_scatter_blocking,
+                        [(by_part[p][0].addr.host, by_part[p][0].addr.port)
+                         for p, _ in items],
+                        grant.chunk_id, grant.version,
+                        [by_part[p][0].part_id for p, _ in items],
+                        [pay for _, pay in items], lengths,
+                    )
+                    self._record("parts_scatter_write")
+                    return
+                except (native_io.NativeIOError, OSError,
+                        ConnectionError, st.StatusError):
+                    self._record("parts_scatter_fallback")
+                    # fall through per-part — bytes were already
+                    # charged to the throttle above, don't pay twice
+                    await asyncio.gather(*(
+                        send_of(p, pay, skip_throttle=True)
+                        for p, pay in items
+                    ))
+                    return
+            await asyncio.gather(*(send_of(p, pay) for p, pay in items))
 
         if slice_type.is_standard or slice_type.is_tape:
             # whole-chunk copies: stream the caller's buffer directly
@@ -894,18 +937,14 @@ class Client:
             return {d + j: p for j, p in enumerate(par)}
 
         par_task = asyncio.ensure_future(parity_parts())
-        tasks = [
-            asyncio.ensure_future(send_of(first + i, stacked[i]))
-            for i in range(d)
-            if first + i in by_part
-        ]
+        tasks = [asyncio.ensure_future(
+            send_batch([(first + i, stacked[i]) for i in range(d)])
+        )]
         try:
             par = await par_task
-            tasks += [
-                asyncio.ensure_future(send_of(p, payload))
-                for p, payload in par.items()
-                if p in by_part
-            ]
+            tasks.append(asyncio.ensure_future(
+                send_batch(sorted(par.items()))
+            ))
             for t in tasks:
                 await t
         finally:
@@ -946,12 +985,16 @@ class Client:
         payload: np.ndarray,
         length: int,
         part_offset: int = 0,
+        skip_throttle: bool = False,
     ) -> None:
         """Write ``payload[:length]`` at ``part_offset`` within one part:
         head of the chain + forwarding for extra copies (WriteExecutor
         analog, write_executor.cc:66-96). Pieces never cross 64 KiB block
-        boundaries; each carries its own CRC."""
-        await self._throttle(max(length, 0))
+        boundaries; each carries its own CRC. ``skip_throttle``: the
+        caller already charged these bytes (QoS rule: charge once, not
+        per retry/fallback)."""
+        if not skip_throttle:
+            await self._throttle(max(length, 0))
         head = locs[0]
         chain = locs[1:]
 

@@ -74,6 +74,16 @@ if _lib is not None:
             _lib.lz_read_parts_gather.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: the whole-stripe fast path stays off
+        try:
+            _lib.lz_write_parts_scatter.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_uint32,
+            ]
+            _lib.lz_write_parts_scatter.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: multi-part write fast path stays off
     except AttributeError:
         _lib = None
 
@@ -508,3 +518,91 @@ def abort_parts_gather(cell: dict) -> None:
             sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
+
+
+def parts_scatter_available() -> bool:
+    return _lib is not None and hasattr(_lib, "lz_write_parts_scatter")
+
+
+def write_parts_scatter_blocking(
+    addrs: list[tuple[str, int]],
+    chunk_id: int,
+    version: int,
+    part_ids: list[int],
+    payloads: list[np.ndarray],
+    lengths: list[int],
+    part_offset: int = 0,
+) -> None:
+    """Write n whole parts (one bulk frame + ack each) in ONE
+    poll-driven native exchange — the write-path mirror of
+    read_parts_gather_blocking: one executor thread and one C call
+    (which also runs the per-block CRC pass) replace n of each. The
+    WriteInit/WriteEnd handshakes stay in Python framing (they carry
+    the variable-length chain list). Raises NativeIOError on the first
+    failing part; the caller falls back to per-part writes."""
+    n = len(addrs)
+    assert n == len(part_ids) == len(payloads) == len(lengths)
+    for attempt in (0, 1):
+        reqs = (_PartReq * n)()
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        socks: list[tuple[tuple[str, int], socket.socket]] = []
+        try:
+            # init handshakes: send ALL requests first, then collect
+            # replies — serialized request/response per socket would
+            # pay n round trips instead of ~1
+            for i, addr in enumerate(addrs):
+                s = (POOL.acquire(addr) if attempt == 0
+                     else _blocking_socket(addr, 60.0))
+                socks.append((addr, s))
+                s.sendall(framing.encode(m.CltocsWriteInit(
+                    req_id=1, chunk_id=chunk_id, version=version,
+                    part_id=part_ids[i], chain=[], create=False,
+                )))
+            for i, (_, s) in enumerate(socks):
+                init = _recv_message(s)
+                if (not isinstance(init, m.CstoclWriteStatus)
+                        or init.status != st.OK):
+                    raise NativeIOError(
+                        getattr(init, "status", -2), "write init"
+                    )
+                buf = payloads[i]
+                assert buf.flags.c_contiguous and buf.nbytes >= lengths[i]
+                reqs[i].fd = s.fileno()
+                reqs[i].chunk_id = chunk_id
+                reqs[i].version = 1  # carries the bulk write_id
+                reqs[i].part_id = part_ids[i]
+                reqs[i].rc = 0
+                ptrs[i] = buf.ctypes.data_as(ctypes.c_void_p).value
+                lens[i] = lengths[i]
+            rc = _lib.lz_write_parts_scatter(
+                ctypes.cast(reqs, ctypes.c_void_p), n, ptrs, lens,
+                part_offset, 120_000,
+            )
+            if rc == 0:
+                for _, s in socks:
+                    s.sendall(framing.encode(
+                        m.CltocsWriteEnd(req_id=0, chunk_id=chunk_id)
+                    ))
+                for _, s in socks:
+                    end = _recv_message(s)
+                    if (not isinstance(end, m.CstoclWriteStatus)
+                            or end.status != st.OK):
+                        raise NativeIOError(
+                            getattr(end, "status", -2), "write end"
+                        )
+                for addr, s in socks:
+                    POOL.release(addr, s)
+                socks.clear()
+                return
+            bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+            if attempt == 0 and bad == -1:
+                continue  # stale pooled sockets: redial everything once
+            raise NativeIOError(bad, "parts scatter write")
+        except (ConnectionError, OSError, st.StatusError):
+            if attempt == 0:
+                continue  # redial once (pool may hold staled sockets)
+            raise
+        finally:
+            for _, s in socks:
+                POOL.discard(s)

@@ -78,6 +78,50 @@ bool send_all(int fd, const uint8_t* buf, size_t len) {
     return true;
 }
 
+int64_t steady_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+constexpr uint32_t kTypeWriteBulk = 1214;
+
+// One bulk-write frame header (type 1214): fixed fields + per-block
+// CRC table + payload length. Shared by the single-part and the
+// multi-part scatter paths so the layout lives in exactly one place.
+void build_bulk_write_header(std::vector<uint8_t>& head, uint64_t chunk_id,
+                             uint32_t write_id, uint64_t part_offset,
+                             const uint8_t* payload, uint64_t len) {
+    const uint32_t ncrcs =
+        static_cast<uint32_t>((len + kBlockSize - 1) / kBlockSize);
+    head.resize(8 + 25 + 4 * ncrcs + 4);
+    const size_t body = head.size() - 8 + len;
+    put32(head.data(), kTypeWriteBulk);
+    put32(head.data() + 4, static_cast<uint32_t>(body));
+    head[8] = kProtoVersion;
+    put32(head.data() + 9, write_id);
+    put64(head.data() + 13, chunk_id);
+    put32(head.data() + 21, write_id);
+    put32(head.data() + 25, static_cast<uint32_t>(part_offset));
+    put32(head.data() + 29, ncrcs);
+    for (uint32_t b = 0; b < ncrcs; ++b) {
+        const uint64_t start = uint64_t(b) * kBlockSize;
+        const uint32_t piece = static_cast<uint32_t>(
+            std::min<uint64_t>(kBlockSize, len - start));
+        put32(head.data() + 33 + 4 * b, lz_crc32(0, payload + start, piece));
+    }
+    put32(head.data() + 33 + 4 * ncrcs, static_cast<uint32_t>(len));
+}
+
+// Validate a CstoclWriteStatus ack payload for a bulk write: returns
+// the peer status (0 = OK) or -2 on a protocol violation.
+int parse_bulk_write_ack(const uint8_t* pay, uint32_t len,
+                         uint32_t write_id) {
+    if (len < 18 || pay[0] != kProtoVersion) return -2;
+    if (get32(pay + 13) != write_id) return -2;
+    return pay[17];
+}
+
 bool recv_all(int fd, uint8_t* buf, size_t len) {
     while (len) {
         ssize_t n = ::recv(fd, buf, len, 0);
@@ -222,27 +266,10 @@ int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
 int lz_write_part_bulk(int fd, uint64_t chunk_id, const uint8_t* payload,
                        uint64_t len, uint64_t part_offset,
                        uint32_t write_id) {
-    constexpr uint32_t kTypeWriteBulk = 1214;
     if (part_offset % kBlockSize != 0 || len > (64u << 20)) return -2;
-    uint32_t ncrcs = static_cast<uint32_t>((len + kBlockSize - 1) / kBlockSize);
-    std::vector<uint8_t> head(8 + 25 + 4 * ncrcs + 4);
-    size_t body = head.size() - 8 + len;
-    put32(head.data(), kTypeWriteBulk);
-    put32(head.data() + 4, static_cast<uint32_t>(body));
-    head[8] = kProtoVersion;
-    put32(head.data() + 9, write_id);
-    put64(head.data() + 13, chunk_id);
-    put32(head.data() + 21, write_id);
-    put32(head.data() + 25, static_cast<uint32_t>(part_offset));
-    put32(head.data() + 29, ncrcs);
-    for (uint32_t b = 0; b < ncrcs; ++b) {
-        uint64_t start = static_cast<uint64_t>(b) * kBlockSize;
-        uint32_t piece = static_cast<uint32_t>(
-            std::min<uint64_t>(kBlockSize, len - start));
-        put32(head.data() + 33 + 4 * b,
-              lz_crc32(0, payload + start, piece));
-    }
-    put32(head.data() + 33 + 4 * ncrcs, static_cast<uint32_t>(len));
+    std::vector<uint8_t> head;
+    build_bulk_write_header(head, chunk_id, write_id, part_offset,
+                            payload, len);
     if (!send_all(fd, head.data(), head.size())) return -1;
     if (!send_all(fd, payload, len)) return -1;
     // single ack
@@ -254,9 +281,7 @@ int lz_write_part_bulk(int fd, uint64_t chunk_id, const uint8_t* payload,
     if (type != kTypeWriteStatus || length < 18 || length > sizeof(pay))
         return -2;
     if (!recv_all(fd, pay, length)) return -1;
-    if (pay[0] != kProtoVersion) return -2;
-    if (get32(pay + 13) != write_id) return -2;
-    return pay[17];
+    return parse_bulk_write_ack(pay, length, write_id);
 }
 
 // Stream [part_offset, part_offset+len) of payload as WriteData pieces
@@ -547,6 +572,170 @@ int lz_read_parts_gather(lz_part_req* parts, uint32_t d, uint32_t offset,
     }
     int ret = 0;
     for (uint32_t i = 0; i < d; ++i) {
+        if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        if (parts[i].rc != 0) ret = -1;
+    }
+    return ret;
+}
+
+// Whole-stripe fan-out: stream n part payloads as bulk writes (one
+// 1214 frame + one ack each) over n already-initialized sockets in ONE
+// poll-driven loop. The mirror of lz_read_parts_gather for the write
+// path: one native call replaces n thread dispatches, and the
+// per-block CRC pass over every payload runs here, GIL-free. The
+// caller has already exchanged WriteInit on each socket and sends
+// WriteEnd afterwards.
+//
+// parts[i].version carries the bulk write_id for part i (reusing the
+// request struct; the chunk version is already bound by WriteInit).
+// parts[i].rc: 0 ok; >0 peer status; -1 socket; -2 protocol. Returns
+// 0 iff every part succeeded (caller falls back to per-part writes).
+int lz_write_parts_scatter(lz_part_req* parts, uint32_t n,
+                           const uint8_t* const* payloads,
+                           const uint64_t* lens, uint64_t part_offset,
+                           uint32_t max_ms) {
+    if (n == 0 || part_offset % kBlockSize != 0) return -1;
+    struct St {
+        enum Phase { kSendHdr, kSendPay, kAckHdr, kAckPay, kDone };
+        Phase phase = kSendHdr;
+        std::vector<uint8_t> head;
+        uint64_t sent = 0;   // bytes sent in the current phase
+        uint32_t got = 0;    // bytes received in the current phase
+        uint32_t ack_len = 0;
+        uint8_t small[32];
+    };
+    std::vector<St> st(n);
+    for (uint32_t i = 0; i < n; ++i) {
+        if (lens[i] > (64u << 20)) { parts[i].rc = -2; continue; }
+        build_bulk_write_header(st[i].head, parts[i].chunk_id,
+                                parts[i].version, part_offset,
+                                payloads[i], lens[i]);
+        parts[i].rc = 1 << 30;  // in flight
+    }
+    const int64_t deadline = steady_ms() + max_ms;
+    uint32_t live = 0;
+    bool failed = false;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (parts[i].rc == (1 << 30)) ++live;
+        else failed = true;
+    }
+    std::vector<pollfd> pfds(n);
+    while (live && !failed) {
+        const int64_t now = steady_ms();
+        if (now >= deadline) {
+            for (uint32_t i = 0; i < n; ++i)
+                if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+            break;
+        }
+        int nfds = 0;
+        for (uint32_t i = 0; i < n; ++i) {
+            if (parts[i].rc != (1 << 30)) continue;
+            pfds[nfds].fd = parts[i].fd;
+            pfds[nfds].events =
+                (st[i].phase <= St::kSendPay) ? POLLOUT : POLLIN;
+            pfds[nfds].revents = 0;
+            ++nfds;
+        }
+        int pr = ::poll(pfds.data(), nfds,
+                        static_cast<int>(std::min<int64_t>(deadline - now,
+                                                           30000)));
+        if (pr < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int pi = 0; pi < nfds; ++pi) {
+            if (!(pfds[pi].revents &
+                  (POLLIN | POLLOUT | POLLERR | POLLHUP)))
+                continue;
+            uint32_t i = 0;
+            while (i < n && parts[i].fd != pfds[pi].fd) ++i;
+            if (i == n) continue;
+            St& s = st[i];
+            bool progress = true;
+            while (progress && parts[i].rc == (1 << 30)) {
+                progress = false;
+                if (s.phase == St::kSendHdr || s.phase == St::kSendPay) {
+                    const uint8_t* src;
+                    uint64_t total;
+                    if (s.phase == St::kSendHdr) {
+                        src = s.head.data();
+                        total = s.head.size();
+                    } else {
+                        src = payloads[i];
+                        total = lens[i];
+                    }
+                    while (s.sent < total) {
+                        ssize_t w = ::send(parts[i].fd, src + s.sent,
+                                           static_cast<size_t>(
+                                               total - s.sent),
+                                           MSG_DONTWAIT);
+                        if (w < 0) {
+                            if (errno == EAGAIN || errno == EWOULDBLOCK)
+                                break;
+                            if (errno == EINTR) continue;
+                            parts[i].rc = -1; --live;
+                            break;
+                        }
+                        s.sent += static_cast<uint64_t>(w);
+                    }
+                    if (parts[i].rc != (1 << 30)) break;
+                    if (s.sent >= total) {
+                        s.sent = 0;
+                        s.phase = (s.phase == St::kSendHdr)
+                                      ? St::kSendPay : St::kAckHdr;
+                        progress = true;
+                    }
+                    continue;
+                }
+                // ack phases
+                uint8_t* dst;
+                uint32_t want;
+                if (s.phase == St::kAckHdr) {
+                    dst = s.small;
+                    want = 8;
+                } else {
+                    dst = s.small;
+                    want = s.ack_len;
+                }
+                ssize_t r = ::recv(parts[i].fd, dst + s.got, want - s.got,
+                                   MSG_DONTWAIT);
+                if (r == 0) { parts[i].rc = -1; --live; break; }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                    if (errno == EINTR) { progress = true; continue; }
+                    parts[i].rc = -1; --live;
+                    break;
+                }
+                s.got += static_cast<uint32_t>(r);
+                if (s.got < want) { progress = true; continue; }
+                s.got = 0;
+                if (s.phase == St::kAckHdr) {
+                    const uint32_t type = get32(s.small);
+                    s.ack_len = get32(s.small + 4);
+                    if (type != kTypeWriteStatus || s.ack_len < 18 ||
+                        s.ack_len > sizeof(s.small)) {
+                        parts[i].rc = -2; --live;
+                        break;
+                    }
+                    s.phase = St::kAckPay;
+                    progress = true;
+                } else {
+                    parts[i].rc = parse_bulk_write_ack(
+                        s.small, s.ack_len, parts[i].version);
+                    s.phase = St::kDone;
+                    --live;
+                }
+            }
+        }
+        for (uint32_t i = 0; i < n; ++i) {
+            if (parts[i].rc != 0 && parts[i].rc != (1 << 30)) {
+                failed = true;
+                break;
+            }
+        }
+    }
+    int ret = 0;
+    for (uint32_t i = 0; i < n; ++i) {
         if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
         if (parts[i].rc != 0) ret = -1;
     }

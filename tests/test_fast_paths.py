@@ -253,3 +253,70 @@ async def test_abort_before_dial_refuses_cleanly():
         assert np.all(out == 0x77)
     finally:
         sock.close()
+
+
+# --- multi-part scatter WRITE fast path -------------------------------------
+
+async def test_parts_scatter_write_engages(tmp_path):
+    """Striped writes must take the one-call native multi-part path
+    (counter proves it) and produce byte-identical data."""
+    if not native_io.parts_scatter_available():
+        pytest.skip("native parts scatter not built")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "scatterw.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(11, 3 * 2**20 + 777).tobytes()
+        await c.write_file(f.inode, payload)
+        assert c.op_counters.get("parts_scatter_write", 0) >= 1, \
+            "scatter write path not engaged"
+        back = await c.read_file(f.inode, 0, len(payload))
+        assert bytes(back) == payload
+    finally:
+        await cluster.stop()
+
+
+async def test_parts_scatter_write_failure_falls_back(tmp_path, monkeypatch):
+    """A native scatter failure degrades to per-part writes with the
+    same bytes on disk."""
+    if not native_io.parts_scatter_available():
+        pytest.skip("native parts scatter not built")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+
+        def boom(*a, **k):
+            raise native_io.NativeIOError(5, "injected scatter failure")
+
+        monkeypatch.setattr(native_io, "write_parts_scatter_blocking", boom)
+        f = await c.create(1, "fallbackw.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(12, 2 * 2**20).tobytes()
+        await c.write_file(f.inode, payload)
+        assert c.op_counters.get("parts_scatter_fallback", 0) >= 1
+        back = await c.read_file(f.inode, 0, len(payload))
+        assert bytes(back) == payload
+    finally:
+        await cluster.stop()
+
+
+async def test_parts_scatter_skips_chained_copies(tmp_path):
+    """goal-2 copies use relay chains (two holders per part) — the
+    scatter path must stand aside and the chain path still work."""
+    if not native_io.parts_scatter_available():
+        pytest.skip("native parts scatter not built")
+    cluster = Cluster(tmp_path, n_cs=4)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "chained.bin")
+        await c.setgoal(f.inode, 2)  # 2 copies -> chain write
+        payload = data_generator.generate(13, 1 * 2**20 + 55).tobytes()
+        await c.write_file(f.inode, payload)
+        back = await c.read_file(f.inode, 0, len(payload))
+        assert bytes(back) == payload
+    finally:
+        await cluster.stop()
