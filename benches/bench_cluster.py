@@ -133,8 +133,13 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                         root = await nc.mnt("/")
                         _, fh = await nc.create(root, f"nfs_{rep}.bin")
                         t0 = time.perf_counter()
+                        # kernel-client pattern: UNSTABLE stream + one
+                        # COMMIT (the gateway write-gathers)
                         for off in range(0, len(blob), 65536):
-                            await nc.write(fh, off, blob[off : off + 65536])
+                            await nc.write(
+                                fh, off, blob[off : off + 65536], stable=0
+                            )
+                        await nc.commit(fh)
                         wts.append(time.perf_counter() - t0)
                         t0 = time.perf_counter()
                         got = bytearray()

@@ -107,8 +107,10 @@ class Nfs3Client:
         return nfs.NFS3_OK, u.opaque(64)
 
     async def write(self, fh: bytes, offset: int, data: bytes,
-                    expect=nfs.NFS3_OK) -> int:
-        args = (Packer().opaque(fh).u64(offset).u32(len(data)).u32(2)
+                    expect=nfs.NFS3_OK, stable: int = 2) -> int:
+        """stable: 0 UNSTABLE (gathered server-side, COMMIT required),
+        1 DATA_SYNC, 2 FILE_SYNC (default: durable before reply)."""
+        args = (Packer().opaque(fh).u64(offset).u32(len(data)).u32(stable)
                 .opaque(data).bytes())
         u = await self.call(7, args)
         code = u.u32()
@@ -117,8 +119,21 @@ class Nfs3Client:
             return 0
         self.skip_wcc(u)
         n = u.u32()
-        assert u.u32() == 2  # FILE_SYNC
+        committed = u.u32()
+        # the server may commit MORE strictly than asked, never less
+        assert committed >= (2 if stable == 2 else 0)
         return n
+
+    async def commit(self, fh: bytes, offset: int = 0, count: int = 0) -> bytes:
+        """COMMIT gathered UNSTABLE writes; returns the write verifier
+        (a changed verifier between writes and commit means the server
+        rebooted and the client must resend)."""
+        u = await self.call(
+            21, Packer().opaque(fh).u64(offset).u32(count).bytes()
+        )
+        assert u.u32() == nfs.NFS3_OK
+        self.skip_wcc(u)
+        return u.fixed(8)
 
     async def read(self, fh: bytes, offset: int, count: int) -> tuple[bytes, bool]:
         u = await self.call(6, Packer().opaque(fh).u64(offset).u32(count).bytes())

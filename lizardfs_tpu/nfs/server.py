@@ -181,6 +181,57 @@ class _Sattr3:
         return mask, kw
 
 
+class _WriteGather:
+    """Write-behind buffer for one inode's UNSTABLE writes.
+
+    Sequential 64 KiB WRITEs coalesce into contiguous runs that flush
+    as few large pwrites (one striped RMW per run instead of one per
+    wire op). The analog of knfsd/Ganesha write gathering; COMMIT and
+    any dependent read/attr op force the flush (RFC 1813 §3.3.7/21).
+    """
+
+    def __init__(self) -> None:
+        self.segs: list[tuple[int, bytearray]] = []  # sorted, disjoint
+        self.nbytes = 0
+        self.last_add = 0.0
+
+    def try_add(self, offset: int, data: bytes) -> bool:
+        """Append/merge; False when the write overlaps existing segments
+        (caller flushes first — overlap means a retransmit or random
+        rewrite, both rare)."""
+        self.last_add = time.monotonic()
+        new_end = offset + len(data)
+        # overlap check FIRST, against every segment: a merge that runs
+        # a segment over a later one would flush stale bytes on top of
+        # newer ones
+        for start, buf in self.segs:
+            if offset < start + len(buf) and new_end > start:
+                return False
+        for i, (start, buf) in enumerate(self.segs):
+            end = start + len(buf)
+            if offset == end:
+                buf.extend(data)
+                # merge with the next segment if we just bridged the gap
+                if (i + 1 < len(self.segs)
+                        and start + len(buf) == self.segs[i + 1][0]):
+                    buf.extend(self.segs[i + 1][1])
+                    del self.segs[i + 1]
+                self.nbytes += len(data)
+                return True
+            if new_end == start:
+                self.segs[i] = (offset, bytearray(data) + buf)
+                self.nbytes += len(data)
+                return True
+        self.segs.append((offset, bytearray(data)))
+        self.segs.sort(key=lambda s: s[0])
+        self.nbytes += len(data)
+        return True
+
+    @property
+    def end(self) -> int:
+        return max((s + len(b) for s, b in self.segs), default=0)
+
+
 class NfsGateway:
     """One process serving MOUNT3 + NFS3 (and a local portmapper view).
 
@@ -209,13 +260,90 @@ class NfsGateway:
         # inodes outside an export are not rejected — use master-side
         # subtree exports for hard isolation.
         self._export_roots: set[int] = set()
+        # UNSTABLE write gathering: inode -> buffered segments; flushed
+        # on COMMIT / stable writes / dependent ops / idle timer / size
+        # caps. Serialized per inode so a flush never races an add.
+        self._gather: dict[int, _WriteGather] = {}
+        self._gather_locks: dict[int, asyncio.Lock] = {}
+        self._gather_total = 0  # bytes buffered across all inodes
+        self._gather_task: asyncio.Task | None = None
+        self.GATHER_FLUSH_BYTES = 8 * 2**20     # per inode
+        self.GATHER_TOTAL_BYTES = 64 * 2**20    # whole gateway
+        self.GATHER_IDLE_S = 1.0
 
     @property
     def port(self) -> int:
         return self.rpc.port
 
+    def _lock_entry(self, inode: int) -> list:
+        # [lock, refcount] — dropped when nobody holds or awaits it
+        # (same pattern as the client's per-chunk write locks)
+        e = self._gather_locks.get(inode)
+        if e is None:
+            e = self._gather_locks[inode] = [asyncio.Lock(), 0]
+        return e
+
+    async def _flush_locked(self, inode: int) -> None:
+        """Write out the inode's gathered segments; caller holds its
+        gather lock. On failure the unwritten segments are RE-QUEUED —
+        the server has acked these bytes as UNSTABLE, and dropping them
+        while write_verf stays unchanged would make the client discard
+        its only copy (RFC 1813 verifier contract)."""
+        g = self._gather.pop(inode, None)
+        if g is None:
+            return
+        self._gather_total -= g.nbytes
+        for i, (start, buf) in enumerate(g.segs):
+            try:
+                await self.client.pwrite(inode, start, bytes(buf))
+            except Exception:
+                requeue = _WriteGather()
+                requeue.segs = g.segs[i:]  # current run is idempotent
+                requeue.nbytes = sum(len(b) for _, b in requeue.segs)
+                requeue.last_add = time.monotonic()
+                self._gather[inode] = requeue
+                self._gather_total += requeue.nbytes
+                raise
+
+    async def _flush_inode(self, inode: int) -> None:
+        """Write out an inode's gathered UNSTABLE segments (no-op when
+        nothing is buffered)."""
+        if inode not in self._gather:
+            return
+        e = self._lock_entry(inode)
+        e[1] += 1
+        try:
+            async with e[0]:
+                await self._flush_locked(inode)
+        finally:
+            e[1] -= 1
+            if e[1] == 0 and self._gather_locks.get(inode) is e:
+                del self._gather_locks[inode]
+
+    async def _flush_all(self) -> None:
+        for inode in list(self._gather):
+            await self._flush_inode(inode)
+
+    async def _gather_sweep(self) -> None:
+        """Bound the write-behind window: idle inodes flush after
+        GATHER_IDLE_S even without a COMMIT. The task must survive ANY
+        flush error (a dead master connection raises ConnectionError,
+        not StatusError) — data stays queued and retries next tick."""
+        while True:
+            await asyncio.sleep(self.GATHER_IDLE_S / 2)
+            now = time.monotonic()
+            for inode, g in list(self._gather.items()):
+                if now - g.last_add >= self.GATHER_IDLE_S:
+                    try:
+                        await self._flush_inode(inode)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        log.exception("idle flush failed for %d", inode)
+
     async def start(self) -> None:
         await self.client.connect(info="nfs-gateway")
+        self._gather_task = asyncio.ensure_future(self._gather_sweep())
         for target in self.exports.values():
             # pre-resolve export roots: clients reusing cached handles
             # after a gateway restart never re-MNT
@@ -230,6 +358,16 @@ class NfsGateway:
         log.info("nfs gateway on port %d", self.port)
 
     async def stop(self) -> None:
+        if self._gather_task is not None:
+            self._gather_task.cancel()
+            try:
+                await self._gather_task
+            except asyncio.CancelledError:
+                pass
+        try:
+            await self._flush_all()
+        except Exception:  # noqa: BLE001 — still stop cleanly
+            log.exception("final gather flush failed")
         await self.rpc.stop()
         await self.client.close()
 
@@ -333,6 +471,7 @@ class NfsGateway:
 
     async def _proc_getattr(self, cred, u) -> bytes:
         inode = fh_unpack(u.opaque(64))
+        await self._flush_inode(inode)  # size must reflect gathered writes
         try:
             attr = await self._attr(inode)
         except st.StatusError as e:
@@ -343,6 +482,9 @@ class NfsGateway:
 
     async def _proc_setattr(self, cred, u) -> bytes:
         inode = fh_unpack(u.opaque(64))
+        # ordering: a truncate must not race gathered writes (and the
+        # ctime guard below must see post-flush attrs)
+        await self._flush_inode(inode)
         sattr = _Sattr3(u)
         if u.boolean():  # sattrguard3: compare-and-set on ctime
             guard_ctime = u.u32()
@@ -427,6 +569,7 @@ class NfsGateway:
         inode = fh_unpack(u.opaque(64))
         offset, count = u.u64(), u.u32()
         count = min(count, 1 << 20)
+        await self._flush_inode(inode)  # read-your-own-UNSTABLE-writes
         attr = await self._attr(inode)
         if attr.ftype == m.FTYPE_DIR:
             raise _NfsError(NFS3ERR_ISDIR)
@@ -443,10 +586,58 @@ class NfsGateway:
     async def _proc_write(self, cred, u) -> bytes:
         inode = fh_unpack(u.opaque(64))
         offset, count = u.u64(), u.u32()
-        u.u32()  # stable_how: we always write through (FILE_SYNC)
+        stable = u.u32()  # 0 UNSTABLE, 1 DATA_SYNC, 2 FILE_SYNC
         data = u.opaque(1 << 22)[:count]
         if not await self.client.access(inode, cred.uid, cred.all_gids, 2):
             raise _NfsError(NFS3ERR_ACCES)
+        if stable == 0:
+            # write gathering: buffer UNSTABLE writes and flush them as
+            # few large pwrites (sequential 64 KiB wire ops would each
+            # pay a full striped read-modify-write otherwise); COMMIT /
+            # stable writes / dependent ops / the idle sweep flush
+            e = self._lock_entry(inode)
+            e[1] += 1
+            try:
+                async with e[0]:
+                    g = self._gather.get(inode)
+                    if g is None:
+                        g = self._gather[inode] = _WriteGather()
+                    if not g.try_add(offset, data):
+                        # overlap (retransmit/random rewrite): flush,
+                        # then start a fresh gather with this write
+                        await self._flush_locked(inode)
+                        g = self._gather[inode] = _WriteGather()
+                        g.try_add(offset, data)
+                    self._gather_total += len(data)
+                    if g.nbytes >= self.GATHER_FLUSH_BYTES:
+                        await self._flush_locked(inode)
+            finally:
+                e[1] -= 1
+                if e[1] == 0 and self._gather_locks.get(inode) is e:
+                    del self._gather_locks[inode]
+            # gateway-wide memory cap: flush the LARGEST gathers (not
+            # this possibly-tiny one) until under budget — done outside
+            # this inode's lock to keep lock acquisition one-at-a-time
+            while self._gather_total >= self.GATHER_TOTAL_BYTES:
+                biggest = max(
+                    self._gather, key=lambda i: self._gather[i].nbytes,
+                    default=None,
+                )
+                if biggest is None:
+                    break
+                await self._flush_inode(biggest)
+            attr = await self._attr_opt(inode)
+            if attr is not None and inode in self._gather:
+                # advisory post-attr: reflect the buffered tail so the
+                # client's size view stays monotonic pre-flush
+                attr.length = max(attr.length, self._gather[inode].end)
+            p = Packer().u32(NFS3_OK)
+            _wcc_data(p, attr)
+            p.u32(len(data))
+            p.u32(0)  # committed = UNSTABLE: client must COMMIT
+            p.fixed(self.write_verf)
+            return p.bytes()
+        await self._flush_inode(inode)  # ordering vs earlier UNSTABLE
         await self.client.pwrite(inode, offset, data)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(inode))
@@ -536,6 +727,16 @@ class NfsGateway:
     async def _proc_remove(self, cred, u) -> bytes:
         parent = fh_unpack(u.opaque(64))
         name = u.string(255)
+        # flush the victim's gathered writes first: local-fs unlink
+        # ordering (data lands, THEN the name goes — the client's
+        # sillyrename pattern for unlink-while-open depends on it)
+        try:
+            victim = await self.client.lookup(
+                parent, name, uid=cred.uid, gids=cred.all_gids
+            )
+            await self._flush_inode(victim.inode)
+        except st.StatusError:
+            pass
         await self.client.unlink(parent, name, uid=cred.uid, gids=cred.all_gids)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(parent))
@@ -693,7 +894,8 @@ class NfsGateway:
     async def _proc_commit(self, cred, u) -> bytes:
         inode = fh_unpack(u.opaque(64))
         u.u64()
-        u.u32()  # offset, count: writes are already durable
+        u.u32()  # offset, count: flushing the whole inode covers any range
+        await self._flush_inode(inode)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(inode))
         p.fixed(self.write_verf)

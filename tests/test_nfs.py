@@ -287,3 +287,126 @@ async def test_nfs_multi_gateway_coherence(tmp_path):
         await gw_a.stop()
         await gw_b.stop()
         await cluster.stop()
+
+
+async def test_nfs_unstable_write_gathering(tmp_path):
+    """UNSTABLE writes gather server-side and become durable at COMMIT
+    (RFC 1813 §3.3.7/21) — with read-your-own-writes, size visibility,
+    and truncate ordering all forcing the flush."""
+    import asyncio
+
+    cluster, gw = await gateway_cluster(tmp_path)
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c:
+            root = await c.mnt("/")
+            code, fh = await c.create(root, "gathered.bin")
+            assert code == nfs.NFS3_OK
+            blob = bytes(range(256)) * 2048  # 512 KiB
+            # sequential UNSTABLE stream (kernel-client pattern)
+            for off in range(0, len(blob), 65536):
+                n = await c.write(fh, off, blob[off:off + 65536], stable=0)
+                assert n == 65536
+            # the gather holds ONE coalesced run pre-commit
+            inode = nfs.fh_unpack(fh)
+            assert gw._gather[inode].nbytes == len(blob)
+            assert len(gw._gather[inode].segs) == 1
+            verf = await c.commit(fh)
+            assert verf == gw.write_verf and inode not in gw._gather
+            got, _ = await c.read(fh, 0, 1 << 20)
+            assert got == blob
+
+            # read-your-own-writes flushes without an explicit COMMIT
+            await c.write(fh, 0, b"FRESH", stable=0)
+            got, _ = await c.read(fh, 0, 5)
+            assert got == b"FRESH" and inode not in gw._gather
+
+            # getattr shows the gathered size (flush-on-getattr)
+            await c.write(fh, len(blob), b"tail!", stable=0)
+            attr = await c.getattr(fh)
+            assert attr["size"] == len(blob) + 5
+
+            # out-of-order + bridging segments coalesce correctly
+            code, fh2 = await c.create(root, "bridge.bin")
+            await c.write(fh2, 131072, b"C" * 65536, stable=0)
+            await c.write(fh2, 0, b"A" * 65536, stable=0)
+            await c.write(fh2, 65536, b"B" * 65536, stable=0)  # bridges
+            inode2 = nfs.fh_unpack(fh2)
+            assert len(gw._gather[inode2].segs) == 1
+            await c.commit(fh2)
+            got, _ = await c.read(fh2, 0, 196608)
+            assert got == b"A" * 65536 + b"B" * 65536 + b"C" * 65536
+
+            # idle sweep flushes without any dependent op
+            await c.write(fh2, 196608, b"idle-flush", stable=0)
+            for _ in range(40):
+                if inode2 not in gw._gather:
+                    break
+                await asyncio.sleep(0.1)
+            assert inode2 not in gw._gather, "idle sweep never flushed"
+    finally:
+        await gw.stop()
+        await cluster.stop()
+
+
+async def test_nfs_gather_overlap_keeps_newest_bytes(tmp_path):
+    """An UNSTABLE write overlapping buffered segments must not let
+    stale buffered bytes win: w3 spans w1's range after an adjacent
+    merge — flush order must leave w3's bytes on disk."""
+    cluster, gw = await gateway_cluster(tmp_path)
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c:
+            root = await c.mnt("/")
+            code, fh = await c.create(root, "overlap.bin")
+            assert code == nfs.NFS3_OK
+            await c.write(fh, 131072, b"1" * 65536, stable=0)   # w1
+            await c.write(fh, 0, b"2" * 65536, stable=0)        # w2
+            await c.write(fh, 65536, b"3" * 131072, stable=0)   # w3 over w1
+            await c.commit(fh)
+            got, _ = await c.read(fh, 0, 196608)
+            assert got == b"2" * 65536 + b"3" * 131072
+    finally:
+        await gw.stop()
+        await cluster.stop()
+
+
+async def test_nfs_gather_requeues_on_flush_failure(tmp_path):
+    """Acked UNSTABLE bytes must survive a failed flush (same verifier
+    => the client is allowed to discard its copy): the gather requeues
+    and a later COMMIT lands the data."""
+    from lizardfs_tpu.proto import status as st_mod
+
+    cluster, gw = await gateway_cluster(tmp_path)
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c:
+            root = await c.mnt("/")
+            code, fh = await c.create(root, "requeue.bin")
+            assert code == nfs.NFS3_OK
+            await c.write(fh, 0, b"precious!" * 7000, stable=0)
+
+            real_pwrite = gw.client.pwrite
+            fails = {"n": 1}
+
+            async def flaky(*a, **k):
+                if fails["n"]:
+                    fails["n"] -= 1
+                    raise st_mod.StatusError(st_mod.EIO, "injected")
+                return await real_pwrite(*a, **k)
+
+            gw.client.pwrite = flaky
+            try:
+                u = await c.call(
+                    21, __import__("lizardfs_tpu.nfs.xdr", fromlist=["Packer"])
+                    .Packer().opaque(fh).u64(0).u32(0).bytes()
+                )
+                assert u.u32() != nfs.NFS3_OK  # commit reports the failure
+                inode = nfs.fh_unpack(fh)
+                assert inode in gw._gather, "data dropped on failed flush"
+                verf = await c.commit(fh)  # retry succeeds
+                assert verf == gw.write_verf
+            finally:
+                gw.client.pwrite = real_pwrite
+            got, _ = await c.read(fh, 0, 63000)
+            assert got == b"precious!" * 7000
+    finally:
+        await gw.stop()
+        await cluster.stop()
