@@ -97,6 +97,9 @@ class Client:
         # write journal, writedata.cc)
         # (inode, chunk) -> [asyncio.Lock, refcount]; see _pwrite_chunk
         self._chunk_write_locks: dict[tuple[int, int], list] = {}
+        # open handles this client registered: inode -> [handle ids]
+        # (release() without an explicit handle drops the most recent)
+        self._open_handles: dict[int, list[int]] = {}
         # reusable stripe-scatter staging buffers, keyed (d, part_len):
         # a fresh 64 MiB allocation pays its page faults inside the
         # scatter copy (~2x measured cost); the write window keeps at
@@ -284,6 +287,33 @@ class Client:
             m.CltomaLookup, parent=parent, name=name, **self._ident(uid, gids)
         )
         return r.attr
+
+    async def open(self, inode: int) -> int:
+        """Register an open handle with the master: while held, the
+        file survives unlink/trash-expiry (sustained files — reference
+        "reserved" namespace). Returns the handle id to pass to
+        release() (retry-safe: the master dedupes on it)."""
+        import secrets
+
+        handle = secrets.randbits(64)
+        await self._call(m.CltomaOpen, inode=inode, handle=handle)
+        self._open_handles.setdefault(inode, []).append(handle)
+        return handle
+
+    async def release(self, inode: int, handle: int | None = None) -> None:
+        """Drop one open handle (best effort: a lost release is cleaned
+        up by the master's session teardown / orphan sweep)."""
+        handles = self._open_handles.get(inode, [])
+        if handle is None:
+            handle = handles[-1] if handles else 0
+        if handle in handles:
+            handles.remove(handle)
+            if not handles:
+                self._open_handles.pop(inode, None)
+        try:
+            await self._call(m.CltomaRelease, inode=inode, handle=handle)
+        except (st.StatusError, ConnectionError, asyncio.TimeoutError):
+            pass
 
     async def getattr(self, inode: int) -> m.Attr:
         r = await self._call(m.CltomaGetattr, inode=inode)

@@ -373,6 +373,14 @@ class LizardFuse:
             return 0
 
         def op_fgetattr(path, out, fi):
+            # by HANDLE, not path: fstat(fd) must work on an
+            # unlinked-but-open (sustained) file whose name is gone
+            inode = fi.contents.fh if fi else 0
+            if inode:
+                self._fill_stat(
+                    self._run(self.client.getattr(inode)), out.contents
+                )
+                return 0
             return op_getattr(path, out)
 
         def op_readdir(path, buf, filler, offset, fi):
@@ -410,6 +418,9 @@ class LizardFuse:
                     parent.inode, name, mode & 0o7777, uid=uid, gid=gids[0]
                 )
             )
+            # the create handle is an open handle (kernel will send a
+            # matching release)
+            self._run(self.client.open(attr.inode))
             fi.contents.fh = attr.inode
             return 0
 
@@ -429,6 +440,9 @@ class LizardFuse:
                 ok = self._run(self.client.access(node.inode, uid, gids, want))
                 if not ok:
                     return -errno.EACCES
+            # register the handle: the file now survives unlink until
+            # op_release (sustained files)
+            self._run(self.client.open(node.inode))
             fi.contents.fh = node.inode
             return 0
 
@@ -515,6 +529,14 @@ class LizardFuse:
             return 0
 
         def op_ftruncate(path, length, fi):
+            # by HANDLE: ftruncate(fd) on a sustained file has no path
+            inode = fi.contents.fh if fi else 0
+            if inode:
+                uid, gids = self._caller()
+                self._run(
+                    self.client.truncate(inode, length, uid=uid, gids=gids)
+                )
+                return 0
             return op_truncate(path, length)
 
         def op_chmod(path, mode):
@@ -580,6 +602,12 @@ class LizardFuse:
 
         def op_release(path, fi):
             self._special_snap.pop(bytes(path), None)
+            inode = fi.contents.fh
+            if inode:
+                try:
+                    self._run(self.client.release(inode), timeout=10.0)
+                except Exception:  # noqa: BLE001 — release is best effort
+                    pass
             return 0
 
         def op_fsync(path, datasync, fi):

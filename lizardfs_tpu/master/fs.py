@@ -141,6 +141,13 @@ class FsTree:
         self.nodes: dict[int, Node] = {}
         self.next_inode = ROOT_INODE + 1
         self.trash: dict[int, tuple[str, int]] = {}  # inode -> (name, del_ts)
+        # open-file registry + sustained namespace (reference: "reserved"
+        # files, filesystem_node_types.h trash & reserved namespaces):
+        # inode -> {session_id: open count}; a file whose last name goes
+        # away while open moves to `sustained` instead of dying, and is
+        # freed at the last release. Replicated via acquire/release ops.
+        self.open_refs: dict[int, dict[int, int]] = {}
+        self.sustained: set[int] = set()
         root = Node(inode=ROOT_INODE, ftype=TYPE_DIR, mode=0o755, nlink=1)
         self.nodes[ROOT_INODE] = root
 
@@ -305,6 +312,10 @@ class FsTree:
             if to_trash and n.ftype == TYPE_FILE and n.trash_time > 0:
                 # keep the last parent+name so undelete can restore
                 self.trash[inode] = (name, ts + n.trash_time, parent)
+            elif self.open_refs.get(inode):
+                # unlink-while-open (POSIX): the data outlives the last
+                # name until the last close — the reference's "reserved"
+                self.sustained.add(inode)
             else:
                 del self.nodes[inode]
         return n
@@ -382,6 +393,10 @@ class FsTree:
         n.ctime = ts
         p.mtime = p.ctime = ts
         self._add_stats(parent, 1, n.length)
+        # re-linking a sustained (nameless-but-open) inode gives it a
+        # name again: it is a normal file now — the last release must
+        # NOT free it out from under the new directory entry
+        self.sustained.discard(inode)
         return n
 
     def apply_setattr(
@@ -442,7 +457,34 @@ class FsTree:
 
     def apply_purge_trash(self, inode: int) -> None:
         self.trash.pop(inode, None)
-        self.nodes.pop(inode, None)
+        if self.open_refs.get(inode):
+            # trash expiry with live openers: sustain instead of
+            # breaking their handles; freed at the last release
+            self.sustained.add(inode)
+        else:
+            self.nodes.pop(inode, None)
+
+    def apply_acquire(self, inode: int, sid: int) -> None:
+        self.node(inode)  # must exist
+        refs = self.open_refs.setdefault(inode, {})
+        refs[sid] = refs.get(sid, 0) + 1
+
+    def apply_release(self, inode: int, sid: int) -> bool:
+        """Drop one open ref. True when the LAST ref of a sustained file
+        went away — the caller frees chunks/quota and the node."""
+        refs = self.open_refs.get(inode)
+        if not refs or sid not in refs:
+            return False
+        refs[sid] -= 1
+        if refs[sid] <= 0:
+            del refs[sid]
+        if refs:
+            return False
+        del self.open_refs[inode]
+        if inode in self.sustained:
+            self.sustained.discard(inode)
+            return True
+        return False
 
     def apply_undelete(self, inode: int, ts: int) -> Node:
         """Restore a trashed file to its original directory (or the root
@@ -556,6 +598,11 @@ class FsTree:
             "next_inode": self.next_inode,
             "nodes": [n.to_dict() for n in self.nodes.values()],
             "trash": {str(i): list(v) for i, v in self.trash.items()},
+            "open": {
+                str(i): {str(s): c for s, c in refs.items()}
+                for i, refs in self.open_refs.items() if refs
+            },
+            "sustained": sorted(self.sustained),
         }
 
     @classmethod
@@ -567,6 +614,11 @@ class FsTree:
             int(i): (v[0], int(v[1]), int(v[2]) if len(v) > 2 else ROOT_INODE)
             for i, v in d.get("trash", {}).items()
         }
+        fs.open_refs = {
+            int(i): {int(s): int(c) for s, c in refs.items()}
+            for i, refs in d.get("open", {}).items()
+        }
+        fs.sustained = set(d.get("sustained", ()))
         for nd in d["nodes"]:
             node = Node.from_dict(nd)
             fs.nodes[node.inode] = node

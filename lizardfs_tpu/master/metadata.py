@@ -75,7 +75,11 @@ class MetadataStore:
 
     def _op_unlink(self, op):
         node = self.fs.apply_unlink(op["parent"], op["name"], op["ts"], op["to_trash"])
-        if node.nlink <= 0 and node.inode not in self.fs.trash:
+        if (
+            node.nlink <= 0
+            and node.inode not in self.fs.trash
+            and node.inode not in self.fs.sustained
+        ):
             self.quotas.charge(node.uid, node.gid, -1, -node.length)
             for cid in node.chunks:
                 if cid:
@@ -158,7 +162,9 @@ class MetadataStore:
 
     def _op_purge_trash(self, op):
         node = self.fs.nodes.get(op["inode"])
-        if node is not None:
+        will_sustain = bool(self.fs.open_refs.get(op["inode"]))
+        if node is not None and not will_sustain:
+            # a sustained file keeps its chunks/quota until last close
             self.quotas.charge(node.uid, node.gid, -1, -node.length)
             for cid in node.chunks:
                 if cid:
@@ -216,6 +222,34 @@ class MetadataStore:
         if old is not None:
             old.refcount -= 1
         self.fs.apply_set_chunk(op["inode"], op["chunk_index"], op["new_chunk_id"])
+
+    # --- open-file registry / sustained files (reference: "reserved") ---
+
+    def _op_acquire(self, op):
+        self.fs.apply_acquire(op["inode"], op["sid"])
+
+    def _release_one(self, inode: int, sid: int) -> None:
+        node = self.fs.nodes.get(inode)
+        if self.fs.apply_release(inode, sid) and node is not None:
+            # last close of a sustained (nameless) file: free it now —
+            # the purge_trash pattern, deferred to the final release
+            self.quotas.charge(node.uid, node.gid, -1, -node.length)
+            for cid in node.chunks:
+                if cid:
+                    self.registry.release_chunk(cid)
+            self.fs.nodes.pop(inode, None)
+            self.content_gen.pop(inode, None)
+
+    def _op_release(self, op):
+        self._release_one(op["inode"], op["sid"])
+
+    def _op_release_session_opens(self, op):
+        sid = op["sid"]
+        for inode in [
+            i for i, refs in list(self.fs.open_refs.items()) if sid in refs
+        ]:
+            while sid in self.fs.open_refs.get(inode, {}):
+                self._release_one(inode, sid)
 
     def _op_lock_posix(self, op):
         self.locks.posix(
@@ -388,6 +422,15 @@ class MetadataStore:
                  c["ts"])
                 for c in copies
             ])
+        if kind == "open":
+            refs = self.fs.open_refs.get(key[1])
+            if not refs:
+                return 0
+            return self._h("open", key[1], tuple(sorted(refs.items())))
+        if kind == "sustained":
+            if key[1] not in self.fs.sustained:
+                return 0
+            return self._h("sustained", key[1])
         if kind == "misc":
             # next_inode / next_chunk_id are EXCLUDED: the server
             # pre-reserves them outside apply() (alloc_inode, chunk-id
@@ -438,6 +481,7 @@ class MetadataStore:
                 if c is not None:
                     out.add(("node", c))
                     out.add(("trash", c))
+                    out.add(("sustained", c))
                     node_quota(c)
                     node_chunks(c)
 
@@ -458,7 +502,8 @@ class MetadataStore:
             child_of(op["parent_dst"], op["name_dst"])
         elif t == "link":
             out |= {("node", op["inode"]), ("node", op["parent"]),
-                    ("edge", op["parent"], op["name"])}
+                    ("edge", op["parent"], op["name"]),
+                    ("sustained", op["inode"])}
         elif t in ("setattr", "setgoal", "set_chunk", "set_acl",
                    "set_rich_acl", "set_xattr"):
             out.add(("node", op["inode"]))
@@ -468,8 +513,21 @@ class MetadataStore:
             node_chunks(op["inode"])
         elif t in ("create_chunk", "bump_chunk_version", "delete_chunk"):
             out.add(("chunk", op["chunk_id"]))
+        elif t in ("acquire", "release"):
+            out |= {("open", op["inode"]), ("sustained", op["inode"]),
+                    ("node", op["inode"])}
+            node_quota(op["inode"])
+            node_chunks(op["inode"])
+        elif t == "release_session_opens":
+            for inode, refs in self.fs.open_refs.items():
+                if op["sid"] in refs:
+                    out |= {("open", inode), ("sustained", inode),
+                            ("node", inode)}
+                    node_quota(inode)
+                    node_chunks(inode)
         elif t in ("purge_trash", "undelete"):
-            out |= {("node", op["inode"]), ("trash", op["inode"])}
+            out |= {("node", op["inode"]), ("trash", op["inode"]),
+                    ("sustained", op["inode"])}
             node_quota(op["inode"])
             node_chunks(op["inode"])
             entry = fs.trash.get(op["inode"])
@@ -531,6 +589,10 @@ class MetadataStore:
                     d ^= self._entity_hash(("edge", inode, name))
         for inode in self.fs.trash:
             d ^= self._entity_hash(("trash", inode))
+        for inode in self.fs.open_refs:
+            d ^= self._entity_hash(("open", inode))
+        for inode in self.fs.sustained:
+            d ^= self._entity_hash(("sustained", inode))
         for cid in self.registry.chunks:
             d ^= self._entity_hash(("chunk", cid))
         for kind, oid in self.quotas.entries:

@@ -400,6 +400,13 @@ class MasterServer(Daemon):
                 self.commit({"op": "lock_release_session", "sid": sid})
                 for inode in held:
                     self._grant_pending_locks(inode)
+            self._release_session_opens(sid)
+
+    def _release_session_opens(self, sid: int) -> None:
+        """Drop a departed session's open handles (freeing any sustained
+        files it was the last holder of)."""
+        if any(sid in refs for refs in self.meta.fs.open_refs.values()):
+            self.commit({"op": "release_session_opens", "sid": sid})
 
     _ORPHAN_LOCK_TIMEOUT = 60.0
 
@@ -420,12 +427,15 @@ class MasterServer(Daemon):
         ]
         for sid in dead:
             del self.sessions[sid]
-        # release locks whose owning session has no live connection and
-        # never reconnected (orphans from a promotion or client crash)
+        # release locks AND open handles whose owning session has no
+        # live connection and never reconnected (orphans from a
+        # promotion or client crash)
         owners = set()
         for table in (self.meta.locks.posix_files, self.meta.locks.flock_files):
             for fl in table.values():
                 owners.update(r.owner.session_id for r in fl.ranges)
+        for refs in self.meta.fs.open_refs.values():
+            owners.update(refs)
         live = set(self._session_writers)
         now_f = time.time()
         for sid in owners - live:
@@ -434,7 +444,9 @@ class MasterServer(Daemon):
             first_seen = self._orphan_lock_seen.setdefault(sid, now_f)
             if now_f - first_seen >= self._ORPHAN_LOCK_TIMEOUT:
                 held = self.meta.locks.session_inodes(sid)
-                self.commit({"op": "lock_release_session", "sid": sid})
+                if held:
+                    self.commit({"op": "lock_release_session", "sid": sid})
+                self._release_session_opens(sid)
                 self._orphan_lock_seen.pop(sid, None)
                 for inode in held:
                     self._grant_pending_locks(inode)
@@ -568,23 +580,29 @@ class MasterServer(Daemon):
                     q[:] = [p for p in q if p["sid"] != session_id]
                 for inode in queued:
                     self._grant_pending_locks(inode)
-                if held:
-                    if self.sessions.get(session_id, {}).get("clean_close"):
-                        # clean goodbye: release now
-                        self.commit(
-                            {"op": "lock_release_session", "sid": session_id}
-                        )
-                        for inode in held:
-                            self._grant_pending_locks(inode)
-                    else:
-                        # abrupt disconnect: HELD locks get a grace
-                        # window — a client that reconnects with its
-                        # session id (network blip, failover) keeps
-                        # them; the sweep releases them if it never
-                        # comes back
-                        self._lock_grace[session_id] = (
-                            time.monotonic() + self.lock_grace_seconds
-                        )
+                clean = self.sessions.get(session_id, {}).get("clean_close")
+                if held and clean:
+                    # clean goodbye: release now
+                    self.commit(
+                        {"op": "lock_release_session", "sid": session_id}
+                    )
+                    for inode in held:
+                        self._grant_pending_locks(inode)
+                has_opens = any(
+                    session_id in refs
+                    for refs in self.meta.fs.open_refs.values()
+                )
+                if (held or has_opens) and not clean:
+                    # abrupt disconnect: HELD locks and open handles get
+                    # a grace window — a client that reconnects with its
+                    # session id (network blip, failover) keeps them;
+                    # the sweep releases both if it never comes back
+                    self._lock_grace[session_id] = (
+                        time.monotonic() + self.lock_grace_seconds
+                    )
+                if clean:
+                    # open handles die with a clean goodbye
+                    self._release_session_opens(session_id)
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -954,6 +972,39 @@ class MasterServer(Daemon):
                          "length": msg.length, "ts": now})
             self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
+        if isinstance(msg, m.CltomaOpen):
+            node = fs.node(msg.inode)
+            if node.ftype == fsmod.TYPE_FILE and session_id:
+                # dedupe on (session, handle): the client RPC layer
+                # retries over reconnects and acquire isn't idempotent
+                handles = session.setdefault("open_handles", set())
+                key = (msg.inode, msg.handle)
+                if key not in handles:
+                    handles.add(key)
+                    self.commit({
+                        "op": "acquire", "inode": msg.inode,
+                        "sid": session_id,
+                    })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaRelease):
+            if session_id and session_id in self.meta.fs.open_refs.get(
+                msg.inode, {}
+            ):
+                handles = session.setdefault("open_handles", set())
+                key = (msg.inode, msg.handle)
+                # release a registered handle exactly once; an UNKNOWN
+                # handle (master restarted since the open: the in-memory
+                # handle set died with the old process) still releases —
+                # the persisted ref must be droppable after recovery
+                if key in handles or not any(
+                    i == msg.inode for i, _ in handles
+                ):
+                    handles.discard(key)
+                    self.commit({
+                        "op": "release", "inode": msg.inode,
+                        "sid": session_id,
+                    })
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaReadChunk):
             return await self._read_chunk(msg, session.get("ip"), session_id)
         if isinstance(msg, m.CltomaWriteChunk):

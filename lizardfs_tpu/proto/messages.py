@@ -483,6 +483,30 @@ class MatoclCacheInvalidate(Message):
     FIELDS = (("inode", "u32"), ("chunk_index", "u32"))
 
 
+class CltomaOpen(Message):
+    """Register an open file handle: while any session holds one, the
+    file survives losing its last name ("reserved"/sustained files,
+    reference: src/master/filesystem_node_types.h trash & reserved
+    namespaces; sessions carry open files in sessions.mfs).
+
+    ``handle`` is a client-chosen unique id: the client's master RPC
+    layer transparently retries over a reconnect, and acquire is not
+    idempotent — the master dedupes on (session, handle) so a
+    lost-reply retry can't double-count the ref."""
+
+    MSG_TYPE = 1068
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("handle", "u64"))
+
+
+class CltomaRelease(Message):
+    """Drop one open handle; the last release of a sustained file frees
+    its data. ``handle`` matches the open — the master only releases a
+    handle it has registered, so a retried release can't double-drop."""
+
+    MSG_TYPE = 1069
+    FIELDS = (("req_id", "u32"), ("inode", "u32"), ("handle", "u64"))
+
+
 class CltomaSetAcl(Message):
     """Set/clear POSIX ACLs; json = {"access": {...}|null,
     "default": {...}|null} (see master/acl.py dict shape). Only the

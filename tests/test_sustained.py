@@ -1,0 +1,220 @@
+"""Sustained ("reserved") files: unlink-while-open keeps the data alive
+until the last close (reference: src/master/filesystem_node_types.h
+trash & reserved namespaces; sessions carry open files).
+
+Covers: unlink with zero trash time, trash expiry with live openers,
+multi-session refcounts, chunk/quota release at last close, session
+death releasing handles, and persistence across a master restart."""
+
+import asyncio
+
+import pytest
+
+from lizardfs_tpu.proto import status as st
+
+from tests.test_cluster import Cluster
+
+pytestmark = pytest.mark.asyncio
+
+
+async def test_unlink_while_open_sustains(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        b = await cluster.client()
+        f = await a.create(1, "hot.bin")
+        await a.settrashtime(f.inode, 0)  # no trash: straight to delete
+        payload = b"still-here!" * 5000
+        await a.write_file(f.inode, payload)
+
+        await a.open(f.inode)
+        await b.unlink(1, "hot.bin")
+
+        # name is gone...
+        with pytest.raises(st.StatusError):
+            await b.lookup(1, "hot.bin")
+        # ...but the open handle still reads (sustained)
+        master = cluster.master
+        assert f.inode in master.meta.fs.sustained
+        back = await a.read_file(f.inode, 0, len(payload))
+        assert bytes(back) == payload
+
+        # chunk data must still be registered
+        node = master.meta.fs.nodes[f.inode]
+        assert any(cid for cid in node.chunks)
+        chunk_ids = [c for c in node.chunks if c]
+        assert all(c in master.meta.registry.chunks for c in chunk_ids)
+
+        # last release frees everything
+        await a.release(f.inode)
+        assert f.inode not in master.meta.fs.nodes
+        assert f.inode not in master.meta.fs.sustained
+        for c in chunk_ids:
+            assert c not in master.meta.registry.chunks
+    finally:
+        await cluster.stop()
+
+
+async def test_multiple_holders_counted(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        b = await cluster.client()
+        f = await a.create(1, "shared.bin")
+        await a.settrashtime(f.inode, 0)
+        await a.write_file(f.inode, b"x" * 1000)
+        await a.open(f.inode)
+        await a.open(f.inode)  # double open from the same session
+        await b.open(f.inode)
+        await a.unlink(1, "shared.bin")
+        master = cluster.master
+
+        await a.release(f.inode)
+        assert f.inode in master.meta.fs.nodes  # a still holds one
+        await a.release(f.inode)
+        assert f.inode in master.meta.fs.nodes  # b still holds one
+        assert bytes(await b.read_file(f.inode, 0, 4)) == b"xxxx"
+        await b.release(f.inode)
+        assert f.inode not in master.meta.fs.nodes
+    finally:
+        await cluster.stop()
+
+
+async def test_trash_expiry_with_opener_sustains(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        f = await a.create(1, "trashy.bin")
+        await a.settrashtime(f.inode, 1)  # 1 s trash
+        await a.write_file(f.inode, b"t" * 100)
+        await a.open(f.inode)
+        await a.unlink(1, "trashy.bin")
+        master = cluster.master
+        assert f.inode in master.meta.fs.trash
+
+        async def sustained():
+            return (f.inode in master.meta.fs.sustained
+                    and f.inode not in master.meta.fs.trash)
+        for _ in range(80):  # purge timer runs every 10 s? force it
+            await master._purge_trash()
+            if await sustained():
+                break
+            await asyncio.sleep(0.1)
+        assert await sustained()
+        assert bytes(await a.read_file(f.inode, 0, 4)) == b"tttt"
+        await a.release(f.inode)
+        assert f.inode not in master.meta.fs.nodes
+    finally:
+        await cluster.stop()
+
+
+async def test_session_close_releases_handles(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        b = await cluster.client()
+        f = await a.create(1, "dying.bin")
+        await a.settrashtime(f.inode, 0)
+        await a.write_file(f.inode, b"d" * 100)
+        await b.open(f.inode)
+        await a.unlink(1, "dying.bin")
+        master = cluster.master
+        assert f.inode in master.meta.fs.sustained
+        # b's clean goodbye drops its handle -> file freed
+        await b.close()
+        cluster.clients.remove(b)
+        for _ in range(50):
+            if f.inode not in master.meta.fs.nodes:
+                break
+            await asyncio.sleep(0.1)
+        assert f.inode not in master.meta.fs.nodes
+    finally:
+        await cluster.stop()
+
+
+async def test_sustained_survives_master_restart(tmp_path):
+    """open_refs + sustained persist in the image and changelog: a
+    replayed master still knows the file is held open."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        f = await a.create(1, "durable.bin")
+        await a.settrashtime(f.inode, 0)
+        await a.write_file(f.inode, b"z" * 100)
+        await a.open(f.inode)
+        await a.unlink(1, "durable.bin")
+        master = cluster.master
+        assert f.inode in master.meta.fs.sustained
+        await master._dump_image()
+
+        # reload the image into a fresh store (restart simulation)
+        from lizardfs_tpu.master.changelog import load_image
+        from lizardfs_tpu.master.metadata import MetadataStore
+
+        version, doc = load_image(master.data_dir)
+        store = MetadataStore()
+        store.load_sections(doc)
+        assert f.inode in store.fs.sustained
+        assert store.fs.open_refs.get(f.inode)
+        # digest machinery knows the new entity kinds
+        assert store.full_digest() == store._digest
+    finally:
+        await cluster.stop()
+
+
+async def test_relink_sustained_file_clears_sustain(tmp_path):
+    """link() of a sustained inode gives it a name again — the last
+    release must NOT free it out from under the new directory entry
+    (caught in review)."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        f = await a.create(1, "orig.bin")
+        await a.settrashtime(f.inode, 0)
+        await a.write_file(f.inode, b"kept" * 100)
+        await a.open(f.inode)
+        await a.unlink(1, "orig.bin")
+        master = cluster.master
+        assert f.inode in master.meta.fs.sustained
+        await a.link(f.inode, 1, "reborn.bin")
+        assert f.inode not in master.meta.fs.sustained
+        await a.release(f.inode)
+        # the node lives on under its new name; directory is readable
+        assert f.inode in master.meta.fs.nodes
+        entries = await a.readdir(1)
+        assert "reborn.bin" in [e.name for e in entries]
+        assert bytes(await a.read_file(f.inode, 0, 4)) == b"kept"
+    finally:
+        await cluster.stop()
+
+
+async def test_duplicate_open_handle_not_double_counted(tmp_path):
+    """A retried CltomaOpen with the same handle id (lost-reply
+    reconnect) must not double-count the ref (caught in review)."""
+    from lizardfs_tpu.proto import messages as m
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        f = await a.create(1, "retry.bin")
+        await a.settrashtime(f.inode, 0)
+        await a.write_file(f.inode, b"r" * 10)
+        handle = await a.open(f.inode)
+        # simulate the transparent retry re-sending the same handle
+        await a._call(m.CltomaOpen, inode=f.inode, handle=handle)
+        master = cluster.master
+        assert sum(master.meta.fs.open_refs[f.inode].values()) == 1
+        await a.unlink(1, "retry.bin")
+        await a.release(f.inode, handle)
+        assert f.inode not in master.meta.fs.nodes  # one release freed it
+        # a retried RELEASE for the now-unregistered handle is a no-op
+        await a._call(m.CltomaRelease, inode=f.inode, handle=handle)
+    finally:
+        await cluster.stop()
