@@ -1964,6 +1964,10 @@ class MasterServer(Daemon):
         )
         self.metrics.gauge("chunkservers_connected").set(len(self.cs_links))
         self.metrics.gauge("inodes").set(len(self.meta.fs.nodes))
+        self.metrics.gauge("open_files").set(len(self.meta.fs.open_refs))
+        self.metrics.gauge("sustained_files").set(
+            len(self.meta.fs.sustained)
+        )
         # released chunks: delete their on-disk parts
         drained = self.meta.registry.pending_deletes[:16]
         del self.meta.registry.pending_deletes[:16]
@@ -2354,6 +2358,9 @@ class MasterServer(Daemon):
                     for s in self.meta.registry.servers.values()
                 ],
                 "sessions": len(self.sessions),
+                "open_files": len(self.meta.fs.open_refs),
+                "sustained_files": len(self.meta.fs.sustained),
+                "trash_files": len(self.meta.fs.trash),
             }
             await framing.send_message(
                 writer,

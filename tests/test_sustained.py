@@ -218,3 +218,27 @@ async def test_duplicate_open_handle_not_double_counted(tmp_path):
         await a._call(m.CltomaRelease, inode=f.inode, handle=handle)
     finally:
         await cluster.stop()
+
+
+async def test_open_release_churn_leaves_no_state(tmp_path):
+    """Open/release cycles must not leak registry state (a long-lived
+    mount opens millions of files over its lifetime)."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        a = await cluster.client()
+        f = await a.create(1, "churn.bin")
+        await a.write_file(f.inode, b"c")
+        for _ in range(50):
+            h = await a.open(f.inode)
+            await a.release(f.inode, h)
+        master = cluster.master
+        assert not master.meta.fs.open_refs
+        assert not master.meta.fs.sustained
+        assert not a._open_handles
+        sess = master.sessions[a.session_id]
+        assert not sess.get("open_handles")
+        # digest stayed consistent through the churn
+        assert master.meta.full_digest() == master.meta._digest
+    finally:
+        await cluster.stop()
