@@ -15,7 +15,6 @@ the floating-IP helper glue).
 import asyncio
 import sys
 
-from lizardfs_tpu.core import geometry
 from lizardfs_tpu.master.server import MasterServer
 from lizardfs_tpu.runtime.config import Config
 from lizardfs_tpu.runtime.daemon import setup_logging
@@ -27,51 +26,32 @@ def _hostport(s: str) -> tuple[str, int]:
 
 
 async def _run(cfg: Config) -> None:
-    goals = geometry.default_goals()
-    goals_path = cfg.get_str("GOALS_CFG", "")
-    if goals_path:
-        with open(goals_path) as f:
-            goals = geometry.load_goal_config(f.read())
     personality = cfg.get_str("PERSONALITY", "master")
     active = cfg.get_str("ACTIVE_MASTER", "")
-    exports = topology = None
-    exports_path = cfg.get_str("EXPORTS_CFG", "")
-    if exports_path:
-        from lizardfs_tpu.master.exports import Exports
-
-        with open(exports_path) as f:
-            exports = Exports.load(f.read())
-    topology_path = cfg.get_str("TOPOLOGY_CFG", "")
-    if topology_path:
-        from lizardfs_tpu.master.exports import Topology
-
-        with open(topology_path) as f:
-            topology = Topology.load(f.read())
-    # per-cgroup IO limits (mfsiolimits.cfg analog)
-    io_limit_subsystem, io_limits = "", None
-    iolimits_path = cfg.get_str("IO_LIMITS_CFG", "")
-    if iolimits_path:
-        from lizardfs_tpu.utils.io_limits import parse_limits_cfg
-
-        with open(iolimits_path) as f:
-            io_limit_subsystem, io_limits = parse_limits_cfg(f.read())
+    config_paths = {
+        key: path for key, path in (
+            ("goals", cfg.get_str("GOALS_CFG", "")),
+            ("exports", cfg.get_str("EXPORTS_CFG", "")),
+            ("topology", cfg.get_str("TOPOLOGY_CFG", "")),
+            ("iolimits", cfg.get_str("IO_LIMITS_CFG", "")),
+        ) if path
+    }
     server = MasterServer(
         data_dir=cfg.get_str("DATA_PATH", "./master-data"),
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
         port=cfg.get_int("LISTEN_PORT", 9420),
-        goals=goals,
         health_interval=cfg.get_float("HEALTH_INTERVAL", 1.0),
         image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0),
         personality=personality,
         active_addr=_hostport(active) if active else None,
-        exports=exports,
-        topology=topology,
         io_limit_bps=cfg.get_int("IO_LIMIT_BPS", 0),
-        io_limit_subsystem=io_limit_subsystem,
-        io_limits=io_limits,
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
         lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0),
+        config_paths=config_paths,
     )
+    # initial load runs the SAME code as SIGHUP reload, strictly: boot
+    # fails loudly on a bad file instead of serving half a config
+    server.reload(strict=True)
     controller = None
     if cfg.get_str("ELECTION_ID", ""):
         from lizardfs_tpu.ha.controller import FailoverController

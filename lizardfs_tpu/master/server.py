@@ -119,6 +119,7 @@ class MasterServer(Daemon):
         io_limit_subsystem: str = "",
         admin_password: str | None = None,
         lock_grace_seconds: float = 30.0,
+        config_paths: dict[str, str] | None = None,
     ):
         super().__init__(host, port)
         self.admin_password = admin_password
@@ -185,7 +186,61 @@ class MasterServer(Daemon):
         self.personality = personality
         self.active_addr = active_addr
         self._shadow_task: asyncio.Task | None = None
+        # config file paths for SIGHUP / admin `reload` (cfg_reload
+        # analog): keys "goals", "exports", "topology", "iolimits"
+        self.config_paths = dict(config_paths or {})
         self.log = logging.getLogger("master")
+
+    def reload(self, strict: bool = False) -> None:
+        """SIGHUP / admin reload: re-read the runtime-reloadable config
+        files (reference: cfg_reload + registered hooks — mfsgoals,
+        mfsexports, mfstopology, iolimits). A file that fails to parse
+        keeps its previous in-memory config (never half-apply).
+
+        ``strict=True`` raises on the first bad file — the STARTUP
+        loading path (__main__) runs the same code so boot and SIGHUP
+        can never interpret a file differently."""
+        reloaded, failed = [], []
+
+        def attempt(key, fn):
+            path = self.config_paths.get(key)
+            if not path:
+                return
+            try:
+                with open(path) as f:
+                    fn(f.read())
+                reloaded.append(key)
+            except Exception:  # noqa: BLE001 — keep serving on bad config
+                if strict:
+                    raise
+                self.log.exception("reload of %s (%s) failed", key, path)
+                failed.append(key)
+
+        def goals(text):
+            self.goals = geometry.load_goal_config(text)
+
+        def exports(text):
+            from lizardfs_tpu.master.exports import Exports
+
+            self.exports = Exports.load(text)
+
+        def topology(text):
+            from lizardfs_tpu.master.exports import Topology
+
+            self.topology = Topology.load(text)
+
+        def iolimits(text):
+            from lizardfs_tpu.utils.io_limits import parse_limits_cfg
+
+            self.io_limit_subsystem, self.io_limits = parse_limits_cfg(text)
+
+        attempt("goals", goals)
+        attempt("exports", exports)
+        attempt("topology", topology)
+        attempt("iolimits", iolimits)
+        self._last_reload = {"reloaded": reloaded, "failed": failed}
+        if reloaded or failed:
+            self.log.info("config reload: ok=%s failed=%s", reloaded, failed)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -2378,6 +2433,16 @@ class MasterServer(Daemon):
         if msg.command == "save-metadata":
             await self._dump_image()
             return m.AdminReply(req_id=msg.req_id, status=st.OK, json="{}")
+        if msg.command == "reload":
+            self.reload()
+            result = getattr(self, "_last_reload", {})
+            return m.AdminReply(
+                req_id=msg.req_id,
+                # scripts check the status like they do for tweaks-set:
+                # a partial reload is a failure, details in the JSON
+                status=st.OK if not result.get("failed") else st.EINVAL,
+                json=json.dumps(result),
+            )
         if msg.command == "chunks-health":
             healthy = endangered = lost = 0
             for chunk in self.meta.registry.chunks.values():

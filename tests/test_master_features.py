@@ -15,6 +15,10 @@ from lizardfs_tpu.master.locks import (
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.utils import data_generator
 
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.master.server import MasterServer
+
 from tests.test_cluster import Cluster, EC_GOAL
 
 
@@ -263,3 +267,56 @@ async def test_concurrent_lock_waiters(tmp_path):
         assert await asyncio.wait_for(w2, 5) is True
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_config_reload_swaps_goals_and_limits(tmp_path):
+    """SIGHUP / admin `reload` re-reads goals/exports/iolimits at
+    runtime (reference: cfg_reload hooks); a broken file keeps the
+    previous config instead of half-applying."""
+    from lizardfs_tpu.core import geometry
+
+    goals_path = tmp_path / "goals.cfg"
+    limits_path = tmp_path / "iolimits.cfg"
+    goals_path.write_text("1 one : _\n")
+    limits_path.write_text("limit unclassified 1000000\n")
+    master = MasterServer(
+        str(tmp_path / "m"),
+        goals=geometry.load_goal_config(goals_path.read_text()),
+        io_limits={"unclassified": 1_000_000},
+        config_paths={"goals": str(goals_path),
+                      "iolimits": str(limits_path)},
+    )
+    await master.start()
+    cs = ChunkServer(str(tmp_path / "cs"),
+                     master_addr=("127.0.0.1", master.port))
+    await cs.start()
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    try:
+        f = await c.create(1, "x.bin")
+        # goal 7 is a default single-copy goal pre-reload
+        assert master.goals[7].disk_slice().type.is_standard
+
+        goals_path.write_text("1 one : _\n7 seven : $xor3\n")
+        limits_path.write_text(
+            "subsystem blkio\nlimit unclassified 5000000\n"
+        )
+        master.reload()
+        assert master._last_reload == {
+            "reloaded": ["goals", "iolimits"], "failed": [],
+        }
+        assert master.goals[7].disk_slice().type.is_xor  # new def live
+        await c.setgoal(f.inode, 7)
+        assert master.io_limits == {"unclassified": 5_000_000}
+        assert master.io_limit_subsystem == "blkio"
+
+        # a corrupt file keeps the old config
+        goals_path.write_text("not a goal line at all : : :\n")
+        master.reload()
+        assert master._last_reload["failed"] == ["goals"]
+        assert master.goals[7].disk_slice().type.is_xor  # old config kept
+    finally:
+        await c.close()
+        await cs.stop()
+        await master.stop()
