@@ -1,0 +1,194 @@
+"""Multi-PROCESS system tests: real daemons, real kill -9.
+
+The reference's system tier launches masters + chunkservers as separate
+processes and kills them mid-IO (reference: tests/tools/lizardfs.sh
+setup_local_empty_lizardfs; ShortSystemTests/test_cs_failure_during_
+xor_read.sh). The in-process Cluster helper can only stop daemons
+gracefully — SIGKILL semantics (no clean goodbye, kernel-closed
+sockets, heartbeat-timeout paths, image+changelog replay on restart)
+only show up with real processes."""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.utils import data_generator
+
+pytestmark = pytest.mark.asyncio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ProcCluster:
+    """master + N chunkservers as subprocesses on localhost."""
+
+    def __init__(self, tmp_path, n_cs=3):
+        self.tmp = tmp_path
+        self.n_cs = n_cs
+        self.master_port = _free_port()
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def _spawn(self, name: str, module: str, cfg_text: str) -> None:
+        cfg = self.tmp / f"{name}.cfg"
+        cfg.write_text(cfg_text)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, "-m", module, str(cfg)],
+            stdout=open(self.tmp / f"{name}.log", "wb"),
+            stderr=subprocess.STDOUT, env=env,
+        )
+
+    async def start(self) -> None:
+        (self.tmp / "goals.cfg").write_text(
+            "1 one : _\n5 ec32 : $ec(3,2)\n"
+        )
+        self._spawn(
+            "master", "lizardfs_tpu.master",
+            f"DATA_PATH = {self.tmp}/master\n"
+            f"LISTEN_PORT = {self.master_port}\n"
+            f"GOALS_CFG = {self.tmp}/goals.cfg\n"
+            "HEALTH_INTERVAL = 0.3\n",
+        )
+        await self._wait_port(self.master_port)
+        for i in range(self.n_cs):
+            self._spawn(
+                f"cs{i}", "lizardfs_tpu.chunkserver",
+                f"DATA_PATH = {self.tmp}/cs{i}\n"
+                f"LISTEN_PORT = {_free_port()}\n"
+                f"MASTER_PORT = {self.master_port}\n"
+                "HEARTBEAT_INTERVAL = 0.3\n",
+            )
+        # all chunkservers registered
+        for _ in range(100):
+            if await self._cs_count() >= self.n_cs:
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError("chunkservers never registered")
+
+    async def _cs_count(self) -> int:
+        import json
+
+        from lizardfs_tpu.proto import framing
+        from lizardfs_tpu.proto import messages as m
+
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", self.master_port)
+            await framing.send_message(w, m.AdminInfo(req_id=1))
+            reply = await framing.read_message(r)
+            w.close()
+            return sum(
+                1 for s in json.loads(reply.json)["chunkservers"]
+                if s["connected"]
+            )
+        except (ConnectionError, OSError):
+            return 0
+
+    async def _wait_port(self, port: int, timeout=15.0) -> None:
+        for _ in range(int(timeout / 0.1)):
+            try:
+                _, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                return
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.1)
+        raise AssertionError(f"port {port} never came up")
+
+    def kill9(self, name: str) -> None:
+        self.procs[name].send_signal(signal.SIGKILL)
+        self.procs[name].wait(timeout=10)
+
+    def stop(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def test_sigkill_chunkserver_degraded_read(tmp_path):
+    """kill -9 a chunkserver mid-cluster: EC reads recover through the
+    survivors, and the health engine re-replicates."""
+    cluster = ProcCluster(tmp_path, n_cs=4)
+    await cluster.start()
+    try:
+        c = Client("127.0.0.1", cluster.master_port, wave_timeout=0.3)
+        await c.connect()
+        f = await c.create(1, "victim.bin")
+        await c.setgoal(f.inode, 5)  # ec(3,2)
+        payload = data_generator.generate(1, 5 * 2**20 + 333).tobytes()
+        await c.write_file(f.inode, payload)
+
+        cluster.kill9("cs0")  # no goodbye, no flush
+        got = await c.read_file(f.inode)
+        assert got == payload, "degraded read after SIGKILL"
+        # health engine restores full redundancy on the survivors
+        for _ in range(150):
+            if await cluster._cs_count() == 3:
+                break
+            await asyncio.sleep(0.1)
+        await c.close()
+    finally:
+        cluster.stop()
+
+
+async def test_sigkill_master_restart_replays(tmp_path):
+    """kill -9 the master (no image dump): the restart replays the
+    changelog and serves the same namespace and bytes."""
+    cluster = ProcCluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = Client("127.0.0.1", cluster.master_port, wave_timeout=0.3)
+        await c.connect()
+        f = await c.create(1, "durable.bin")
+        await c.setgoal(f.inode, 5)
+        payload = data_generator.generate(2, 2 * 2**20).tobytes()
+        await c.write_file(f.inode, payload)
+        await c.mkdir(1, "docs")
+        await c.close()
+
+        cluster.kill9("master")
+        cluster._spawn(
+            "master", "lizardfs_tpu.master",
+            f"DATA_PATH = {tmp_path}/master\n"
+            f"LISTEN_PORT = {cluster.master_port}\n"
+            f"GOALS_CFG = {tmp_path}/goals.cfg\n"
+            "HEALTH_INTERVAL = 0.3\n",
+        )
+        await cluster._wait_port(cluster.master_port)
+        # chunkservers reconnect on their heartbeat (0.3 s interval)
+        for _ in range(200):
+            if await cluster._cs_count() >= 3:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("chunkservers never re-registered")
+
+        c2 = Client("127.0.0.1", cluster.master_port, wave_timeout=0.3)
+        await c2.connect()
+        attr = await c2.lookup(1, "durable.bin")
+        assert attr.length == len(payload)
+        assert (await c2.lookup(1, "docs")).inode > 0
+        got = await c2.read_file(attr.inode)
+        assert got == payload, "bytes lost across master SIGKILL"
+        await c2.close()
+    finally:
+        cluster.stop()
