@@ -3,7 +3,10 @@
 Config keys (mfschunkserver.cfg analog): DATA_PATH (comma-separated
 folders allowed), HDD_CFG (file listing one data folder per line,
 mfshdd.cfg analog; overrides DATA_PATH), LISTEN_HOST, LISTEN_PORT,
-MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|cpp|tpu|auto), LOG_LEVEL.
+MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|cpp|tpu|auto),
+HEARTBEAT_INTERVAL (seconds; also the master-reconnect cadence),
+ADMIN_PASSWORD (challenge-response auth for privileged admin
+commands), LOG_LEVEL.
 """
 
 import asyncio
@@ -45,6 +48,7 @@ def main() -> None:
         port=cfg.get_int("LISTEN_PORT", 0),
         label=cfg.get_str("LABEL", "_"),
         encoder_name=cfg.get_str("ENCODER", "cpu"),
+        heartbeat_interval=cfg.get_float("HEARTBEAT_INTERVAL", 5.0, min_value=0.05),
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
     )
     asyncio.run(server.run_forever())

@@ -40,13 +40,13 @@ async def _run(cfg: Config) -> None:
         data_dir=cfg.get_str("DATA_PATH", "./master-data"),
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
         port=cfg.get_int("LISTEN_PORT", 9420),
-        health_interval=cfg.get_float("HEALTH_INTERVAL", 1.0),
-        image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0),
+        health_interval=cfg.get_float("HEALTH_INTERVAL", 1.0, min_value=0.05),
+        image_interval=cfg.get_float("IMAGE_INTERVAL", 300.0, min_value=1.0),
         personality=personality,
         active_addr=_hostport(active) if active else None,
         io_limit_bps=cfg.get_int("IO_LIMIT_BPS", 0),
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
-        lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0),
+        lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0, min_value=0.0),
         config_paths=config_paths,
     )
     # initial load runs the SAME code as SIGHUP reload, strictly: boot

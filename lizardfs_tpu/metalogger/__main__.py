@@ -21,7 +21,7 @@ async def _run(cfg: Config) -> None:
     ml = Metalogger(
         cfg.get_str("DATA_PATH", "./metalogger-data"),
         addrs,
-        image_interval=cfg.get_float("IMAGE_INTERVAL", 3600.0),
+        image_interval=cfg.get_float("IMAGE_INTERVAL", 3600.0, min_value=1.0),
     )
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -81,16 +81,29 @@ class Config:
             raise ConfigError(f"{key}={value} above maximum {max_value}")
         return value
 
-    def get_float(self, key: str, default: float | None = None) -> float:
+    def get_float(
+        self,
+        key: str,
+        default: float | None = None,
+        min_value: float | None = None,
+    ) -> float:
         v = self._raw(key, None)
         if v is None:
             if default is None:
                 raise ConfigError(f"missing config key {key}")
-            return default
-        try:
-            return float(str(v))
-        except ValueError:
-            raise ConfigError(f"config key {key}={v!r} is not a number") from None
+            value = default
+        else:
+            try:
+                value = float(str(v))
+            except ValueError:
+                raise ConfigError(
+                    f"config key {key}={v!r} is not a number"
+                ) from None
+        # ranged validation like get_int: a zero/negative timer interval
+        # busy-loops the daemon instead of failing fast
+        if min_value is not None and value < min_value:
+            raise ConfigError(f"config key {key}={value} below {min_value}")
+        return value
 
     def get_bool(self, key: str, default: bool | None = None) -> bool:
         v = self._raw(key, None)

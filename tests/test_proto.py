@@ -166,6 +166,10 @@ ENABLE = yes
         cfg.get_int("MISSING")
     with pytest.raises(ConfigError):
         cfg.get_int("PORT", min_value=10000)
+    # ranged floats: a zero timer interval would busy-loop a daemon
+    assert cfg.get_float("RATIO", min_value=1.0) == 1.5
+    with pytest.raises(ConfigError):
+        cfg.get_float("RATIO", min_value=2.0)
     p.write_text("PORT = 1\n")
     cfg.reload()
     assert cfg.get_int("PORT") == 1
