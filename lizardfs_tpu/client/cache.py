@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
-import numpy as np
-
 from lizardfs_tpu.constants import MFSBLOCKSIZE
 
 

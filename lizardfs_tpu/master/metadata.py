@@ -10,7 +10,7 @@ one implementation instead of two.
 from __future__ import annotations
 
 from lizardfs_tpu.master.chunks import ChunkRegistry
-from lizardfs_tpu.master.fs import FsError, FsTree
+from lizardfs_tpu.master.fs import FsTree
 from lizardfs_tpu.master.locks import LockManager
 from lizardfs_tpu.master.quotas import QuotaDatabase
 

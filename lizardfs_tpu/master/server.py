@@ -26,7 +26,6 @@ import logging
 import os
 import time
 
-from lizardfs_tpu.constants import MFSCHUNKSIZE
 from lizardfs_tpu.core import geometry
 from lizardfs_tpu.master import fs as fsmod
 from lizardfs_tpu.master.changelog import Changelog, load_image, save_image

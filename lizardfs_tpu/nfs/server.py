@@ -26,7 +26,7 @@ import time
 from lizardfs_tpu.client.client import Client
 from lizardfs_tpu.constants import MFSBLOCKSIZE
 from lizardfs_tpu.nfs import rpc
-from lizardfs_tpu.nfs.xdr import Packer, Unpacker, XdrError
+from lizardfs_tpu.nfs.xdr import Packer, Unpacker
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 
