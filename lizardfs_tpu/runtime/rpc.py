@@ -74,6 +74,12 @@ class RpcConnection:
         self, msg_cls, *, timeout: float = 30.0, **fields
     ) -> Message:
         """Send a request (auto req_id) and await its response."""
+        if self._closed.is_set():
+            # the pump is gone: nothing will ever resolve the future.
+            # Failing fast here is what makes client failover prompt —
+            # without it every call on a dead connection burns the full
+            # timeout before the reconnect path runs.
+            raise ConnectionError("connection lost")
         req_id = next(self._req_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
