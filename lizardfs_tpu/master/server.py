@@ -73,8 +73,15 @@ class _CsLink:
         # both directions share one connection (see rpc.RpcConnection._pump)
         self._req_ids = iter(range(1 << 30, 1 << 62))
         self._pending: dict[int, asyncio.Future] = {}
+        self._dead = False
 
     async def command(self, msg_cls, *, timeout: float = 20.0, **fields):
+        if self._dead:
+            # a coroutine that kept this link across an await while the
+            # chunkserver dropped would otherwise park on a future
+            # nothing resolves until the full timeout (rpc.py fast-fail
+            # pattern — failover latency, not correctness)
+            raise ConnectionError("chunkserver disconnected")
         req_id = next(self._req_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
@@ -92,6 +99,7 @@ class _CsLink:
         return False
 
     def fail_all(self):
+        self._dead = True
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("chunkserver disconnected"))
