@@ -100,6 +100,11 @@ class Client:
         # open handles this client registered: inode -> [handle ids]
         # (release() without an explicit handle drops the most recent)
         self._open_handles: dict[int, list[int]] = {}
+        # (parent inode, name) -> (inode, expiry): TTL dentry cache for
+        # path walks (see resolve); LRU-bounded
+        from collections import OrderedDict as _OD
+
+        self._dentry: "_OD[tuple[int, str], tuple[int, float]]" = _OD()
         # reusable stripe-scatter staging buffers, keyed (d, part_len):
         # a fresh 64 MiB allocation pays its page faults inside the
         # scatter copy (~2x measured cost); the write window keeps at
@@ -337,6 +342,7 @@ class Client:
         r = await self._call(
             m.CltomaMkdir, parent=parent, name=name, mode=mode, uid=uid, gid=gid
         )
+        self._dentry_drop(parent, name)
         return r.attr
 
     async def create(
@@ -345,6 +351,7 @@ class Client:
         r = await self._call(
             m.CltomaCreate, parent=parent, name=name, mode=mode, uid=uid, gid=gid
         )
+        self._dentry_drop(parent, name)
         return r.attr
 
     async def readdir(self, inode: int, uid: int | None = None,
@@ -359,12 +366,14 @@ class Client:
         await self._call(
             m.CltomaUnlink, parent=parent, name=name, **self._ident(uid, gids)
         )
+        self._dentry_drop(parent, name)
 
     async def rmdir(self, parent: int, name: str, uid: int | None = None,
                      gids: list[int] | None = None) -> None:
         await self._call(
             m.CltomaRmdir, parent=parent, name=name, **self._ident(uid, gids)
         )
+        self._dentry_drop(parent, name)
 
     async def rename(self, psrc: int, nsrc: str, pdst: int, ndst: str,
                      uid: int | None = None,
@@ -374,6 +383,8 @@ class Client:
             parent_src=psrc, name_src=nsrc, parent_dst=pdst, name_dst=ndst,
             **self._ident(uid, gids),
         )
+        self._dentry_drop(psrc, nsrc)
+        self._dentry_drop(pdst, ndst)
 
     async def symlink(self, parent: int, name: str, target: str,
                       uid: int = 0, gid: int = 0) -> m.Attr:
@@ -381,6 +392,7 @@ class Client:
             m.CltomaSymlink, parent=parent, name=name, target=target,
             uid=uid, gid=gid
         )
+        self._dentry_drop(parent, name)
         return r.attr
 
     async def readlink(self, inode: int) -> str:
@@ -394,6 +406,7 @@ class Client:
             m.CltomaLink, inode=inode, parent=parent, name=name,
             **self._ident(uid, gids),
         )
+        self._dentry_drop(parent, name)
         return r.attr
 
     async def setgoal(self, inode: int, goal: int,
@@ -426,13 +439,47 @@ class Client:
     async def settrashtime(self, inode: int, seconds: int) -> m.Attr:
         return await self.setattr(inode, 32, trash_time=seconds)
 
+    # directory-entry cache TTL for path walks (reference: the mount's
+    # direntry cache / kernel entry_timeout model — staleness across
+    # OTHER clients' renames is bounded by this; local mutations
+    # invalidate immediately)
+    DENTRY_TTL = 1.0
+
+    def _dentry_drop(self, parent: int, name: str) -> None:
+        self._dentry.pop((parent, name), None)
+
     async def resolve(self, path: str) -> m.Attr:
-        """Walk an absolute path from the root inode."""
-        attr = await self.getattr(1)
-        for comp in path.strip("/").split("/"):
-            if comp:
-                attr = await self.lookup(attr.inode, comp)
-        return attr
+        """Walk an absolute path from the root inode.
+
+        Intermediate DIRECTORY components come from a TTL dentry cache
+        (FUSE resolves a path per operation — an uncached walk costs
+        O(depth) master RPCs per op); the leaf is always looked up
+        fresh so its attributes (size!) are never stale."""
+        import time as _time
+
+        comps = [c for c in path.strip("/").split("/") if c]
+        if not comps:
+            return await self.getattr(1)
+        now = _time.monotonic()
+        parent = 1
+        for comp in comps[:-1]:
+            hit = self._dentry.get((parent, comp))
+            if hit is not None and hit[1] > now:
+                self._dentry.move_to_end((parent, comp))
+                parent = hit[0]
+                continue
+            attr = await self.lookup(parent, comp)
+            if attr.ftype == m.FTYPE_DIR:
+                self._dentry[(parent, comp)] = (
+                    attr.inode, now + self.DENTRY_TTL
+                )
+                # reassignment keeps the old LRU slot; a refreshed
+                # entry must not be the first evicted
+                self._dentry.move_to_end((parent, comp))
+                while len(self._dentry) > 65536:
+                    self._dentry.popitem(last=False)
+            parent = attr.inode
+        return await self.lookup(parent, comps[-1])
 
     async def resolve_parent(self, path: str) -> tuple[m.Attr, str]:
         """-> (parent dir attr, leaf name) for an absolute path."""

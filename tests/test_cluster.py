@@ -431,3 +431,55 @@ async def test_emergency_doubled_part_migrates_when_server_joins(tmp_path):
         assert bytes(await c.read_file(f.inode)) == payload
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_resolve_dentry_cache(tmp_path):
+    """Path walks cache intermediate DIRECTORY components (TTL +
+    local-mutation invalidation); the leaf is always fresh so sizes
+    can't go stale."""
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d1 = await c.mkdir(1, "a")
+        d2 = await c.mkdir(d1.inode, "b")
+        f = await c.create(d2.inode, "f.txt")
+        await c.write_file(f.inode, b"12345")
+
+        before = c.op_counters.get("CltomaLookup", 0)
+        attr = await c.resolve("/a/b/f.txt")
+        assert attr.inode == f.inode
+        cold = c.op_counters.get("CltomaLookup", 0) - before
+        assert cold == 3  # a, b, leaf
+
+        before = c.op_counters.get("CltomaLookup", 0)
+        attr = await c.resolve("/a/b/f.txt")
+        warm = c.op_counters.get("CltomaLookup", 0) - before
+        assert warm == 1, "intermediate dirs should come from the cache"
+        assert attr.length == 5  # leaf attrs fresh
+
+        # leaf freshness: a write's new size is visible immediately
+        await c.pwrite(f.inode, 0, b"123456789")
+        assert (await c.resolve("/a/b/f.txt")).length == 9
+
+        # local rename invalidates the cached component
+        await c.rename(1, "a", 1, "z")
+        assert (await c.resolve("/z/b/f.txt")).inode == f.inode
+        with pytest.raises(st.StatusError):
+            await c.resolve("/a/b/f.txt")
+
+        # TTL bounds cross-client staleness: another session's rename
+        # becomes visible once the entry EXPIRES (genuinely exercise the
+        # expiry comparison: short TTL set BEFORE the caching resolve)
+        c.DENTRY_TTL = 0.05
+        c._dentry.clear()
+        assert (await c.resolve("/z/b/f.txt")).inode == f.inode  # cache @ short TTL
+        c2 = await cluster.client()
+        await c2.rename(1, "z", 1, "w")
+        await asyncio.sleep(0.06)  # entry expires
+        with pytest.raises(st.StatusError):
+            await c.resolve("/z/b/f.txt")
+        assert (await c.resolve("/w/b/f.txt")).inode == f.inode
+    finally:
+        await cluster.stop()
