@@ -462,3 +462,45 @@ async def test_promoted_shadow_keeps_sustained_files(tmp_path):
             await active.stop()
         except Exception:  # noqa: BLE001 — already stopped
             pass
+
+
+@pytest.mark.asyncio
+async def test_dead_connections_fail_fast():
+    """RPCs on a lost connection must raise immediately, not burn the
+    full call timeout — this bounds client failover latency (and the
+    master's command latency to dead chunkserver links)."""
+    import time
+
+    from lizardfs_tpu.master.server import _CsLink
+    from lizardfs_tpu.runtime.rpc import RpcConnection
+
+    # client side: a closed RpcConnection
+    async def handler(reader, writer):
+        writer.close()
+
+    server = await asyncio.start_server(handler, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    conn = await RpcConnection.connect("127.0.0.1", port)
+    try:
+        for _ in range(50):
+            if conn.closed:
+                break
+            await asyncio.sleep(0.02)
+        assert conn.closed, "connection never observed the close"
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            await conn.call(m.CltomaGetattr, inode=1)
+        assert time.monotonic() - t0 < 1.0, "dead-connection call must not wait"
+    finally:
+        await conn.close()
+        server.close()
+        await server.wait_closed()
+
+    # master side: a failed chunkserver link
+    link = _CsLink(None, None, None)
+    link.fail_all()
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        await link.command(m.MatocsSetVersion, chunk_id=1, old_version=1,
+                           new_version=2, part_id=650)
+    assert time.monotonic() - t0 < 1.0
