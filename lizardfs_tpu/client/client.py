@@ -133,6 +133,8 @@ class Client:
         # fast paths (FUSE native read pool) must stand down so every
         # byte passes _throttle (the fast path cannot classify or pace)
         self.io_limits_active = False
+        self.io_limits_probe_interval = 5.0
+        self._limits_probe_task: asyncio.Task | None = None
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -156,7 +158,8 @@ class Client:
             state["next_renew"] = now + 1.0
             try:
                 r = await self.master.call(
-                    m.CltomaIoLimitRequest, group=group, timeout=5.0
+                    m.CltomaIoLimitRequest, group=group, probe=0,
+                    timeout=5.0
                 )
                 rate = float(r.bytes_per_sec)
                 state["next_renew"] = now + r.renew_ms / 1000.0
@@ -248,16 +251,20 @@ class Client:
                 )
                 # one-shot probe: fast paths (FUSE native reads) need to
                 # know AT MOUNT TIME whether any IO limit is configured
-                # — a read-only workload would otherwise never learn
-                try:
-                    r = await conn.call(
-                        m.CltomaIoLimitRequest, group="", timeout=5.0
+                # — a read-only workload would otherwise never learn.
+                # Errors stay inside the helper: registration already
+                # succeeded, so a failed probe must not fail over to
+                # the next master address
+                await self._probe_limits_active()
+                # keep the flag tracking RUNTIME config changes: a
+                # read-only workload on the native fast path never
+                # calls _throttle, so a SIGHUP that enables limits
+                # would otherwise go unnoticed forever
+                if (self._limits_probe_task is None
+                        or self._limits_probe_task.done()):
+                    self._limits_probe_task = asyncio.ensure_future(
+                        self._limits_probe_loop()
                     )
-                    self.io_limits_active = bool(
-                        getattr(r, "limits_active", 0)
-                    )
-                except (ConnectionError, asyncio.TimeoutError):
-                    pass
                 return
             except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
                 last = e
@@ -273,7 +280,30 @@ class Client:
             await self.connect(self._info, getattr(self, "_password", ""))
             return await self.master.call_ok(msg_cls, **fields)
 
+    async def _probe_limits_active(self) -> None:
+        """Probe-only IoLimitRequest (probe=1: never joins the
+        allocation table): refresh io_limits_active, swallowing every
+        transport error — callers must not fail on a lost probe."""
+        try:
+            r = await self.master.call(
+                m.CltomaIoLimitRequest, group="", probe=1, timeout=5.0
+            )
+            self.io_limits_active = bool(getattr(r, "limits_active", 0))
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                st.StatusError):
+            pass  # reconnect path re-probes at connect
+
+    async def _limits_probe_loop(self) -> None:
+        """Periodic probe so io_limits_active tracks runtime config
+        reloads (SIGHUP/admin) even on workloads that never _throttle."""
+        while True:
+            await asyncio.sleep(self.io_limits_probe_interval)
+            await self._probe_limits_active()
+
     async def close(self) -> None:
+        if self._limits_probe_task is not None:
+            self._limits_probe_task.cancel()
+            self._limits_probe_task = None
         if self.master is not None:
             try:
                 # clean goodbye: the master releases our locks now
@@ -930,14 +960,21 @@ class Client:
         if slice_type is None:
             raise st.StatusError(st.NO_CHUNK_SERVERS, "no locations granted")
 
+        # abort handles for every native send this chunk issues: a
+        # cancelled write must kill zombie executor threads before the
+        # staging buffer they stream from can go back to the pool
+        send_cells: list[dict] = []
+
         def send_of(part_idx: int, payload: np.ndarray,
                     skip_throttle: bool = False):
             length = striping.part_length(
                 slice_type, part_idx, len(chunk_data)
             )
+            cell: dict = {}
+            send_cells.append(cell)
             return self._write_part(
                 grant.chunk_id, grant.version, by_part[part_idx],
-                payload, length, skip_throttle=skip_throttle,
+                payload, length, skip_throttle=skip_throttle, cell=cell,
             )
 
         async def send_batch(items: list[tuple[int, np.ndarray]]) -> None:
@@ -959,6 +996,8 @@ class Client:
                     for p, _ in items
                 ]
                 await self._throttle(sum(lengths))
+                cell: dict = {"submitted": True}
+                send_cells.append(cell)
                 try:
                     await native_io.run(
                         native_io.write_parts_scatter_blocking,
@@ -966,7 +1005,7 @@ class Client:
                          for p, _ in items],
                         grant.chunk_id, grant.version,
                         [by_part[p][0].part_id for p, _ in items],
-                        [pay for _, pay in items], lengths,
+                        [pay for _, pay in items], lengths, 0, cell,
                     )
                     self._record("parts_scatter_write")
                     return
@@ -982,12 +1021,36 @@ class Client:
                     return
             await asyncio.gather(*(send_of(p, pay) for p, pay in items))
 
+        from lizardfs_tpu.core import native_io
+
+        def _abort_zombie_sends() -> list[dict]:
+            """Kill executor threads of cancelled/failed native sends:
+            run_in_executor threads are unkillable, so a cancelled send
+            would otherwise keep streaming from its buffer for up to
+            120 s while pinning a native-IO worker."""
+            zombies = [
+                c for c in send_cells
+                if c.get("submitted") and not c.get("finished")
+            ]
+            for c in zombies:
+                native_io.abort_write(c)
+            return zombies
+
         if slice_type.is_standard or slice_type.is_tape:
             # whole-chunk copies: stream the caller's buffer directly
             # (_write_part only reads it) — no 64 MiB staging copy
-            await asyncio.gather(
-                *(send_of(p, chunk_data) for p in by_part)
-            )
+            copy_tasks = [
+                asyncio.ensure_future(send_of(p, chunk_data))
+                for p in by_part
+            ]
+            try:
+                for t in copy_tasks:
+                    await t
+            finally:
+                for t in copy_tasks:
+                    t.cancel()
+                await asyncio.gather(*copy_tasks, return_exceptions=True)
+                _abort_zombie_sends()
             return
         # striped slices: scatter first (cheap memcpy), then stream the
         # DATA parts while the parity encode (the expensive phase,
@@ -1029,8 +1092,14 @@ class Client:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(par_task, *tasks, return_exceptions=True)
-            # all senders are done — the staging buffer is reusable
-            self._stage_release(stage, poolable=full_chunk)
+            # the coroutines are done, but a cancelled native send's
+            # executor thread may still be streaming from the staging
+            # buffer: kill it now, and never pool a buffer a zombie
+            # thread might still read
+            zombies = _abort_zombie_sends()
+            self._stage_release(
+                stage, poolable=full_chunk and not zombies
+            )
 
     def _stage_acquire(self, d: int, part_len: int) -> np.ndarray | None:
         # stage buffers only serve the native scatter; the numpy
@@ -1063,13 +1132,16 @@ class Client:
         length: int,
         part_offset: int = 0,
         skip_throttle: bool = False,
+        cell: dict | None = None,
     ) -> None:
         """Write ``payload[:length]`` at ``part_offset`` within one part:
         head of the chain + forwarding for extra copies (WriteExecutor
         analog, write_executor.cc:66-96). Pieces never cross 64 KiB block
         boundaries; each carries its own CRC. ``skip_throttle``: the
         caller already charged these bytes (QoS rule: charge once, not
-        per retry/fallback)."""
+        per retry/fallback). ``cell``: abort handle for the native path —
+        a cancelled caller must be able to kill the executor thread that
+        is still streaming from ``payload`` (native_io.abort_write)."""
         if not skip_throttle:
             await self._throttle(max(length, 0))
         head = locs[0]
@@ -1082,12 +1154,16 @@ class Client:
             native_io.available()
             and length >= native_io.NATIVE_WRITE_THRESHOLD
         ):
+            if cell is not None:
+                # marked BEFORE the executor hand-off: an abort racing
+                # the thread's connect phase must still see a zombie
+                cell["submitted"] = True
             try:
                 await native_io.run(
                     native_io.write_part_blocking,
                     (head.addr.host, head.addr.port),
                     chunk_id, version, head.part_id, chain,
-                    payload[:length], part_offset,
+                    payload[:length], part_offset, cell,
                 )
                 return
             except native_io.NativeIOError as e:

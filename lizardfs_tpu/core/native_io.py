@@ -158,11 +158,22 @@ def prestart_executors() -> None:
     Runs once per process, at the FIRST daemon/client startup (while
     the pools are quiet — parking tasks in an already-busy shared pool
     would queue behind live work and head-of-line-block it); later
-    callers no-op."""
+    callers no-op. The spawn/join phase itself runs on a helper daemon
+    thread: Thread.start() × 48 workers can take seconds on a loaded
+    single-core box, and the caller is usually ON the event loop
+    (connect/failover) — the very stall this function exists to avoid."""
     global _prestarted
     if _prestarted:
         return
     _prestarted = True
+    import threading
+
+    threading.Thread(
+        target=_prestart_blocking, name="lz-prestart", daemon=True
+    ).start()
+
+
+def _prestart_blocking() -> None:
     import threading
 
     for pool in (EXECUTOR, SERVE_EXECUTOR):
@@ -329,10 +340,21 @@ def write_part_blocking(
     chain: list,
     payload: bytes | np.ndarray,
     part_offset: int,
+    cell: dict | None = None,
 ) -> None:
     """Full write exchange: WriteInit handshake (Python framing), bulk
-    WriteData streaming + acks (native), WriteEnd handshake."""
+    WriteData streaming + acks (native), WriteEnd handshake. ``cell``
+    publishes the live socket so abort_write() can cancel the exchange
+    (the executor thread is otherwise unkillable while it streams from
+    the caller's buffer); ``cell["finished"]`` is set when this thread
+    has stopped touching ``payload``."""
     sock = _blocking_socket(addr, 60.0)
+    if cell is not None:
+        cell["sock"] = sock
+        if cell.get("aborted"):
+            sock.close()
+            cell["finished"] = True
+            raise NativeIOError(-1, "write (aborted)")
     try:
         sock.sendall(
             framing.encode(
@@ -366,6 +388,9 @@ def write_part_blocking(
             raise st.StatusError(getattr(end, "status", st.EIO), "write end")
     finally:
         sock.close()
+        if cell is not None:
+            cell.pop("sock", None)
+            cell["finished"] = True
 
 
 def _n_pieces(offset: int, size: int) -> int:
@@ -518,6 +543,19 @@ def abort_parts_gather(cell: dict) -> None:
             sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
+    sock = cell.get("sock")
+    if sock is not None:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+
+# write-side aborts use the same cell shape ("sock"/"socks" + "aborted");
+# a cancelled write task must kill its executor thread's exchange before
+# the staging buffer the thread streams from can be reused
+abort_write = abort_parts_gather
+abort_parts_scatter = abort_parts_gather
 
 
 def parts_scatter_available() -> bool:
@@ -532,6 +570,7 @@ def write_parts_scatter_blocking(
     payloads: list[np.ndarray],
     lengths: list[int],
     part_offset: int = 0,
+    cell: dict | None = None,
 ) -> None:
     """Write n whole parts (one bulk frame + ack each) in ONE
     poll-driven native exchange — the write-path mirror of
@@ -539,9 +578,28 @@ def write_parts_scatter_blocking(
     (which also runs the per-block CRC pass) replace n of each. The
     WriteInit/WriteEnd handshakes stay in Python framing (they carry
     the variable-length chain list). Raises NativeIOError on the first
-    failing part; the caller falls back to per-part writes."""
+    failing part; the caller falls back to per-part writes. ``cell``
+    publishes the live sockets so abort_parts_scatter() can kill the
+    exchange from another thread; ``cell["finished"]`` marks when this
+    thread has stopped reading from ``payloads``."""
     n = len(addrs)
     assert n == len(part_ids) == len(payloads) == len(lengths)
+    try:
+        _write_parts_scatter(
+            addrs, chunk_id, version, part_ids, payloads, lengths,
+            part_offset, cell,
+        )
+    finally:
+        if cell is not None:
+            cell.pop("socks", None)
+            cell["finished"] = True
+
+
+def _write_parts_scatter(
+    addrs, chunk_id, version, part_ids, payloads, lengths,
+    part_offset, cell,
+) -> None:
+    n = len(addrs)
     for attempt in (0, 1):
         reqs = (_PartReq * n)()
         ptrs = (ctypes.c_void_p * n)()
@@ -559,6 +617,10 @@ def write_parts_scatter_blocking(
                     req_id=1, chunk_id=chunk_id, version=version,
                     part_id=part_ids[i], chain=[], create=False,
                 )))
+            if cell is not None:
+                cell["socks"] = [s for _, s in socks]
+                if cell.get("aborted"):
+                    raise NativeIOError(-1, "parts scatter (aborted)")
             for i, (_, s) in enumerate(socks):
                 init = _recv_message(s)
                 if (not isinstance(init, m.CstoclWriteStatus)
@@ -596,11 +658,15 @@ def write_parts_scatter_blocking(
                 socks.clear()
                 return
             bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
-            if attempt == 0 and bad == -1:
+            if attempt == 0 and bad == -1 and not (
+                cell is not None and cell.get("aborted")
+            ):
                 continue  # stale pooled sockets: redial everything once
             raise NativeIOError(bad, "parts scatter write")
         except (ConnectionError, OSError, st.StatusError):
-            if attempt == 0:
+            if attempt == 0 and not (
+                cell is not None and cell.get("aborted")
+            ):
                 continue  # redial once (pool may hold staled sockets)
             raise
         finally:

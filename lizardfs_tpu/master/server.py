@@ -1225,6 +1225,14 @@ class MasterServer(Daemon):
             )
         if isinstance(msg, m.CltomaIoLimitRequest):
             active = 1 if (self.io_limits or self.io_limit_bps > 0) else 0
+            if getattr(msg, "probe", 0):
+                # pure status query: answer limits_active without
+                # registering the session in the allocation table
+                return m.MatoclIoLimitReply(
+                    req_id=msg.req_id, status=st.OK, bytes_per_sec=0,
+                    renew_ms=10_000, subsystem=self.io_limit_subsystem,
+                    limits_active=active,
+                )
             if self.io_limits:
                 # per-cgroup budgets: resolve the claimed group to its
                 # closest configured ancestor, then share that group's

@@ -584,10 +584,13 @@ class CltomaIoLimitRequest(Message):
     src/mount/io_limit_group.cc classification); "" means
     unclassified. With per-group limits configured, the master matches
     the group against its configured prefixes and divides that group's
-    budget among the sessions renewing under it."""
+    budget among the sessions renewing under it. ``probe=1`` asks only
+    whether limits are configured (``limits_active``) WITHOUT joining
+    the allocation table — connect-time probes must not dilute real
+    consumers' shares for a renew period."""
 
     MSG_TYPE = 1062
-    FIELDS = (("req_id", "u32"), ("group", "str"))
+    FIELDS = (("req_id", "u32"), ("group", "str"), ("probe", "u8"))
 
 
 class MatoclIoLimitReply(Message):

@@ -48,28 +48,66 @@ class Daemon:
         # event-loop stall watchdog (loop_watchdog.h analog): a blocked
         # loop is THE latency failure mode of an asyncio daemon — the
         # reference aborts on a stuck poll loop; here a stall is logged
-        # with its duration and charted so operators see it
+        # with its duration and charted so operators see it. A sampler
+        # THREAD grabs the loop thread's stack while the stall is in
+        # progress (the loop itself can only notice after the fact), so
+        # the warning names a file:line instead of guessing.
         self.watchdog_warn_s = 0.25
         self._wd_last = 0.0
         self._wd_max_lag = 0.0  # worst lag since the last metrics sample
+        self._wd_beat = 0.0  # written by the loop tick, read by sampler
+        self._wd_loop_ident = 0
+        self._wd_sampler_stop: object | None = None
+        self._wd_sampler_thread: object | None = None
+        self._wd_stall_stack: str | None = None  # set mid-stall by sampler
         self.add_timer(0.1, self._watchdog_tick)
+
+    def _wd_sampler(self) -> None:
+        """Watchdog sampler thread: when the loop misses its heartbeat,
+        snapshot the loop thread's Python stack (the culprit is whatever
+        frame the loop thread is stuck in). One capture per stall; a
+        stack parked in select/epoll means GIL starvation by another
+        thread rather than an on-loop blocking call."""
+        import time as _time
+        import traceback as _tb
+
+        captured_for = -1.0
+        while not self._wd_sampler_stop.wait(0.05):
+            beat = self._wd_beat
+            if not beat or beat == captured_for:
+                continue
+            if _time.monotonic() - beat > self.watchdog_warn_s + 0.1:
+                frame = sys._current_frames().get(self._wd_loop_ident)
+                # validate AFTER capturing: a beat that moved means the
+                # stall ended mid-capture and the frame is an innocent
+                # post-stall callback — blaming it would send the
+                # operator to the wrong code (GIL-starved stalls end
+                # exactly when this thread gets to run again)
+                if frame is not None and self._wd_beat == beat:
+                    self._wd_stall_stack = "".join(_tb.format_stack(frame))
+                    captured_for = beat
 
     async def _watchdog_tick(self) -> None:
         import time as _time
 
         now = _time.monotonic()
-        if self._wd_last:
-            lag = max(now - self._wd_last - 0.1, 0.0)
+        # refresh the heartbeat FIRST: the sampler must not attribute
+        # this tick's own logging to the stall it is reporting
+        last, self._wd_last = self._wd_last, now
+        self._wd_beat = now
+        if last:
+            lag = max(now - last - 0.1, 0.0)
             if lag > self.watchdog_warn_s:
+                stack, self._wd_stall_stack = self._wd_stall_stack, None
                 self.log.warning(
-                    "event loop stalled for %.0f ms "
-                    "(blocking call on the loop thread?)", lag * 1000,
+                    "event loop stalled for %.0f ms%s", lag * 1000,
+                    "; loop thread was at:\n" + stack if stack
+                    else " (stack not captured)",
                 )
                 self.metrics.counter("loop_stalls").inc()
             # hold the WORST lag until the 1 Hz sampler reads it —
             # a transient stall must not be erased by the next tick
             self._wd_max_lag = max(self._wd_max_lag, lag)
-        self._wd_last = now
 
     async def _sample_metrics(self) -> None:
         self.metrics.gauge("loop_lag_ms").set(self._wd_max_lag * 1000)
@@ -319,10 +357,21 @@ class Daemon:
         self.port = self._server.sockets[0].getsockname()[1]
         for interval, coro_fn in self._timers:
             self.spawn(self._run_timer(interval, coro_fn))
+        import threading
+
+        self._wd_loop_ident = threading.get_ident()
+        self._wd_sampler_stop = threading.Event()
+        self._wd_sampler_thread = threading.Thread(
+            target=self._wd_sampler, name=self.name + "-watchdog", daemon=True
+        )
+        self._wd_sampler_thread.start()
         self.log.info("%s listening on %s:%d", self.name, self.host, self.port)
 
     async def stop(self) -> None:
         self._stopping.set()
+        if self._wd_sampler_stop is not None:
+            self._wd_sampler_stop.set()
+            self._wd_sampler_thread.join(timeout=1.0)
         if self._server is not None:
             self._server.close()
             # drop live connections: python 3.12's wait_closed() blocks

@@ -320,3 +320,116 @@ async def test_parts_scatter_skips_chained_copies(tmp_path):
         assert bytes(back) == payload
     finally:
         await cluster.stop()
+
+
+# --- write-abort path: zombie sender threads must die promptly --------------
+
+async def test_abort_write_scatter_unblocks_thread():
+    """abort_write must unblock a scatter-write executor thread stuck on
+    an unresponsive chunkserver, and mark the cell finished so the
+    caller knows the payload buffers are no longer being read."""
+    if not native_io.parts_scatter_available():
+        pytest.skip("native parts scatter not built")
+    stalled = asyncio.Event()
+    teardown = asyncio.Event()
+
+    async def stall_handler(reader, writer):
+        try:
+            await reader.read(4096)  # swallow the WriteInit, never reply
+            stalled.set()
+            await teardown.wait()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(stall_handler, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        payloads = [np.zeros(B, dtype=np.uint8) for _ in range(3)]
+        cell: dict = {"submitted": True}
+        fut = asyncio.get_running_loop().run_in_executor(
+            native_io.EXECUTOR,
+            lambda: native_io.write_parts_scatter_blocking(
+                [("127.0.0.1", port)] * 3, 42, 1, [1, 2, 3],
+                payloads, [B] * 3, 0, cell,
+            ),
+        )
+        await asyncio.wait_for(stalled.wait(), 10.0)
+        t0 = asyncio.get_running_loop().time()
+        native_io.abort_write(cell)
+        with pytest.raises((native_io.NativeIOError, OSError)):
+            await asyncio.wait_for(fut, 10.0)
+        assert asyncio.get_running_loop().time() - t0 < 5.0, \
+            "abort did not unblock the sender thread"
+        assert cell.get("finished") is True
+    finally:
+        teardown.set()
+        server.close()
+        await server.wait_closed()
+
+
+async def test_cancelled_striped_write_does_not_pool_staging(
+    tmp_path, monkeypatch
+):
+    """A cancelled chunk write whose native sender may still be running
+    must NOT return the staging buffer to the reuse pool (the zombie
+    thread streams from it; pooling it lets the next chunk's scatter
+    overwrite bytes mid-send) — and must abort the zombie's sockets."""
+    if not (native_io.parts_scatter_available()
+            and native.stripe_helpers_available()):
+        pytest.skip("native fast paths not built")
+    import threading
+    import time as time_mod
+
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "pool.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        full = data_generator.generate(21, MFSCHUNKSIZE).tobytes()
+        # 1) clean full-chunk write pools its staging buffer
+        await c.write_file(f.inode, full)
+        pooled = sum(len(b) for b in c._stage_buffers.values())
+        assert pooled >= 1, "full-chunk write should pool its stage"
+
+        # 2) hung scatter + cancellation: the (reused) buffer must not
+        # come back to the pool, and the cell must be aborted
+        started = threading.Event()
+        seen_cells: list[dict] = []
+
+        def hang_until_abort(addrs, cid, ver, pids, payloads, lengths,
+                             part_offset=0, cell=None):
+            seen_cells.append(cell)
+            started.set()
+            deadline = time_mod.monotonic() + 15.0
+            while time_mod.monotonic() < deadline:
+                if cell is not None and cell.get("aborted"):
+                    break
+                time_mod.sleep(0.01)
+            try:
+                raise native_io.NativeIOError(-1, "hung exchange aborted")
+            finally:
+                if cell is not None:
+                    cell["finished"] = True
+
+        monkeypatch.setattr(
+            native_io, "write_parts_scatter_blocking", hang_until_abort
+        )
+        g = await c.create(1, "pool2.bin")
+        await c.setgoal(g.inode, EC_GOAL)
+        task = asyncio.ensure_future(c.write_file(g.inode, full))
+        await asyncio.wait_for(
+            asyncio.get_running_loop().run_in_executor(None, started.wait, 10),
+            15.0,
+        )
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert sum(len(b) for b in c._stage_buffers.values()) == 0, \
+            "staging buffer pooled while a zombie sender may hold it"
+        assert any(cl and cl.get("aborted") for cl in seen_cells), \
+            "cancelled write did not abort its in-flight sender"
+    finally:
+        await cluster.stop()

@@ -153,3 +153,49 @@ async def test_caller_pid_contextvar_routes_group(tmp_path):
         await c.close()
         await cs.stop()
         await master.stop()
+
+
+async def test_connect_probe_does_not_join_allocation(tmp_path):
+    """The connect-time limits probe (probe=1) must not register the
+    session in the allocation table — a mount/reconnect storm would
+    otherwise dilute real consumers' shares for a renew period."""
+    master = MasterServer(str(tmp_path / "m"), io_limit_bps=1_000_000)
+    await master.start()
+    c = Client("127.0.0.1", master.port)
+    await c.connect("probe-client")
+    try:
+        assert c.io_limits_active is True  # the probe still learns this
+        assert master._io_limited_sessions == {}, \
+            "probe joined the allocation table"
+    finally:
+        await c.close()
+        await master.stop()
+
+
+async def test_limits_active_tracks_runtime_reload(tmp_path):
+    """IO limits enabled AFTER mount (SIGHUP/admin reload) must reach
+    io_limits_active without any _throttle traffic — the native FUSE
+    read fast path consults only this flag."""
+    master = MasterServer(str(tmp_path / "m"))
+    await master.start()
+    c = Client("127.0.0.1", master.port)
+    c.io_limits_probe_interval = 0.1
+    await c.connect("reload-client")
+    try:
+        assert c.io_limits_active is False
+        master.io_limit_bps = 5_000_000  # runtime reload analog
+        for _ in range(50):
+            if c.io_limits_active:
+                break
+            await asyncio.sleep(0.1)
+        assert c.io_limits_active is True, \
+            "probe loop never observed the runtime limit change"
+        master.io_limit_bps = 0
+        for _ in range(50):
+            if not c.io_limits_active:
+                break
+            await asyncio.sleep(0.1)
+        assert c.io_limits_active is False
+    finally:
+        await c.close()
+        await master.stop()

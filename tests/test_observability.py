@@ -102,9 +102,10 @@ def test_timing_histogram():
 
 
 @pytest.mark.asyncio
-async def test_loop_watchdog_detects_stall(tmp_path):
+async def test_loop_watchdog_detects_stall(tmp_path, caplog):
     """loop_watchdog.h analog: a blocking call on the loop thread is
-    detected, logged, and counted."""
+    detected, logged with the loop thread's stack (captured mid-stall
+    by the sampler thread), and counted."""
     import time as _time
 
     from lizardfs_tpu.runtime.daemon import Daemon
@@ -112,11 +113,22 @@ async def test_loop_watchdog_detects_stall(tmp_path):
     d = Daemon()
     await d.start()
     try:
-        await asyncio.sleep(0.3)  # watchdog baseline ticks
-        _time.sleep(0.6)  # blocks the loop: the stall under test
-        await asyncio.sleep(0.3)  # let the watchdog observe it
+        with caplog.at_level("WARNING", logger=d.name):
+            await asyncio.sleep(0.3)  # watchdog baseline ticks
+            _time.sleep(0.8)  # blocks the loop: the stall under test
+            await asyncio.sleep(0.3)  # let the watchdog observe it
         assert d.metrics.counter("loop_stalls").total >= 1
         assert d.metrics.gauge("loop_lag_ms").value >= 0.0
+        stall_logs = [
+            r.getMessage() for r in caplog.records
+            if "event loop stalled" in r.getMessage()
+        ]
+        assert stall_logs
+        # the sampler must name the culprit: this very test's sleep call
+        assert any(
+            "test_observability" in s and "_time.sleep" in s
+            for s in stall_logs
+        ), stall_logs
     finally:
         await d.stop()
 
