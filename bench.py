@@ -311,6 +311,15 @@ def cluster_throughput() -> dict:
                 out[f"cluster_{key}_spread_pct"] = max(
                     r.get("write_spread_pct", 0), r.get("read_spread_pct", 0)
                 )
+                # per-rep raw values + target/met verdicts (r04 #6: a
+                # miss must be readable from the artifact alone)
+                for extra in (
+                    "write_reps_MBps", "read_reps_MBps",
+                    "write_target_MBps", "write_target_met",
+                    "read_target_MBps", "read_target_met",
+                ):
+                    if extra in r:
+                        out[f"cluster_{key}_{extra}"] = r[extra]
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]

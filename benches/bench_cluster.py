@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -36,7 +37,17 @@ GOALS = [
     (12, "ec(8,4)"),
 ]
 
-REPS = 3  # runs per row; rows report the median + spread
+REPS = 3  # runs per non-goal row; rows report the median + spread
+GOAL_REPS = 5  # goal rows: the write direction has been the noisy one
+# (r04 driver capture: goal-2 write spread 116.9%) — more samples +
+# persisted reps make a miss distinguishable from noise in the artifact
+
+# per-row targets (VERDICT r04 #6): a miss must be visible in the JSON
+# itself, not just in review prose
+TARGETS = {
+    ("ec(8,4)", "write_MBps"): 450.0,
+    ("goal 2 (2 copies)", "write_MBps"): 400.0,
+}
 
 
 def _median_spread(vals: list[float]) -> tuple[float, float]:
@@ -47,6 +58,16 @@ def _median_spread(vals: list[float]) -> tuple[float, float]:
     return round(med, 1), round(100.0 * (max(vals) - min(vals)) / med, 1)
 
 
+def _attach_targets(row: dict) -> dict:
+    for (goal, key), target in TARGETS.items():
+        if row.get("goal") == goal and key in row:
+            row[key.replace("_MBps", "_target_MBps")] = target
+            row[key.replace("_MBps", "_target_met")] = bool(
+                row[key] >= target
+            )
+    return row
+
+
 def bench_goals():
     goals = geometry.default_goals()
     goals[10] = geometry.parse_goal_line("10 ec32 : $ec(3,2)")[1]
@@ -55,8 +76,21 @@ def bench_goals():
     return goals
 
 
+def _bench_dir() -> Path:
+    """Cluster data dir: prefer ramdisk so the bench measures the
+    framework, not the box's disk (measured: buffered pwrite to a fresh
+    /tmp file sustains ~240 MB/s under dirty-page throttling on the r05
+    builder box — below several of the software rates under test). The
+    reference's own harness does the same (reference:
+    tests/tools/config.sh:23 RAMDISK_DIR, lizardfs.sh use_ramdisk)."""
+    shm = Path("/dev/shm")
+    if shm.is_dir() and os.access(shm, os.W_OK):
+        return Path(tempfile.mkdtemp(prefix="lizbench", dir=shm))
+    return Path(tempfile.mkdtemp(prefix="lizbench"))
+
+
 async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
-    tmp = Path(tempfile.mkdtemp(prefix="lizbench"))
+    tmp = _bench_dir()
     master = MasterServer(str(tmp / "master"), goals=bench_goals(),
                           health_interval=5.0)
     await master.start()
@@ -82,13 +116,34 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
     payload_arr = np.frombuffer(payload, dtype=np.uint8)
     back = np.empty(len(payload), dtype=np.uint8)
     rows = []
+
+    async def drop_bench_files(names: list[str]) -> None:
+        """Unlink + purge a goal's files and wait for the chunkservers
+        to free the bytes. The builder/driver boxes slow-fault hard
+        once ~4-5 GB of pages are resident (measured r05: page-touch
+        rate drops 7x past ~5 GB on the VM), so cumulative bench data
+        must stay bounded or later rows measure the hypervisor, not
+        the framework."""
+        for name in names:
+            try:
+                node = await client.lookup(1, name)
+                await client.settrashtime(node.inode, 0)
+                await client.unlink(1, name)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if not master.meta.registry.chunks:
+                break
+            await asyncio.sleep(0.25)
+
     try:
         for goal_id, label in GOALS:
             # median of REPS runs per row: single samples have been seen
             # to swing 4x under co-located load (r03 driver capture), and
             # a median with recorded spread separates signal from noise
             wts, rts = [], []
-            for rep in range(REPS):
+            for rep in range(GOAL_REPS):
                 f = await client.create(1, f"bench_{goal_id}_{rep}.bin")
                 await client.setgoal(f.inode, goal_id)
                 t0 = time.perf_counter()
@@ -104,15 +159,24 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                     np.array_equal, back, payload_arr
                 )
                 assert equal, f"corruption at goal {label}"
-            w_med, w_spread = _median_spread([size_mb / t for t in wts])
-            r_med, r_spread = _median_spread([size_mb / t for t in rts])
-            rows.append({
+            await drop_bench_files(
+                [f"bench_{goal_id}_{rep}.bin" for rep in range(GOAL_REPS)]
+            )
+            w_reps = [round(size_mb / t, 1) for t in wts]
+            r_reps = [round(size_mb / t, 1) for t in rts]
+            w_med, w_spread = _median_spread(w_reps)
+            r_med, r_spread = _median_spread(r_reps)
+            rows.append(_attach_targets({
                 "goal": label,
                 "write_MBps": w_med,
                 "read_MBps": r_med,
                 "write_spread_pct": w_spread,
                 "read_spread_pct": r_spread,
-            })
+                # raw per-rep values: a 326-vs-450 miss with a 66%
+                # spread is uninterpretable without them (r04 lesson)
+                "write_reps_MBps": w_reps,
+                "read_reps_MBps": r_reps,
+            }))
         # NFS gateway throughput: the wire-level analog of mounting the
         # gateway and running dd (no kernel nfs module in the image, so
         # the RFC 1813 client is the e2e path). One gateway process ==
@@ -132,32 +196,55 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                     async with Nfs3Client("127.0.0.1", gw.port) as nc:
                         root = await nc.mnt("/")
                         _, fh = await nc.create(root, f"nfs_{rep}.bin")
+                        # kernel-client pattern: 8 outstanding 64 KiB
+                        # ops on one connection (rsize/wsize pipeline),
+                        # UNSTABLE writes gathered + one COMMIT
+                        sem = asyncio.Semaphore(8)
+
+                        async def wslice(off):
+                            async with sem:
+                                await nc.write(
+                                    fh, off, blob[off: off + 65536],
+                                    stable=0,
+                                )
+
                         t0 = time.perf_counter()
-                        # kernel-client pattern: UNSTABLE stream + one
-                        # COMMIT (the gateway write-gathers)
-                        for off in range(0, len(blob), 65536):
-                            await nc.write(
-                                fh, off, blob[off : off + 65536], stable=0
-                            )
+                        await asyncio.gather(*(
+                            wslice(off)
+                            for off in range(0, len(blob), 65536)
+                        ))
                         await nc.commit(fh)
                         wts.append(time.perf_counter() - t0)
+                        got = bytearray(len(blob))
+
+                        async def rslice(off):
+                            async with sem:
+                                piece, _eof = await nc.read(fh, off, 65536)
+                                got[off: off + len(piece)] = piece
+
                         t0 = time.perf_counter()
-                        got = bytearray()
-                        off = 0
-                        while off < len(blob):
-                            piece, _eof = await nc.read(fh, off, 65536)
-                            got += piece
-                            off += len(piece)
+                        await asyncio.gather(*(
+                            rslice(off)
+                            for off in range(0, len(blob), 65536)
+                        ))
                         rts.append(time.perf_counter() - t0)
                         assert bytes(got) == blob, "nfs read mismatch"
-                w_med, w_spread = _median_spread([nfs_mb / t for t in wts])
-                r_med, r_spread = _median_spread([nfs_mb / t for t in rts])
+                w_reps = [round(nfs_mb / t, 1) for t in wts]
+                r_reps = [round(nfs_mb / t, 1) for t in rts]
+                w_med, w_spread = _median_spread(w_reps)
+                r_med, r_spread = _median_spread(r_reps)
                 rows.append({
                     "goal": "nfs gateway",
                     "write_MBps": w_med,
                     "read_MBps": r_med,
                     "write_spread_pct": w_spread,
                     "read_spread_pct": r_spread,
+                    "write_reps_MBps": w_reps,
+                    "read_reps_MBps": r_reps,
+                    # r04 #3: a gateway that reads slower than it
+                    # writes fails its own scale-out rationale
+                    "read_target_MBps": w_med,
+                    "read_target_met": bool(r_med >= w_med),
                 })
             finally:
                 await gw.stop()
@@ -226,6 +313,8 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
         for cs in servers:
             await cs.stop()
         await master.stop()
+        # a ramdisk bench dir holds GiBs of RAM — never leak it
+        shutil.rmtree(tmp, ignore_errors=True)
     return rows
 
 

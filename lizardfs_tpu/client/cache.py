@@ -51,6 +51,14 @@ class BlockCache:
         self.max_version_notes = 8192
         self.hits = 0
         self.misses = 0
+        self._invalidate_listeners: list = []
+
+    def add_invalidate_listener(self, fn) -> None:
+        """``fn(inode)`` runs on every explicit invalidation (master
+        push, local write, truncate): layers stacked above the client —
+        e.g. the NFS gateway's readahead buffers — stay coherent
+        without their own push plumbing."""
+        self._invalidate_listeners.append(fn)
 
     def _remove(self, key: tuple[int, int, int]) -> None:
         data, _, _ = self._entries.pop(key)
@@ -124,6 +132,8 @@ class BlockCache:
         if ci is None:
             for vk in [k for k in self._versions if k[0] == inode]:
                 del self._versions[vk]
+        for fn in self._invalidate_listeners:
+            fn(inode)
 
 
 class ReadaheadAdviser:

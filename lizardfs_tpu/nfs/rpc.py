@@ -216,13 +216,53 @@ class RpcClient:
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._xid = 1
+        # xid-demuxed reply pump: real kernel clients keep MANY calls
+        # outstanding on one connection (wsize/rsize deep pipelines);
+        # serial request/response here would make every benchmark and
+        # multi-gateway drive understate the gateway by the RTT count
+        self._pending: dict[int, asyncio.Future] = {}
+        self._pump_task: asyncio.Task | None = None
+        self._pump_dead = False
+        # serialize write+drain: concurrent drain() waiters crash on
+        # Python < 3.12 (FlowControlMixin asserts a single waiter)
+        self._send_lock: asyncio.Lock | None = None
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._pump_dead = False
+        self._send_lock = asyncio.Lock()
+        self._pump_task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                record = await read_record(self._reader)
+                u = Unpacker(record)
+                rxid = u.u32()
+                fut = self._pending.pop(rxid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(u)
+        except (asyncio.CancelledError, Exception) as e:  # noqa: BLE001
+            # flag FIRST: a call() registering after this cleanup must
+            # fail fast instead of awaiting a future nobody will resolve
+            self._pump_dead = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"rpc link lost: {e!r}"))
+            self._pending.clear()
+            if isinstance(e, asyncio.CancelledError):
+                raise
 
     async def close(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, ConnectionError):
+                pass
+            self._pump_task = None
         if self._writer is not None:
             self._writer.close()
             try:
@@ -242,20 +282,26 @@ class RpcClient:
 
     async def call(self, prog: int, vers: int, proc: int, args: bytes) -> Unpacker:
         assert self._writer is not None, "not connected"
+        if self._pump_dead:
+            raise ConnectionError("rpc link lost")
         self._xid += 1
         xid = self._xid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[xid] = fut
         p = Packer()
         p.u32(xid).u32(CALL).u32(RPC_VERSION)
         p.u32(prog).u32(vers).u32(proc)
         p.u32(AUTH_SYS).opaque(self._cred_bytes())
         p.u32(AUTH_NONE).u32(0)
         p.raw(args)
-        self._writer.write(frame_record(p.bytes()))
-        await self._writer.drain()
-        record = await read_record(self._reader)
-        u = Unpacker(record)
-        rxid = u.u32()
-        if rxid != xid or u.u32() != REPLY:
+        try:
+            async with self._send_lock:
+                self._writer.write(frame_record(p.bytes()))
+                await self._writer.drain()
+            u = await fut  # xid already consumed by the pump
+        finally:
+            self._pending.pop(xid, None)
+        if u.u32() != REPLY:
             raise XdrError("bad RPC reply header")
         if u.u32() != MSG_ACCEPTED:
             raise XdrError("RPC call denied")

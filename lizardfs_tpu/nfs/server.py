@@ -23,6 +23,7 @@ import secrets
 import struct
 import time
 
+from lizardfs_tpu.client.cache import ReadaheadAdviser
 from lizardfs_tpu.client.client import Client
 from lizardfs_tpu.constants import MFSBLOCKSIZE
 from lizardfs_tpu.nfs import rpc
@@ -270,6 +271,25 @@ class NfsGateway:
         self.GATHER_FLUSH_BYTES = 8 * 2**20     # per inode
         self.GATHER_TOTAL_BYTES = 64 * 2**20    # whole gateway
         self.GATHER_IDLE_S = 1.0
+        # server-side readahead (r04 weak #3: cold per-READ path read at
+        # half the gateway's own write speed): per-inode sequentiality
+        # detector + one buffered span ahead of the stream, refilled
+        # under a per-inode lock so 8 pipelined 64 KiB READs cost one
+        # back-end fetch, not 8. Coherence: an invalidate-listener on
+        # the client's BlockCache drops the span on ANY invalidation
+        # (local write/truncate or master push from another gateway's
+        # mutation) + a TTL backstop mirroring the BlockCache's.
+        self._ra: dict[int, list] = {}  # inode -> [adviser, off, buf, ts]
+        self._ra_locks: dict[int, asyncio.Lock] = {}
+        self._ra_epoch: dict[int, int] = {}  # bumped by every drop
+        # sequentiality detectors OUTLIVE the spans: a write invalidates
+        # cached bytes, not the fact that the reader is streaming
+        self._ra_advisers: dict[int, ReadaheadAdviser] = {}
+        self.RA_WINDOW_MAX = 4 * 2**20   # per inode
+        self.RA_TOTAL_BYTES = 64 * 2**20  # whole gateway
+        self.RA_TTL_S = 1.0
+        self._ra_total = 0
+        self.client.cache.add_invalidate_listener(self._ra_drop)
 
     @property
     def port(self) -> int:
@@ -340,6 +360,17 @@ class NfsGateway:
                         raise
                     except Exception:  # noqa: BLE001
                         log.exception("idle flush failed for %d", inode)
+            # readahead hygiene: expire stale spans, then drop idle
+            # per-inode locks/epochs (an unlocked inode with no span
+            # needs neither — the next READ recreates both)
+            for inode, e in list(self._ra.items()):
+                if now - e[3] > self.RA_TTL_S:
+                    self._ra_drop(inode)
+            for inode, lock in list(self._ra_locks.items()):
+                if not lock.locked() and inode not in self._ra:
+                    del self._ra_locks[inode]
+                    self._ra_epoch.pop(inode, None)
+                    self._ra_advisers.pop(inode, None)
 
     async def start(self) -> None:
         await self.client.connect(info="nfs-gateway")
@@ -565,6 +596,71 @@ class NfsGateway:
         p.string(target)
         return p.bytes()
 
+    def _ra_drop(self, inode: int) -> None:
+        """Invalidate-listener + local eviction: drop an inode's
+        readahead span (runs synchronously on the loop thread, so it is
+        ordered against _ra_read's store)."""
+        e = self._ra.pop(inode, None)
+        if e is not None:
+            self._ra_total -= len(e[2])
+        # epoch entries only matter to a reader mid-fetch (one holds
+        # the inode's lock); bumping for never-read inodes would leak
+        # one dict entry per written file forever
+        if inode in self._ra_locks:
+            self._ra_epoch[inode] = self._ra_epoch.get(inode, 0) + 1
+
+    async def _ra_read(self, inode: int, offset: int, count: int) -> bytes:
+        """READ through the per-inode readahead span: sequential
+        streams fetch up to RA_WINDOW_MAX ahead in one back-end read
+        and serve the following READs from memory; non-sequential
+        offsets reset the window to zero and bypass buffering entirely
+        (adviser semantics: client/cache.py ReadaheadAdviser)."""
+        lock = self._ra_locks.get(inode)
+        if lock is None:
+            lock = self._ra_locks[inode] = asyncio.Lock()
+        async with lock:
+            adviser = self._ra_advisers.get(inode)
+            if adviser is None:
+                adviser = self._ra_advisers[inode] = ReadaheadAdviser(
+                    max_window=self.RA_WINDOW_MAX
+                )
+            e = self._ra.get(inode)
+            if e is not None:
+                _adv, off, buf, ts = e
+                if (
+                    time.monotonic() - ts <= self.RA_TTL_S
+                    and off <= offset
+                    and offset + count <= off + len(buf)
+                ):
+                    adviser.advise(offset, count)  # keep the stream hot
+                    lo = offset - off
+                    return bytes(buf[lo: lo + count])
+            extra = adviser.advise(offset, count)
+            if extra:
+                epoch = self._ra_epoch.get(inode, 0)
+                data = await self.client.read_file(
+                    inode, offset, count + extra
+                )
+                self._ra_drop(inode)
+                if (len(data) > count
+                        and self._ra_epoch.get(inode, 0) == epoch + 1):
+                    # store only if no invalidation raced the fetch
+                    # (the +1 is our own _ra_drop above) — mirroring
+                    # the BlockCache's revoked-put refusal
+                    self._ra[inode] = [
+                        adviser, offset, bytes(data), time.monotonic()
+                    ]
+                    self._ra_total += len(data)
+                    while self._ra_total > self.RA_TOTAL_BYTES and self._ra:
+                        oldest = min(self._ra, key=lambda i: self._ra[i][3])
+                        self._ra_drop(oldest)
+                return bytes(data[:count])
+        # non-sequential miss: nothing to buffer — read OUTSIDE the
+        # lock so random READs of one file keep their pipeline
+        # concurrency instead of serializing on the adviser
+        data = await self.client.read_file(inode, offset, count)
+        return bytes(data[:count])
+
     async def _proc_read(self, cred, u) -> bytes:
         inode = fh_unpack(u.opaque(64))
         offset, count = u.u64(), u.u32()
@@ -575,7 +671,7 @@ class NfsGateway:
             raise _NfsError(NFS3ERR_ISDIR)
         if not await self.client.access(inode, cred.uid, cred.all_gids, 4):
             raise _NfsError(NFS3ERR_ACCES)
-        data = await self.client.read_file(inode, offset, count)
+        data = await self._ra_read(inode, offset, count)
         p = Packer().u32(NFS3_OK)
         _post_op_attr(p, attr)
         p.u32(len(data))

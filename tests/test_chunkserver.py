@@ -349,3 +349,43 @@ def test_store_legacy_filename_migration(tmp_path):
     assert os.path.basename(found.path) == chunk_filename(9, PART, 3)
     [(_, data, _c)] = store2.read(9, 3, PART, 0, 65536)
     assert data[:1] == b"\x7a"
+
+
+@pytest.mark.asyncio
+async def test_chunk_tester_rotates_with_budget(tmp_path):
+    """The scrubber must (a) stop after ~test_budget_bytes per round and
+    (b) ROTATE so every part is eventually covered — a fixed prefix
+    would re-scan the same parts forever and never reach a corrupted
+    part beyond it (the pre-r05 behavior)."""
+    cs = ChunkServer(str(tmp_path), master_addr=None,
+                     native_data_plane=False)
+    block = data_generator.generate(3, MFSBLOCKSIZE).tobytes()
+    crc = crc_mod.crc32(block)
+    for cid in range(1, 13):
+        cs.store.create(cid, 1, PART)
+        cs.store.write(cid, 1, PART, 0, 0, block, crc)
+    # corrupt the LAST part's data without fixing its CRC
+    victim = cs.store.get(12, PART)
+    with open(victim.path, "r+b") as f:
+        f.seek(-17, os.SEEK_END)
+        f.write(b"\xff")
+    cs.test_budget_bytes = 2 * MFSBLOCKSIZE  # ~2 parts per round
+    reported = []
+
+    async def fake_send(msg):
+        reported.extend(msg.chunks)
+
+    class _FakeMaster:
+        closed = False
+        send = staticmethod(fake_send)
+
+    cs.master = _FakeMaster()
+    seen_cursors = set()
+    for _ in range(12):  # enough rounds for a full lap at 2 parts/round
+        await cs._test_chunks()
+        seen_cursors.add(cs._test_cursor)
+    assert any(c.chunk_id == 12 for c in reported), \
+        "rotation never reached the corrupted part"
+    assert len(seen_cursors) > 1, "cursor did not advance"
+    # healthy parts were not reported
+    assert all(c.chunk_id == 12 for c in reported)

@@ -410,3 +410,84 @@ async def test_nfs_gather_requeues_on_flush_failure(tmp_path):
     finally:
         await gw.stop()
         await cluster.stop()
+
+
+async def test_nfs_readahead_span_and_coherence(tmp_path):
+    """Sequential READs warm the gateway's server-side readahead span
+    (one back-end fetch serves the following wire READs); any write
+    must drop the span via the BlockCache invalidate-listener so no
+    READ ever serves pre-overwrite bytes from it."""
+    import asyncio
+
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    gw = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    gw_b = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw.start()
+    await gw_b.start()
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c, \
+                Nfs3Client("127.0.0.1", gw_b.port) as cb:
+            root = await c.mnt("/")
+            _, fh = await c.create(root, "ra.bin")
+            blob = bytes(range(256)) * 2048  # 512 KiB
+            await c.write(fh, 0, blob)
+            # sequential stream: span appears and serves hits
+            got = bytearray()
+            for off in range(0, len(blob), 65536):
+                piece, _ = await c.read(fh, off, 65536)
+                got += piece
+            assert bytes(got) == blob
+            assert gw._ra, "sequential stream did not warm a span"
+            inode = next(iter(gw._ra))
+            # local write through the SAME gateway drops the span
+            await c.write(fh, 0, b"\xff" * 16)
+            assert inode not in gw._ra, "local write left a stale span"
+            piece, _ = await c.read(fh, 0, 16)
+            assert piece == b"\xff" * 16
+            # re-warm, then a write through ANOTHER gateway must
+            # invalidate via the master push within the TTL
+            for off in range(0, len(blob), 65536):
+                await c.read(fh, off, 65536)
+            assert gw._ra
+            _, fh_b, _ = await cb.lookup(await cb.mnt("/"), "ra.bin")
+            await cb.write(fh_b, 0, b"\xee" * 16)
+            await asyncio.sleep(0.3)
+            piece, _ = await c.read(fh, 0, 16)
+            assert piece == b"\xee" * 16, "served stale readahead bytes"
+    finally:
+        await gw.stop()
+        await gw_b.stop()
+        await cluster.stop()
+
+
+async def test_nfs_pipelined_reads_one_connection(tmp_path):
+    """8 concurrent READs on ONE RPC connection (xid demux) return the
+    right bytes — the kernel-client rsize pipeline pattern."""
+    import asyncio
+
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    gw = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw.start()
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c:
+            root = await c.mnt("/")
+            _, fh = await c.create(root, "pipe.bin")
+            blob = bytes([i % 251 for i in range(1 << 20)])
+            await c.write(fh, 0, blob)
+            got = bytearray(len(blob))
+            sem = asyncio.Semaphore(8)
+
+            async def rslice(off):
+                async with sem:
+                    piece, _ = await c.read(fh, off, 65536)
+                    got[off: off + len(piece)] = piece
+
+            await asyncio.gather(*(
+                rslice(off) for off in range(0, len(blob), 65536)
+            ))
+            assert bytes(got) == blob
+    finally:
+        await gw.stop()
+        await cluster.stop()
