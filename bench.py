@@ -320,6 +320,13 @@ def cluster_throughput() -> dict:
                 ):
                     if extra in r:
                         out[f"cluster_{key}_{extra}"] = r[extra]
+            elif "ops_per_s" in r:
+                out[f"cluster_{key}_MBps"] = r["MBps"]
+                out[f"cluster_{key}_ops_per_s"] = r["ops_per_s"]
+                out[f"cluster_{key}_spread_pct"] = r.get("spread_pct", 0)
+                for extra in ("MBps_reps", "ops_reps"):
+                    if extra in r:
+                        out[f"cluster_{key}_{extra}"] = r[extra]
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]

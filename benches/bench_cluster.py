@@ -177,6 +177,71 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 "write_reps_MBps": w_reps,
                 "read_reps_MBps": r_reps,
             }))
+        # dbench analog (reference: tests/test_suites/Benchmarks/
+        # test_dbench_throughput.sh — 12 concurrent procs of mixed
+        # create/write/read/stat/unlink): N concurrent CLIENT SESSIONS
+        # hammering the same cluster. This is the instrument single-
+        # stream dd rows can't provide: it catches loop-serialization
+        # regressions that only bite under concurrency.
+        try:
+            from lizardfs_tpu.core.encoder import get_encoder as _ge
+
+            async def dbench_worker(idx: int, stop_at: float):
+                wc = Client("127.0.0.1", master.port, encoder=None)
+                if encoder != "auto":
+                    wc.encoder = _ge(encoder)
+                await wc.connect(f"dbench{idx}")
+                blob = payload[: 2**20]
+                ops = moved = seq = 0
+                try:
+                    while time.monotonic() < stop_at:
+                        name = f"db_{idx}_{seq}"
+                        seq += 1
+                        f = await wc.create(1, name)
+                        await wc.settrashtime(f.inode, 0)
+                        await wc.write_file(f.inode, blob)
+                        await wc.getattr(f.inode)
+                        wc.cache.invalidate(f.inode)
+                        data = await wc.read_file(f.inode, 0, len(blob))
+                        assert bytes(data) == blob, "dbench corruption"
+                        await wc.unlink(1, name)
+                        ops += 6
+                        moved += 2 * len(blob)
+                finally:
+                    await wc.close()
+                return ops, moved
+
+            N_DBENCH = 8
+            DBENCH_SECS = 8.0
+            mb_reps, ops_reps = [], []
+            for _ in range(REPS):
+                stop_at = time.monotonic() + DBENCH_SECS
+                t0 = time.perf_counter()
+                results = await asyncio.gather(*(
+                    dbench_worker(i, stop_at) for i in range(N_DBENCH)
+                ))
+                wall = time.perf_counter() - t0
+                total_ops = sum(o for o, _ in results)
+                total_mb = sum(mv for _, mv in results) / 2**20
+                mb_reps.append(round(total_mb / wall, 1))
+                ops_reps.append(round(total_ops / wall, 1))
+            mb_med, mb_spread = _median_spread(mb_reps)
+            ops_med, ops_spread = _median_spread(ops_reps)
+            rows.append({
+                "goal": "dbench8",
+                "MBps": mb_med,
+                "ops_per_s": ops_med,
+                "spread_pct": max(mb_spread, ops_spread),
+                "MBps_reps": mb_reps,
+                "ops_reps": ops_reps,
+            })
+        except AssertionError:
+            raise  # corruption fails the bench like the goal rows
+        except Exception:  # noqa: BLE001 — infra failure must not kill it
+            import logging
+
+            logging.getLogger("bench").exception("dbench row failed")
+
         # NFS gateway throughput: the wire-level analog of mounting the
         # gateway and running dd (no kernel nfs module in the image, so
         # the RFC 1813 client is the e2e path). One gateway process ==
@@ -333,6 +398,9 @@ def main(argv=None) -> int:
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")
+        elif "ops_per_s" in r:
+            print(f"{r['goal']:>18s}:  {r['MBps']:8.1f} MB/s"
+                  f"   {r['ops_per_s']:8.1f} ops/s")
         else:
             print(f"{r['goal']:>18s}:  write {r['write_MBps']:8.1f} MB/s"
                   f"   read {r['read_MBps']:8.1f} MB/s")

@@ -3,7 +3,10 @@
 Config keys (mfschunkserver.cfg analog): DATA_PATH (comma-separated
 folders allowed), HDD_CFG (file listing one data folder per line,
 mfshdd.cfg analog; overrides DATA_PATH), LISTEN_HOST, LISTEN_PORT,
-MASTER_HOST, MASTER_PORT, LABEL, ENCODER (cpu|cpp|tpu|auto),
+MASTER_HOST, MASTER_PORT, MASTER_ADDRS (host:port,host:port,... —
+every master incl. shadows, for floating-IP-less failover: the
+registration loop cycles until the ACTIVE master accepts; overrides
+MASTER_HOST/PORT), LABEL, ENCODER (cpu|cpp|tpu|auto),
 HEARTBEAT_INTERVAL (seconds; also the master-reconnect cadence),
 ADMIN_PASSWORD (challenge-response auth for privileged admin
 commands), LOG_LEVEL.
@@ -38,12 +41,30 @@ def _folders(cfg: Config) -> list[str]:
 def main() -> None:
     cfg = Config(sys.argv[1] if len(sys.argv) > 1 else None)
     setup_logging("chunkserver", cfg.get_str("LOG_LEVEL", "INFO"))
-    server = ChunkServer(
-        data_folder=_folders(cfg),
-        master_addr=(
+    addrs_raw = cfg.get_str("MASTER_ADDRS", "")
+    if addrs_raw:
+        master_addr = []
+        for item in addrs_raw.split(","):
+            item = item.strip()
+            if not item:
+                continue  # tolerate trailing/double commas
+            host, sep, port = item.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise SystemExit(
+                    f"MASTER_ADDRS: bad entry {item!r} "
+                    "(expected host:port[,host:port...])"
+                )
+            master_addr.append((host, int(port)))
+        if not master_addr:
+            raise SystemExit("MASTER_ADDRS: no addresses given")
+    else:
+        master_addr = (
             cfg.get_str("MASTER_HOST", "127.0.0.1"),
             cfg.get_int("MASTER_PORT", 9420),
-        ),
+        )
+    server = ChunkServer(
+        data_folder=_folders(cfg),
+        master_addr=master_addr,
         host=cfg.get_str("LISTEN_HOST", "127.0.0.1"),
         port=cfg.get_int("LISTEN_PORT", 0),
         label=cfg.get_str("LABEL", "_"),

@@ -135,6 +135,9 @@ class Client:
         self.io_limits_active = False
         self.io_limits_probe_interval = 5.0
         self._limits_probe_task: asyncio.Task | None = None
+        # how long a lost master may stay unreachable before ops fail
+        # (election + promotion fit well inside this on a sane cluster)
+        self.failover_timeout = 15.0
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -271,14 +274,44 @@ class Client:
         raise ConnectionError(f"no active master reachable: {last}")
 
     async def _call(self, msg_cls, **fields):
-        """Master RPC with one transparent reconnect+retry on a lost or
+        """Master RPC with transparent reconnect+retry on a lost or
         demoted master (failover support)."""
         self._record(msg_cls.__name__)
         try:
             return await self.master.call_ok(msg_cls, **fields)
         except (ConnectionError, asyncio.TimeoutError):
-            await self.connect(self._info, getattr(self, "_password", ""))
+            await self._reconnect()
             return await self.master.call_ok(msg_cls, **fields)
+
+    async def _reconnect(self) -> None:
+        """Cycle the master address list with backoff until one accepts
+        (or ``failover_timeout`` passes): after the active master dies,
+        an election takes time — during it EVERY address refuses (dead)
+        or answers NOT_POSSIBLE (still shadow), and a single pass would
+        fail exactly the ops the address list exists to save (reference:
+        the mount's fs_reconnect loop)."""
+        import time as _time
+
+        deadline = _time.monotonic() + self.failover_timeout
+        delay = 0.1
+        while True:
+            # bound the whole pass, not just the gap between passes: a
+            # blackholed master host (SYN silently dropped) would
+            # otherwise pin one connect() for the OS ~2 min SYN timeout
+            budget = max(deadline - _time.monotonic(), 0.5)
+            try:
+                await asyncio.wait_for(
+                    self.connect(self._info, getattr(self, "_password", "")),
+                    timeout=min(budget, 5.0 * len(self.master_addrs)),
+                )
+                return
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                if _time.monotonic() + delay > deadline:
+                    raise ConnectionError(
+                        f"failover window exhausted: {e}"
+                    ) from None
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 1.0)
 
     async def _probe_limits_active(self) -> None:
         """Probe-only IoLimitRequest (probe=1: never joins the

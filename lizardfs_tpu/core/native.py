@@ -16,9 +16,17 @@ from lizardfs_tpu.constants import MFSBLOCKSIZE
 from lizardfs_tpu.core.encoder import ChunkEncoder
 from lizardfs_tpu.ops import gf256
 
-_LIB_PATHS = (
-    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libec_native.so"),
-    "libec_native.so",
+_LIB_PATHS = tuple(
+    p for p in (
+        # LZ_NATIVE_SO: load an alternate build (the ASAN/TSAN targets
+        # in native/Makefile) without touching the production .so
+        os.environ.get("LZ_NATIVE_SO", ""),
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native",
+            "libec_native.so",
+        ),
+        "libec_native.so",
+    ) if p
 )
 
 

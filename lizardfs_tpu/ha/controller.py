@@ -32,12 +32,17 @@ class FailoverController:
         peers: dict[str, tuple[str, int]],
         promote_exec: str | None = None,
         demote_exec: str | None = None,
+        service_addrs: dict[str, tuple[str, int]] | None = None,
         **election_kwargs,
     ):
         self.master = master
         self.node_id = node_id
         self.promote_exec = promote_exec
         self.demote_exec = demote_exec
+        # node id -> master SERVICE address (not the election port):
+        # lets every follower re-point its changelog stream at whoever
+        # currently leads, instead of a boot-time ACTIVE_MASTER
+        self.service_addrs = service_addrs or {}
         # serialize hooks: during flapping, a stale demote finishing
         # after a fresh promote would strip the new leader's service IP
         self._hook_lock = asyncio.Lock()
@@ -85,12 +90,20 @@ class FailoverController:
             await self._run_hook(self.promote_exec, "master")
 
     async def _on_follower(self, leader_id: str) -> None:
-        if self.master.personality == "master":
+        was_active = self.master.personality == "master"
+        if was_active:
             # split-brain guard: an active master that lost leadership
-            # stops accepting work; operators restart it as a shadow
+            # stops accepting work
             self.log.warning(
-                "lost leadership to %s — demoting to shadow (read-only)",
-                leader_id,
+                "lost leadership to %s — demoting to shadow", leader_id
             )
+        addr = self.service_addrs.get(leader_id)
+        if addr is not None:
+            # follow the CURRENT leader's changelog — every replica
+            # must converge on it or the next promotion loses writes
+            self.master.follow(addr)
+        elif was_active:
+            # no service map configured: read-only until restarted
             self.master.personality = "shadow"
+        if was_active:
             await self._run_hook(self.demote_exec, "shadow")

@@ -61,6 +61,14 @@ async def _run(cfg: Config) -> None:
             if item.strip():
                 pid, _, addr = item.strip().partition("=")
                 peers[pid] = _hostport(addr)
+        # MASTER_PEERS (id=host:port,...): each node's master SERVICE
+        # address, so followers can re-point their changelog stream at
+        # whichever node currently leads (no floating IP required)
+        service_addrs = {}
+        for item in cfg.get_str("MASTER_PEERS", "").split(","):
+            if item.strip():
+                pid, _, addr = item.strip().partition("=")
+                service_addrs[pid] = _hostport(addr)
         controller = FailoverController(
             server,
             cfg.get_str("ELECTION_ID"),
@@ -68,6 +76,7 @@ async def _run(cfg: Config) -> None:
             peers,
             promote_exec=cfg.get_str("PROMOTE_EXEC", "") or None,
             demote_exec=cfg.get_str("DEMOTE_EXEC", "") or None,
+            service_addrs=service_addrs,
         )
     if controller is not None:
         await controller.start()

@@ -2397,6 +2397,25 @@ class MasterServer(Daemon):
             self._shadow_task = None
         self.log.info("promoted to active master at v%d", self.changelog.version)
 
+    def follow(self, addr: tuple[str, int]) -> None:
+        """(Re-)point this node at the CURRENT active master and stream
+        its changelog. The failover controller calls this whenever the
+        election names a leader: a shadow must track the live leader —
+        not its boot-time ACTIVE_MASTER, which may itself have been
+        demoted — and a demoted master must start following, or every
+        replica silently stays behind and a later promotion loses
+        acknowledged writes (r05 HA e2e flake root cause)."""
+        if self.personality == "master" or self.active_addr != addr:
+            self.personality = "shadow"
+            self.active_addr = addr
+            if self._shadow_task is not None:
+                self._shadow_task.cancel()
+            self._shadow_task = self.spawn(self._shadow_follow())
+            self.log.info(
+                "following active master at %s:%d (v%d)",
+                addr[0], addr[1], self.changelog.version,
+            )
+
     # --- admin ----------------------------------------------------------------------------
 
     # mutating admin surface requires challenge-response auth when an

@@ -1277,10 +1277,15 @@ void connection_loop(Server& srv, int cfd) {
     }
     ::close(cfd);
     {
+        // notify UNDER the mutex: the stop path deletes the Server
+        // (and this condvar) as soon as it observes active_conns == 0,
+        // and it can only observe that after we release conn_mu — a
+        // notify after the unlock would race pthread_cond_destroy
+        // (found by TSAN, r05)
         std::lock_guard<std::mutex> g(srv.conn_mu);
         --srv.active_conns;
+        srv.conn_cv.notify_all();
     }
-    srv.conn_cv.notify_all();
 }
 
 void accept_loop(Server* srv) {
