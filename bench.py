@@ -82,19 +82,24 @@ def tpu_throughput(k: int = K, m: int = M,
 
         return loop
 
-    # try the grid-step-halving residency first (ROOFLINE #1); its VMEM
-    # model is unverified on silicon, so a compile failure downgrades —
-    # LOUDLY and tagged — to the r01-verified default config
+    # staged configs, most aggressive first (benches/ROOFLINE.md #1-3);
+    # every one is byte-parity-pinned in interpret mode, but VMEM
+    # residency and Mosaic lowering are only provable on silicon, so a
+    # compile failure downgrades — LOUDLY and tagged — down the ladder
+    # to the r01-verified default
     global KERNEL_CONFIG_USED
     if fused is jax_ec.fused_encode_crc:
-        big = None  # CPU fallback path has no tile knob
-        KERNEL_CONFIG_USED = "jax-cpu"
+        ladder = [(None, "jax-cpu")]
     else:
-        from lizardfs_tpu.ops.pallas_ec import BIG_TILE_CONFIG
+        from lizardfs_tpu.ops.pallas_ec import (
+            BIG_TILE_CONFIG, ROOFLINE_CONFIG,
+        )
 
-        big = functools.partial(fused, **BIG_TILE_CONFIG)
-        KERNEL_CONFIG_USED = "big-tile-64K/11.5M"
-    loop = make_loop(big if big is not None else fused)
+        ladder = [
+            (ROOFLINE_CONFIG, "roofline-64K/wide-crc/reuse-planes"),
+            (BIG_TILE_CONFIG, "big-tile-64K/11.5M"),
+            (None, "verified-16K/10M (staged-config fallback)"),
+        ]
 
     def timed(n):
         t0 = time.perf_counter()
@@ -104,21 +109,23 @@ def tpu_throughput(k: int = K, m: int = M,
     import statistics
 
     L = 16
-    try:
-        timed(1)  # compile L=1
-    except Exception as e:  # noqa: BLE001 — Mosaic VMEM overrun fails fast
-        if big is None:
-            raise  # no alternate config to try — real error
-        import sys
+    for i, (cfg, tag) in enumerate(ladder):
+        call = functools.partial(fused, **cfg) if cfg else fused
+        loop = make_loop(call)
+        KERNEL_CONFIG_USED = tag
+        try:
+            timed(1)  # compile L=1
+            break
+        except Exception as e:  # noqa: BLE001 — Mosaic fails fast
+            if i == len(ladder) - 1:
+                raise  # no alternate config left — real error
+            import sys
 
-        print(
-            f"big-tile kernel config failed to compile ({str(e)[:160]}); "
-            "falling back to verified 16K/10M",
-            file=sys.stderr,
-        )
-        KERNEL_CONFIG_USED = "verified-16K/10M (big-tile fallback)"
-        loop = make_loop(fused)
-        timed(1)
+            print(
+                f"kernel config {tag} failed to compile "
+                f"({str(e)[:160]}); trying the next",
+                file=sys.stderr,
+            )
     timed(L)  # compile L=16
     vals, totals = [], []
     # several measurement rounds: the first reads low until clocks and

@@ -206,7 +206,7 @@ def block_crcs(blocks: jnp.ndarray, block_size: int = MFSBLOCKSIZE) -> jnp.ndarr
 CRC_SUB = 128  # sub-block bytes = one full vreg lane width
 
 
-def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
+def _fused_vmem_bytes(k: int, m: int, tile: int, wide: bool = False) -> int:
     rows = k + m
     kp, mp = -(-k // 8) * 8, -(-m // 8) * 8
     sg = max(tile // CRC_GROUP, 1)
@@ -222,6 +222,8 @@ def _fused_vmem_bytes(k: int, m: int, tile: int) -> int:
         + (kp * k + mp * m) * sg      # selection matrices, int8
         + 16 * 32 * 32          # shift stack, int8
         + 64 * q * q * k * m    # block-diagonal bigm_q (q*8m x q*8k int8)
+        # wide CRC (ROOFLINE #3): 128-lane stage-1 acc (4x) + 4x W
+        + (rows * sg * 32 * 16 + 3 * 8 * CRC_GROUP * 32 if wide else 0)
     )
 
 
@@ -229,9 +231,26 @@ CRC_GROUP = 512  # stage-1 group bytes: M = rows*T/512 fills MXU sublanes
 _ENC_STACK_MAX = 128  # cap on q*8m when stacking column quarters
 
 
-def _chunk_registers(x, w_ref, shifts_ref, sel_ref, group: int):
+def _chunk_registers(x, w_ref, shifts_ref, sel_ref, group: int,
+                     wide: bool = False):
     """(rows, T) uint8 tile -> (rp, 32) GF(2) CRC registers (rp = rows
-    padded to x8 by the selection matrix).
+    padded to x8 by the selection matrix). Extracts the bit planes and
+    delegates to :func:`_registers_from_planes`."""
+    rows, t = x.shape
+    sc = t // group
+    groups = x.reshape(rows * sc, group)
+    planes = jnp.concatenate(
+        [((groups & jnp.uint8(1 << b)) != 0).astype(jnp.int8)
+         for b in range(8)],
+        axis=1,
+    )  # (n, 8G), plane-major along lanes (W rows match this order)
+    return _registers_from_planes(planes, w_ref, shifts_ref, sel_ref,
+                                  sc, wide)
+
+
+def _registers_from_planes(planes, w_ref, shifts_ref, sel_ref, sc: int,
+                           wide: bool):
+    """(rows*sc, 8G) bit planes -> (rp, 32) GF(2) CRC registers.
 
     Stage 1 (MXU): one matmul computes the CRC register of every
     ``group``-byte span: the 8 bit planes are concatenated along the
@@ -245,33 +264,53 @@ def _chunk_registers(x, w_ref, shifts_ref, sel_ref, group: int):
     (MXU): a 0/1 selection matmul extracts each row's j=0 register
     straight into the padded output layout. All in VMEM: no
     partial-register round trip through HBM (the round-1 bottleneck).
+
+    ``wide`` (ROOFLINE #3): stage 1's natural N=32 output fills only a
+    quarter of the MXU's 128-lane output tile. The wide path multiplies
+    against a (8G, 128) W whose four 32-column blocks are the register
+    PRE-SHIFTED by 3G/2G/1G/0 bytes — same MXU tile count, 4x useful
+    output — then folds each aligned run of 4 group registers with one
+    lane select + two roll/XOR levels, replacing the first two scan
+    LEVELS' 32x32 matmuls and shrinking the scan to sc/4 spans.
     """
-    rows, t = x.shape
-    sc = t // group
-    n = rows * sc
-    groups = x.reshape(n, group)
-    planes = jnp.concatenate(
-        [((groups & jnp.uint8(1 << b)) != 0).astype(jnp.int8)
-         for b in range(8)],
-        axis=1,
-    )  # (n, 8G), plane-major along lanes (W rows match this order)
+    n = planes.shape[0]
     acc = jax.lax.dot_general(
         planes, w_ref[:],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # s8 x s8 -> s32 MXU: exact, 2x the bf16 rate, half the VMEM
-    g = acc & 1  # (n, 32) group registers (i32: pltpu.roll needs 32-bit)
-    j = jax.lax.broadcasted_iota(jnp.int32, (n, 32), 0) & (sc - 1)
-    levels = sc.bit_length() - 1
+    if not wide:
+        g = acc & 1  # (n, 32) group registers (i32: pltpu.roll needs 32b)
+        j = jax.lax.broadcasted_iota(jnp.int32, (n, 32), 0) & (sc - 1)
+        levels = sc.bit_length() - 1
+        span = 1  # groups per scan element
+    else:
+        g128 = acc & 1  # (n, 128): lane block v = register << (v*G bytes)
+        # row for group s needs block 3 - s%4 (its position inside the
+        # 4-group span); select it into lanes 0..31 and XOR the 4
+        # consecutive rows together -> span register at rows s%4 == 0
+        j128 = jax.lax.broadcasted_iota(jnp.int32, (n, 128), 0) & (sc - 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (n, 128), 1)
+        want = 3 - (j128 & 3)
+        vals = jnp.where((lane >> 5) == want, g128, 0)
+        masked = (vals[:, :32] ^ vals[:, 32:64]
+                  ^ vals[:, 64:96] ^ vals[:, 96:128])
+        r1 = masked ^ pltpu.roll(masked, n - 1, axis=0)
+        g = r1 ^ pltpu.roll(r1, n - 2, axis=0)  # rows s%4==0: span regs
+        j = jax.lax.broadcasted_iota(jnp.int32, (n, 32), 0) & (sc - 1)
+        j = j >> 2  # span index; garbage rows never feed valid ones
+        sc = sc // 4
+        levels = sc.bit_length() - 1
+        span = 4
     for l in range(levels):
         h = 1 << l
-        # g'_j = g_j @ S^(G*h bytes)  ^  g_{j+h}   (0 past the row end)
+        # g'_j = g_j @ S^(span*G*h bytes)  ^  g_{j+h}  (0 past row end)
         shifted = jax.lax.dot_general(
             g.astype(jnp.int8), shifts_ref[l],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
         ) & 1
-        nxt = pltpu.roll(g, n - h, axis=0)  # g[i+h] lands at i
+        nxt = pltpu.roll(g, n - span * h, axis=0)  # g[i+span*h] at i
         nxt = jnp.where(j < sc - h, nxt, 0)
         g = shifted ^ nxt
     reg = jax.lax.dot_general(
@@ -288,8 +327,19 @@ def _encode_tile(bigm_ref, data, m: int, q: int):
     ``q`` column quarters are stacked along the contraction dim against
     a block-diagonal generator (q*8m, q*8k): the parity matmul's M dim
     grows from 8m (as low as 8) to q*8m ~ 128, filling the MXU's output
-    tile instead of wasting 7/8 of it.
+    tile instead of wasting 7/8 of it. (The unused bit-plane outputs
+    are dead-code-eliminated under tracing.)
     """
+    packed, _bits, _pbits = _encode_tile_bits(bigm_ref, data, m, q)
+    return packed
+
+
+def _encode_tile_bits(bigm_ref, data, m: int, q: int):
+    """_encode_tile variant that also returns the UNPACKED bit planes
+    of both the data ((q*8k, Tq) int8) and the parity ((q*8m, Tq)
+    int8), so the CRC stage can consume them instead of re-deriving
+    planes from packed bytes (ROOFLINE #2: the re-extraction costs ~8
+    VPU ops per byte over all k+m rows)."""
     k, t = data.shape
     tq = t // q
     if q == 1:
@@ -308,36 +358,82 @@ def _encode_tile(bigm_ref, data, m: int, q: int):
     weights = jax.lax.broadcasted_iota(jnp.int32, (q * m, 8, tq), 1)
     packed = (pbits.reshape(q * m, 8, tq) << weights).sum(axis=1)
     packed = packed.astype(jnp.uint8)  # (q*m, Tq), quarter-major rows
-    if q == 1:
-        return packed
-    return jnp.concatenate(
-        [packed[i * m:(i + 1) * m, :] for i in range(q)], axis=1
-    )  # (m, T)
+    if q != 1:
+        packed = jnp.concatenate(
+            [packed[i * m:(i + 1) * m, :] for i in range(q)], axis=1
+        )  # (m, T)
+    return packed, bits, pbits.astype(jnp.int8)
+
+
+def _planes_from_bits(bits, rows: int, q: int, tq: int, group: int):
+    """(q*8rows, Tq) quarter-major bit rows -> (rows*sc, 8G) group-major
+    CRC planes, by pure in-VMEM relayout (no re-extraction). Element
+    mapping: bit b of byte (row j, abs col i_q*Tq + s_local*G + p) lives
+    at bits[i_q*8rows + j*8 + b, s_local*G + p] and must land at
+    planes[j*sc + (i_q*scq + s_local), b*G + p]."""
+    scq = tq // group
+    b = bits.reshape(q, rows, 8, scq, group)
+    b = b.transpose(1, 0, 3, 2, 4)  # (rows, q, scq, 8, G)
+    return b.reshape(rows * q * scq, 8 * group)
 
 
 def _fused_kernel(bigm_ref, w_ref, shifts_ref, seld_ref, selp_ref,
                   data_ref, parity_ref, dreg_ref, preg_ref,
-                  *, m: int, q: int, group: int):
+                  *, m: int, q: int, group: int, wide: bool = False,
+                  reuse: bool = False):
     data = data_ref[:]
+    k, t = data.shape
+    if reuse:
+        tq = t // q
+        parity, bits, pbits = _encode_tile_bits(bigm_ref, data, m, q)
+        parity_ref[:] = parity
+        sc = t // group
+        dreg_ref[:] = _registers_from_planes(
+            _planes_from_bits(bits, k, q, tq, group),
+            w_ref, shifts_ref, seld_ref, sc, wide,
+        )
+        preg_ref[:] = _registers_from_planes(
+            _planes_from_bits(pbits, m, q, tq, group),
+            w_ref, shifts_ref, selp_ref, sc, wide,
+        )
+        return
     parity = _encode_tile(bigm_ref, data, m, q)
     parity_ref[:] = parity
-    dreg_ref[:] = _chunk_registers(data, w_ref, shifts_ref, seld_ref, group)
-    preg_ref[:] = _chunk_registers(parity, w_ref, shifts_ref, selp_ref, group)
+    dreg_ref[:] = _chunk_registers(
+        data, w_ref, shifts_ref, seld_ref, group, wide
+    )
+    preg_ref[:] = _chunk_registers(
+        parity, w_ref, shifts_ref, selp_ref, group, wide
+    )
 
 
 # Silicon-verified default (r01). The bigger-tile/bigger-budget config
 # below halves per-chunk grid steps (benches/ROOFLINE.md #1) but its
 # VMEM model is unverified on hardware, so production callers keep the
-# proven residency; bench.py opts into BIG_TILE_CONFIG first and tags
-# its JSON with whichever config actually compiled.
+# proven residency; bench.py opts into the staged configs first (most
+# aggressive first) and tags its JSON with whichever actually compiled.
 _FUSED_VMEM_BUDGET = 10 * 2**20
 # 11.5 MiB of ~16 MiB physical: ec(8,4) fits tile=32 KiB (10.1 MiB ->
 # 256 steps/chunk, 2x fewer), ec(3,2) a full 64 KiB block
 BIG_TILE_CONFIG = {"tile": 65536, "vmem_budget": 11_534_336}
+# ROOFLINE items 2+3 on top of the big tiles: wide_crc fills the CRC
+# stage-1 matmul's 128-lane output tile (4 pre-shifted register
+# variants) and removes two scan levels; reuse_planes feeds the CRC
+# stage from the encode's already-unpacked bit planes via in-VMEM
+# relayout instead of re-extracting (~8 VPU ops/byte over k+m rows).
+# Byte parity of every combination is pinned in interpret mode
+# (tests/test_pallas.py); only the SPEED is a silicon question.
+ROOFLINE_CONFIG = {
+    "tile": 65536, "vmem_budget": 11_534_336,
+    "wide_crc": True, "reuse_planes": True,
+}
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "tile", "interpret", "vmem_budget")
+    jax.jit, static_argnames=(
+        "block_size", "tile", "interpret", "vmem_budget", "wide_crc",
+        "reuse_planes",
+    )
 )
 def fused_encode_crc(
     bigm: jnp.ndarray,
@@ -346,6 +442,8 @@ def fused_encode_crc(
     tile: int = 16384,
     interpret: bool | None = None,
     vmem_budget: int = _FUSED_VMEM_BUDGET,
+    wide_crc: bool = False,
+    reuse_planes: bool = False,
 ):
     """Single-pass fused RS encode + per-block CRC32.
 
@@ -354,9 +452,9 @@ def fused_encode_crc(
 
     ``tile`` shrinks until it fits the VMEM budget, divides the block
     size, and divides N. Defaults are the silicon-verified residency;
-    pass ``**BIG_TILE_CONFIG`` to halve per-chunk grid steps (the
-    measured cost in benches/ROOFLINE.md #1) once a live chip can
-    verify the bigger footprint.
+    pass ``**BIG_TILE_CONFIG`` (ROOFLINE #1) or ``**ROOFLINE_CONFIG``
+    (#1+#2+#3: + wide 128-lane CRC stage-1, + bit-plane reuse) — both
+    numerically pinned, speed pending a live chip.
     """
     if interpret is None:
         interpret = not supported()  # CPU backend: interpret mode
@@ -364,7 +462,7 @@ def fused_encode_crc(
     m = bigm.shape[0] // 8
     rows = k + m
     while tile > 2 * CRC_SUB and (
-        _fused_vmem_bytes(k, m, tile) > vmem_budget
+        _fused_vmem_bytes(k, m, tile, wide_crc) > vmem_budget
         or block_size % tile or n % tile
     ):
         tile //= 2
@@ -383,17 +481,30 @@ def fused_encode_crc(
 
     group = min(CRC_GROUP, tile)
     sg = tile // group  # group registers per row per tile
+    # the wide fold needs aligned runs of 4 group registers per row
+    wide = bool(wide_crc) and sg % 4 == 0 and sg >= 4
     c_sub, _levels, k_const = crc_host.block_crc_matrices(block_size, group)
     # W rows match the kernel's plane-major lane concat: row b*G+p = bit
     # b of byte position p (row 8p+b of C_G^T)
     ct = np.asarray(c_sub.T, dtype=np.float32)  # (8G, 32), rows 8p+b
     w = np.concatenate([ct[b::8, :] for b in range(8)], axis=0)
-    # scan shift matrices: level l combines spans of 2^l groups, so
-    # every row uses the SAME shift(G * 2^l) matrix at that level
-    levels = sg.bit_length() - 1
+    if wide:
+        # (8G, 128): column block v = the group register pre-shifted by
+        # v*G bytes (W @ S(vG)^T over GF(2)); the kernel's lane select
+        # assigns block 3 - s%4 to group s
+        w64 = w.astype(np.int64)
+        w = np.concatenate([
+            (w64 @ crc_host.shift_matrix(v * group).T.astype(np.int64)) % 2
+            for v in range(4)
+        ], axis=1).astype(np.float32)
+    # scan shift matrices: level l combines spans of 2^l scan elements
+    # (4 groups per element on the wide path), so every row uses the
+    # SAME shift matrix at that level
+    span_bytes = group * (4 if wide else 1)
+    levels = (sg // (4 if wide else 1)).bit_length() - 1
     shifts = np.zeros((max(levels, 1), 32, 32), dtype=np.float32)
     for l in range(levels):
-        shifts[l] = crc_host.shift_matrix(group * (1 << l)).T
+        shifts[l] = crc_host.shift_matrix(span_bytes * (1 << l)).T
     kp, mp = -(-k // 8) * 8, -(-m // 8) * 8  # register rows padded to x8
     # 0/1 selection matrices: row r of the padded output takes the
     # scanned register at sub-row r*sg (row r's full-span register)
@@ -402,13 +513,17 @@ def fused_encode_crc(
     selp = np.zeros((mp, m * sg), dtype=np.float32)
     selp[np.arange(m), np.arange(m) * sg] = 1.0
     q, bigm_q = _stack_generator(bigm, k, m, tile, max_groups=sg)
+    # plane reuse needs whole groups inside each stacked quarter
+    reuse = bool(reuse_planes) and (tile // q) % group == 0 and tile >= group
     # G: combines the cpb chunk registers of one block in XLA (tiny)
     comb = np.zeros((cpb * 32, 32), dtype=np.int32)
     for c in range(cpb):
         comb[c * 32:(c + 1) * 32, :] = \
             crc_host.shift_matrix(tile * (cpb - 1 - c)).T
 
-    kernel = functools.partial(_fused_kernel, m=m, q=q, group=group)
+    kernel = functools.partial(
+        _fused_kernel, m=m, q=q, group=group, wide=wide, reuse=reuse
+    )
     parity, dreg, preg = pl.pallas_call(
         kernel,
         out_shape=(

@@ -135,3 +135,44 @@ def test_pallas_default_tile_shrinks_to_fit():
         np.testing.assert_array_equal(np.asarray(p), wp)
         np.testing.assert_array_equal(np.asarray(dc), wd)
         np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+@pytest.mark.parametrize("wide,reuse", [
+    (True, False), (False, True), (True, True),
+])
+@pytest.mark.parametrize("k,m", [(3, 2), (8, 4)])
+def test_pallas_roofline_config_byte_identical(k, m, wide, reuse):
+    """ROOFLINE items #2 (reuse_planes: CRC consumes the encode's
+    unpacked bit planes) and #3 (wide_crc: 128-lane stage-1 + 4-group
+    fold) must be byte-identical to the golden path in every
+    combination — only their SPEED is a silicon question."""
+    rng = np.random.default_rng(11)
+    bs = 65536
+    data = rng.integers(0, 256, size=(k, 2 * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    p, dc, pc = pe.fused_encode_crc(
+        bigm, data, bs, tile=65536, vmem_budget=64 * 2**20,
+        wide_crc=wide, reuse_planes=reuse,
+    )
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(p), wp)
+    np.testing.assert_array_equal(np.asarray(dc), wd)
+    np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+def test_pallas_roofline_small_tile_falls_back():
+    """Tiles too small for the 4-group fold (sc < 4) or for whole
+    groups per quarter must still produce golden bytes (the flags
+    silently downgrade rather than mis-compute)."""
+    rng = np.random.default_rng(12)
+    k, m, bs = 8, 4, 65536
+    data = rng.integers(0, 256, size=(k, bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    p, dc, pc = pe.fused_encode_crc(
+        bigm, data, bs, tile=512, vmem_budget=64 * 2**20,
+        wide_crc=True, reuse_planes=True,
+    )
+    wp, wd, wpc = cpu.encode_with_checksums(k, m, data, block_size=bs)
+    np.testing.assert_array_equal(np.asarray(p), wp)
+    np.testing.assert_array_equal(np.asarray(dc), wd)
+    np.testing.assert_array_equal(np.asarray(pc), wpc)
