@@ -260,23 +260,31 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 for rep in range(REPS):
                     async with Nfs3Client("127.0.0.1", gw.port) as nc:
                         root = await nc.mnt("/")
+                        # kernel-client pattern: honor the server's
+                        # FSINFO transfer-size preferences (Linux
+                        # sizes rsize/wsize from rtpref/wtpref), keep
+                        # 8 ops outstanding on one connection, gather
+                        # UNSTABLE writes + one COMMIT
+                        pref = await nc.fsinfo(root)
+                        wsz = min(max(pref["wtpref"], 65536),
+                                  pref["wtmax"] or 65536, 1 << 20)
+                        rsz = min(max(pref["rtpref"], 65536),
+                                  pref["rtmax"] or 65536, 1 << 20)
                         _, fh = await nc.create(root, f"nfs_{rep}.bin")
-                        # kernel-client pattern: 8 outstanding 64 KiB
-                        # ops on one connection (rsize/wsize pipeline),
-                        # UNSTABLE writes gathered + one COMMIT
                         sem = asyncio.Semaphore(8)
 
                         async def wslice(off):
                             async with sem:
-                                await nc.write(
-                                    fh, off, blob[off: off + 65536],
-                                    stable=0,
+                                piece = blob[off: off + wsz]
+                                n = await nc.write(
+                                    fh, off, piece, stable=0
                                 )
+                                assert n == len(piece), "short NFS write"
 
                         t0 = time.perf_counter()
                         await asyncio.gather(*(
                             wslice(off)
-                            for off in range(0, len(blob), 65536)
+                            for off in range(0, len(blob), wsz)
                         ))
                         await nc.commit(fh)
                         wts.append(time.perf_counter() - t0)
@@ -284,13 +292,13 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
 
                         async def rslice(off):
                             async with sem:
-                                piece, _eof = await nc.read(fh, off, 65536)
+                                piece, _eof = await nc.read(fh, off, rsz)
                                 got[off: off + len(piece)] = piece
 
                         t0 = time.perf_counter()
                         await asyncio.gather(*(
                             rslice(off)
-                            for off in range(0, len(blob), 65536)
+                            for off in range(0, len(blob), rsz)
                         ))
                         rts.append(time.perf_counter() - t0)
                         assert bytes(got) == blob, "nfs read mismatch"

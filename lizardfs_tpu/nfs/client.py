@@ -135,6 +135,38 @@ class Nfs3Client:
         self.skip_wcc(u)
         return u.fixed(8)
 
+    async def setattr(self, fh: bytes, mode: int | None = None,
+                      size: int | None = None,
+                      guard_ctime: int | None = None) -> int:
+        """SETATTR (proc 2); returns the NFS3 status (callers assert).
+        ``guard_ctime`` packs the sattrguard3 compare-and-set."""
+        p = Packer().opaque(fh)
+        p.boolean(mode is not None)
+        if mode is not None:
+            p.u32(mode)
+        p.boolean(False).boolean(False)  # uid/gid unchanged
+        p.boolean(size is not None)
+        if size is not None:
+            p.u64(size)
+        p.u32(0).u32(0)  # atime/mtime: DONT_CHANGE
+        p.boolean(guard_ctime is not None)
+        if guard_ctime is not None:
+            p.u32(guard_ctime).u32(0)
+        u = await self.call(2, p.bytes())
+        return u.u32()
+
+    async def fsinfo(self, fh: bytes) -> dict:
+        """FSINFO (proc 19): the server's transfer-size preferences —
+        real kernel clients size rsize/wsize from these, so bulk
+        drivers should too."""
+        u = await self.call(19, Packer().opaque(fh).bytes())
+        assert u.u32() == nfs.NFS3_OK
+        self.skip_post_op(u)
+        rtmax, rtpref, _rtmult = u.u32(), u.u32(), u.u32()
+        wtmax, wtpref, _wtmult = u.u32(), u.u32(), u.u32()
+        return {"rtmax": rtmax, "rtpref": rtpref,
+                "wtmax": wtmax, "wtpref": wtpref}
+
     async def read(self, fh: bytes, offset: int, count: int) -> tuple[bytes, bool]:
         u = await self.call(6, Packer().opaque(fh).u64(offset).u32(count).bytes())
         assert u.u32() == nfs.NFS3_OK

@@ -289,7 +289,19 @@ class NfsGateway:
         self.RA_TOTAL_BYTES = 64 * 2**20  # whole gateway
         self.RA_TTL_S = 1.0
         self._ra_total = 0
-        self.client.cache.add_invalidate_listener(self._ra_drop)
+        # access/attr decision caches: without them every wire READ or
+        # WRITE pays 1-2 master RPCs (access + getattr) — kernel NFS
+        # servers/clients cache both far longer than this TTL. Both are
+        # dropped per inode by (a) the data-invalidate listener (local
+        # writes + master pushes) and (b) _meta_dirty() after every
+        # metadata-mutating proc THIS gateway serves; cross-gateway
+        # chmod/utimes staleness is bounded by the TTL alone (the
+        # master pushes invalidations for data mutations only).
+        self._access_cache: dict[int, dict[tuple, tuple[bool, float]]] = {}
+        self._access_cache_n = 0
+        self._attr_cache: dict[int, tuple[object, float]] = {}
+        self.META_TTL_S = 1.0
+        self.client.cache.add_invalidate_listener(self._on_invalidate)
 
     @property
     def port(self) -> int:
@@ -486,14 +498,55 @@ class NfsGateway:
             p.boolean(False)  # post_op_attr absent
         return p.bytes()
 
+    def _meta_dirty(self, *inodes: int) -> None:
+        """Drop cached attr/access decisions for inodes whose metadata
+        a proc just mutated (setattr, create/remove in a parent, ...):
+        the mutating reply's post-op attrs and any guarded follow-up
+        must see post-mutation state, not a TTL-stale snapshot."""
+        for inode in inodes:
+            self._attr_cache.pop(inode, None)
+            dropped = self._access_cache.pop(inode, None)
+            if dropped:
+                self._access_cache_n -= len(dropped)
+
+    def _on_invalidate(self, inode: int) -> None:
+        self._ra_drop(inode)
+        self._meta_dirty(inode)
+
     async def _attr(self, inode: int) -> m.Attr:
-        return await self.client.getattr(inode)
+        e = self._attr_cache.get(inode)
+        if e is not None and time.monotonic() - e[1] <= self.META_TTL_S:
+            return e[0]
+        attr = await self.client.getattr(inode)
+        self._attr_cache[inode] = (attr, time.monotonic())
+        if len(self._attr_cache) > 65536:
+            self._attr_cache.clear()  # crude bound; refills on demand
+        return attr
 
     async def _attr_opt(self, inode: int) -> m.Attr | None:
         try:
-            return await self.client.getattr(inode)
+            return await self._attr(inode)
         except st.StatusError:
             return None
+
+    async def _access(self, inode: int, cred, mask: int) -> bool:
+        sub = self._access_cache.get(inode)
+        key = (cred.uid, tuple(cred.all_gids), mask)
+        now = time.monotonic()
+        if sub is not None:
+            e = sub.get(key)
+            if e is not None and now - e[1] <= self.META_TTL_S:
+                return e[0]
+        ok = await self.client.access(inode, cred.uid, cred.all_gids, mask)
+        if sub is None:
+            sub = self._access_cache.setdefault(inode, {})
+        if key not in sub:
+            self._access_cache_n += 1
+        sub[key] = (ok, now)
+        if self._access_cache_n > 65536:
+            self._access_cache.clear()
+            self._access_cache_n = 0
+        return ok
 
     # Each proc_* returns the XDR result body (success or mapped error).
 
@@ -520,6 +573,9 @@ class NfsGateway:
         if u.boolean():  # sattrguard3: compare-and-set on ctime
             guard_ctime = u.u32()
             u.u32()  # nsec (server ctimes are whole seconds)
+            # guard reads bypass the TTL cache: compare-and-set against
+            # a stale ctime would let a lost-update race through
+            self._meta_dirty(inode)
             current = await self._attr(inode)
             if current.ctime != guard_ctime:
                 p = Packer().u32(NFS3ERR_NOT_SYNC)
@@ -536,6 +592,7 @@ class NfsGateway:
                 inode, mask, caller_uid=cred.uid,
                 caller_gids=cred.all_gids, **kw,
             )
+            self._meta_dirty(inode)  # mode/owner changed: access too
         else:
             attr = await self._attr_opt(inode)
         p = Packer().u32(NFS3_OK)
@@ -579,9 +636,7 @@ class NfsGateway:
             (ACCESS3_MODIFY | ACCESS3_EXTEND | ACCESS3_DELETE, 2),
         )
         for bits, mask in checks:
-            if want & bits and await self.client.access(
-                inode, cred.uid, cred.all_gids, mask
-            ):
+            if want & bits and await self._access(inode, cred, mask):
                 granted |= want & bits
         p = Packer().u32(NFS3_OK)
         _post_op_attr(p, attr)
@@ -669,7 +724,7 @@ class NfsGateway:
         attr = await self._attr(inode)
         if attr.ftype == m.FTYPE_DIR:
             raise _NfsError(NFS3ERR_ISDIR)
-        if not await self.client.access(inode, cred.uid, cred.all_gids, 4):
+        if not await self._access(inode, cred, 4):
             raise _NfsError(NFS3ERR_ACCES)
         data = await self._ra_read(inode, offset, count)
         p = Packer().u32(NFS3_OK)
@@ -684,7 +739,7 @@ class NfsGateway:
         offset, count = u.u64(), u.u32()
         stable = u.u32()  # 0 UNSTABLE, 1 DATA_SYNC, 2 FILE_SYNC
         data = u.opaque(1 << 22)[:count]
-        if not await self.client.access(inode, cred.uid, cred.all_gids, 2):
+        if not await self._access(inode, cred, 2):
             raise _NfsError(NFS3ERR_ACCES)
         if stable == 0:
             # write gathering: buffer UNSTABLE writes and flush them as
@@ -778,6 +833,7 @@ class NfsGateway:
                 p = Packer().u32(_nfs_code(e))
                 _wcc_data(p, await self._attr_opt(parent))
                 return p.bytes()
+        self._meta_dirty(parent)
         p = Packer().u32(NFS3_OK)
         p.boolean(True).opaque(fh_pack(attr.inode))
         _post_op_attr(p, attr)
@@ -797,6 +853,7 @@ class NfsGateway:
             p = Packer().u32(_nfs_code(e))
             _wcc_data(p, await self._attr_opt(parent))
             return p.bytes()
+        self._meta_dirty(parent)
         p = Packer().u32(NFS3_OK)
         p.boolean(True).opaque(fh_pack(attr.inode))
         _post_op_attr(p, attr)
@@ -811,6 +868,7 @@ class NfsGateway:
         attr = await self.client.symlink(
             parent, name, target, uid=cred.uid, gid=cred.gid
         )
+        self._meta_dirty(parent)
         p = Packer().u32(NFS3_OK)
         p.boolean(True).opaque(fh_pack(attr.inode))
         _post_op_attr(p, attr)
@@ -834,6 +892,7 @@ class NfsGateway:
         except st.StatusError:
             pass
         await self.client.unlink(parent, name, uid=cred.uid, gids=cred.all_gids)
+        self._meta_dirty(parent)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(parent))
         return p.bytes()
@@ -842,6 +901,7 @@ class NfsGateway:
         parent = fh_unpack(u.opaque(64))
         name = u.string(255)
         await self.client.rmdir(parent, name, uid=cred.uid, gids=cred.all_gids)
+        self._meta_dirty(parent)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(parent))
         return p.bytes()
@@ -854,6 +914,7 @@ class NfsGateway:
         await self.client.rename(
             psrc, nsrc, pdst, ndst, uid=cred.uid, gids=cred.all_gids
         )
+        self._meta_dirty(psrc, pdst)
         p = Packer().u32(NFS3_OK)
         _wcc_data(p, await self._attr_opt(psrc))
         _wcc_data(p, await self._attr_opt(pdst))
@@ -866,6 +927,7 @@ class NfsGateway:
         attr = await self.client.link(
             inode, parent, name, uid=cred.uid, gids=cred.all_gids
         )
+        self._meta_dirty(parent, inode)  # nlink changed on the target
         p = Packer().u32(NFS3_OK)
         _post_op_attr(p, attr)
         _wcc_data(p, await self._attr_opt(parent))

@@ -491,3 +491,42 @@ async def test_nfs_pipelined_reads_one_connection(tmp_path):
     finally:
         await gw.stop()
         await cluster.stop()
+
+
+async def test_nfs_chmod_drops_cached_access_immediately(tmp_path):
+    """The gateway caches access decisions (META_TTL_S); a SETATTR
+    through the SAME gateway must drop them synchronously — a chmod-000
+    followed by a READ inside the TTL has to refuse, not serve from a
+    pre-chmod cache entry."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    gw = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw.start()
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as r, \
+                Nfs3Client("127.0.0.1", gw.port, uid=1000, gid=1000) as c:
+            pub = await r.mkdir(await r.mnt("/"), "pub", mode=0o777)
+            root = await c.mnt("/")
+            code, fh, _ = await c.lookup(root, "pub")
+            assert code == nfs.NFS3_OK
+            code, fh = await c.create(fh, "locked.bin", mode=0o644)
+            assert code == nfs.NFS3_OK, code
+            await c.write(fh, 0, b"secret-bytes!")
+            piece, _ = await c.read(fh, 0, 13)  # warms the access cache
+            assert piece == b"secret-bytes!"
+            assert await c.setattr(fh, mode=0) == nfs.NFS3_OK
+            # immediately inside the TTL: must be refused now
+            from lizardfs_tpu.nfs.xdr import Packer
+
+            u = await c.call(
+                6, Packer().opaque(fh).u64(0).u32(13).bytes()
+            )
+            assert u.u32() == nfs.NFS3ERR_ACCES, \
+                "READ served from a stale access-cache entry after chmod"
+            # and chmod back restores service (owner can always chmod)
+            assert await c.setattr(fh, mode=0o644) == nfs.NFS3_OK
+            piece, _ = await c.read(fh, 0, 13)
+            assert piece == b"secret-bytes!"
+    finally:
+        await gw.stop()
+        await cluster.stop()
