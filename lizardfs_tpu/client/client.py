@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time as _time
 
 import numpy as np
 
@@ -138,6 +139,17 @@ class Client:
         # how long a lost master may stay unreachable before ops fail
         # (election + promotion fit well inside this on a sane cluster)
         self.failover_timeout = 15.0
+        # read-locate cache (reference: src/mount/chunk_locator.h
+        # ReadChunkLocator's timed cache): repeat reads of a chunk skip
+        # the master RPC entirely. Coherence mirrors the BlockCache's
+        # three layers: dropped by the SAME invalidations (local writes,
+        # truncate, master pushes — via the listener below), bypassed on
+        # every read retry (a dead/stale holder re-locates), and
+        # TTL-bounded as the backstop.
+        self._locate_cache: dict[tuple[int, int], tuple[object, float]] = {}
+        self._locate_epoch: dict[int, int] = {}
+        self.locate_cache_ttl = 3.0
+        self.cache.add_invalidate_listener(self._drop_locates)
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -150,8 +162,6 @@ class Client:
     async def _throttle(self, nbytes: int) -> None:
         """Apply the master-coordinated IO limit to a data transfer,
         under the calling process's limit group."""
-        import time as _time
-
         group = self._io_group_of_caller()
         state = self._io_groups.setdefault(
             group, {"bucket": None, "next_renew": 0.0}
@@ -202,8 +212,6 @@ class Client:
         }
 
     def _record(self, op: str, **kw) -> None:
-        import time as _time
-
         self.oplog.append((_time.time(), op, kw))
         self.op_counters[op] = self.op_counters.get(op, 0) + 1
 
@@ -290,8 +298,6 @@ class Client:
         or answers NOT_POSSIBLE (still shadow), and a single pass would
         fail exactly the ops the address list exists to save (reference:
         the mount's fs_reconnect loop)."""
-        import time as _time
-
         deadline = _time.monotonic() + self.failover_timeout
         delay = 0.1
         while True:
@@ -325,6 +331,18 @@ class Client:
         except (ConnectionError, OSError, asyncio.TimeoutError,
                 st.StatusError):
             pass  # reconnect path re-probes at connect
+
+    def _drop_locates(self, inode: int) -> None:
+        """BlockCache invalidate-listener + end-of-write hook: any
+        invalidation of an inode's data drops its cached chunk
+        locations, and bumps the inode's epoch so an in-flight locate
+        that raced the invalidation refuses to store its reply (the
+        BlockCache's revoked-put rule, applied to locations)."""
+        for key in [k for k in self._locate_cache if k[0] == inode]:
+            del self._locate_cache[key]
+        self._locate_epoch[inode] = self._locate_epoch.get(inode, 0) + 1
+        if len(self._locate_epoch) > 65536:
+            self._locate_epoch.clear()
 
     async def _limits_probe_loop(self) -> None:
         """Periodic probe so io_limits_active tracks runtime config
@@ -518,8 +536,6 @@ class Client:
         (FUSE resolves a path per operation — an uncached walk costs
         O(depth) master RPCs per op); the leaf is always looked up
         fresh so its attributes (size!) are never stale."""
-        import time as _time
-
         comps = [c for c in path.strip("/").split("/") if c]
         if not comps:
             return await self.getattr(1)
@@ -880,6 +896,10 @@ class Client:
                 chunk_id=grant.chunk_id, inode=inode, chunk_index=ci,
                 file_length=new_length, status=status_code,
             )
+            # a locate cached BETWEEN this write's grant and its end
+            # carries the pre-write length/identity — drop again now
+            # (the master's end-of-write push excludes our own session)
+            self._drop_locates(inode)
 
     async def _rmw_striped(
         self, grant, slice_type, copies, ci: int, coff: int,
@@ -981,6 +1001,9 @@ class Client:
                 file_length=file_length,
                 status=status_code,
             )
+            # see _write_chunk's twin: locates cached mid-write carry
+            # pre-write length/identity and must not outlive the write
+            self._drop_locates(inode)
 
     async def _push_chunk_parts(self, grant, chunk_data: np.ndarray) -> None:
         # group locations by part index
@@ -1276,6 +1299,18 @@ class Client:
     # --- read path ---------------------------------------------------------------------
 
     async def read_file(self, inode: int, offset: int = 0, size: int | None = None) -> bytes:
+        if size is not None and size > 0:
+            ci = offset // MFSCHUNKSIZE
+            if (offset + size - 1) // MFSCHUNKSIZE == ci:
+                # sized single-chunk read (every FUSE/NFS READ is this
+                # shape): ONE master RPC — the locate reply carries
+                # file_length, so the separate getattr round trip that
+                # used to precede every read is gone (reference:
+                # fs_readchunk returns the length the same way)
+                piece = await self._read_chunk_range(
+                    inode, ci, offset - ci * MFSCHUNKSIZE, size, None
+                )
+                return b"" if piece is None else piece.tobytes()
         attr = await self.getattr(inode)
         length = attr.length
         if size is None:
@@ -1346,15 +1381,25 @@ class Client:
 
     async def _read_chunk_range(
         self, inode: int, chunk_index: int, off: int, size: int,
-        file_length: int, into: np.ndarray | None = None,
+        file_length: int | None, into: np.ndarray | None = None,
         into_offset: int = 0,
     ) -> np.ndarray | None:
         """Read one chunk range. Returns the bytes — or ``None`` when
         they were scattered directly into ``into`` (bulk aligned reads
-        of standard chunks land network bytes in the caller's buffer)."""
-        chunk_len = min(
-            max(file_length - chunk_index * MFSCHUNKSIZE, 0), MFSCHUNKSIZE
-        )
+        of standard chunks land network bytes in the caller's buffer).
+
+        ``file_length=None``: length unknown — learn it from the locate
+        reply (MatoclReadChunk.file_length, like the reference's
+        fs_readchunk) and clamp there, saving sized reads the separate
+        getattr round trip. Only valid with ``into=None``."""
+        if file_length is None:
+            assert into is None, "length-from-locate needs the copy path"
+            chunk_len = MFSCHUNKSIZE  # provisional; clamped post-locate
+        else:
+            chunk_len = min(
+                max(file_length - chunk_index * MFSCHUNKSIZE, 0),
+                MFSCHUNKSIZE,
+            )
         # bulk reads skip the block cache entirely: probing + filling it
         # costs a per-64KiB-block copy, and streaming workloads would
         # only evict it anyway (the reference's readcache is similarly
@@ -1389,21 +1434,61 @@ class Client:
         )
         read_size = aligned_end - aligned_off
 
-        await self._throttle(read_size)  # QoS: charge once, not per retry
+        throttled = file_length is not None
+        if throttled:
+            await self._throttle(read_size)  # QoS: charge once, not per retry
         last_error: Exception | None = None
         bad_addrs: set[tuple[str, int]] = set()  # replicas that failed us
         for attempt in range(self.retries):
             if attempt:
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
-            loc = await self._call(
-                m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
-                **self._ident(None, None),
-            )
+            loc = None
+            if attempt == 0:
+                cached = self._locate_cache.get((inode, chunk_index))
+                if (cached is not None and _time.monotonic() - cached[1]
+                        <= self.locate_cache_ttl):
+                    loc = cached[0]
+                    self.op_counters["locate_cache_hit"] = (
+                        self.op_counters.get("locate_cache_hit", 0) + 1
+                    )
+            if loc is None:
+                epoch = self._locate_epoch.get(inode, 0)
+                loc = await self._call(
+                    m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
+                    **self._ident(None, None),
+                )
+                if self._locate_epoch.get(inode, 0) == epoch:
+                    # refuse stores that raced an invalidation: the
+                    # reply may predate the mutation that bumped epoch
+                    self._locate_cache[(inode, chunk_index)] = (
+                        loc, _time.monotonic()
+                    )
+                    if len(self._locate_cache) > 4096:
+                        self._locate_cache.clear()  # crude bound
             # revalidate cached blocks against the chunk identity this
             # locate returned: a rewrite bumps the version, a truncate+
             # regrow swaps the chunk_id — either way stale blocks drop
             chunk_tag = (loc.chunk_id, loc.version)
             self.cache.note_version(inode, chunk_index, chunk_tag)
+            if file_length is None:
+                # clamp the provisional geometry with the length the
+                # locate just taught us
+                file_length = loc.file_length
+                chunk_len = min(
+                    max(file_length - chunk_index * MFSCHUNKSIZE, 0),
+                    MFSCHUNKSIZE,
+                )
+                size = min(size, max(chunk_len - off, 0))
+                if size <= 0:
+                    return np.zeros(0, dtype=np.uint8)  # past EOF
+                aligned_end = min(aligned_end, chunk_len)
+                read_size = aligned_end - aligned_off
+            if not throttled:
+                # deferred until the locate-taught clamp: charging the
+                # provisional geometry would bill EOF reads for bytes
+                # never transferred
+                throttled = True
+                await self._throttle(read_size)
             if loc.chunk_id == 0:
                 if into is not None:
                     into[into_offset : into_offset + size] = 0

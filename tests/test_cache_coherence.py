@@ -141,3 +141,88 @@ def test_blockcache_version_notes_bounded():
     # an evicted note only costs a skipped fill, never a wrong read
     c.put(0, 0, 0, b"q" * 10, version=(0, 1))
     assert c.get(0, 0, 0) is None
+
+
+async def test_locate_cache_hits_and_write_invalidation(tmp_path):
+    """Repeat sized reads of an unchanged chunk serve their location
+    from the client's locate cache (chunk_locator.h analog — one
+    master RPC for the first read, zero after); any write to the inode
+    drops the cached location so the next read re-locates."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.locate_cache_ttl = 60.0  # pin behavior, not wall-clock speed
+        from lizardfs_tpu.utils import data_generator
+
+        f = await c.create(1, "loc.bin")
+        payload = data_generator.generate(4, 8 << 20).tobytes()
+        await c.write_file(f.inode, payload)
+        # bulk-sized reads bypass the block cache, so every one needs a
+        # location — only the FIRST may pay a master RPC
+        got = await c.read_file(f.inode, 0, 4 << 20)
+        assert bytes(got) == payload[: 4 << 20]
+        before = dict(c.op_counters)
+        for i in range(3):
+            off = i * (1 << 20)
+            got = await c.read_file(f.inode, off, 4 << 20)
+            assert bytes(got) == payload[off: off + (4 << 20)]
+        delta_locates = (
+            c.op_counters.get("CltomaReadChunk", 0)
+            - before.get("CltomaReadChunk", 0)
+        )
+        hits = (
+            c.op_counters.get("locate_cache_hit", 0)
+            - before.get("locate_cache_hit", 0)
+        )
+        assert delta_locates == 0, f"{delta_locates} extra locates"
+        assert hits == 3
+        # a write drops the cached location (version moved)
+        await c.pwrite(f.inode, 0, b"Z" * 8192)
+        before = dict(c.op_counters)
+        got = await c.read_file(f.inode, 0, 4096)
+        assert bytes(got) == b"Z" * 4096
+        assert (
+            c.op_counters.get("CltomaReadChunk", 0)
+            - before.get("CltomaReadChunk", 0)
+        ) == 1, "write did not invalidate the locate cache"
+    finally:
+        await cluster.stop()
+
+
+async def test_locate_cached_mid_write_dropped_at_write_end(tmp_path):
+    """A locate performed while a write to the same inode is in flight
+    (between its grant and its WriteChunkEnd) reflects pre-write
+    length/identity; it must not be served from the locate cache after
+    the write returns (r05 review finding: the master's end-of-write
+    push excludes the mutator's own session, so the client drops its
+    own locates at write end)."""
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.locate_cache_ttl = 60.0
+        # EXTENSION is the sharp case: file length only grows at
+        # WriteChunkEnd, so a mid-write locate caches file_length=0
+        # and a post-write sized read would clamp to it, returning b""
+        f = await c.create(1, "race.bin")
+        mid_read: list[bytes] = []
+        orig = c._push_chunk_parts
+
+        async def hooked(grant, chunk_data):
+            await orig(grant, chunk_data)
+            # data pushed, WriteChunkEnd NOT yet sent: a concurrent
+            # reader locates now and caches a pre-end location
+            mid_read.append(bytes(await c.read_file(f.inode, 0, 8)))
+
+        c._push_chunk_parts = hooked
+        try:
+            await c.write_file(f.inode, b"B" * 65536)
+        finally:
+            c._push_chunk_parts = orig
+        assert mid_read == [b""], mid_read  # pre-end view: length 0
+        got = await c.read_file(f.inode, 0, 8)
+        assert bytes(got) == b"B" * 8, \
+            "read clamped to a locate cached mid-write (stale length 0)"
+    finally:
+        await cluster.stop()
