@@ -87,7 +87,7 @@ def tpu_throughput(k: int = K, m: int = M,
     # residency and Mosaic lowering are only provable on silicon, so a
     # compile failure downgrades — LOUDLY and tagged — down the ladder
     # to the r01-verified default
-    global KERNEL_CONFIG_USED
+    global KERNEL_CONFIG_USED, KERNEL_CFG
     if fused is jax_ec.fused_encode_crc:
         ladder = [(None, "jax-cpu")]
     else:
@@ -109,10 +109,16 @@ def tpu_throughput(k: int = K, m: int = M,
     import statistics
 
     L = 16
+    headline = (k, m, nblocks_per_part) == (K, M, NBLOCKS_PER_PART)
     for i, (cfg, tag) in enumerate(ladder):
         call = functools.partial(fused, **cfg) if cfg else fused
         loop = make_loop(call)
-        KERNEL_CONFIG_USED = tag
+        if headline:
+            # only the HEADLINE run owns the shipped tag/config — the
+            # wide (32,8) row runs its own ladder afterwards and must
+            # not clobber what the artifact attributes to other rows
+            KERNEL_CONFIG_USED = tag
+            KERNEL_CFG = cfg or {}
         try:
             timed(1)  # compile L=1
             break
@@ -223,15 +229,33 @@ def tpu_reconstruct_latency_ms() -> float:
         )
     )
 
-    def once() -> float:
+    def once(call) -> float:
         t0 = time.perf_counter()
-        rec, _dc, _rc = fused(bigm, survivors, BLOCK)
+        rec, _dc, _rc = call(bigm, survivors, BLOCK)
         np.asarray(rec)  # force device->host of the rebuilt part
         return (time.perf_counter() - t0) * 1e3
 
-    once()
-    once()  # compile, then warm
-    return statistics.median(once() for _ in range(7))
+    call = fused
+    if fused is not jax_ec.fused_encode_crc and KERNEL_CFG:
+        # the ladder proved this config for the ENCODE shapes only; the
+        # recovery program may still displease Mosaic, and this row is
+        # optional (exceptions are swallowed upstream) — downgrade
+        # loudly to the verified default instead of vanishing
+        try:
+            staged = functools.partial(fused, **KERNEL_CFG)
+            once(staged)  # compile probe
+            call = staged
+        except Exception as e:  # noqa: BLE001 — Mosaic fails fast
+            import sys
+
+            print(
+                f"rec row: staged config failed to compile "
+                f"({str(e)[:120]}); using verified default",
+                file=sys.stderr,
+            )
+    once(call)
+    once(call)  # compile, then warm
+    return statistics.median(once(call) for _ in range(7))
 
 
 def cpu_reconstruct_ms() -> float:
@@ -346,6 +370,7 @@ def cluster_throughput() -> dict:
 
 
 KERNEL_CONFIG_USED = ""  # set by tpu_throughput; shipped via the queue
+KERNEL_CFG: dict = {}  # the winning staged config; other rows reuse it
 
 
 def _tpu_worker(q):

@@ -582,7 +582,10 @@ def fused_encode_crc(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "interpret")
+    jax.jit, static_argnames=(
+        "block_size", "interpret", "tile", "vmem_budget", "wide_crc",
+        "reuse_planes",
+    )
 )
 def fused_decode_verify(
     bigm_rec: jnp.ndarray,
@@ -590,6 +593,10 @@ def fused_decode_verify(
     expected_crcs: jnp.ndarray,
     block_size: int = MFSBLOCKSIZE,
     interpret: bool | None = None,
+    tile: int = 16384,
+    vmem_budget: int = _FUSED_VMEM_BUDGET,
+    wide_crc: bool = False,
+    reuse_planes: bool = False,
 ):
     """Fused reconstruct + CRC verify of the recovered parts.
 
@@ -601,6 +608,8 @@ def fused_decode_verify(
     post-recovery verify, reference read_plan_executor.cc + crc.cc).
     """
     recovered, _scrc, rcrc = fused_encode_crc(
-        bigm_rec, survivors, block_size, interpret=interpret
+        bigm_rec, survivors, block_size, interpret=interpret,
+        tile=tile, vmem_budget=vmem_budget, wide_crc=wide_crc,
+        reuse_planes=reuse_planes,
     )
     return recovered, rcrc, rcrc == expected_crcs.astype(jnp.uint32)

@@ -176,3 +176,28 @@ def test_pallas_roofline_small_tile_falls_back():
     np.testing.assert_array_equal(np.asarray(p), wp)
     np.testing.assert_array_equal(np.asarray(dc), wd)
     np.testing.assert_array_equal(np.asarray(pc), wpc)
+
+
+def test_pallas_decode_verify_roofline_config_byte_identical():
+    """fused_decode_verify must accept the staged ROOFLINE config and
+    recover byte-identically through a RECOVERY bitmatrix (the encode
+    parity tests cover only generator-matrix shapes; the rec bench row
+    uses exactly this path with the ladder's winning config)."""
+    from lizardfs_tpu.ops import gf256
+
+    rng = np.random.default_rng(13)
+    k, m, bs, nb = 8, 4, 65536, 2
+    data = rng.integers(0, 256, size=(k, nb * bs), dtype=np.uint8)
+    bigm = jax_ec.encoding_bitmatrix(k, m)
+    parity, dcrc, _pcrc = pe.fused_encode_crc(bigm, data, bs)
+    allparts = np.concatenate([data, np.asarray(parity)], axis=0)
+    lost = [0]
+    have = [i for i in range(k + m) if i not in lost]
+    used, _ = gf256.recovery_selection(k, m, have, lost)
+    big_rec = jax_ec.recovery_bitmatrix(k, m, tuple(used), tuple(lost))
+    rec, _crcs, ok = pe.fused_decode_verify(
+        np.asarray(big_rec), allparts[list(used)],
+        np.asarray(dcrc)[lost], bs, **pe.ROOFLINE_CONFIG,
+    )
+    np.testing.assert_array_equal(np.asarray(rec), data[lost])
+    assert np.asarray(ok).all()
