@@ -128,8 +128,8 @@ async def test_sigkill_chunkserver_degraded_read(tmp_path):
     """kill -9 a chunkserver mid-cluster: EC reads recover through the
     survivors, and the health engine re-replicates."""
     cluster = ProcCluster(tmp_path, n_cs=4)
-    await cluster.start()
     try:
+        await cluster.start()  # inside try: a failed start must not leak
         c = Client("127.0.0.1", cluster.master_port, wave_timeout=0.3)
         await c.connect()
         f = await c.create(1, "victim.bin")
@@ -140,11 +140,31 @@ async def test_sigkill_chunkserver_degraded_read(tmp_path):
         cluster.kill9("cs0")  # no goodbye, no flush
         got = await c.read_file(f.inode)
         assert got == payload, "degraded read after SIGKILL"
-        # health engine restores full redundancy on the survivors
-        for _ in range(150):
-            if await cluster._cs_count() == 3:
+        # health engine restores full redundancy on the 3 survivors:
+        # every part of the ec(3,2) chunks reappears somewhere live
+        from lizardfs_tpu.proto import framing
+        from lizardfs_tpu.proto import messages as m
+
+        async def endangered_count() -> int:
+            import json
+
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", cluster.master_port
+            )
+            await framing.send_message(
+                w, m.AdminCommand(req_id=1, command="chunks-health", json="{}")
+            )
+            reply = await framing.read_message(r)
+            w.close()
+            doc = json.loads(reply.json)
+            return int(doc.get("endangered", 0)) + int(doc.get("lost", 0))
+
+        for _ in range(200):
+            if await endangered_count() == 0:
                 break
             await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("health engine never restored redundancy")
         await c.close()
     finally:
         cluster.stop()
@@ -154,8 +174,8 @@ async def test_sigkill_master_restart_replays(tmp_path):
     """kill -9 the master (no image dump): the restart replays the
     changelog and serves the same namespace and bytes."""
     cluster = ProcCluster(tmp_path, n_cs=3)
-    await cluster.start()
     try:
+        await cluster.start()  # inside try: a failed start must not leak
         c = Client("127.0.0.1", cluster.master_port, wave_timeout=0.3)
         await c.connect()
         f = await c.create(1, "durable.bin")
@@ -234,18 +254,6 @@ async def test_sigkill_active_master_shadow_process_promotes(tmp_path):
         return cfg
 
     (tmp_path / "goals.cfg").write_text("1 one : _\n5 ec32 : $ec(3,2)\n")
-    for me in ("a", "b", "c"):
-        cluster._spawn(f"master_{me}", "lizardfs_tpu.master", master_cfg(me))
-    await cluster._wait_port(pa)
-    addrs = ",".join(f"127.0.0.1:{p}" for p, _ in peers.values())
-    for i in range(cluster.n_cs):
-        cluster._spawn(
-            f"cs{i}", "lizardfs_tpu.chunkserver",
-            f"DATA_PATH = {tmp_path}/cs{i}\n"
-            f"LISTEN_PORT = {_free_port()}\n"
-            f"MASTER_ADDRS = {addrs}\n"
-            "HEARTBEAT_INTERVAL = 0.3\n",
-        )
 
     async def wait_active(exclude: int | None = None) -> int:
         """Port of the master every chunkserver is registered with —
@@ -261,12 +269,28 @@ async def test_sigkill_active_master_shadow_process_promotes(tmp_path):
             await asyncio.sleep(0.1)
         raise AssertionError("no master has all chunkservers registered")
 
-    active = await wait_active()
-    leader_name = next(
-        f"master_{pid}" for pid, (p, _) in peers.items() if p == active
-    )
-
+    # ALL spawns happen inside try/finally: a failure during setup
+    # (wait_port/wait_active raising) must still tear every spawned
+    # process down — early versions leaked whole clusters on failure
     try:
+        for me in ("a", "b", "c"):
+            cluster._spawn(
+                f"master_{me}", "lizardfs_tpu.master", master_cfg(me)
+            )
+        await cluster._wait_port(pa)
+        addrs = ",".join(f"127.0.0.1:{p}" for p, _ in peers.values())
+        for i in range(cluster.n_cs):
+            cluster._spawn(
+                f"cs{i}", "lizardfs_tpu.chunkserver",
+                f"DATA_PATH = {tmp_path}/cs{i}\n"
+                f"LISTEN_PORT = {_free_port()}\n"
+                f"MASTER_ADDRS = {addrs}\n"
+                "HEARTBEAT_INTERVAL = 0.3\n",
+            )
+        active = await wait_active()
+        leader_name = next(
+            f"master_{pid}" for pid, (p, _) in peers.items() if p == active
+        )
         c = Client(
             "127.0.0.1", active, wave_timeout=0.3,
             master_addrs=[("127.0.0.1", p) for p, _ in peers.values()],
