@@ -433,3 +433,30 @@ async def test_cancelled_striped_write_does_not_pool_staging(
             "cancelled write did not abort its in-flight sender"
     finally:
         await cluster.stop()
+
+
+# --- same-host unix-socket fast path ----------------------------------------
+
+async def test_uds_fast_path_engages(tmp_path):
+    """The same-host abstract-socket fast path must actually engage:
+    this pins the name contract between native_io._blocking_socket and
+    serve_native.cpp's uds_data_addr — a silent format drift would
+    quietly fall back to TCP and forfeit the ~2.5x per-byte win."""
+    if not native_io.available():
+        pytest.skip("native io not built")
+    before = native_io.UDS_CONNECTS
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "uds.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(17, 2 * 2**20).tobytes()
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        back = await c.read_file(f.inode, 0, len(payload))
+        assert bytes(back) == payload
+        assert native_io.UDS_CONNECTS > before, \
+            "no data-plane connection took the unix-socket fast path"
+    finally:
+        await cluster.stop()
