@@ -131,8 +131,9 @@ POOL = _SocketPool()
 
 # observability + contract pin: how many data-plane connections took the
 # same-host unix-socket fast path (tests assert this moves, so a silent
-# name-format drift between here and serve_native.cpp fails loudly)
+# name-format drift between here and native/wire.h fails loudly)
 UDS_CONNECTS = 0
+_UDS_COUNT_LOCK = threading.Lock()  # incremented from executor threads
 
 # Dedicated executor: native IO calls block for a full network exchange.
 # Sharing asyncio's default to_thread pool would let a burst of bulk
@@ -258,16 +259,27 @@ def _blocking_socket(addr: tuple[str, int], io_timeout: float) -> socket.socket:
     global UDS_CONNECTS
     sock = None
     if (
-        addr[0] in ("127.0.0.1", "localhost", "::1")
+        addr[0] in ("127.0.0.1", "localhost")  # exactly wire.h uds_host()
         and not os.environ.get("LZ_NO_UDS")  # operational kill-switch
     ):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             s.settimeout(5.0)
             s.connect(f"\0lzfs-data-{addr[0]}-{addr[1]}")
+            # abstract names bypass filesystem permissions: verify the
+            # peer is OUR uid (or root) via SO_PEERCRED before trusting
+            # it with chunk data — anything else could be an impostor
+            # that bound the name first
+            pid_uid_gid = s.getsockopt(
+                socket.SOL_SOCKET, socket.SO_PEERCRED, struct.calcsize("3i")
+            )
+            _pid, uid, _gid = struct.unpack("3i", pid_uid_gid)
+            if uid not in (os.geteuid(), 0):
+                raise OSError("unix listener owned by another uid")
             s.settimeout(None)
             sock = s
-            UDS_CONNECTS += 1
+            with _UDS_COUNT_LOCK:
+                UDS_CONNECTS += 1
         except OSError:
             s.close()
     if sock is None:

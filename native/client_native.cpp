@@ -125,7 +125,7 @@ struct liz {
         auto key = std::make_pair(h, p);
         auto it = data_fds.find(key);
         if (it != data_fds.end()) return it->second;
-        int fd = connect_tcp(h, p);
+        int fd = connect_data(h, p);  // same-host unix fast path
         if (fd >= 0) {
             set_recv_timeout(fd, 30);
             data_fds[key] = fd;
@@ -359,7 +359,7 @@ int write_chunk_range(liz_t* fs, const ChunkGrant& g, uint32_t inode,
     }
     // one chain through all copies (WriteExecutor analog)
     const Location& head = g.locations[0];
-    int fd = connect_tcp(head.host, head.port);  // exclusive for the chain
+    int fd = connect_data(head.host, head.port);  // exclusive for the chain
     if (fd < 0) return kErrConn;
     int code = stEIO;
     do {

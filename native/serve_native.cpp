@@ -65,6 +65,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "wire.h"
+
 extern "C" uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len);
 
 namespace {
@@ -751,77 +753,13 @@ void relay_down(WriteSession* s, int up_fd, std::mutex* send_mu) {
     }
 }
 
-socklen_t uds_data_addr(const std::string& host, uint16_t port,
-                        struct sockaddr_un* ua) {
-    // abstract namespace (leading NUL): vanishes with the listener, no
-    // filesystem residue. The name embeds the server's ADVERTISED host
-    // string as well as the port, so a dial of 127.0.0.1:P only
-    // matches a server that really advertised 127.0.0.1:P — a port
-    // forward to a remote server, or a second server owning P on a
-    // different interface, produces a non-matching name and falls back
-    // to TCP instead of silently reaching the wrong data plane.
-    // KEEP IN SYNC with lizardfs_tpu/core/native_io.py
-    // _blocking_socket (the format contract is pinned by
-    // tests/test_fast_paths.py::test_uds_fast_path_engages).
-    std::memset(ua, 0, sizeof(*ua));
-    ua->sun_family = AF_UNIX;
-    char name[96];
-    int n = std::snprintf(name, sizeof(name), "lzfs-data-%s-%u",
-                          host.c_str(), port);
-    if (n <= 0 || n > 90) n = std::snprintf(name, sizeof(name),
-                                            "lzfs-data-%u", port);
-    std::memcpy(ua->sun_path + 1, name, static_cast<size_t>(n));
-    return static_cast<socklen_t>(
-        offsetof(struct sockaddr_un, sun_path) + 1 + n);
-}
-
-bool uds_disabled() {
-    static const bool off = std::getenv("LZ_NO_UDS") != nullptr;
-    return off;
-}
-
-int connect_uds(const std::string& host, uint16_t port) {
-    int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
-    if (fd < 0) return -1;
-    struct sockaddr_un ua;
-    socklen_t len = uds_data_addr(host, port, &ua);
-    if (::connect(fd, reinterpret_cast<struct sockaddr*>(&ua), len) != 0) {
-        ::close(fd);
-        return -1;
-    }
-    return fd;
-}
-
 int connect_addr(const std::string& host, uint16_t port) {
-    if ((host == "127.0.0.1" || host == "localhost") && !uds_disabled()) {
-        // same-host fast path: the data plane also listens on an
-        // abstract unix socket — ~2.5x less per-byte CPU than
-        // loopback TCP on the measured boxes (chain relays between
-        // co-located chunkservers ride this too)
-        int ufd = connect_uds(host, port);
-        if (ufd >= 0) {
-            set_bulk_sockopts(ufd);  // TCP_NODELAY harmlessly fails
-            return ufd;
-        }
-    }
-    struct addrinfo hints {};
-    hints.ai_family = AF_UNSPEC;
-    hints.ai_socktype = SOCK_STREAM;
-    char portstr[8];
-    std::snprintf(portstr, sizeof(portstr), "%u", port);
-    struct addrinfo* res = nullptr;
-    if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0) return -1;
-    int fd = -1;
-    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-        if (fd < 0) continue;
-        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
-        ::close(fd);
-        fd = -1;
-    }
-    ::freeaddrinfo(res);
-    if (fd >= 0) set_bulk_sockopts(fd);
-    return fd;
+    // same-host dials prefer the peer's abstract unix listener (chain
+    // relays between co-located chunkservers ride this too); remote or
+    // absent listeners fall back to TCP — all via the ONE contract
+    // copy in wire.h (lzwire::connect_data applies buffer opts; the
+    // TCP branch also sets TCP_NODELAY)
+    return lzwire::connect_data(host, port);
 }
 
 uint8_t create_chunk_file(const std::string& folder, uint64_t chunk_id,
@@ -1406,12 +1344,14 @@ int lz_serve_start(const char* folders_nl, const char* host, int port) {
     // best-effort same-host fast path: an abstract unix listener named
     // after the advertised host + TCP port (clients and chain relays
     // on this host prefer it; any bind failure leaves TCP-only service)
-    int ufd = uds_disabled() ? -1 : ::socket(AF_UNIX, SOCK_STREAM, 0);
+    int ufd = lzwire::uds_disabled() ? -1
+                                      : ::socket(AF_UNIX, SOCK_STREAM, 0);
     if (ufd >= 0) {
         struct sockaddr_un ua;
-        socklen_t ulen = uds_data_addr(
+        socklen_t ulen = lzwire::uds_data_addr(
             host, static_cast<uint16_t>(srv->port), &ua);
-        if (::bind(ufd, reinterpret_cast<struct sockaddr*>(&ua), ulen) < 0 ||
+        if (ulen == 0 ||
+            ::bind(ufd, reinterpret_cast<struct sockaddr*>(&ua), ulen) < 0 ||
             ::listen(ufd, 128) < 0) {
             ::close(ufd);
             ufd = -1;

@@ -6,8 +6,10 @@
 #pragma once
 
 #include <cerrno>
+#include <cstddef>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <netdb.h>
 #include <vector>
@@ -15,6 +17,7 @@
 #include <netinet/tcp.h>
 #include <string>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 namespace lzwire {
@@ -91,6 +94,72 @@ inline int connect_tcp(const std::string& host, uint16_t port) {
         ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
     }
     return fd;
+}
+
+// --- same-host data-plane fast path (abstract unix sockets) ---------------
+//
+// Name contract: "lzfs-data-<advertised-host>-<port>", host checked
+// against exactly {"127.0.0.1", "localhost"} — the ONE C copy of the
+// contract; serve_native.cpp binds with uds_data_addr and relays via
+// connect_data, and lizardfs_tpu/core/native_io.py mirrors it in
+// Python (pinned by tests/test_fast_paths.py::test_uds_fast_path_
+// engages and the FUSE read-pool tests). Master links must use
+// connect_tcp — only the data plane binds a unix listener.
+
+inline bool uds_disabled() {
+    static const bool off = std::getenv("LZ_NO_UDS") != nullptr;
+    return off;
+}
+
+inline bool uds_host(const std::string& host) {
+    return host == "127.0.0.1" || host == "localhost";
+}
+
+inline socklen_t uds_data_addr(const std::string& host, uint16_t port,
+                               struct sockaddr_un* ua) {
+    std::memset(ua, 0, sizeof(*ua));
+    ua->sun_family = AF_UNIX;
+    char name[96];
+    int n = std::snprintf(name, sizeof(name), "lzfs-data-%s-%u",
+                          host.c_str(), port);
+    if (n <= 0 || n > 90) return 0;
+    std::memcpy(ua->sun_path + 1, name, static_cast<size_t>(n));
+    return static_cast<socklen_t>(
+        offsetof(struct sockaddr_un, sun_path) + 1 + n);
+}
+
+// DATA-plane connect: same-host dials prefer the chunkserver's abstract
+// unix listener (~2.5x less per-byte CPU than loopback TCP), falling
+// back to TCP when absent, disabled, or owned by another uid (abstract
+// names bypass filesystem permissions, so the peer is VERIFIED via
+// SO_PEERCRED: only a server running as our own uid — or root — may
+// serve us, anything else is a potential local impostor).
+inline int connect_data(const std::string& host, uint16_t port) {
+    if (uds_host(host) && !uds_disabled()) {
+        int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+        if (fd >= 0) {
+            struct sockaddr_un ua;
+            socklen_t len = uds_data_addr(host, port, &ua);
+            if (len > 0 &&
+                ::connect(fd, reinterpret_cast<struct sockaddr*>(&ua),
+                          len) == 0) {
+                struct ucred uc {};
+                socklen_t ul = sizeof(uc);
+                if (::getsockopt(fd, SOL_SOCKET, SO_PEERCRED, &uc, &ul)
+                        == 0 &&
+                    (uc.uid == ::geteuid() || uc.uid == 0)) {
+                    int bufsz = 4 * 1024 * 1024;
+                    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz,
+                                 sizeof(bufsz));
+                    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz,
+                                 sizeof(bufsz));
+                    return fd;
+                }
+            }
+            ::close(fd);
+        }
+    }
+    return connect_tcp(host, port);
 }
 
 // Growable message builder for request bodies.
