@@ -87,7 +87,7 @@ def tpu_throughput(k: int = K, m: int = M,
     # residency and Mosaic lowering are only provable on silicon, so a
     # compile failure downgrades — LOUDLY and tagged — down the ladder
     # to the r01-verified default
-    global KERNEL_CONFIG_USED, KERNEL_CFG
+    global KERNEL_CONFIG_USED, KERNEL_CFG, KERNEL_LADDER
     if fused is jax_ec.fused_encode_crc:
         ladder = [(None, "jax-cpu")]
     else:
@@ -106,52 +106,89 @@ def tpu_throughput(k: int = K, m: int = M,
         float(loop(bigm, data, n))
         return time.perf_counter() - t0
 
+    def measure() -> float:
+        timed(L)  # compile L=16
+        vals, totals = [], []
+        # several measurement rounds: the first reads low until clocks
+        # and the axon tunnel warm up. Rounds where the L-iter run does
+        # not clearly exceed its own dispatch floor are tunnel jitter
+        # and are discarded; the result is the true median of the last
+        # surviving rounds (robust to both the slow warm-up round and
+        # noise).
+        for _ in range(5):
+            floor = min(timed(1) for _ in range(3))
+            total = min(timed(L) for _ in range(3))
+            totals.append(total)
+            if total < floor * 1.1:
+                continue
+            vals.append(data_mib / ((total - floor) / (L - 1)))
+        if vals:
+            return statistics.median(vals[-3:])
+        # every round was filtered: the kernel is fast relative to
+        # dispatch (floor-dominated). Report the conservative
+        # no-floor-subtraction number from the best round instead of
+        # failing the bench.
+        return data_mib / (min(totals) / L)
+
     import statistics
 
     L = 16
     headline = (k, m, nblocks_per_part) == (K, M, NBLOCKS_PER_PART)
+    headline_val = None
     for i, (cfg, tag) in enumerate(ladder):
         call = functools.partial(fused, **cfg) if cfg else fused
         loop = make_loop(call)
-        if headline:
-            # only the HEADLINE run owns the shipped tag/config — the
-            # wide (32,8) row runs its own ladder afterwards and must
-            # not clobber what the artifact attributes to other rows
-            KERNEL_CONFIG_USED = tag
-            KERNEL_CFG = cfg or {}
         try:
             timed(1)  # compile L=1
-            break
         except Exception as e:  # noqa: BLE001 — Mosaic fails fast
-            if i == len(ladder) - 1:
+            if headline_val is None and i == len(ladder) - 1:
                 raise  # no alternate config left — real error
             import sys
 
+            if headline:
+                KERNEL_LADDER[tag] = f"compile failed: {str(e)[:80]}"
             print(
                 f"kernel config {tag} failed to compile "
                 f"({str(e)[:160]}); trying the next",
                 file=sys.stderr,
             )
-    timed(L)  # compile L=16
-    vals, totals = [], []
-    # several measurement rounds: the first reads low until clocks and
-    # the axon tunnel warm up. Rounds where the L-iter run does not
-    # clearly exceed its own dispatch floor are tunnel jitter and are
-    # discarded; the result is the true median of the last surviving
-    # rounds (robust to both the slow warm-up round and noise).
-    for _ in range(5):
-        floor = min(timed(1) for _ in range(3))
-        total = min(timed(L) for _ in range(3))
-        totals.append(total)
-        if total < floor * 1.1:
             continue
-        vals.append(data_mib / ((total - floor) / (L - 1)))
-    if vals:
-        return statistics.median(vals[-3:])
-    # every round was filtered: the kernel is fast relative to dispatch
-    # (floor-dominated). Report the conservative no-floor-subtraction
-    # number from the best round instead of failing the bench.
-    return data_mib / (min(totals) / L)
+        try:
+            val = measure()
+        except Exception as e:  # noqa: BLE001 — runtime (not compile) failure
+            # compiled at L=1 but died measuring (runtime VMEM class of
+            # failure): once a headline value exists, record the loss in
+            # the ladder instead of discarding the whole TPU row; with
+            # no value yet, keep walking down the ladder as before
+            if headline:
+                KERNEL_LADDER[tag] = f"measure failed: {str(e)[:80]}"
+            if headline_val is not None:
+                continue
+            if i == len(ladder) - 1:
+                raise
+            import sys
+
+            print(
+                f"kernel config {tag} failed while measuring "
+                f"({str(e)[:160]}); trying the next",
+                file=sys.stderr,
+            )
+            continue
+        if headline_val is None:
+            # the headline number stays the FIRST compiling config's
+            # (the staged-ladder contract since r04); the wide (32,8)
+            # row reuses this function and must not clobber the tag
+            headline_val = val
+            if headline:
+                KERNEL_CONFIG_USED = tag
+                KERNEL_CFG = cfg or {}
+        if not headline:
+            return headline_val
+        # headline shape: measure EVERY config that compiles, so one
+        # silicon run arbitrates the staged roofline ladder
+        # (ROOFLINE.md #1-3) instead of only blessing the first winner
+        KERNEL_LADDER[tag] = round(val, 1)
+    return headline_val
 
 
 def cpu_baseline_throughput() -> float:
@@ -356,6 +393,11 @@ def cluster_throughput() -> dict:
                 ):
                     if extra in r:
                         out[f"cluster_{key}_{extra}"] = r[extra]
+                if "write_phases_ms" in r:
+                    # per-phase (encode/stage/send/commit) busy-time
+                    # over the row's write reps — the instrument the
+                    # 4-round ec(8,4) miss has been waiting for
+                    out[f"cluster_{key}_write_phases"] = r["write_phases_ms"]
             elif "ops_per_s" in r:
                 out[f"cluster_{key}_MBps"] = r["MBps"]
                 out[f"cluster_{key}_ops_per_s"] = r["ops_per_s"]
@@ -376,6 +418,7 @@ def cluster_throughput() -> dict:
 
 KERNEL_CONFIG_USED = ""  # set by tpu_throughput; shipped via the queue
 KERNEL_CFG: dict = {}  # the winning staged config; other rows reuse it
+KERNEL_LADDER: dict = {}  # tag -> MiB/s (or compile error) per config
 
 
 def _tpu_worker(q):
@@ -384,7 +427,13 @@ def _tpu_worker(q):
         # the optional rows can't discard it
         q.put(("ok", tpu_throughput()))
         q.put(("cfg", KERNEL_CONFIG_USED))
+        q.put(("ladder", KERNEL_LADDER))
     except Exception as e:  # noqa: BLE001
+        if KERNEL_LADDER:
+            # per-config diagnostics survive even when the whole row
+            # errors (the all-configs-fail case is exactly when the
+            # ladder's compile/measure failure strings matter most)
+            q.put(("ladder", KERNEL_LADDER))
         q.put(("err", str(e)[:200]))
         return
     for key, fn in (
@@ -527,6 +576,11 @@ def main():
         # which kernel residency actually compiled (ROOFLINE #1): a
         # fallback here means the big-tile config overran real VMEM
         row["kernel_config"] = tpu_rows["cfg"]
+    if tpu_rows.get("ladder"):
+        # per-config throughput of the staged roofline ladder
+        # (ROOFLINE.md #1-3): a silicon run arbitrates the configs in
+        # one artifact instead of only blessing the first that compiles
+        row["kernel_ladder"] = tpu_rows["ladder"]
     if "wide" in tpu_rows:
         row["ec32_8_single_chip_MiBps"] = round(tpu_rows["wide"], 1)
     # BASELINE config 4: reconstruct-1-shard latency. CPU row always
@@ -554,7 +608,74 @@ def main():
     except Exception as e:  # noqa: BLE001 — fiducials must not kill the line
         row["box_health_error"] = str(e)[:120]
     row.update(cluster_throughput())
+    # full row set first (humans, driver logs), then the durable copy on
+    # disk, then the COMPACT summary as the very last stdout line: the
+    # driver records only a ~2000-byte stdout tail, and r05's artifact
+    # landed parsed:null because the single fat line was cut mid-JSON.
+    # Whatever happens above, the last complete line must be valid JSON
+    # that carries the verdict-bearing fields.
     print(json.dumps(row))
+    summary = _summary_row(row)
+    try:
+        import os
+
+        full_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json"
+        )
+        with open(full_path, "w") as f:
+            json.dump(row, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        summary["full_write_error"] = str(e)[:120]
+    print(json.dumps(summary))
+
+
+def _summary_row(row: dict) -> dict:
+    """The tail-surviving one-liner: kernel row + config tag, box
+    fiducials, every tracked target verdict, and the ec write phase
+    rows — everything needed to judge the round from the tail alone,
+    budgeted to stay well under the driver's ~2000-byte stdout tail.
+    Full detail (per-rep arrays, spreads, attempts log) lives in
+    BENCH_FULL.json."""
+    s = {"summary": 1, "full": "BENCH_FULL.json"}
+    for key in (
+        "metric", "value", "unit", "vs_baseline", "kernel_config",
+        "kernel_ladder", "tpu_error",
+        "reconstruct_1shard_cpu_ms", "reconstruct_1shard_ms",
+        "ec8_2_batch1_cpu_us", "ec8_2_batch1_us",
+        "box_cpus", "box_memcpy_GBps", "box_pyloop_ms",
+        "cluster_error",
+    ):
+        if key in row:
+            s[key] = row[key]
+    targeted = {
+        key[: -len("_target_met")]
+        for key in row
+        if key.endswith("_target_met")
+    }
+    for key, value in row.items():
+        if not key.startswith("cluster_"):
+            continue
+        if key.endswith((
+            "_write_MBps", "_read_MBps", "_target_MBps", "_target_met",
+        )) or key in ("cluster_dbench8_MBps", "cluster_dbench8_ops_per_s"):
+            s[key] = value
+        elif key.endswith("_spread_pct") and any(
+            t.startswith(key[: -len("_spread_pct")]) for t in targeted
+        ):
+            # spreads only for rows carrying a target verdict (noise
+            # context for the verdict); the rest live in the full file
+            s[key] = value
+        elif key.endswith("_write_phases") and (
+            "_ec8_4_" in key or "_ec3_2_" in key
+        ):
+            # the phase instrument the ec(8,4) target miss exists for
+            # (+ ec(3,2) as its cross-check), integer ms to stay lean
+            s[key] = {
+                k: (int(round(v)) if isinstance(v, float) else v)
+                for k, v in value.items()
+            }
+    return s
 
 
 if __name__ == "__main__":

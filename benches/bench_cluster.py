@@ -137,12 +137,15 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 break
             await asyncio.sleep(0.25)
 
+    from lizardfs_tpu.runtime.metrics import phase_delta
+
     try:
         for goal_id, label in GOALS:
             # median of REPS runs per row: single samples have been seen
             # to swing 4x under co-located load (r03 driver capture), and
             # a median with recorded spread separates signal from noise
             wts, rts = [], []
+            phases_before = client.write_phases.snapshot()
             for rep in range(GOAL_REPS):
                 f = await client.create(1, f"bench_{goal_id}_{rep}.bin")
                 await client.setgoal(f.inode, goal_id)
@@ -166,7 +169,7 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             r_reps = [round(size_mb / t, 1) for t in rts]
             w_med, w_spread = _median_spread(w_reps)
             r_med, r_spread = _median_spread(r_reps)
-            rows.append(_attach_targets({
+            row = {
                 "goal": label,
                 "write_MBps": w_med,
                 "read_MBps": r_med,
@@ -176,7 +179,17 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 # spread is uninterpretable without them (r04 lesson)
                 "write_reps_MBps": w_reps,
                 "read_reps_MBps": r_reps,
-            }))
+            }
+            if "ec" in label or "xor" in label:
+                # per-phase busy-time breakdown over this goal's write
+                # reps (client_write phases: encode/stage/send/commit).
+                # Phases overlap in the pipelined path, so their sum can
+                # exceed wall — the gap is the overlap win; a phase that
+                # dominates names where the next MB/s must come from.
+                row["write_phases_ms"] = phase_delta(
+                    client.write_phases.snapshot(), phases_before
+                )
+            rows.append(_attach_targets(row))
         # dbench analog (reference: tests/test_suites/Benchmarks/
         # test_dbench_throughput.sh — 12 concurrent procs of mixed
         # create/write/read/stat/unlink): N concurrent CLIENT SESSIONS

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os as _os
 import time as _time
 
 import numpy as np
@@ -34,6 +35,7 @@ from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
+from lizardfs_tpu.runtime.metrics import PhaseBreakdown
 from lizardfs_tpu.runtime.rpc import RpcConnection
 from lizardfs_tpu.utils import striping
 
@@ -148,8 +150,36 @@ class Client:
         # TTL-bounded as the backstop.
         self._locate_cache: dict[tuple[int, int], tuple[object, float]] = {}
         self._locate_epoch: dict[int, int] = {}
+        # bumped whenever _locate_epoch is bulk-cleared: folded into the
+        # per-inode epoch token so a clear can never reset an inode to a
+        # previously-seen epoch value (which would let an in-flight
+        # locate that raced the clear cache a pre-mutation reply)
+        self._locate_gen = 0
         self.locate_cache_ttl = 3.0
         self.cache.add_invalidate_listener(self._drop_locates)
+        # per-phase busy-time accounting for the write data path
+        # (encode/stage/send/commit); pipelined phases overlap, so the
+        # phase sum may exceed wall time — see runtime.metrics
+        self.write_phases = PhaseBreakdown(
+            "client_write", ("encode", "stage", "send", "commit")
+        )
+        # double-buffered stripe pipeline for striped (xor/ec) chunk
+        # writes: encode stripe segment i+1 while segment i's parts are
+        # in flight. LZ_WRITE_PIPELINE=0 is the kill switch (strictly
+        # serial stage->encode->send ordering, the byte-identity golden
+        # reference); LZ_WRITE_PIPELINE_SEGMENTS tunes pipeline depth.
+        self.write_pipeline = _os.environ.get(
+            "LZ_WRITE_PIPELINE", "1"
+        ).lower() not in ("0", "off", "false", "no")
+        try:
+            self.write_pipeline_segments = max(
+                2, int(_os.environ.get("LZ_WRITE_PIPELINE_SEGMENTS", "4"))
+            )
+        except ValueError:
+            self.write_pipeline_segments = 4
+        # below this chunk payload size the per-segment handshake
+        # overhead outweighs the overlap win — serial path handles it
+        self.WRITE_PIPELINE_MIN_BYTES = 8 * 1024 * 1024
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -342,7 +372,18 @@ class Client:
             del self._locate_cache[key]
         self._locate_epoch[inode] = self._locate_epoch.get(inode, 0) + 1
         if len(self._locate_epoch) > 65536:
+            # bulk-evict the bound, but never reset an inode to a
+            # previously-seen epoch: the generation makes every
+            # pre-clear token stale forever (ADVICE r05)
             self._locate_epoch.clear()
+            self._locate_gen += 1
+
+    def _locate_token(self, inode: int) -> tuple[int, int]:
+        """Epoch token captured before a locate RPC and compared after:
+        unequal means an invalidation (or a table clear) raced the RPC
+        and the reply must not be cached. Folding the clear generation
+        in keeps tokens unique across `_locate_epoch.clear()`."""
+        return (self._locate_gen, self._locate_epoch.get(inode, 0))
 
     async def _limits_probe_loop(self) -> None:
         """Periodic probe so io_limits_active tracks runtime config
@@ -770,6 +811,7 @@ class Client:
         the reference's extend-on-write semantics)."""
         data = np.frombuffer(bytes(data), dtype=np.uint8)
         total = len(data)
+        wall_t0 = _time.perf_counter()
         old_length = (await self.getattr(inode)).length
         # a small in-flight window pipelines chunk N+1's grant + transfer
         # behind chunk N's tail (write_cache_window analog); chunks are
@@ -804,6 +846,7 @@ class Client:
             await asyncio.gather(*tasks, return_exceptions=True)
         if old_length > total:
             await self.truncate(inode, total)
+        self.write_phases.add_wall(_time.perf_counter() - wall_t0)
 
     async def pwrite(self, inode: int, offset: int, data: bytes | np.ndarray) -> None:
         """Positional write at an arbitrary offset (POSIX pwrite).
@@ -816,6 +859,7 @@ class Client:
         data = np.frombuffer(bytes(data), dtype=np.uint8)
         if len(data) == 0:
             return
+        wall_t0 = _time.perf_counter()
         old_length = (await self.getattr(inode)).length
         end = offset + len(data)
         pos = offset
@@ -828,6 +872,10 @@ class Client:
                 old_length, max(old_length, end),
             )
             pos += take
+        # the RMW path charges encode/send phases above — close the rep
+        # so phase sums stay attributable against wall time for
+        # pwrite-heavy workloads too
+        self.write_phases.add_wall(_time.perf_counter() - wall_t0)
 
     async def _pwrite_chunk(
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
@@ -962,9 +1010,11 @@ class Client:
         region[coff - region_start : coff - region_start + len(piece)] = piece
 
         # recompute the affected stripes' parity and rewrite all parts
+        t0 = _time.perf_counter()
         parts = await asyncio.to_thread(
             striping.split_chunk, region, slice_type, self.encoder
         )
+        self.write_phases.add("encode", _time.perf_counter() - t0)
         sends = []
         for part_idx, locs in copies.items():
             stream = parts.get(part_idx)
@@ -978,21 +1028,26 @@ class Client:
                     part_offset=lo_s * MFSBLOCKSIZE,
                 )
             )
+        t0 = _time.perf_counter()
         await asyncio.gather(*sends)
+        self.write_phases.add("send", _time.perf_counter() - t0)
 
     async def _write_chunk(
         self, inode: int, chunk_index: int, chunk_data: np.ndarray, file_length: int
     ) -> None:
+        t0 = _time.perf_counter()
         grant = await self._call(
             m.CltomaWriteChunk, inode=inode, chunk_index=chunk_index,
             **self._ident(None, None),
         )
+        self.write_phases.add("commit", _time.perf_counter() - t0)
         self.cache.invalidate(inode, chunk_index)
         status_code = st.EIO
         try:
             await self._push_chunk_parts(grant, chunk_data)
             status_code = st.OK
         finally:
+            t0 = _time.perf_counter()
             await self._call(
                 m.CltomaWriteChunkEnd,
                 chunk_id=grant.chunk_id,
@@ -1001,6 +1056,7 @@ class Client:
                 file_length=file_length,
                 status=status_code,
             )
+            self.write_phases.add("commit", _time.perf_counter() - t0)
             # see _write_chunk's twin: locates cached mid-write carry
             # pre-write length/identity and must not outlive the write
             self._drop_locates(inode)
@@ -1033,49 +1089,68 @@ class Client:
                 payload, length, skip_throttle=skip_throttle, cell=cell,
             )
 
-        async def send_batch(items: list[tuple[int, np.ndarray]]) -> None:
+        async def send_batch(
+            items: list[tuple[int, np.ndarray]], skip_throttle: bool = False
+        ) -> None:
             """Write several whole parts: ONE native poll-driven call
             when every part has a single holder (no relay chain),
-            per-part sends otherwise or on native failure."""
+            per-part sends otherwise or on native failure.
+            ``skip_throttle``: the caller already charged these bytes
+            (QoS rule: charge once, not per retry/fallback)."""
             from lizardfs_tpu.core import native_io
 
             items = [(p, pay) for p, pay in items if p in by_part]
             if not items:
                 return
-            if (
-                native_io.parts_scatter_available()
-                and len(items) > 1
-                and all(len(by_part[p]) == 1 for p, _ in items)
-            ):
-                lengths = [
-                    striping.part_length(slice_type, p, len(chunk_data))
-                    for p, _ in items
-                ]
+            lengths = [
+                striping.part_length(slice_type, p, len(chunk_data))
+                for p, _ in items
+            ]
+            if not skip_throttle:
+                # charged BEFORE the send timer starts: QoS queueing
+                # (token-bucket waits, the limit-renew RPC) must not be
+                # booked as send_ms, or a throttled client's phase row
+                # misattributes pacing as chunkserver transfer time
                 await self._throttle(sum(lengths))
-                cell: dict = {"submitted": True}
-                send_cells.append(cell)
-                try:
-                    await native_io.run(
-                        native_io.write_parts_scatter_blocking,
-                        [(by_part[p][0].addr.host, by_part[p][0].addr.port)
-                         for p, _ in items],
-                        grant.chunk_id, grant.version,
-                        [by_part[p][0].part_id for p, _ in items],
-                        [pay for _, pay in items], lengths, 0, cell,
-                    )
-                    self._record("parts_scatter_write")
-                    return
-                except (native_io.NativeIOError, OSError,
-                        ConnectionError, st.StatusError):
-                    self._record("parts_scatter_fallback")
-                    # fall through per-part — bytes were already
-                    # charged to the throttle above, don't pay twice
-                    await asyncio.gather(*(
-                        send_of(p, pay, skip_throttle=True)
-                        for p, pay in items
-                    ))
-                    return
-            await asyncio.gather(*(send_of(p, pay) for p, pay in items))
+            t0 = _time.perf_counter()
+            try:
+                if (
+                    native_io.parts_scatter_available()
+                    and len(items) > 1
+                    and all(len(by_part[p]) == 1 for p, _ in items)
+                ):
+                    cell: dict = {"submitted": True}
+                    send_cells.append(cell)
+                    try:
+                        await native_io.run(
+                            native_io.write_parts_scatter_blocking,
+                            [(by_part[p][0].addr.host,
+                              by_part[p][0].addr.port)
+                             for p, _ in items],
+                            grant.chunk_id, grant.version,
+                            [by_part[p][0].part_id for p, _ in items],
+                            [pay for _, pay in items], lengths, 0, cell,
+                        )
+                        self._record("parts_scatter_write")
+                        return
+                    except (native_io.NativeIOError, OSError,
+                            ConnectionError, st.StatusError):
+                        self._record("parts_scatter_fallback")
+                        # fall through per-part — bytes were already
+                        # charged to the throttle above, don't pay twice
+                        await asyncio.gather(*(
+                            send_of(p, pay, skip_throttle=True)
+                            for p, pay in items
+                        ))
+                        return
+                # bytes already charged above — per-part sends must not
+                # pay again (and their throttle would pollute the timer)
+                await asyncio.gather(*(
+                    send_of(p, pay, skip_throttle=True)
+                    for p, pay in items
+                ))
+            finally:
+                self.write_phases.add("send", _time.perf_counter() - t0)
 
         from lizardfs_tpu.core import native_io
 
@@ -1108,46 +1183,100 @@ class Client:
                 await asyncio.gather(*copy_tasks, return_exceptions=True)
                 _abort_zombie_sends()
             return
-        # striped slices: scatter first (cheap memcpy), then stream the
-        # DATA parts while the parity encode (the expensive phase,
-        # ~40% of a serial chunk write) runs concurrently off-loop —
-        # chunk_writer.cc computes parity inline per stripe; here the
-        # whole-chunk encode overlaps the data transfer instead
+        # striped slices: scatter into contiguous part streams first
+        # (one memcpy, the `stage` phase), then hand off to one of:
+        #   * the segmented stripe pipeline (default, preconditions
+        #     permitting): encode segment i+1 while segment i's data AND
+        #     parity are in flight — parity lands straight in the send
+        #     buffer, no second staging copy;
+        #   * the overlapped whole-chunk path (pipeline on, but chains/
+        #     missing parts/no native scatter): whole-chunk encode
+        #     overlaps the data-part transfer (chunk_writer.cc computes
+        #     parity inline per stripe; this is its coarse analog);
+        #   * the strictly serial path (LZ_WRITE_PIPELINE=0 kill
+        #     switch): stage -> encode -> send(data) -> send(parity),
+        #     the byte-identity golden reference whose phase totals sum
+        #     to ~the rep wall time.
         d = slice_type.data_parts
         nblocks = -(-len(chunk_data) // MFSBLOCKSIZE)
         part_len = -(-nblocks // d) * MFSBLOCKSIZE
         stage = self._stage_acquire(d, part_len)
+        t0 = _time.perf_counter()
         stacked, _ = await asyncio.to_thread(
             striping.padded_data_parts, chunk_data, d, stage
         )
+        self.write_phases.add("stage", _time.perf_counter() - t0)
         first = 1 if slice_type.is_xor else 0
         full_chunk = len(chunk_data) == MFSCHUNKSIZE
 
         async def parity_parts() -> dict[int, np.ndarray]:
-            if slice_type.is_xor:
-                par = await asyncio.to_thread(self.encoder.xor_parity, stacked)
-                return {0: par}
-            par = await asyncio.to_thread(
-                self.encoder.encode, d, slice_type.parity_parts, list(stacked)
-            )
-            return {d + j: p for j, p in enumerate(par)}
+            t0 = _time.perf_counter()
+            try:
+                if slice_type.is_xor:
+                    par = await asyncio.to_thread(
+                        self.encoder.xor_parity, stacked
+                    )
+                    return {0: par}
+                par = await asyncio.to_thread(
+                    self.encoder.encode, d, slice_type.parity_parts,
+                    list(stacked),
+                )
+                return {d + j: p for j, p in enumerate(par)}
+            finally:
+                self.write_phases.add("encode", _time.perf_counter() - t0)
 
-        par_task = asyncio.ensure_future(parity_parts())
-        tasks = [asyncio.ensure_future(
-            send_batch([(first + i, stacked[i]) for i in range(d)])
-        )]
         try:
-            par = await par_task
-            tasks.append(asyncio.ensure_future(
-                send_batch(sorted(par.items()))
-            ))
-            for t in tasks:
-                await t
+            throttled = False
+            if self.write_pipeline and self._pipeline_eligible(
+                slice_type, by_part, chunk_data, part_len
+            ):
+                # charge the QoS budget up front (one acquire for the
+                # chunk); a fallback below must then not charge again
+                await self._throttle(sum(
+                    striping.part_length(slice_type, p, len(chunk_data))
+                    for p in by_part
+                ))
+                throttled = True
+                try:
+                    await self._push_striped_pipelined(
+                        grant, chunk_data, slice_type, by_part, stacked,
+                        part_len, full_chunk, send_cells,
+                    )
+                    self._record("write_pipeline")
+                    return
+                except (native_io.NativeIOError, OSError, ConnectionError,
+                        st.StatusError):
+                    # torn segments are healed by the full-part rewrite
+                    # the paths below perform
+                    self._record("write_pipeline_fallback")
+            if not self.write_pipeline:
+                par = await parity_parts()
+                await send_batch(
+                    [(first + i, stacked[i]) for i in range(d)],
+                    skip_throttle=throttled,
+                )
+                await send_batch(sorted(par.items()), skip_throttle=throttled)
+                return
+            par_task = asyncio.ensure_future(parity_parts())
+            tasks = [asyncio.ensure_future(
+                send_batch(
+                    [(first + i, stacked[i]) for i in range(d)],
+                    skip_throttle=throttled,
+                )
+            )]
+            try:
+                par = await par_task
+                tasks.append(asyncio.ensure_future(
+                    send_batch(sorted(par.items()), skip_throttle=throttled)
+                ))
+                for t in tasks:
+                    await t
+            finally:
+                par_task.cancel()
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(par_task, *tasks, return_exceptions=True)
         finally:
-            par_task.cancel()
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(par_task, *tasks, return_exceptions=True)
             # the coroutines are done, but a cancelled native send's
             # executor thread may still be streaming from the staging
             # buffer: kill it now, and never pool a buffer a zombie
@@ -1178,6 +1307,147 @@ class Client:
         bucket = self._stage_buffers.setdefault(buf.shape, [])
         if len(bucket) < 2:
             bucket.append(buf)
+
+    def _parity_acquire(self, m: int, part_len: int) -> np.ndarray:
+        """Parity send buffer for the pipelined path ((m, part_len),
+        pooled with the stage buffers): the encoder writes parity
+        straight into it and the native scatter streams from it — the
+        per-chunk parity staging copy is gone."""
+        bucket = self._stage_buffers.get((m, part_len))
+        if bucket:
+            return bucket.pop()
+        return np.empty((m, part_len), dtype=np.uint8)
+
+    def _pipeline_eligible(
+        self, slice_type, by_part, chunk_data, part_len: int
+    ) -> bool:
+        """Segmented stripe pipeline preconditions: native scatter
+        built, every expected part granted with exactly one holder (no
+        relay chains — the session sends chain-less frames), and a
+        payload big enough that per-segment overlap beats the extra
+        segment barriers. Anything else takes the fallback paths."""
+        from lizardfs_tpu.core import native_io
+
+        if not native_io.parts_scatter_available():
+            return False
+        if len(chunk_data) < self.WRITE_PIPELINE_MIN_BYTES:
+            return False
+        if part_len < 2 * MFSBLOCKSIZE:
+            return False  # a single slot per part: nothing to overlap
+        return all(
+            p in by_part and len(by_part[p]) == 1
+            for p in range(slice_type.expected_parts)
+        )
+
+    async def _push_striped_pipelined(
+        self, grant, chunk_data, slice_type, by_part, stacked,
+        part_len: int, full_chunk: bool, send_cells: list[dict],
+    ) -> None:
+        """Double-buffered stripe pipeline: ONE WriteInit/End handshake
+        pair per part for the whole chunk, the part streams cut into
+        slot-aligned segments, and segment i+1's parity encoding (into
+        the send buffer, via the ChunkEncoder boundary) overlapping
+        segment i's data+parity transfer.
+
+        Byte-identical to the serial path by construction: RS/xor
+        parity is columnwise (parity[j][x] depends only on column x of
+        the data parts), so a per-segment encode equals the matching
+        slice of a whole-part encode; segment boundaries stay 64 KiB
+        aligned, so the chunkservers see the same per-block pieces and
+        store the same CRCs. Raises on any failure — the caller falls
+        back to the serial path, whose full-part rewrite heals torn
+        segments. The caller has already charged the QoS throttle."""
+        from lizardfs_tpu.core import native_io
+
+        d = slice_type.data_parts
+        first = 1 if slice_type.is_xor else 0
+        m_par = 1 if slice_type.is_xor else slice_type.parity_parts
+        data_idx = [first + i for i in range(d)]
+        par_idx = [0] if slice_type.is_xor else [d + j for j in range(m_par)]
+        order = data_idx + par_idx
+        plens = {
+            p: striping.part_length(slice_type, p, len(chunk_data))
+            for p in order
+        }
+        par_buf = self._parity_acquire(m_par, part_len)
+        cell: dict = {}
+        send_cells.append(cell)
+        session = native_io.PartsScatterSession(
+            [(by_part[p][0].addr.host, by_part[p][0].addr.port)
+             for p in order],
+            grant.chunk_id, grant.version,
+            [by_part[p][0].part_id for p in order],
+            cell,
+        )
+        blocks_per_part = part_len // MFSBLOCKSIZE
+        nseg = min(self.write_pipeline_segments, blocks_per_part)
+        seg_blocks = -(-blocks_per_part // nseg)
+        bounds = [
+            (a * MFSBLOCKSIZE,
+             min(a + seg_blocks, blocks_per_part) * MFSBLOCKSIZE)
+            for a in range(0, blocks_per_part, seg_blocks)
+        ]
+
+        def encode_segment(a: int, b: int) -> None:
+            data_seg = [stacked[i][a:b] for i in range(d)]
+            if slice_type.is_xor:
+                self.encoder.xor_parity_into(data_seg, par_buf[0][a:b])
+            else:
+                self.encoder.encode_into(
+                    d, m_par, data_seg,
+                    [par_buf[j][a:b] for j in range(m_par)],
+                )
+
+        async def send_segment(a: int, b: int, wid: int, after) -> None:
+            # chained on the previous segment's task: the session's
+            # sockets carry one exchange at a time, and a predecessor's
+            # failure propagates down the chain
+            if after is not None:
+                await after
+            payloads = (
+                [stacked[i][a:b] for i in range(d)]
+                + [par_buf[j][a:b] for j in range(m_par)]
+            )
+            lengths = [max(min(b, plens[p]) - a, 0) for p in order]
+            t0 = _time.perf_counter()
+            await native_io.run(
+                session.send_segment, payloads, lengths, a, wid
+            )
+            self.write_phases.add("send", _time.perf_counter() - t0)
+
+        send_tasks: list[asyncio.Task] = []
+        try:
+            t0 = _time.perf_counter()
+            await native_io.run(session.open)
+            self.write_phases.add("send", _time.perf_counter() - t0)
+            for wid, (a, b) in enumerate(bounds, start=1):
+                t0 = _time.perf_counter()
+                await asyncio.to_thread(encode_segment, a, b)
+                self.write_phases.add("encode", _time.perf_counter() - t0)
+                send_tasks.append(asyncio.ensure_future(send_segment(
+                    a, b, wid, send_tasks[-1] if send_tasks else None
+                )))
+            await send_tasks[-1]
+            t0 = _time.perf_counter()
+            await native_io.run(session.finish)
+            self.write_phases.add("send", _time.perf_counter() - t0)
+        except BaseException:
+            for t in send_tasks:
+                t.cancel()
+            await asyncio.gather(*send_tasks, return_exceptions=True)
+            # the session's executor thread may still be streaming from
+            # stacked/par_buf — kill the exchange before those buffers
+            # can be released (the caller's zombie-abort also covers
+            # this cell, but do it promptly here)
+            native_io.abort_write(cell)
+            raise
+        finally:
+            self._stage_release(
+                par_buf,
+                poolable=full_chunk and not (
+                    cell.get("submitted") and not cell.get("finished")
+                ),
+            )
 
     async def _write_part(
         self,
@@ -1429,10 +1699,12 @@ class Client:
             else adviser.advise(chunk_index * MFSCHUNKSIZE + off, size)
         )
         aligned_off = lo_b * MFSBLOCKSIZE
-        aligned_end = min(
-            -(-(off + size + extra) // MFSBLOCKSIZE) * MFSBLOCKSIZE, chunk_len
-        )
+        # the unclamped end the caller asked for: re-clamps against a
+        # fresher file_length (growth during retries) start from here
+        aligned_target = -(-(off + size + extra) // MFSBLOCKSIZE) * MFSBLOCKSIZE
+        aligned_end = min(aligned_target, chunk_len)
         read_size = aligned_end - aligned_off
+        req_size = size
 
         throttled = file_length is not None
         if throttled:
@@ -1443,6 +1715,7 @@ class Client:
             if attempt:
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
             loc = None
+            fresh = False
             if attempt == 0:
                 cached = self._locate_cache.get((inode, chunk_index))
                 if (cached is not None and _time.monotonic() - cached[1]
@@ -1452,14 +1725,17 @@ class Client:
                         self.op_counters.get("locate_cache_hit", 0) + 1
                     )
             if loc is None:
-                epoch = self._locate_epoch.get(inode, 0)
+                token = self._locate_token(inode)
                 loc = await self._call(
                     m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
                     **self._ident(None, None),
                 )
-                if self._locate_epoch.get(inode, 0) == epoch:
+                fresh = True
+                if self._locate_token(inode) == token:
                     # refuse stores that raced an invalidation: the
                     # reply may predate the mutation that bumped epoch
+                    # (the token folds in the clear generation, so a
+                    # bulk clear can never alias an old epoch value)
                     self._locate_cache[(inode, chunk_index)] = (
                         loc, _time.monotonic()
                     )
@@ -1470,18 +1746,25 @@ class Client:
             # regrow swaps the chunk_id — either way stale blocks drop
             chunk_tag = (loc.chunk_id, loc.version)
             self.cache.note_version(inode, chunk_index, chunk_tag)
-            if file_length is None:
+            if file_length is None or (
+                fresh and loc.file_length > file_length
+            ):
                 # clamp the provisional geometry with the length the
-                # locate just taught us
+                # locate just taught us — and RE-clamp on every fresh
+                # (non-cached) reply that reports growth: a read racing
+                # an append must not return short against the stale
+                # length a first (possibly cached) locate pinned
+                # (ADVICE r05). Growth after the throttle charge leaves
+                # a few bytes unbilled — QoS charges once, not per retry.
                 file_length = loc.file_length
                 chunk_len = min(
                     max(file_length - chunk_index * MFSCHUNKSIZE, 0),
                     MFSCHUNKSIZE,
                 )
-                size = min(size, max(chunk_len - off, 0))
+                size = min(req_size, max(chunk_len - off, 0))
                 if size <= 0:
                     return np.zeros(0, dtype=np.uint8)  # past EOF
-                aligned_end = min(aligned_end, chunk_len)
+                aligned_end = min(aligned_target, chunk_len)
                 read_size = aligned_end - aligned_off
             if not throttled:
                 # deferred until the locate-taught clamp: charging the

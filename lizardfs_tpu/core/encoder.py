@@ -73,6 +73,33 @@ class ChunkEncoder(abc.ABC):
         """XOR parity (xor2..xor9 goals)."""
         return rs.xor_parity(parts)
 
+    def encode_into(
+        self,
+        k: int,
+        m: int,
+        data_parts: list[np.ndarray],
+        out: list[np.ndarray],
+    ) -> None:
+        """``encode`` writing the m parity streams into caller buffers.
+
+        ``out`` holds m contiguous uint8 arrays (typically row slices of
+        one send buffer) each the length of a data part. Backends that
+        can emit parity in place override this to skip the staging copy
+        (the client's pipelined write path sends straight from ``out``);
+        this default stays correct everywhere else.
+        """
+        parity = self.encode(k, m, data_parts)
+        for dst, src in zip(out, parity):
+            np.copyto(dst, src)
+
+    def xor_parity_into(
+        self, parts: list[np.ndarray], out: np.ndarray
+    ) -> None:
+        """``xor_parity`` writing into a caller buffer (see encode_into)."""
+        np.copyto(out, parts[0])
+        for p in parts[1:]:
+            np.bitwise_xor(out, p, out=out)
+
 
 class CpuChunkEncoder(ChunkEncoder):
     """Golden numpy backend (reference-identical bytes)."""

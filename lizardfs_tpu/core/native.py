@@ -102,14 +102,26 @@ ENCODE_THREADS = max(1, min(4, (os.cpu_count() or 2) // 2))
 
 
 def apply_matrix(
-    matrix: np.ndarray, parts: list[np.ndarray], threads: int | None = None
+    matrix: np.ndarray, parts: list[np.ndarray], threads: int | None = None,
+    out: list[np.ndarray] | None = None,
 ) -> list[np.ndarray]:
-    """out[i] = XOR_j matrix[i,j] * parts[j] via the SIMD kernel."""
+    """out[i] = XOR_j matrix[i,j] * parts[j] via the SIMD kernel.
+
+    ``out``: optional caller-owned destination rows (each contiguous
+    uint8 of the part size) — the kernel writes parity in place, so hot
+    paths can encode straight into a send buffer."""
     assert _lib is not None
     rows, k = matrix.shape
     assert k == len(parts)
     size = parts[0].shape[0] if parts else 0
-    out = [np.empty(size, dtype=np.uint8) for _ in range(rows)]
+    if out is None:
+        out = [np.empty(size, dtype=np.uint8) for _ in range(rows)]
+    else:
+        assert len(out) == rows and all(
+            o.flags.c_contiguous and o.dtype == np.uint8
+            and o.shape[0] == size
+            for o in out
+        )
     if size == 0 or rows == 0:
         return out
     mat = np.ascontiguousarray(matrix, dtype=np.uint8)
@@ -223,6 +235,13 @@ class CppChunkEncoder(ChunkEncoder):
         mat = gf256.reduce_columns(mat, nonzero)
         parts = [np.asarray(data_parts[i], dtype=np.uint8) for i in nonzero]
         return apply_matrix(mat, parts)
+
+    def encode_into(self, k, m, data_parts, out):
+        if len(data_parts) != k:
+            raise ValueError(f"expected {k} data parts, got {len(data_parts)}")
+        mat = gf256.encoding_matrix(k, m)
+        parts = [np.asarray(p, dtype=np.uint8) for p in data_parts]
+        apply_matrix(mat, parts, out=list(out))
 
     def recover(self, k, m, parts, wanted):
         used, mat = gf256.recovery_selection(k, m, list(parts.keys()), wanted)

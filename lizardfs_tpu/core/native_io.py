@@ -607,6 +607,196 @@ def parts_scatter_available() -> bool:
     return _lib is not None and hasattr(_lib, "lz_write_parts_scatter")
 
 
+# shared building blocks of the two scatter-write paths (the one-shot
+# write_parts_scatter_blocking and the multi-segment PartsScatterSession):
+# a protocol change lands in exactly one place
+
+
+def _send_write_init(sock: socket.socket, chunk_id: int, version: int,
+                     part_id: int) -> None:
+    sock.sendall(framing.encode(m.CltocsWriteInit(
+        req_id=1, chunk_id=chunk_id, version=version,
+        part_id=part_id, chain=[], create=False,
+    )))
+
+
+def _recv_write_init_acks(socks: list[socket.socket]) -> None:
+    """Collect one WriteInit ack per socket (inits were sent for ALL
+    sockets first — serialized request/response would pay n round
+    trips instead of ~1); raises NativeIOError on a refusal."""
+    for s in socks:
+        init = _recv_message(s)
+        if not isinstance(init, m.CstoclWriteStatus) or init.status != st.OK:
+            raise NativeIOError(getattr(init, "status", -2), "write init")
+
+
+def _marshal_part_reqs(
+    fds: list[int], chunk_id: int, write_id: int, part_ids: list[int],
+    payloads: list[np.ndarray], lengths: list[int],
+):
+    """-> (reqs, ptrs, lens) ctypes arrays for lz_write_parts_scatter.
+    The req's ``version`` slot carries the bulk frame's write_id."""
+    n = len(fds)
+    reqs = (_PartReq * n)()
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    for i in range(n):
+        buf = payloads[i]
+        assert buf.flags.c_contiguous and buf.nbytes >= lengths[i]
+        reqs[i].fd = fds[i]
+        reqs[i].chunk_id = chunk_id
+        reqs[i].version = write_id
+        reqs[i].part_id = part_ids[i]
+        reqs[i].rc = 0
+        ptrs[i] = buf.ctypes.data_as(ctypes.c_void_p).value
+        lens[i] = lengths[i]
+    return reqs, ptrs, lens
+
+
+def _write_end_handshake(socks: list[socket.socket], chunk_id: int) -> None:
+    for s in socks:
+        s.sendall(framing.encode(
+            m.CltocsWriteEnd(req_id=0, chunk_id=chunk_id)
+        ))
+    for s in socks:
+        end = _recv_message(s)
+        if not isinstance(end, m.CstoclWriteStatus) or end.status != st.OK:
+            raise NativeIOError(getattr(end, "status", -2), "write end")
+
+
+class PartsScatterSession:
+    """Pipelined multi-segment part writes over persistent connections.
+
+    The write-path building block of the client's double-buffered stripe
+    pipeline: ``open()`` dials every part's holder once and runs the
+    WriteInit handshakes; ``send_segment()`` streams one slot-aligned
+    segment of every part (one poll-driven ``lz_write_parts_scatter``
+    call: bulk frame + ack per part, per-block CRCs computed in C);
+    ``finish()`` runs the WriteEnd handshakes. One handshake pair per
+    part per *chunk* instead of per segment — the per-segment cost is
+    only the bulk frames themselves, so encode(i+1) can overlap
+    send(i) without paying n extra round trips per segment.
+
+    Every method is blocking (call via :func:`run`). Any failure leaves
+    the sockets closed and the exchange dead; the caller falls back to
+    the serial write path (a full-part rewrite heals torn segments).
+    ``cell`` follows the abort contract of write_parts_scatter_blocking:
+    abort_write(cell) from another thread kills the exchange,
+    ``cell["finished"]`` marks when no thread reads the payloads anymore.
+    """
+
+    def __init__(
+        self,
+        addrs: list[tuple[str, int]],
+        chunk_id: int,
+        version: int,
+        part_ids: list[int],
+        cell: dict | None = None,
+    ):
+        assert len(addrs) == len(part_ids)
+        self.addrs = addrs
+        self.chunk_id = chunk_id
+        self.version = version
+        self.part_ids = part_ids
+        self.cell = cell if cell is not None else {}
+        self._socks: list[socket.socket] = []
+
+    def open(self) -> None:
+        self.cell["submitted"] = True
+        for attempt in (0, 1):
+            try:
+                for i, addr in enumerate(self.addrs):
+                    # pooled sockets first (the write hot path dials
+                    # d+m connections per chunk — churn that the pool
+                    # exists to absorb); a stale pooled connection
+                    # (server restart) fails the init handshake and
+                    # retries once with fresh dials, mirroring
+                    # _write_parts_scatter
+                    s = (POOL.acquire(addr) if attempt == 0
+                         else _blocking_socket(addr, 60.0))
+                    self._socks.append(s)
+                    _send_write_init(
+                        s, self.chunk_id, self.version, self.part_ids[i]
+                    )
+                self.cell["socks"] = list(self._socks)
+                if self.cell.get("aborted"):
+                    raise NativeIOError(-1, "scatter session (aborted)")
+                _recv_write_init_acks(self._socks)
+                return
+            except (ConnectionError, OSError, st.StatusError):
+                for s in self._socks:
+                    POOL.discard(s)
+                self._socks.clear()
+                self.cell.pop("socks", None)
+                if attempt == 1 or self.cell.get("aborted"):
+                    self.cell["finished"] = True
+                    raise
+            except BaseException:
+                self.close()
+                raise
+
+    def send_segment(
+        self,
+        payloads: list[np.ndarray],
+        lengths: list[int],
+        part_offset: int,
+        write_id: int,
+    ) -> None:
+        """Stream ``payloads[i][:lengths[i]]`` at ``part_offset`` within
+        every live part. A zero length skips that part this segment
+        (tail segments cover fewer parts)."""
+        assert self._socks, "session not open"
+        n = len(self._socks)
+        assert n == len(payloads) == len(lengths)
+        live = [i for i in range(n) if lengths[i] > 0]
+        if not live:
+            return
+        try:
+            if self.cell.get("aborted"):
+                raise NativeIOError(-1, "scatter session (aborted)")
+            reqs, ptrs, lens = _marshal_part_reqs(
+                [self._socks[i].fileno() for i in live],
+                self.chunk_id, write_id,
+                [self.part_ids[i] for i in live],
+                [payloads[i] for i in live],
+                [lengths[i] for i in live],
+            )
+            rc = _lib.lz_write_parts_scatter(
+                ctypes.cast(reqs, ctypes.c_void_p), len(live), ptrs, lens,
+                part_offset, 120_000,
+            )
+            if rc != 0:
+                bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+                raise NativeIOError(bad, "scatter session segment")
+        except BaseException:
+            self.close()
+            raise
+
+    def finish(self) -> None:
+        try:
+            _write_end_handshake(self._socks, self.chunk_id)
+        except BaseException:
+            self.close()
+            raise
+        # clean end: the sockets sit in the same reusable protocol
+        # state the one-shot scatter path pools — release, don't close
+        for addr, s in zip(self.addrs, self._socks):
+            POOL.release(addr, s)
+        self._socks.clear()
+        self.cell.pop("socks", None)
+        self.cell["finished"] = True
+
+    def close(self) -> None:
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+        self.cell.pop("socks", None)
+        self.cell["finished"] = True
+
+
 def write_parts_scatter_blocking(
     addrs: list[tuple[str, int]],
     chunk_id: int,
@@ -646,58 +836,28 @@ def _write_parts_scatter(
 ) -> None:
     n = len(addrs)
     for attempt in (0, 1):
-        reqs = (_PartReq * n)()
-        ptrs = (ctypes.c_void_p * n)()
-        lens = (ctypes.c_uint64 * n)()
         socks: list[tuple[tuple[str, int], socket.socket]] = []
         try:
-            # init handshakes: send ALL requests first, then collect
-            # replies — serialized request/response per socket would
-            # pay n round trips instead of ~1
             for i, addr in enumerate(addrs):
                 s = (POOL.acquire(addr) if attempt == 0
                      else _blocking_socket(addr, 60.0))
                 socks.append((addr, s))
-                s.sendall(framing.encode(m.CltocsWriteInit(
-                    req_id=1, chunk_id=chunk_id, version=version,
-                    part_id=part_ids[i], chain=[], create=False,
-                )))
+                _send_write_init(s, chunk_id, version, part_ids[i])
             if cell is not None:
                 cell["socks"] = [s for _, s in socks]
                 if cell.get("aborted"):
                     raise NativeIOError(-1, "parts scatter (aborted)")
-            for i, (_, s) in enumerate(socks):
-                init = _recv_message(s)
-                if (not isinstance(init, m.CstoclWriteStatus)
-                        or init.status != st.OK):
-                    raise NativeIOError(
-                        getattr(init, "status", -2), "write init"
-                    )
-                buf = payloads[i]
-                assert buf.flags.c_contiguous and buf.nbytes >= lengths[i]
-                reqs[i].fd = s.fileno()
-                reqs[i].chunk_id = chunk_id
-                reqs[i].version = 1  # carries the bulk write_id
-                reqs[i].part_id = part_ids[i]
-                reqs[i].rc = 0
-                ptrs[i] = buf.ctypes.data_as(ctypes.c_void_p).value
-                lens[i] = lengths[i]
+            _recv_write_init_acks([s for _, s in socks])
+            reqs, ptrs, lens = _marshal_part_reqs(
+                [s.fileno() for _, s in socks], chunk_id, 1, part_ids,
+                payloads, lengths,
+            )
             rc = _lib.lz_write_parts_scatter(
                 ctypes.cast(reqs, ctypes.c_void_p), n, ptrs, lens,
                 part_offset, 120_000,
             )
             if rc == 0:
-                for _, s in socks:
-                    s.sendall(framing.encode(
-                        m.CltocsWriteEnd(req_id=0, chunk_id=chunk_id)
-                    ))
-                for _, s in socks:
-                    end = _recv_message(s)
-                    if (not isinstance(end, m.CstoclWriteStatus)
-                            or end.status != st.OK):
-                        raise NativeIOError(
-                            getattr(end, "status", -2), "write end"
-                        )
+                _write_end_handshake([s for _, s in socks], chunk_id)
                 for addr, s in socks:
                     POOL.release(addr, s)
                 socks.clear()

@@ -60,6 +60,24 @@ def _pack_value(ftype: str, value: Any, out: bytearray) -> None:
         raise TypeError(f"unknown field type {ftype!r}")
 
 
+def _default_value(ftype: str) -> Any:
+    """Zero value of a field type — what a peer that predates the field
+    would have meant. Used to default-fill trailing fields missing from
+    a version-skewed sender's encoding (see Message.unpack_body)."""
+    if ftype in _SCALARS:
+        return False if ftype == "bool" else 0
+    if ftype == "bytes":
+        return b""
+    if ftype == "str":
+        return ""
+    if ftype.startswith("list:"):
+        return []
+    if ftype.startswith("msg:"):
+        cls = _MESSAGE_CLASSES[ftype[4:]]
+        return cls(**{n: _default_value(t) for n, t in cls.FIELDS})
+    raise TypeError(f"unknown field type {ftype!r}")
+
+
 def _unpack_value(ftype: str, buf: memoryview, off: int) -> tuple[Any, int]:
     if ftype in _SCALARS:
         fmt = _SCALARS[ftype]
@@ -93,6 +111,17 @@ class Message:
 
     MSG_TYPE: int | None = None
     FIELDS: tuple[tuple[str, str], ...] = ()
+    # opt-in version-skew tolerance: the index of the first OPTIONAL
+    # field — fields from this index on default-fill when the wire ends
+    # before them (an older peer predating the additions); everything
+    # before it stays required. STRICTLY opt-in per message and scoped
+    # to the genuinely-additive suffix: blanket tolerance would fail
+    # OPEN — e.g. a truncated CstoclWriteStatus would decode its
+    # missing ``status`` u8 as 0 == OK and report a write committed
+    # that no server ever acknowledged, and a reply cut before a
+    # verdict-bearing v0 field must still be a parse error, not a
+    # zero. None (default) = every field required.
+    SKEW_TOLERANT_FROM: int | None = None
     # fast path for data-plane messages: when FIELDS is all scalars plus
     # optionally one trailing ``bytes`` field, the scalar prefix packs/
     # unpacks as one struct call (per-64KiB-piece overhead matters)
@@ -150,7 +179,10 @@ class Message:
 
     @classmethod
     def unpack_body(cls, buf: memoryview | bytes, off: int = 0):
-        if cls._FAST is not None:
+        optional_from = cls.SKEW_TOLERANT_FROM
+        if cls._FAST is not None and (
+            optional_from is None or len(buf) - off >= cls._FAST.size
+        ):
             msg = cls.__new__(cls)
             for name, value in zip(
                 cls._FAST_NAMES, cls._FAST.unpack_from(buf, off)
@@ -158,15 +190,36 @@ class Message:
                 setattr(msg, name, value)
             off += cls._FAST.size
             if cls._FAST_TAIL is not None:
-                (n,) = struct.unpack_from(">I", buf, off)
-                off += 4
-                setattr(msg, cls._FAST_TAIL, bytes(buf[off : off + n]))
-                off += n
+                if (
+                    off == len(buf)
+                    and optional_from is not None
+                    and optional_from <= len(cls.FIELDS) - 1
+                ):
+                    # sender predates the tail field: default-fill
+                    setattr(msg, cls._FAST_TAIL, b"")
+                else:
+                    (n,) = struct.unpack_from(">I", buf, off)
+                    off += 4
+                    setattr(msg, cls._FAST_TAIL, bytes(buf[off : off + n]))
+                    off += n
             return msg, off
         buf = memoryview(buf)
         values = {}
-        for name, ftype in cls.FIELDS:
-            values[name], off = _unpack_value(ftype, buf, off)
+        for i, (name, ftype) in enumerate(cls.FIELDS):
+            if (
+                off == len(buf)
+                and optional_from is not None
+                and i >= optional_from
+            ):
+                # version skew: the sender's schema ends here — newer
+                # trailing fields default-fill instead of failing the
+                # whole parse (a rolling upgrade would otherwise break
+                # e.g. CltomaIoLimitRequest on its new `probe` field).
+                # A REQUIRED field missing, or a field CUT MID-VALUE,
+                # still raises: that is truncation/corruption, not skew.
+                values[name] = _default_value(ftype)
+            else:
+                values[name], off = _unpack_value(ftype, buf, off)
         return cls(**values), off
 
     @classmethod

@@ -589,16 +589,26 @@ class CltomaIoLimitRequest(Message):
     the allocation table — connect-time probes must not dilute real
     consumers' shares for a renew period."""
 
+    # ``group``/``probe`` were added after v0 — a version-skewed peer
+    # that omits them means "" / no-probe; ``req_id`` stays required
     MSG_TYPE = 1062
+    SKEW_TOLERANT_FROM = 1
     FIELDS = (("req_id", "u32"), ("group", "str"), ("probe", "u8"))
 
 
 class MatoclIoLimitReply(Message):
     """``subsystem`` tells clients which cgroup hierarchy to classify
     callers with ("" = v2 unified / classification off) — served from
-    master config so mounts need no local limits file."""
+    master config so mounts need no local limits file.
+
+    Only ``subsystem``/``limits_active`` are skew-optional (additive
+    hints an older master omits, meaning "no classification, no limits
+    configured" — exactly their zero values); a reply cut before the
+    verdict-bearing v0 fields (status, bytes_per_sec, renew_ms) is
+    corruption and still fails the parse."""
 
     MSG_TYPE = 1063
+    SKEW_TOLERANT_FROM = 4
     FIELDS = (
         ("req_id", "u32"),
         ("status", "u8"),

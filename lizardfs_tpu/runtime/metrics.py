@@ -110,6 +110,51 @@ class Timing:
         }
 
 
+class PhaseBreakdown:
+    """Per-phase busy-time accounting for a multi-phase operation (the
+    client write pipeline's encode/stage/send/commit split).
+
+    Each ``add`` charges wall-clock seconds spent *inside* one phase;
+    ``add_wall`` closes one rep (one whole operation) with its end-to-end
+    time. In a serial execution the phase totals sum to ~the wall total;
+    in a pipelined execution phases overlap, so the sum legitimately
+    exceeds wall time — the gap IS the overlap win. ``snapshot`` returns
+    cumulative totals; subtract two snapshots (:func:`phase_delta`) to
+    scope the breakdown to a measured interval (bench reps)."""
+
+    __slots__ = ("name", "phase_names", "totals_s", "wall_s", "reps")
+
+    def __init__(self, name: str, phase_names: tuple[str, ...]):
+        self.name = name
+        self.phase_names = tuple(phase_names)
+        self.totals_s = {p: 0.0 for p in self.phase_names}
+        self.wall_s = 0.0
+        self.reps = 0
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.totals_s[phase] += seconds
+
+    def add_wall(self, seconds: float) -> None:
+        self.wall_s += seconds
+        self.reps += 1
+
+    def snapshot(self) -> dict:
+        out = {f"{p}_ms": round(v * 1e3, 2) for p, v in self.totals_s.items()}
+        out["wall_ms"] = round(self.wall_s * 1e3, 2)
+        out["reps"] = self.reps
+        return out
+
+
+def phase_delta(after: dict, before: dict) -> dict:
+    """Elementwise ``after - before`` of two :meth:`PhaseBreakdown.snapshot`
+    dicts (same keys), rounded back to centi-ms."""
+    return {
+        k: round(after[k] - before.get(k, 0), 2) if k != "reps"
+        else after[k] - before.get(k, 0)
+        for k in after
+    }
+
+
 class Metrics:
     def __init__(self):
         self.series: dict[str, Series] = {}

@@ -296,6 +296,13 @@ struct Server {
     std::vector<std::string> folders;
     int listen_fd = -1;
     int uds_fd = -1;  // same-host fast path (abstract unix socket)
+    // the bound abstract name, kept for the stop-time self-connect:
+    // close()/shutdown() of an AF_UNIX *listening* socket does not wake
+    // a blocked accept() on every kernel (observed on 4.4 — the thread
+    // sleeps forever and lz_serve_stop's join deadlocks the daemon), so
+    // stop pokes the listener awake through its own name
+    struct sockaddr_un uds_addr {};
+    socklen_t uds_addr_len = 0;
     int port = 0;
     std::atomic<bool> stopping{false};
     std::thread accept_thread;
@@ -1355,6 +1362,9 @@ int lz_serve_start(const char* folders_nl, const char* host, int port) {
             ::listen(ufd, 128) < 0) {
             ::close(ufd);
             ufd = -1;
+        } else {
+            srv->uds_addr = ua;
+            srv->uds_addr_len = ulen;
         }
     }
     srv->uds_fd = ufd;
@@ -1389,6 +1399,21 @@ void lz_serve_stop(int handle) {
     ::shutdown(srv->listen_fd, SHUT_RDWR);
     ::close(srv->listen_fd);
     if (srv->uds_fd >= 0) {
+        // shutdown()/close() of an AF_UNIX LISTENING socket does not
+        // wake a blocked accept() on every kernel (observed on 4.4:
+        // the accept thread sleeps forever and the join below never
+        // returns, wedging daemon shutdown). Poke the listener awake
+        // with a self-connect through its abstract name FIRST — the
+        // accept loop sees `stopping` and exits — then tear it down.
+        if (srv->uds_addr_len > 0) {
+            int poke = ::socket(AF_UNIX, SOCK_STREAM, 0);
+            if (poke >= 0) {
+                ::connect(poke,
+                          reinterpret_cast<struct sockaddr*>(&srv->uds_addr),
+                          srv->uds_addr_len);
+                ::close(poke);
+            }
+        }
         ::shutdown(srv->uds_fd, SHUT_RDWR);
         ::close(srv->uds_fd);
     }

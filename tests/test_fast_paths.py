@@ -386,6 +386,9 @@ async def test_cancelled_striped_write_does_not_pool_staging(
     await cluster.start()
     try:
         c = await cluster.client()
+        # pin the scatter-batch (serial) path: the pipelined path has
+        # its own session sender and is exercised below
+        c.write_pipeline = False
         f = await c.create(1, "pool.bin")
         await c.setgoal(f.inode, EC_GOAL)
         full = data_generator.generate(21, MFSCHUNKSIZE).tobytes()
@@ -431,6 +434,45 @@ async def test_cancelled_striped_write_does_not_pool_staging(
             "staging buffer pooled while a zombie sender may hold it"
         assert any(cl and cl.get("aborted") for cl in seen_cells), \
             "cancelled write did not abort its in-flight sender"
+
+        # 3) same invariant for the PIPELINED sender: a cancelled
+        # session segment must abort its cell and keep both the stage
+        # and the parity send buffer out of the pool
+        monkeypatch.undo()
+        c.write_pipeline = True
+        started3 = threading.Event()
+        cells3: list[dict] = []
+
+        def hang_segment(self, payloads, lengths, part_offset, write_id):
+            cells3.append(self.cell)
+            started3.set()
+            deadline = time_mod.monotonic() + 15.0
+            while time_mod.monotonic() < deadline:
+                if self.cell.get("aborted"):
+                    break
+                time_mod.sleep(0.01)
+            self.close()
+            raise native_io.NativeIOError(-1, "hung segment aborted")
+
+        monkeypatch.setattr(
+            native_io.PartsScatterSession, "send_segment", hang_segment
+        )
+        h = await c.create(1, "pool3.bin")
+        await c.setgoal(h.inode, EC_GOAL)
+        task = asyncio.ensure_future(c.write_file(h.inode, full))
+        await asyncio.wait_for(
+            asyncio.get_running_loop().run_in_executor(
+                None, started3.wait, 10
+            ),
+            15.0,
+        )
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert sum(len(b) for b in c._stage_buffers.values()) == 0, \
+            "buffers pooled while a zombie session sender may hold them"
+        assert any(cl and cl.get("aborted") for cl in cells3), \
+            "cancelled pipelined write did not abort its session"
     finally:
         await cluster.stop()
 

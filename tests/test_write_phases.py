@@ -1,0 +1,269 @@
+"""Phase-instrumented, pipelined write path.
+
+Pins the tentpole's two contracts:
+  * the per-phase accounting (encode/stage/send/commit) is plumbed in
+    the right units — phases are all exercised by a striped write and
+    their busy-time sum lands in the same ballpark as the rep's wall
+    clock (serial ordering keeps them comparable; see tolerance notes),
+  * the segmented stripe pipeline is byte-identical to the serial path
+    (parity AND per-block CRCs, verified against the golden
+    striping.split_chunk oracle and against a serial write's on-disk
+    part files), and the LZ_WRITE_PIPELINE=0 kill switch forces serial.
+
+Plus regressions for the r05 ADVICE satellites: trailing-field
+default-fill at decode, and the locate-epoch clear generation.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.chunkserver.chunk_store import HEADER_SIZE, SIGNATURE_SIZE
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.runtime.metrics import PhaseBreakdown, phase_delta
+from lizardfs_tpu.utils import striping
+
+from tests.test_cluster import Cluster
+
+EC84_GOAL = 13  # $ec(8,4) in tests.test_cluster.make_goals
+EC32_GOAL = 10  # $ec(3,2)
+
+
+def _payload(nbytes: int) -> bytes:
+    return np.random.default_rng(7).integers(
+        0, 256, size=nbytes, dtype=np.uint8
+    ).tobytes()
+
+
+async def _write_and_read_back(cluster, client, goal, name, payload):
+    f = await client.create(1, name)
+    await client.setgoal(f.inode, goal)
+    await client.write_file(f.inode, payload)
+    client.cache.invalidate(f.inode)
+    back = await client.read_file(f.inode, 0, len(payload))
+    assert back == payload, "roundtrip corruption"
+    return f.inode
+
+
+def _find_part_files(cluster, chunk_id):
+    """(part_id -> path) across every chunkserver's data dirs."""
+    out = {}
+    for cs in cluster.chunkservers:
+        for cf in cs.store.all_parts():
+            if cf.chunk_id == chunk_id:
+                out[cf.part_id] = cf.path
+    return out
+
+
+def _read_part(path):
+    """-> (data bytes, crc table bytes) of one on-disk part file."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    return blob[HEADER_SIZE:], blob[SIGNATURE_SIZE:HEADER_SIZE]
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("goal", [EC84_GOAL, EC32_GOAL])
+async def test_pipelined_write_byte_identical_to_serial(tmp_path, goal):
+    """Same payload written pipelined and serial (kill switch) must
+    produce identical part files: data bytes, parity bytes, and the
+    stored per-block CRC tables — and both must match the golden
+    split_chunk oracle."""
+    payload = _payload(12 * 2**20 + 12345)  # multi-stripe + ragged tail
+    cluster = Cluster(tmp_path, n_cs=12)
+    await cluster.start(health_interval=5.0)
+    try:
+        client = await cluster.client()
+        client.WRITE_PIPELINE_MIN_BYTES = 1  # engage on the small payload
+        client.write_pipeline = True
+        ino_pipe = await _write_and_read_back(
+            cluster, client, goal, "pipe.bin", payload
+        )
+        assert client.op_counters.get("write_pipeline", 0) >= 1, \
+            "pipelined path did not engage"
+        client.write_pipeline = False  # the LZ_WRITE_PIPELINE=0 path
+        ino_serial = await _write_and_read_back(
+            cluster, client, goal, "serial.bin", payload
+        )
+        assert client.op_counters.get("write_pipeline", 0) == 1, \
+            "kill switch did not force the serial path"
+
+        loc_p = await client.chunk_info(ino_pipe, 0)
+        loc_s = await client.chunk_info(ino_serial, 0)
+        parts_p = _find_part_files(cluster, loc_p.chunk_id)
+        parts_s = _find_part_files(cluster, loc_s.chunk_id)
+        assert set(parts_p) == set(parts_s) and parts_p
+
+        # golden oracle: client-side split of the same chunk bytes
+        slice_type = geometry.ChunkPartType.from_id(
+            next(iter(parts_p))
+        ).type
+        golden = striping.split_chunk(
+            np.frombuffer(payload, dtype=np.uint8), slice_type
+        )
+        for part_id in sorted(parts_p):
+            cpt = geometry.ChunkPartType.from_id(part_id)
+            data_p, crcs_p = _read_part(parts_p[part_id])
+            data_s, crcs_s = _read_part(parts_s[part_id])
+            assert data_p == data_s, f"part {cpt.part} bytes differ"
+            assert crcs_p == crcs_s, f"part {cpt.part} CRC tables differ"
+            want = golden[cpt.part]
+            assert (
+                np.frombuffer(data_p, dtype=np.uint8)
+                == want[: len(data_p)]
+            ).all(), f"part {cpt.part} differs from the golden split"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_phase_breakdown_sums_to_wall_clock(tmp_path):
+    """Serial (kill-switch) writes: every phase is populated and the
+    busy-time sum is within tolerance of wall clock. The serial path
+    still overlaps the whole-chunk encode with the data-part sends, so
+    the sum may exceed wall — but never by more than the double-counted
+    encode; and phases can't account for more than all of wall plus
+    that overlap, nor less than half of it (catches unit mistakes and
+    unplumbed phases, the failure modes this accounting can actually
+    have)."""
+    payload = _payload(8 * 2**20)
+    cluster = Cluster(tmp_path, n_cs=12)
+    await cluster.start(health_interval=5.0)
+    try:
+        client = await cluster.client()
+        client.write_pipeline = False
+        for goal in (EC84_GOAL, EC32_GOAL):
+            before = client.write_phases.snapshot()
+            await _write_and_read_back(
+                cluster, client, goal, f"phases_{goal}.bin", payload
+            )
+            d = phase_delta(client.write_phases.snapshot(), before)
+            assert d["reps"] == 1
+            for phase in ("encode", "stage", "send", "commit"):
+                assert d[f"{phase}_ms"] > 0.0, f"{phase} not recorded"
+            total = sum(
+                d[f"{p}_ms"] for p in ("encode", "stage", "send", "commit")
+            )
+            assert d["wall_ms"] > 0
+            assert 0.4 * d["wall_ms"] <= total <= 2.0 * d["wall_ms"], (
+                f"phase sum {total} vs wall {d['wall_ms']} out of range"
+            )
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_pipelined_write_survives_mid_write_fallback(tmp_path):
+    """A pipeline transport failure must degrade to the serial path and
+    still produce a correct file (torn segments healed by the full-part
+    rewrite)."""
+    from lizardfs_tpu.core import native_io
+
+    payload = _payload(9 * 2**20)
+    cluster = Cluster(tmp_path, n_cs=12)
+    await cluster.start(health_interval=5.0)
+    try:
+        client = await cluster.client()
+        client.WRITE_PIPELINE_MIN_BYTES = 1
+        orig = native_io.PartsScatterSession.send_segment
+        calls = {"n": 0}
+
+        def broken(self, payloads, lengths, part_offset, write_id):
+            calls["n"] += 1
+            if calls["n"] == 2:  # fail mid-chunk, after segment 1 landed
+                self.close()
+                raise native_io.NativeIOError(-1, "injected")
+            return orig(self, payloads, lengths, part_offset, write_id)
+
+        native_io.PartsScatterSession.send_segment = broken
+        try:
+            await _write_and_read_back(
+                cluster, client, EC84_GOAL, "fb.bin", payload
+            )
+        finally:
+            native_io.PartsScatterSession.send_segment = orig
+        assert client.op_counters.get("write_pipeline_fallback", 0) >= 1
+    finally:
+        await cluster.stop()
+
+
+# --- satellite regressions --------------------------------------------------
+
+
+def test_decode_default_fills_missing_trailing_fields():
+    """A version-skewed peer that predates a trailing field must still
+    decode: the new probe u8 on CltomaIoLimitRequest (and any trailing
+    tail generally) default-fills instead of failing strict parse."""
+    msg = m.CltomaIoLimitRequest(req_id=3, group="grp", probe=1)
+    old_wire = msg.pack_body()[:-1]  # sender without the probe field
+    parsed = m.CltomaIoLimitRequest.parse(old_wire)
+    assert (parsed.req_id, parsed.group, parsed.probe) == (3, "grp", 0)
+
+    # several trailing fields missing at once, ending on a scalar/list/str
+    reply = m.MatoclIoLimitReply(
+        req_id=1, status=0, bytes_per_sec=10, renew_ms=500,
+        subsystem="cg", limits_active=1,
+    )
+    full = reply.pack_body()
+    # strip limits_active (u8) + subsystem (u32 len + 2 bytes)
+    stripped = full[: -(1 + 4 + 2)]
+    parsed = m.MatoclIoLimitReply.parse(stripped)
+    assert parsed.renew_ms == 500
+    assert parsed.subsystem == ""
+    assert parsed.limits_active == 0
+
+    # a field cut MID-VALUE is corruption, not skew: still refused
+    # (renew_ms u32 left with 2 of its 4 bytes)
+    with pytest.raises(Exception):
+        m.MatoclIoLimitReply.parse(full[: -(1 + 4 + 2 + 2)])
+
+    # a REQUIRED (pre-skew, verdict-bearing) field missing at an exact
+    # boundary is also refused: tolerance covers only the additive
+    # suffix, never e.g. renew_ms/bytes_per_sec/status — a reply
+    # truncated there must not default-fill into "unlimited, OK"
+    with pytest.raises(Exception):
+        m.MatoclIoLimitReply.parse(full[: -(1 + 4 + 2 + 4)])
+
+    # trailing EXTRA bytes stay rejected (newer-sender direction is
+    # handled by the sender, not by silently eating bytes)
+    with pytest.raises(ValueError):
+        m.CltomaIoLimitRequest.parse(msg.pack_body() + b"x")
+
+    # tolerance is OPT-IN: a non-tolerant message with a missing
+    # trailing field must still FAIL the parse — default-filling a
+    # truncated write ack's status u8 would read as st.OK and report a
+    # commit no chunkserver ever acknowledged (fail-open)
+    ack = m.CstoclWriteStatus(req_id=1, chunk_id=2, write_id=3, status=5)
+    assert m.CstoclWriteStatus.SKEW_TOLERANT_FROM is None
+    with pytest.raises(Exception):
+        m.CstoclWriteStatus.parse(ack.pack_body()[:-1])
+
+
+def test_locate_epoch_clear_bumps_generation():
+    """_locate_epoch.clear() must never reset an inode to a
+    previously-seen token: an in-flight locate that snapshotted the
+    pre-clear token may not cache its (possibly pre-mutation) reply
+    even if per-inode epochs climb back to the same numbers."""
+    client = Client("127.0.0.1", 0)
+    inode = 42
+    client._drop_locates(inode)          # epoch 1
+    token = client._locate_token(inode)  # in-flight locate snapshots this
+    # invalidations on many other inodes overflow the table -> clear
+    for other in range(70000):
+        if other != inode:
+            client._locate_epoch[other] = 1
+    client._drop_locates(inode + 1)      # tips past the bound, clears
+    assert not client._locate_epoch or len(client._locate_epoch) <= 2
+    client._drop_locates(inode)          # per-inode epoch back to 1
+    assert client._locate_token(inode) != token, (
+        "post-clear token aliases the pre-clear token; a raced locate "
+        "would cache a stale reply"
+    )
+    # and without a clear, tokens do still match across a quiet period
+    quiet = client._locate_token(inode)
+    assert client._locate_token(inode) == quiet
