@@ -398,6 +398,17 @@ def cluster_throughput() -> dict:
                     # over the row's write reps — the instrument the
                     # 4-round ec(8,4) miss has been waiting for
                     out[f"cluster_{key}_write_phases"] = r["write_phases_ms"]
+            elif "coverage_pct" in r:
+                # cross-role trace attribution of one ec(8,4) write rep
+                # (benches/bench_cluster.py traced rep): wall, how much
+                # of it named segments cover, and the per-role split
+                out[f"cluster_{key}"] = {
+                    "rep_MBps": r.get("rep_MBps", 0),
+                    "wall_ms": r["wall_ms"],
+                    "coverage_pct": r["coverage_pct"],
+                    "by_role_ms": r.get("by_role_ms", {}),
+                    "spans": r.get("spans", 0),
+                }
             elif "ops_per_s" in r:
                 out[f"cluster_{key}_MBps"] = r["MBps"]
                 out[f"cluster_{key}_ops_per_s"] = r["ops_per_s"]
@@ -675,6 +686,44 @@ def _summary_row(row: dict) -> dict:
                 k: (int(round(v)) if isinstance(v, float) else v)
                 for k, v in value.items()
             }
+        elif key.endswith("_write_trace") and isinstance(value, dict):
+            # the traced rep's verdict: coverage + per-role split,
+            # integer ms (segment detail lives in BENCH_FULL.json)
+            s[key] = {
+                "coverage_pct": value.get("coverage_pct", 0),
+                "wall_ms": int(round(value.get("wall_ms", 0))),
+                "by_role_ms": {
+                    r: int(round(v))
+                    for r, v in value.get("by_role_ms", {}).items()
+                },
+            }
+    return _fit_summary(s)
+
+
+# the driver records only a ~2000-byte stdout tail; leave margin for
+# the trailing newline + any stderr interleaving. Structural guard:
+# tests/test_bench_summary.py pins that a worst-case row set fits.
+SUMMARY_BUDGET_BYTES = 1900
+
+# dropped (in order) when a fat round outgrows the budget — ordered
+# least-verdict-bearing first; each drop is recorded so the tail shows
+# WHAT was cut instead of cutting mid-JSON like r05
+_SUMMARY_DROP_ORDER = (
+    "kernel_ladder", "cluster_ec3_2_write_phases",
+    "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
+    "cluster_ec8_4_write_phases",
+)
+
+
+def _fit_summary(s: dict) -> dict:
+    dropped = []
+    for key in _SUMMARY_DROP_ORDER:
+        if len(json.dumps(s)) <= SUMMARY_BUDGET_BYTES:
+            break
+        if key in s:
+            del s[key]
+            dropped.append(key)
+            s["dropped"] = dropped  # idempotent re-assign, stays last
     return s
 
 

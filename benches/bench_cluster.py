@@ -190,6 +190,46 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                     client.write_phases.snapshot(), phases_before
                 )
             rows.append(_attach_targets(row))
+
+        # one TRACED ec(8,4) write rep: cross-role request tracing
+        # (runtime/tracing.py) merges client phase spans with the
+        # chunkservers' native per-op receive/disk timestamps and the
+        # master's RPC spans into one timeline — the
+        # cluster_ec8_4_write_trace row turns the 428.9-vs-450 MB/s
+        # question into a measurement (coverage target: >=90% of the
+        # rep's wall attributed to named segments)
+        from lizardfs_tpu.runtime import tracing as _tracing
+
+        if _tracing.enabled():
+            try:
+                f = await client.create(1, "trace_ec84.bin")
+                await client.setgoal(f.inode, 12)
+                tid = _tracing.start_trace()
+                t0 = time.perf_counter()
+                await client.write_file(f.inode, payload)
+                rep_s = time.perf_counter() - t0
+                _tracing.clear_trace()
+                spans = list(client.trace_ring.dump(tid))
+                spans += master.trace_spans(tid)
+                for cs in servers:
+                    spans += cs.trace_spans(tid)
+                timeline = _tracing.merge_timeline(
+                    spans, tid, wall_name="write_file"
+                )
+                rows.append({
+                    "goal": "ec(8,4) write trace",
+                    "rep_MBps": round(size_mb / rep_s, 1),
+                    "wall_ms": timeline["wall_ms"],
+                    "coverage_pct": timeline["coverage_pct"],
+                    "by_role_ms": timeline["by_role_ms"],
+                    "spans": len(timeline["segments"]),
+                })
+                await drop_bench_files(["trace_ec84.bin"])
+            except Exception:  # noqa: BLE001 — tracing must not kill the bench
+                import logging
+
+                logging.getLogger("bench").exception("trace row failed")
+
         # dbench analog (reference: tests/test_suites/Benchmarks/
         # test_dbench_throughput.sh — 12 concurrent procs of mixed
         # create/write/read/stat/unlink): N concurrent CLIENT SESSIONS
@@ -416,6 +456,13 @@ def main(argv=None) -> int:
     for r in rows:
         if args.json:
             print(json.dumps(r))
+        elif "coverage_pct" in r:
+            by_role = ", ".join(
+                f"{role} {ms:.0f}ms"
+                for role, ms in r.get("by_role_ms", {}).items()
+            )
+            print(f"{r['goal']:>18s}:  wall {r['wall_ms']:8.1f} ms"
+                  f"   coverage {r['coverage_pct']:5.1f}%   [{by_role}]")
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")

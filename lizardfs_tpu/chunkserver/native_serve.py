@@ -38,8 +38,25 @@ if _lib is not None:
             ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
         ]
         _lib.lz_serve_stats.restype = None
+        try:
+            _lib.lz_serve_stats2.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
+            ]
+            _lib.lz_serve_stats2.restype = None
+            _lib.lz_serve_trace.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
+            ]
+            _lib.lz_serve_trace.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: per-op timing/trace channel stays off
     except AttributeError:
         _lib = None
+
+
+# lz_serve_trace flattens one op to 8 u64 slots — keep in sync with
+# serve_native.cpp TraceOp
+TRACE_OP_SLOTS = 8
+_TRACE_KINDS = {1: "cs_read", 2: "cs_read_bulk", 4: "cs_write_bulk"}
 
 
 def available() -> bool:
@@ -60,6 +77,22 @@ class DataPlaneServer:
         self.port = _lib.lz_serve_port(self._handle)
 
     def stats(self) -> dict[str, int]:
+        """v1 counters plus, when the .so exports stats v2, per-op
+        accumulated disk/net microseconds per direction — the native
+        per-op counters folded into the chunkserver's Metrics registry."""
+        if hasattr(_lib, "lz_serve_stats2"):
+            out = (ctypes.c_uint64 * 8)()
+            _lib.lz_serve_stats2(self._handle, out)
+            return {
+                "bytes_read": out[0],
+                "bytes_written": out[1],
+                "read_ops": out[2],
+                "write_ops": out[3],
+                "read_disk_us": out[4],
+                "read_net_us": out[5],
+                "write_disk_us": out[6],
+                "write_net_us": out[7],
+            }
         out = (ctypes.c_uint64 * 4)()
         _lib.lz_serve_stats(self._handle, out)
         return {
@@ -68,6 +101,29 @@ class DataPlaneServer:
             "read_ops": out[2],
             "write_ops": out[3],
         }
+
+    def trace_ops(self, max_ops: int = 1024) -> list[dict]:
+        """Drain the native per-op trace ring: one dict per traced op
+        with CLOCK_REALTIME second bounds (t0/t1) and accumulated
+        disk/net microseconds, ready to fold into a SpanRing."""
+        if not hasattr(_lib, "lz_serve_trace") or self._handle < 0:
+            return []
+        out = (ctypes.c_uint64 * (TRACE_OP_SLOTS * max_ops))()
+        n = _lib.lz_serve_trace(self._handle, out, max_ops)
+        ops = []
+        for i in range(n):
+            s = out[TRACE_OP_SLOTS * i : TRACE_OP_SLOTS * (i + 1)]
+            ops.append({
+                "name": _TRACE_KINDS.get(int(s[0]), f"cs_op_{int(s[0])}"),
+                "trace_id": int(s[1]),
+                "chunk_id": int(s[2]),
+                "bytes": int(s[3]),
+                "t0": s[4] / 1e6,
+                "t1": s[5] / 1e6,
+                "disk_us": int(s[6]),
+                "net_us": int(s[7]),
+            })
+        return ops
 
     def stop(self) -> None:
         if self._handle >= 0:

@@ -43,6 +43,7 @@ from lizardfs_tpu.core.encoder import get_encoder
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
 from lizardfs_tpu.runtime.rpc import RpcConnection
 
@@ -54,10 +55,12 @@ class _WriteSession:
     a dedicated connection per chain head (csserventry analog).
     """
 
-    def __init__(self, chunk_id: int, version: int, part_id: int):
+    def __init__(self, chunk_id: int, version: int, part_id: int,
+                 trace_id: int = 0):
         self.chunk_id = chunk_id
         self.version = version
         self.part_id = part_id
+        self.trace_id = trace_id  # request trace from WriteInit
         self.downstream: tuple[asyncio.StreamReader, asyncio.StreamWriter] | None = None
         self.down_status: dict[int, int] = {}  # write_id -> status
         self.down_event: dict[int, asyncio.Event] = {}
@@ -251,12 +254,21 @@ class ChunkServer(Daemon):
         total, used = self.store.space()
         if self.data_server is not None:
             # fold native-plane counters into the metrics registry so
-            # charts/admin see one consistent view
+            # charts/admin/prometheus see one consistent view — incl.
+            # the per-op disk/net time split (stats v2), which answers
+            # "where does data-plane wall time go" without tracing
             s = self.data_server.stats()
             self.metrics.gauge("native_bytes_read").set(float(s["bytes_read"]))
             self.metrics.gauge("native_bytes_written").set(
                 float(s["bytes_written"])
             )
+            for key in (
+                "read_ops", "write_ops", "read_disk_us", "read_net_us",
+                "write_disk_us", "write_net_us",
+            ):
+                if key in s:
+                    self.metrics.gauge(f"native_{key}").set(float(s[key]))
+            self._fold_native_trace()
         try:
             await self.master.call(
                 m.CstomaHeartbeat,
@@ -267,6 +279,32 @@ class ChunkServer(Daemon):
             )
         except (ConnectionError, asyncio.TimeoutError):
             pass
+
+    def _fold_native_trace(self) -> None:
+        """Drain the native data plane's per-op trace ring into this
+        daemon's SpanRing (the C side records receive/disk/send
+        timestamps per traced op; here they become chunkserver-role
+        spans dumps/merges understand)."""
+        if self.data_server is None:
+            return
+        try:
+            ops = self.data_server.trace_ops()
+        except Exception:  # noqa: BLE001 — tracing must never hurt serving
+            self.log.debug("native trace drain failed", exc_info=True)
+            return
+        for op in ops:
+            self.trace_ring.record(
+                op["trace_id"], op["name"], op["t0"], op["t1"],
+                role="chunkserver", bytes=op["bytes"],
+                disk_us=op["disk_us"], net_us=op["net_us"],
+                chunk_id=op["chunk_id"],
+            )
+
+    def trace_spans(self, trace_id: int | None = None) -> list[dict]:
+        # pull whatever the native plane recorded since the last
+        # heartbeat before dumping, so trace-dump is never stale
+        self._fold_native_trace()
+        return self.trace_ring.dump(trace_id)
 
     async def _test_chunks(self) -> None:
         """Chunk tester (hdd_test_chunk analog): rotate through every
@@ -493,6 +531,7 @@ class ChunkServer(Daemon):
                     # native streaming needs exclusive use of the socket;
                     # in-flight pipelined writes still owe status frames
                     t0 = time.perf_counter()
+                    tw0 = time.time()
                     await self._serve_read(
                         writer, msg,
                         native_ok=not sessions and not pending_writes,
@@ -500,11 +539,20 @@ class ChunkServer(Daemon):
                     self.metrics.timing("read").record(
                         time.perf_counter() - t0
                     )
+                    self.trace_ring.record(
+                        msg.trace_id, "cs_read", tw0, time.time(),
+                        role="chunkserver", bytes=msg.size,
+                    )
                 elif isinstance(msg, m.CltocsReadBulk):
                     t0 = time.perf_counter()
+                    tw0 = time.time()
                     await self._serve_read_bulk(writer, msg)
                     self.metrics.timing("read_bulk").record(
                         time.perf_counter() - t0
+                    )
+                    self.trace_ring.record(
+                        msg.trace_id, "cs_read_bulk", tw0, time.time(),
+                        role="chunkserver", bytes=msg.size,
                     )
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
@@ -787,7 +835,9 @@ class ChunkServer(Daemon):
         )
 
     async def _serve_write_init(self, writer, msg: m.CltocsWriteInit, sessions):
-        session = _WriteSession(msg.chunk_id, msg.version, msg.part_id)
+        session = _WriteSession(
+            msg.chunk_id, msg.version, msg.part_id, trace_id=msg.trace_id
+        )
         code = st.OK
         try:
             if msg.create and self.store.get(msg.chunk_id, msg.part_id) is None:
@@ -814,6 +864,7 @@ class ChunkServer(Daemon):
                         part_id=nxt.part_id,
                         chain=msg.chain[1:],
                         create=msg.create,
+                        trace_id=msg.trace_id,
                     ),
                 )
                 reply = await framing.read_message(dr)
@@ -934,6 +985,7 @@ class ChunkServer(Daemon):
         if session is None or msg.part_offset % MFSBLOCKSIZE != 0:
             await ack(st.EINVAL)
             return
+        tw0 = time.time()
         down_ok = st.OK
         down_ev = None
         if session.downstream is not None:
@@ -984,6 +1036,10 @@ class ChunkServer(Daemon):
                 code = down_ok
             session.down_event.pop(msg.write_id, None)
             session.down_status.pop(msg.write_id, None)
+        self.trace_ring.record(
+            session.trace_id, "cs_write_bulk", tw0, time.time(),
+            role="chunkserver", bytes=len(msg.data),
+        )
         await ack(code)
 
     def _local_write(self, session: _WriteSession, msg: m.CltocsWriteData) -> None:

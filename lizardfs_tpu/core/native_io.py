@@ -84,6 +84,11 @@ if _lib is not None:
             _lib.lz_write_parts_scatter.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: multi-part write fast path stays off
+        try:
+            _lib.lz_trace_set.argtypes = [ctypes.c_uint64]
+            _lib.lz_trace_set.restype = None
+        except AttributeError:
+            pass  # stale .so: native requests stay untraced
     except AttributeError:
         _lib = None
 
@@ -228,9 +233,51 @@ def serve_slot_release() -> None:
 
 
 async def run(fn, *args):
-    """Run a blocking native-IO function on the dedicated executor."""
+    """Run a blocking native-IO function on the dedicated executor.
+
+    The caller's request trace id (runtime/tracing.py contextvar) is
+    captured HERE — run_in_executor does not carry context into the
+    worker thread — and installed as the C side's thread-local
+    (lz_trace_set) for the duration of the call, so the native request
+    builders tag their frames with the trace of the request they serve."""
     loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(EXECUTOR, functools.partial(fn, *args))
+    return await loop.run_in_executor(EXECUTOR, partial_with_trace(fn, *args))
+
+
+def partial_with_trace(fn, *args):
+    """``functools.partial`` carrying the caller's trace id into the
+    executor thread — for call sites that need raw run_in_executor
+    (shield/abort-cell patterns) instead of :func:`run`."""
+    from lizardfs_tpu.runtime import tracing
+
+    trace_id = tracing.current_trace_id()
+    if trace_id:
+        return functools.partial(_traced_call, trace_id, fn, *args)
+    return functools.partial(fn, *args)
+
+
+# worker-thread trace id: read by the python-framed handshakes
+# (_send_write_init) the same way the C builders read lz_trace_set
+_TRACE_TL = threading.local()
+
+
+def _thread_trace_id() -> int:
+    return getattr(_TRACE_TL, "trace_id", 0)
+
+
+def _traced_call(trace_id, fn, *args):
+    _TRACE_TL.trace_id = trace_id
+    has_c = _lib is not None and hasattr(_lib, "lz_trace_set")
+    if has_c:
+        _lib.lz_trace_set(trace_id)
+    try:
+        return fn(*args)
+    finally:
+        # pooled executor threads serve many requests — never leak a
+        # trace id into the next one
+        _TRACE_TL.trace_id = 0
+        if has_c:
+            _lib.lz_trace_set(0)
 
 
 async def run_serve(fn, *args):
@@ -406,6 +453,7 @@ def write_part_blocking(
                 m.CltocsWriteInit(
                     req_id=1, chunk_id=chunk_id, version=version,
                     part_id=part_id, chain=chain, create=False,
+                    trace_id=_thread_trace_id(),
                 )
             )
         )
@@ -617,6 +665,7 @@ def _send_write_init(sock: socket.socket, chunk_id: int, version: int,
     sock.sendall(framing.encode(m.CltocsWriteInit(
         req_id=1, chunk_id=chunk_id, version=version,
         part_id=part_id, chain=[], create=False,
+        trace_id=_thread_trace_id(),
     )))
 
 

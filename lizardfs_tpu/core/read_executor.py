@@ -20,6 +20,7 @@ from lizardfs_tpu.ops import crc32 as crc_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import tracing
 
 log = logging.getLogger("read_executor")
 
@@ -78,12 +79,12 @@ async def read_part_range(
         # or failed attempt would otherwise keep writing `out` while a
         # retry refills the same region. The cell lets us shut the
         # socket down (killing the thread's recv) and join it.
-        import functools
-
         cell: dict = {}
         fut = asyncio.get_running_loop().run_in_executor(
             native_io.EXECUTOR,
-            functools.partial(
+            # partial_with_trace: carries the request trace id into the
+            # worker thread (plain run_in_executor drops context)
+            native_io.partial_with_trace(
                 native_io.read_part_blocking,
                 addr, chunk_id, version, part_id, offset, size, tmp,
                 cell if scatter_direct else None,
@@ -123,6 +124,7 @@ async def read_part_range(
                 part_id=part_id,
                 offset=offset,
                 size=size,
+                trace_id=tracing.current_trace_id(),
             ),
         )
         received = 0

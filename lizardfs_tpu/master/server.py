@@ -605,6 +605,7 @@ class MasterServer(Daemon):
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 t0 = time.perf_counter()
+                tw0 = time.time()
                 try:
                     reply = await self._handle_client(msg, session_id)
                 except fsmod.FsError as e:
@@ -615,6 +616,12 @@ class MasterServer(Daemon):
                 # request_log.h analog: per-op-type latency histograms
                 self.metrics.timing(type(msg).__name__).record(
                     time.perf_counter() - t0
+                )
+                # request-scoped tracing: RPCs carrying a trace id land
+                # in the span ring (dumped via admin `trace-dump`)
+                self.trace_ring.record(
+                    getattr(msg, "trace_id", 0), type(msg).__name__,
+                    tw0, time.time(), role="master",
                 )
                 if reply is not None:
                     await framing.send_message(writer, reply)
@@ -2132,7 +2139,11 @@ class MasterServer(Daemon):
                     " %s (sources: %s)",
                     chunk.chunk_id, chunk.version, part, target.cs_id,
                     st.name(reply.status),
-                    [(l.cs_id, geometry.ChunkPartType.from_id(l.part_id).part)
+                    # PartLocation carries addr+part, not cs_id — the
+                    # old cs_id access raised here, killing the task
+                    # with the failure reason unlogged
+                    [(f"{l.addr.host}:{l.addr.port}",
+                      geometry.ChunkPartType.from_id(l.part_id).part)
                      for l in sources],
                 )
                 self._repl_fail_until[chunk.chunk_id] = (

@@ -30,6 +30,7 @@ from lizardfs_tpu.nfs import rpc
 from lizardfs_tpu.nfs.xdr import Packer, Unpacker
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime.tweaks import Tweaks
 
 log = logging.getLogger("lizardfs.nfs")
 
@@ -300,12 +301,31 @@ class NfsGateway:
         self._access_cache: dict[int, dict[tuple, tuple[bool, float]]] = {}
         self._access_cache_n = 0
         self._attr_cache: dict[int, tuple[object, float]] = {}
-        self.META_TTL_S = 1.0
+        # META_TTL_S is the operator-tunable consistency knob (ADVICE
+        # r05 item 4): the access/attr caches mean a chmod via ANOTHER
+        # gateway/mount keeps granting cached decisions for up to this
+        # many seconds (master invalidation pushes cover data mutations
+        # only). Registered as a runtime tweak so operators can trade
+        # cross-gateway revocation lag against master RPC load without
+        # a restart; 0 disables the caches. See doc/operations.md.
+        self.tweaks = Tweaks()
+        self._meta_ttl = self.tweaks.register("meta_ttl_s", 1.0)
         self.client.cache.add_invalidate_listener(self._on_invalidate)
 
     @property
     def port(self) -> int:
         return self.rpc.port
+
+    # kept as an attribute-style accessor for existing call sites and
+    # tests; assignment routes through the tweak so `tweaks`/`META_TTL_S`
+    # can never disagree
+    @property
+    def META_TTL_S(self) -> float:
+        return float(self._meta_ttl.value)
+
+    @META_TTL_S.setter
+    def META_TTL_S(self, value: float) -> None:
+        self._meta_ttl.value = float(value)
 
     def _lock_entry(self, inode: int) -> list:
         # [lock, refcount] — dropped when nobody holds or awaits it

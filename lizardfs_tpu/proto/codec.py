@@ -156,15 +156,36 @@ class Message:
         cls._FAST_TAIL = tail
 
     def __init__(self, **kwargs):
-        for name, _ in self.FIELDS:
+        optional_from = self.SKEW_TOLERANT_FROM
+        for i, (name, ftype) in enumerate(self.FIELDS):
             if name not in kwargs:
+                if optional_from is not None and i >= optional_from:
+                    # optional-on-the-wire fields are optional in the
+                    # constructor too: call sites predating an additive
+                    # trailing field keep working (same zero the decoder
+                    # would fill for a skewed peer)
+                    setattr(self, name, _default_value(ftype))
+                    continue
                 raise TypeError(f"{type(self).__name__} missing field {name!r}")
             setattr(self, name, kwargs.pop(name))
         if kwargs:
             raise TypeError(f"{type(self).__name__} unknown fields {sorted(kwargs)}")
 
     def pack_body(self) -> bytes:
-        if self._FAST is not None:
+        # canonical skew-friendly encoding: OPTIONAL trailing fields
+        # still holding their default are not emitted at all, so a
+        # message whose additive suffix is unused stays byte-identical
+        # to the pre-addition encoding — a new sender interoperates
+        # with old receivers (whose parse would reject trailing bytes)
+        # unless it actually USES a new field
+        n_emit = len(self.FIELDS)
+        if self.SKEW_TOLERANT_FROM is not None:
+            while (
+                n_emit > self.SKEW_TOLERANT_FROM
+                and self._field_is_default(n_emit - 1)
+            ):
+                n_emit -= 1
+        if self._FAST is not None and n_emit == len(self.FIELDS):
             head = self._FAST.pack(
                 *(getattr(self, n) for n in self._FAST_NAMES)
             )
@@ -173,9 +194,13 @@ class Message:
             tail = bytes(getattr(self, self._FAST_TAIL))
             return head + struct.pack(">I", len(tail)) + tail
         out = bytearray()
-        for name, ftype in self.FIELDS:
+        for name, ftype in self.FIELDS[:n_emit]:
             _pack_value(ftype, getattr(self, name), out)
         return bytes(out)
+
+    def _field_is_default(self, i: int) -> bool:
+        name, ftype = self.FIELDS[i]
+        return getattr(self, name) == _default_value(ftype)
 
     @classmethod
     def unpack_body(cls, buf: memoryview | bytes, off: int = 0):

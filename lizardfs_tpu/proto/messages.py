@@ -212,13 +212,18 @@ class CltomaSetGoal(Message):
 
 
 class CltomaReadChunk(Message):
+    # ``trace_id`` (request-scoped tracing, runtime/tracing.py) is a
+    # skew-tolerant trailing field: a peer predating it decodes as
+    # trace 0 = untraced (tests/test_tracing.py pins the skew)
     MSG_TYPE = 1020
+    SKEW_TOLERANT_FROM = 5
     FIELDS = (
         ("req_id", "u32"),
         ("inode", "u32"),
         ("chunk_index", "u32"),
         ("uid", "u32"),
         ("gids", "list:u32"),
+        ("trace_id", "u64"),
     )
 
 
@@ -235,13 +240,16 @@ class MatoclReadChunk(Message):
 
 
 class CltomaWriteChunk(Message):
+    # trailing ``trace_id``: see CltomaReadChunk
     MSG_TYPE = 1022
+    SKEW_TOLERANT_FROM = 5
     FIELDS = (
         ("req_id", "u32"),
         ("inode", "u32"),
         ("chunk_index", "u32"),
         ("uid", "u32"),
         ("gids", "list:u32"),
+        ("trace_id", "u64"),
     )
 
 
@@ -258,7 +266,10 @@ class MatoclWriteChunk(Message):
 
 
 class CltomaWriteChunkEnd(Message):
+    # trailing ``trace_id``: see CltomaReadChunk. The verdict-bearing
+    # ``status`` stays REQUIRED — only the trace hint is optional.
     MSG_TYPE = 1024
+    SKEW_TOLERANT_FROM = 6
     FIELDS = (
         ("req_id", "u32"),
         ("chunk_id", "u64"),
@@ -266,6 +277,7 @@ class CltomaWriteChunkEnd(Message):
         ("chunk_index", "u32"),
         ("file_length", "u64"),
         ("status", "u8"),
+        ("trace_id", "u64"),
     )
 
 
@@ -782,7 +794,12 @@ class CstomaChunkOpStatus(Message):
 
 
 class CltocsRead(Message):
+    # trailing ``trace_id`` (optional, skew-tolerant): the native C
+    # data plane reads it as an optional trailing u64 past the fixed
+    # 28-byte body (native/wire.h trace contract); peers predating it
+    # decode/serve as trace 0
     MSG_TYPE = 1200
+    SKEW_TOLERANT_FROM = 6
     FIELDS = (
         ("req_id", "u32"),
         ("chunk_id", "u64"),
@@ -790,6 +807,7 @@ class CltocsRead(Message):
         ("part_id", "u32"),
         ("offset", "u32"),
         ("size", "u32"),
+        ("trace_id", "u64"),
     )
 
 
@@ -814,7 +832,9 @@ class CltocsReadBulk(Message):
     and the receiver can land bytes directly in the destination buffer.
     ``offset`` must be 64 KiB-block-aligned."""
 
+    # trailing ``trace_id``: see CltocsRead
     MSG_TYPE = 1206
+    SKEW_TOLERANT_FROM = 6
     FIELDS = (
         ("req_id", "u32"),
         ("chunk_id", "u64"),
@@ -822,6 +842,7 @@ class CltocsReadBulk(Message):
         ("part_id", "u32"),
         ("offset", "u32"),
         ("size", "u32"),
+        ("trace_id", "u64"),
     )
 
 
@@ -865,7 +886,11 @@ class CltocsWriteInit(Message):
     """Open a write chain: this CS stores the part and forwards to the
     rest of the chain (cltocs WRITE_INIT, network_worker_thread.cc:574)."""
 
+    # trailing ``trace_id``: carries the request trace into the data
+    # plane for the whole write session (both the asyncio server and
+    # serve_native.cpp read it; peers predating it serve as trace 0)
     MSG_TYPE = 1210
+    SKEW_TOLERANT_FROM = 6
     FIELDS = (
         ("req_id", "u32"),
         ("chunk_id", "u64"),
@@ -873,6 +898,7 @@ class CltocsWriteInit(Message):
         ("part_id", "u32"),
         ("chain", "list:msg:PartLocation"),  # remaining chain after this CS
         ("create", "bool"),  # create part if absent (first write)
+        ("trace_id", "u64"),
     )
 
 

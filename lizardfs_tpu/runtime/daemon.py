@@ -13,6 +13,7 @@ import logging
 import signal
 import sys
 
+from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import Metrics
 from lizardfs_tpu.runtime.tweaks import Tweaks
 
@@ -42,6 +43,10 @@ class Daemon:
         self._stopping = asyncio.Event()
         self.metrics = Metrics()
         self.tweaks = Tweaks()
+        # request-scoped span ring (oplog-style), dumped over the admin
+        # link via `trace-dump` and merged client-side into per-request
+        # timelines (runtime/tracing.py)
+        self.trace_ring = tracing.SpanRing()
         # challenge-response admin password (None = open admin port)
         self.admin_password: str | None = None
         self.add_timer(1.0, self._sample_metrics)
@@ -188,6 +193,30 @@ class Daemon:
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
+        if command == "metrics-prom":
+            # Prometheus text exposition, relayed as JSON over the admin
+            # link (the webui /metrics endpoint unwraps "text" verbatim)
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({"text": self.metrics.to_prometheus()}),
+            )
+        if command == "trace-dump":
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+            except ValueError:
+                payload = {}
+            try:
+                trace_id = int(payload.get("trace_id", 0))
+            except (TypeError, ValueError):
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL, json="{}"
+                )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(
+                    {"spans": self.trace_spans(trace_id or None)}
+                ),
+            )
         if getattr(msg, "command", None) == "tweaks":
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
@@ -205,6 +234,12 @@ class Daemon:
                 json=json.dumps(self.tweaks.to_dict()),
             )
         return None
+
+    def trace_spans(self, trace_id: int | None = None) -> list[dict]:
+        """Spans for `trace-dump` — subclasses that hold spans outside
+        the ring (the chunkserver's native data plane) fold them in
+        here before dumping."""
+        return self.trace_ring.dump(trace_id)
 
     # --- admin authentication (registered_admin_connection.cc analog) -------
     #

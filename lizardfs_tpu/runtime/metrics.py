@@ -36,6 +36,20 @@ RESOLUTION_NAMES = tuple(r[0] for r in RESOLUTIONS)
 RPN_OPS = ("ADD", "SUB", "MUL", "DIV", "MIN", "MAX")
 
 
+def _prom_name(name: str) -> str:
+    """Series name -> valid Prometheus metric-name fragment."""
+    return "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+
+
+def _prom_value(v: float) -> str:
+    # integral values print without the trailing ".0" scrapers choke on
+    # less often than one would hope
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
 class Series:
     def __init__(self, name: str, kind: str = "counter"):
         self.name = name
@@ -263,6 +277,47 @@ class Metrics:
             else:
                 stack.append((True, [float(t)]))
         return stack[0][1]
+
+    def to_prometheus(self, prefix: str = "lizardfs") -> str:
+        """Prometheus text exposition (format 0.0.4) of the registry.
+
+        Counters export as ``<prefix>_<name>_total``, gauges as
+        ``<prefix>_<name>``, derived series as gauges of their most
+        recent value, and :class:`Timing` histograms as native
+        Prometheus histograms in microseconds: bucket i of the log2
+        table covers [2^i, 2^(i+1)) us, so the cumulative ``le`` bound
+        of bucket i is 2^(i+1). Served at the webui ``/metrics``
+        endpoint and over the admin link (``metrics-prom``)."""
+        lines: list[str] = []
+
+        def emit(name: str, mtype: str, value, suffix: str = "") -> None:
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.append(f"{name}{suffix} {_prom_value(value)}")
+
+        for name, s in sorted(self.series.items()):
+            pname = f"{prefix}_{_prom_name(name)}"
+            if s.kind == "counter":
+                emit(pname + "_total", "counter", s.total)
+            else:
+                emit(pname, "gauge", s.value)
+        for name, expr in sorted(self.derived.items()):
+            pname = f"{prefix}_{_prom_name(name)}"
+            try:
+                points = self.eval_rpn(expr)
+            except ValueError:
+                continue  # a bad redefinition must not poison the page
+            emit(pname, "gauge", points[-1] if points else 0.0)
+        for name, t in sorted(self.timings.items()):
+            pname = f"{prefix}_timing_{_prom_name(name)}_us"
+            lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            for i, n in enumerate(t.buckets):
+                cum += n
+                lines.append(f'{pname}_bucket{{le="{2 ** (i + 1)}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {t.count}')
+            lines.append(f"{pname}_sum {_prom_value(t.total_us)}")
+            lines.append(f"{pname}_count {t.count}")
+        return "\n".join(lines) + "\n"
 
     def to_dict(self, resolution: str = "sec") -> dict:
         out = {

@@ -1,0 +1,272 @@
+"""Request-scoped distributed tracing across the three roles.
+
+The reference ships per-daemon charts and an oplog but nothing
+request-scoped; closing a cross-process throughput gap (the ec(8,4)
+write target) needs attribution past the client boundary. This module
+is the L0 piece: trace ids, span records, a bounded per-process span
+ring (oplog-style), and the client-side timeline merge.
+
+Propagation:
+  * master RPCs carry the trace id as a skew-tolerant TRAILING field on
+    the wire messages (proto/messages.py ``trace_id``; the codec
+    default-fills missing trailing fields, so a peer predating the
+    field still decodes — version-skew pinned in tests/test_tracing.py),
+  * the native data plane carries it as an OPTIONAL trailing u64 on
+    request frames (native/wire.h "trace propagation" contract); the
+    C++ server records per-op receive/disk/send timestamps into its own
+    ring, drained into the chunkserver's SpanRing
+    (chunkserver/server.py trace_spans).
+
+Each daemon's ring is dumped over the admin link
+(``lizardfs-admin <addr> trace-dump``) and merged client-side with
+:func:`merge_timeline` into a per-request timeline, so one ec(8,4)
+write rep decomposes into client encode/stage/send, chunkserver
+recv/disk-commit, and ack segments across processes.
+
+Cost contract: with ``LZ_TRACE=0`` no ids are issued,
+``current_trace_id()`` is 0 everywhere, and every record path is a
+single falsy check — the acceptance bound is <1% on the ec(8,4) write
+row.
+
+Clocks: spans carry CLOCK_REALTIME epoch seconds (C side: microseconds
+via clock_gettime) so same-host cross-process merges line up; durations
+inside one process stay monotonic-accurate at the span granularity
+(tens of microseconds and up) this subsystem targets.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import secrets
+import time
+from collections import deque
+
+# process-wide kill switch: LZ_TRACE=0 disables issuing trace ids, which
+# short-circuits every record path (spans are only recorded for nonzero
+# trace ids)
+_ENABLED = os.environ.get("LZ_TRACE", "1").lower() not in (
+    "0", "off", "false", "no"
+)
+
+# (trace_id, parent_span_id) of the request this task is serving
+CURRENT: contextvars.ContextVar[tuple[int, int] | None] = (
+    contextvars.ContextVar("lz_trace", default=None)
+)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Test/ops hook mirroring the LZ_TRACE env gate."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def new_id() -> int:
+    # 63-bit nonzero: fits i64/u64 everywhere, 0 stays "untraced"
+    return secrets.randbits(63) | 1
+
+
+def current_trace_id() -> int:
+    cur = CURRENT.get()
+    return cur[0] if cur is not None else 0
+
+
+def start_trace() -> int:
+    """Begin a new trace in this task's context; returns the trace id
+    (0 when tracing is disabled — callers pass it through untouched)."""
+    if not _ENABLED:
+        return 0
+    tid = new_id()
+    CURRENT.set((tid, 0))
+    return tid
+
+
+def ensure_trace() -> int:
+    """Current trace id, starting a fresh trace if none is active."""
+    tid = current_trace_id()
+    return tid if tid else start_trace()
+
+
+def begin() -> tuple[int, bool]:
+    """Join the active trace or start a fresh one.
+
+    Returns ``(trace_id, started)``; pass ``started`` to :func:`end`
+    when the operation finishes so an op that STARTED its trace clears
+    the context again — otherwise every later top-level op in the same
+    task would silently reuse the first op's id and merge unrelated
+    requests into one timeline."""
+    tid = current_trace_id()
+    if tid:
+        return tid, False
+    return start_trace(), True
+
+
+def end(started: bool) -> None:
+    if started:
+        clear_trace()
+
+
+def clear_trace() -> None:
+    CURRENT.set(None)
+
+
+class SpanRing:
+    """Bounded in-memory span ring, one per daemon/client (the oplog
+    model applied to spans). Records are plain dicts so dumps are
+    JSON-ready for the admin link."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._ring: deque = deque(maxlen=maxlen)
+
+    def record(
+        self,
+        trace_id: int,
+        name: str,
+        t0: float,
+        t1: float,
+        role: str = "",
+        parent_id: int = 0,
+        **attrs,
+    ) -> int:
+        """Record one finished span; no-op (returns 0) for trace id 0,
+        which is what every call site passes when tracing is off."""
+        if not trace_id:
+            return 0
+        span_id = new_id()
+        rec = {
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent_id,
+            "role": role,
+            "name": name,
+            "t0": t0,
+            "t1": t1,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        self._ring.append(rec)
+        return span_id
+
+    def span(self, name: str, role: str = "", trace_id: int | None = None):
+        """Context manager timing a block into the ring (sync code)."""
+        return _SpanCtx(self, name, role, trace_id)
+
+    def dump(self, trace_id: int | None = None) -> list[dict]:
+        if trace_id:
+            return [s for s in self._ring if s["trace_id"] == trace_id]
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+class _SpanCtx:
+    __slots__ = ("ring", "name", "role", "trace_id", "t0")
+
+    def __init__(self, ring, name, role, trace_id):
+        self.ring = ring
+        self.name = name
+        self.role = role
+        self.trace_id = (
+            trace_id if trace_id is not None else current_trace_id()
+        )
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.ring.record(
+            self.trace_id, self.name, self.t0, time.time(), role=self.role
+        )
+        return False
+
+
+def _union_seconds(intervals: list[tuple[float, float]]) -> float:
+    """Total length of the union of [t0, t1] intervals."""
+    total = 0.0
+    end = float("-inf")
+    for a, b in sorted(intervals):
+        if b <= end:
+            continue
+        total += b - max(a, end)
+        end = b
+    return total
+
+
+def merge_timeline(
+    spans: list[dict], trace_id: int | None = None,
+    wall_name: str | None = None,
+) -> dict:
+    """Merge spans (from any number of role rings) into one per-request
+    timeline.
+
+    ``wall_name`` names the root span whose [t0, t1] is the rep's wall
+    time; it is EXCLUDED from coverage (a root span trivially covers
+    100%) — coverage is the union of the remaining segments over the
+    wall, the honest "how much of the rep can we attribute" number.
+    Without a matching root the wall is the overall span envelope.
+    """
+    if trace_id:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+    if not spans:
+        return {"trace_id": trace_id or 0, "segments": [],
+                "wall_ms": 0.0, "coverage_pct": 0.0, "by_role_ms": {}}
+    root = None
+    if wall_name is not None:
+        for s in spans:
+            if s["name"] == wall_name and (
+                root is None or s["t1"] - s["t0"] > root["t1"] - root["t0"]
+            ):
+                root = s
+    segs = [s for s in spans if s is not root]
+    t_lo = root["t0"] if root else min(s["t0"] for s in spans)
+    t_hi = root["t1"] if root else max(s["t1"] for s in spans)
+    wall = max(t_hi - t_lo, 1e-9)
+    covered = _union_seconds(
+        [(max(s["t0"], t_lo), min(s["t1"], t_hi)) for s in segs
+         if s["t1"] > t_lo and s["t0"] < t_hi]
+    )
+    by_role: dict[str, float] = {}
+    segments = []
+    for s in sorted(segs, key=lambda x: (x["t0"], x["t1"])):
+        dur = s["t1"] - s["t0"]
+        by_role[s["role"]] = by_role.get(s["role"], 0.0) + dur
+        segments.append({
+            "role": s["role"], "name": s["name"],
+            "start_ms": round((s["t0"] - t_lo) * 1e3, 3),
+            "dur_ms": round(dur * 1e3, 3),
+            **({"attrs": s["attrs"]} if "attrs" in s else {}),
+        })
+    return {
+        "trace_id": spans[0]["trace_id"],
+        "wall_ms": round(wall * 1e3, 3),
+        "coverage_pct": round(100.0 * covered / wall, 1),
+        "by_role_ms": {
+            r: round(v * 1e3, 3) for r, v in sorted(by_role.items())
+        },
+        "segments": segments,
+    }
+
+
+def format_timeline(timeline: dict) -> str:
+    """Human-readable one-line-per-segment rendering (admin CLI)."""
+    lines = [
+        # 0x prefix: an all-digit bare hex id would reparse as decimal
+        f"trace 0x{timeline.get('trace_id', 0):x}  "
+        f"wall {timeline.get('wall_ms', 0.0):.2f} ms  "
+        f"coverage {timeline.get('coverage_pct', 0.0):.1f}%"
+    ]
+    for seg in timeline.get("segments", ()):
+        lines.append(
+            f"  {seg['start_ms']:>10.3f} ms  +{seg['dur_ms']:<10.3f} "
+            f"{seg['role']:<12s} {seg['name']}"
+        )
+    return "\n".join(lines)

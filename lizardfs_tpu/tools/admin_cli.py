@@ -56,6 +56,16 @@ async def _admin(addr: tuple[str, int], command: str, payload: str = "{}",
         writer.close()
 
 
+def _parse_trace_id(raw: str) -> int:
+    """Accept ids as timelines display them (0x-prefixed hex) as well
+    as decimal; a bare hex string with letters also parses, so
+    copy-pasting from any output works."""
+    try:
+        return int(raw, 0)
+    except ValueError:
+        return int(raw, 16)
+
+
 async def _amain(argv) -> int:
     p = argparse.ArgumentParser(prog="lizardfs-admin", description=__doc__)
     p.add_argument("master", help="daemon host:port (master or chunkserver)")
@@ -64,10 +74,13 @@ async def _amain(argv) -> int:
         choices=[
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
-            "metrics", "metrics-csv", "tweaks", "tweaks-set",
+            "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
+            "trace-dump",
         ],
     )
-    p.add_argument("extra", nargs="*", help="tweaks-set: NAME VALUE; metrics: [resolution]")
+    p.add_argument("extra", nargs="*",
+                   help="tweaks-set: NAME VALUE; metrics: [resolution]; "
+                        "trace-dump: [trace_id]")
     p.add_argument("--password", default=None,
                    help="admin password (challenge-response)")
     args = p.parse_args(argv)
@@ -82,6 +95,30 @@ async def _amain(argv) -> int:
         reply = await _admin(addr, cmd, json.dumps({"resolution": resolution}), password=args.password)
         if cmd == "metrics-csv" and reply.status == 0:
             print(json.loads(reply.json)["csv"], end="")
+            return 0
+    elif cmd == "metrics-prom":
+        reply = await _admin(addr, cmd, password=args.password)
+        if reply.status == 0:
+            # raw Prometheus text exposition, ready to pipe to a scraper
+            print(json.loads(reply.json)["text"], end="")
+            return 0
+    elif cmd == "trace-dump":
+        trace_id = _parse_trace_id(args.extra[0]) if args.extra else 0
+        reply = await _admin(
+            addr, cmd, json.dumps({"trace_id": trace_id}),
+            password=args.password,
+        )
+        if reply.status == 0:
+            from lizardfs_tpu.runtime import tracing
+
+            spans = json.loads(reply.json).get("spans", [])
+            if trace_id:
+                # merged per-request timeline for one trace
+                print(tracing.format_timeline(
+                    tracing.merge_timeline(spans, trace_id)
+                ))
+            else:
+                print(json.dumps(spans, indent=2))
             return 0
     elif cmd == "tweaks-set":
         if len(args.extra) != 2:

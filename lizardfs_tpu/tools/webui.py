@@ -7,7 +7,8 @@ live HTML dashboard plus raw JSON endpoints.
 
     python -m lizardfs_tpu.tools.webui --master 127.0.0.1:9420 --port 9425
 
-Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics
+Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics,
+/metrics (Prometheus text exposition of the master's registry)
 """
 
 from __future__ import annotations
@@ -125,6 +126,15 @@ class Dashboard:
                 )
             ).json
         )
+
+    def metrics_prom(self) -> str:
+        """Prometheus text exposition of the master's registry (the
+        daemon renders it; this just unwraps the admin relay)."""
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="metrics-prom", json="{}")
+            ).json
+        )["text"]
 
     def cs_metrics_all(self, addrs: list[tuple[str, int]],
                        resolution: str = "sec") -> list[dict | None]:
@@ -244,7 +254,13 @@ def make_handler(dash: Dashboard):
 
         def do_GET(self):
             try:
-                if self.path == "/api/info":
+                if self.path == "/metrics":
+                    # standard Prometheus scrape endpoint
+                    self._send(
+                        dash.metrics_prom(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/api/info":
                     self._send(json.dumps(dash.info()), "application/json")
                 elif self.path == "/api/health":
                     self._send(json.dumps(dash.health()), "application/json")

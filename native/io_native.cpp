@@ -137,17 +137,25 @@ bool recv_all(int fd, uint8_t* buf, size_t len) {
 
 }  // namespace
 
+// Per-thread request trace id (runtime/tracing.py): the python caller
+// sets it on the SAME executor thread right before the exchange, so
+// the C request builders can append it as the optional trailing u64 of
+// the request frame (wire.h trace contract) without signature churn.
+// 0 (the default) keeps request frames byte-identical to pre-trace
+// builds.
+thread_local uint64_t g_trace_id = 0;
+
 extern "C" {
+
+void lz_trace_set(uint64_t trace_id) { g_trace_id = trace_id; }
 
 // Read [offset, offset+size) of one part into out. Whole exchange.
 int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
                  uint32_t part_id, uint32_t offset, uint32_t size,
                  uint8_t* out) {
-    // request
-    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4];
+    // request (+8 reserved for the optional trailing trace id)
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
     size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
-    put32(req, kTypeRead);
-    put32(req + 4, static_cast<uint32_t>(body));
     req[8] = kProtoVersion;
     put32(req + 9, 1);            // req_id
     put64(req + 13, chunk_id);
@@ -155,7 +163,13 @@ int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
     put32(req + 25, part_id);
     put32(req + 29, offset);
     put32(req + 33, size);
-    if (!send_all(fd, req, sizeof(req))) return -1;
+    if (g_trace_id != 0) {
+        put64(req + 37, g_trace_id);
+        body += 8;
+    }
+    put32(req, kTypeRead);
+    put32(req + 4, static_cast<uint32_t>(body));
+    if (!send_all(fd, req, 8 + body)) return -1;
 
     std::vector<uint8_t> payload(kMaxPayload);
     uint64_t received = 0;
@@ -207,10 +221,8 @@ int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
                       uint8_t* out) {
     constexpr uint32_t kTypeReadBulk = 1206;
     constexpr uint32_t kTypeReadBulkData = 1207;
-    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4];
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
     size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
-    put32(req, kTypeReadBulk);
-    put32(req + 4, static_cast<uint32_t>(body));
     req[8] = kProtoVersion;
     put32(req + 9, 1);
     put64(req + 13, chunk_id);
@@ -218,7 +230,13 @@ int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
     put32(req + 25, part_id);
     put32(req + 29, offset);
     put32(req + 33, size);
-    if (!send_all(fd, req, sizeof(req))) return -1;
+    if (g_trace_id != 0) {  // optional trailing trace id (wire.h)
+        put64(req + 37, g_trace_id);
+        body += 8;
+    }
+    put32(req, kTypeReadBulk);
+    put32(req + 4, static_cast<uint32_t>(body));
+    if (!send_all(fd, req, 8 + body)) return -1;
 
     uint8_t header[8];
     if (!recv_all(fd, header, 8)) return -1;
@@ -395,9 +413,8 @@ int lz_read_parts_gather(lz_part_req* parts, uint32_t d, uint32_t offset,
             parts[i].rc = 0;
             continue;
         }
-        uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4];
-        put32(req, kTypeReadBulk);
-        put32(req + 4, 1 + 4 + 8 + 4 + 4 + 4 + 4);
+        uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
+        size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
         req[8] = kProtoVersion;
         put32(req + 9, 1);
         put64(req + 13, parts[i].chunk_id);
@@ -405,7 +422,13 @@ int lz_read_parts_gather(lz_part_req* parts, uint32_t d, uint32_t offset,
         put32(req + 25, parts[i].part_id);
         put32(req + 29, offset);
         put32(req + 33, part_blocks[i] * kBlockSize);
-        parts[i].rc = send_all(parts[i].fd, req, sizeof(req)) ? 1 << 30 : -1;
+        if (g_trace_id != 0) {  // optional trailing trace id (wire.h)
+            put64(req + 37, g_trace_id);
+            body += 8;
+        }
+        put32(req, kTypeReadBulk);
+        put32(req + 4, static_cast<uint32_t>(body));
+        parts[i].rc = send_all(parts[i].fd, req, 8 + body) ? 1 << 30 : -1;
     }
     uint32_t live = 0;
     bool failed = false;

@@ -282,6 +282,7 @@ struct WriteSession {
     uint64_t chunk_id = 0;
     uint32_t version = 0;
     uint32_t part_id = 0;
+    uint64_t trace_id = 0;  // from WriteInit's optional trailing field
     int fd = -1;           // owned by the session (closed at teardown)
     int max_blocks = 0;
     int down_fd = -1;      // owned here
@@ -291,6 +292,26 @@ struct WriteSession {
     std::map<uint32_t, uint8_t> down_acked;   // write_id -> status
     bool down_dead = false;
 };
+
+// one finished data-plane op for the trace ring (runtime/tracing.py):
+// absolute CLOCK_REALTIME bounds + accumulated disk/net time inside.
+// Flattened to 8 u64 slots by lz_serve_trace; keep in sync with
+// chunkserver/native_serve.py TRACE_OP_SLOTS.
+struct TraceOp {
+    uint64_t kind;      // 1=read 2=read_bulk 4=write_bulk
+    uint64_t trace_id;
+    uint64_t chunk_id;
+    uint64_t bytes;
+    uint64_t t_start_us;
+    uint64_t t_end_us;
+    uint64_t disk_us;   // time in flock..unlock block IO (+ CRC pass)
+    uint64_t net_us;    // send time (reads) / recv time (writes)
+};
+
+constexpr uint64_t kTraceRead = 1;
+constexpr uint64_t kTraceReadBulk = 2;
+constexpr uint64_t kTraceWriteBulk = 4;
+constexpr size_t kTraceRingCap = 1024;
 
 struct Server {
     std::vector<std::string> folders;
@@ -316,7 +337,38 @@ struct Server {
     size_t active_conns = 0;
     std::atomic<uint64_t> bytes_read{0}, bytes_written{0};
     std::atomic<uint64_t> read_ops{0}, write_ops{0};
+    // per-op accumulated microseconds (stats v2): where data-plane wall
+    // time goes even with tracing off — folded into the chunkserver's
+    // Metrics registry over the stats channel
+    std::atomic<uint64_t> read_disk_us{0}, read_net_us{0};
+    std::atomic<uint64_t> write_disk_us{0}, write_net_us{0};
+    // bounded per-op ring, drained by lz_serve_trace; entries are only
+    // pushed for traced ops (trace_id != 0), so LZ_TRACE=0 costs two
+    // clock reads + atomic adds per op here
+    std::mutex trace_mu;
+    std::vector<TraceOp> trace_ring;
 };
+
+void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
+              uint64_t chunk_id, uint64_t bytes, uint64_t t_start_us,
+              uint64_t t_end_us, uint64_t disk_us, uint64_t net_us) {
+    if (kind == kTraceWriteBulk) {
+        srv.write_disk_us.fetch_add(disk_us, std::memory_order_relaxed);
+        srv.write_net_us.fetch_add(net_us, std::memory_order_relaxed);
+    } else {
+        srv.read_disk_us.fetch_add(disk_us, std::memory_order_relaxed);
+        srv.read_net_us.fetch_add(net_us, std::memory_order_relaxed);
+    }
+    if (trace_id == 0) return;
+    std::lock_guard<std::mutex> g(srv.trace_mu);
+    if (srv.trace_ring.size() >= kTraceRingCap) {
+        // drop oldest half: cheap amortized bound without a cursor
+        srv.trace_ring.erase(srv.trace_ring.begin(),
+                             srv.trace_ring.begin() + kTraceRingCap / 2);
+    }
+    srv.trace_ring.push_back(TraceOp{kind, trace_id, chunk_id, bytes,
+                                     t_start_us, t_end_us, disk_us, net_us});
+}
 
 std::mutex g_servers_mu;
 std::vector<Server*> g_servers;
@@ -348,13 +400,16 @@ bool send_status(int fd, std::mutex* send_mu, uint32_t type, uint32_t req_id,
 // --- read serving ---------------------------------------------------------
 
 void serve_read(Server& srv, int cfd, std::mutex* send_mu,
-                const uint8_t* body) {
+                const uint8_t* body, uint32_t blen) {
+    uint64_t t_start = lzwire::now_us();
     uint32_t req_id = get32(body);
     uint64_t chunk_id = get64(body + 4);
     uint32_t version = get32(body + 12);
     uint32_t part_id = get32(body + 16);
     uint32_t offset = get32(body + 20);
     uint32_t size = get32(body + 24);
+    // optional trailing trace id (wire.h trace contract)
+    uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
 
     uint8_t code = stOK;
     std::string path;
@@ -390,6 +445,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     std::vector<uint8_t> crc_raw(4 * nblocks);
     std::vector<uint32_t> piece_crc(nblocks);
 
+    uint64_t disk0 = lzwire::now_us();
     ::flock(fd, LOCK_SH);
     struct stat stbuf;
     uint64_t data_len = 0;
@@ -411,6 +467,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     }
     ::flock(fd, LOCK_UN);
     ::close(fd);
+    uint64_t disk_us = lzwire::now_us() - disk0;
     if (!io_ok) {
         send_status(cfd, send_mu, kTypeReadStatus, req_id, chunk_id, 0, stEIO);
         return;
@@ -480,6 +537,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     iov[niov].iov_len = 22;
     ++niov;
 
+    uint64_t net0 = lzwire::now_us();
     if (send_mu != nullptr) send_mu->lock();
     size_t sent_iov = 0;
     bool ok = true;
@@ -504,8 +562,11 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     }
     if (send_mu != nullptr) send_mu->unlock();
     if (ok) {
+        uint64_t t_end = lzwire::now_us();
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
+        trace_op(srv, kTraceRead, trace_id, chunk_id, size, t_start, t_end,
+                 disk_us, t_end - net0);
     }
 }
 
@@ -533,13 +594,15 @@ void send_bulk_error(int cfd, std::mutex* send_mu, uint32_t req_id,
 }
 
 void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
-                     const uint8_t* body) {
+                     const uint8_t* body, uint32_t blen) {
+    uint64_t t_start = lzwire::now_us();
     uint32_t req_id = get32(body);
     uint64_t chunk_id = get64(body + 4);
     uint32_t version = get32(body + 12);
     uint32_t part_id = get32(body + 16);
     uint32_t offset = get32(body + 20);
     uint32_t size = get32(body + 24);
+    uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
 
     uint8_t code = stOK;
     std::string path;
@@ -572,6 +635,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t nblocks = last_b - first_b + 1;
     std::vector<uint8_t> crc_raw(4 * nblocks);
 
+    uint64_t disk0 = lzwire::now_us();
     ::flock(fd, LOCK_SH);
     struct stat stbuf;
     uint64_t data_len = 0;
@@ -613,6 +677,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     // receiver retries, while holding the lock would stall every write
     // to this chunk for the transfer duration
     ::flock(fd, LOCK_UN);
+    uint64_t disk_us = lzwire::now_us() - disk0;
     if (!io_ok) {
         ::close(fd);
         send_bulk_error(cfd, send_mu, req_id, chunk_id, stEIO);
@@ -638,6 +703,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
         data_len > offset ? std::min<uint64_t>(data_len - offset, size) : 0;
 
     bool ok;
+    uint64_t net0 = lzwire::now_us();
     {
         std::lock_guard<std::mutex> g(*send_mu);
         ok = send_all(cfd, head.data(), head.size());
@@ -665,8 +731,11 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     }
     ::close(fd);
     if (ok) {
+        uint64_t t_end = lzwire::now_us();
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
+        trace_op(srv, kTraceReadBulk, trace_id, chunk_id, size, t_start,
+                 t_end, disk_us, t_end - net0);
     }
 }
 
@@ -681,6 +750,7 @@ uint8_t do_local_write(Server& srv, WriteSession& s, uint32_t block,
     uint64_t block_pos =
         kHeaderSize + static_cast<uint64_t>(block) * kBlockSize;
     uint8_t ret = stOK;
+    uint64_t disk0 = lzwire::now_us();
     ::flock(s.fd, LOCK_EX);
     uint32_t new_crc;
     if (dlen == kBlockSize) {
@@ -708,6 +778,8 @@ uint8_t do_local_write(Server& srv, WriteSession& s, uint32_t block,
             ret = stEIO;
     }
     ::flock(s.fd, LOCK_UN);
+    srv.write_disk_us.fetch_add(lzwire::now_us() - disk0,
+                                std::memory_order_relaxed);
     if (ret == stOK) {
         srv.bytes_written.fetch_add(dlen, std::memory_order_relaxed);
         srv.write_ops.fetch_add(1, std::memory_order_relaxed);
@@ -850,6 +922,9 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
         return;
     }
     bool create = body[pos] != 0;
+    // optional trailing trace id (wire.h trace contract): tags every op
+    // of this write session in the trace ring
+    uint64_t trace_id = pos + 1 + 8 <= blen ? get64(body + pos + 1) : 0;
 
     uint8_t code = stOK;
     std::string path;
@@ -917,6 +992,11 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
                       chain[i].part_id);
             }
             f.push_back(create ? 1 : 0);
+            if (trace_id != 0) {  // propagate down the relay chain
+                size_t base = f.size();
+                f.resize(base + 8);
+                put64(f.data() + base, trace_id);
+            }
             put32(f.data(), kTypeWriteInit);
             put32(f.data() + 4, static_cast<uint32_t>(f.size() - 8));
             bool ok = send_all(s->down_fd, f.data(), f.size());
@@ -945,6 +1025,7 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
         s->chunk_id = chunk_id;
         s->version = version;
         s->part_id = part_id;
+        s->trace_id = trace_id;
         s->max_blocks = blocks_in_part(part_id);
         WriteSession* raw = s.release();
         if (raw->down_fd >= 0) {
@@ -1026,6 +1107,8 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
                       std::unordered_map<uint64_t, WriteSession*>* sessions,
                       bool* conn_ok) {
     *conn_ok = false;  // until the full frame is consumed
+    uint64_t t_start = lzwire::now_us();
+    uint64_t recv_us = 0, disk_us = 0;
     // fixed: ver(1) req(4) chunk(8) write_id(4) part_offset(4) ncrcs(4)
     uint8_t fixed[25];
     if (length < sizeof(fixed) + 4 || !recv_all(cfd, fixed, sizeof(fixed)))
@@ -1080,7 +1163,9 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t done = 0;
     while (done < dlen) {
         uint32_t take = std::min(dlen - done, kBatch);
+        uint64_t recv0 = lzwire::now_us();
         if (!recv_all(cfd, batch.data(), take)) return;  // conn dead
+        recv_us += lzwire::now_us() - recv0;
         if (chained && !send_all(s->down_fd, batch.data(), take)) {
             std::lock_guard<std::mutex> g(s->mu);
             s->down_dead = true;
@@ -1115,6 +1200,7 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
             if (code == stOK) {
                 uint64_t pos = kHeaderSize +
                                static_cast<uint64_t>(first_block) * kBlockSize;
+                uint64_t disk0 = lzwire::now_us();
                 ::flock(s->fd, LOCK_EX);
                 // a partial tail piece rewrites only its bytes but the
                 // stored CRC must cover the FULL (zero-padded) block
@@ -1150,6 +1236,7 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
                         static_cast<ssize_t>(slot_be.size()))
                     code = stEIO;
                 ::flock(s->fd, LOCK_UN);
+                disk_us += lzwire::now_us() - disk0;
                 if (code == stOK) {
                     srv.bytes_written.fetch_add(take,
                                                 std::memory_order_relaxed);
@@ -1160,6 +1247,8 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
         done += take;
     }
     *conn_ok = true;  // frame fully consumed; socket still in sync
+    trace_op(srv, kTraceWriteBulk, s != nullptr ? s->trace_id : 0, chunk_id,
+             dlen, t_start, lzwire::now_us(), disk_us, recv_us);
 
     bool down_was_dead = false;
     if (s != nullptr && s->down_fd >= 0) {
@@ -1227,9 +1316,9 @@ void connection_loop(Server& srv, int cfd) {
         const uint8_t* body = frame.data() + 9;
         uint32_t blen = length - 1;
         if (type == kTypeRead && blen >= 28) {
-            serve_read(srv, cfd, &send_mu, body);
+            serve_read(srv, cfd, &send_mu, body, blen);
         } else if (type == kTypeReadBulk && blen >= 28) {
-            serve_read_bulk(srv, cfd, &send_mu, body);
+            serve_read_bulk(srv, cfd, &send_mu, body, blen);
         } else if (type == kTypeWriteData) {
             serve_write_data(srv, cfd, &send_mu, frame.data(),
                              static_cast<uint32_t>(frame.size()), &sessions);
@@ -1444,6 +1533,60 @@ void lz_serve_stats(int handle, uint64_t* out) {
     out[1] = srv->bytes_written.load();
     out[2] = srv->read_ops.load();
     out[3] = srv->write_ops.load();
+}
+
+// stats v2: the v1 four counters plus accumulated per-op microseconds
+// (disk/net per direction) — 8 slots. Folded into the chunkserver's
+// Metrics registry by the heartbeat.
+void lz_serve_stats2(int handle, uint64_t* out) {
+    for (int i = 0; i < 8; ++i) out[i] = 0;
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+        g_servers[handle] == nullptr)
+        return;
+    Server* srv = g_servers[handle];
+    out[0] = srv->bytes_read.load();
+    out[1] = srv->bytes_written.load();
+    out[2] = srv->read_ops.load();
+    out[3] = srv->write_ops.load();
+    out[4] = srv->read_disk_us.load();
+    out[5] = srv->read_net_us.load();
+    out[6] = srv->write_disk_us.load();
+    out[7] = srv->write_net_us.load();
+}
+
+// Drain up to max_ops finished traced ops, oldest first, 8 u64 slots
+// each: kind, trace_id, chunk_id, bytes, t_start_us, t_end_us, disk_us,
+// net_us. Returns the op count. Draining keeps the Python fold free of
+// dedupe bookkeeping.
+int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
+    Server* srv = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_servers_mu);
+        if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+            g_servers[handle] == nullptr)
+            return 0;
+        srv = g_servers[handle];
+    }
+    std::lock_guard<std::mutex> g(srv->trace_mu);
+    int n = static_cast<int>(
+        std::min<size_t>(srv->trace_ring.size(),
+                         max_ops > 0 ? static_cast<size_t>(max_ops) : 0));
+    for (int i = 0; i < n; ++i) {
+        const TraceOp& op = srv->trace_ring[static_cast<size_t>(i)];
+        uint64_t* slot = out + 8 * i;
+        slot[0] = op.kind;
+        slot[1] = op.trace_id;
+        slot[2] = op.chunk_id;
+        slot[3] = op.bytes;
+        slot[4] = op.t_start_us;
+        slot[5] = op.t_end_us;
+        slot[6] = op.disk_us;
+        slot[7] = op.net_us;
+    }
+    srv->trace_ring.erase(srv->trace_ring.begin(),
+                          srv->trace_ring.begin() + n);
+    return n;
 }
 
 }  // extern "C"

