@@ -409,6 +409,17 @@ def cluster_throughput() -> dict:
                     "by_role_ms": r.get("by_role_ms", {}),
                     "spans": r.get("spans", 0),
                 }
+            elif "health_status" in r:
+                # SLO/flight-recorder fiducials (the "slo health" row):
+                # breach counts make a co-located-load rep attributable
+                # from the tail alone
+                out["cluster_health_status"] = r["health_status"]
+                out["cluster_slo_breaches"] = r["slo_breaches"]
+                out["cluster_slow_ops"] = r["slow_ops"]
+                if r.get("breaches_by_class"):
+                    out["cluster_slo_breaches_by_class"] = (
+                        r["breaches_by_class"]
+                    )
             elif "ops_per_s" in r:
                 out[f"cluster_{key}_MBps"] = r["MBps"]
                 out[f"cluster_{key}_ops_per_s"] = r["ops_per_s"]
@@ -656,6 +667,10 @@ def _summary_row(row: dict) -> dict:
         "ec8_2_batch1_cpu_us", "ec8_2_batch1_us",
         "box_cpus", "box_memcpy_GBps", "box_pyloop_ms",
         "cluster_error",
+        # slo/flight-recorder fiducials: nonzero breaches on a slow
+        # round name the degraded role+class from the tail alone
+        "cluster_health_status", "cluster_slo_breaches",
+        "cluster_slow_ops", "cluster_slo_breaches_by_class",
     ):
         if key in row:
             s[key] = row[key]
@@ -709,7 +724,8 @@ SUMMARY_BUDGET_BYTES = 1900
 # least-verdict-bearing first; each drop is recorded so the tail shows
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
-    "kernel_ladder", "cluster_ec3_2_write_phases",
+    "cluster_slo_breaches_by_class", "kernel_ladder",
+    "cluster_ec3_2_write_phases",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
     "cluster_ec8_4_write_phases",
 )

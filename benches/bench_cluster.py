@@ -230,6 +230,38 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
 
                 logging.getLogger("bench").exception("trace row failed")
 
+        # SLO / flight-recorder fiducials: with objectives watching the
+        # hot paths, a driver-box stall during a rep is attributable
+        # from the artifact alone — it shows up as breach counts +
+        # slowops entries on the role that stalled instead of an
+        # unexplained MB/s dip (and proves the SLO hooks cost nothing
+        # when nothing breaches: all-zero on a quiet run)
+        try:
+            from lizardfs_tpu.runtime import slo as _slo
+
+            if _slo.enabled():
+                breaches: dict[str, int] = {}
+                slow_ops = 0
+                for daemon in [master, *servers]:
+                    for cls, s in daemon.slo.snapshot().items():
+                        if s["breaches"]:
+                            breaches[cls] = (
+                                breaches.get(cls, 0) + s["breaches"]
+                            )
+                    slow_ops += len(daemon.slo.recorder.slowops())
+                health = master.cluster_health(evaluate_chunks=False)
+                rows.append({
+                    "goal": "slo health",
+                    "health_status": health["status"],
+                    "slo_breaches": sum(breaches.values()),
+                    "breaches_by_class": breaches,
+                    "slow_ops": slow_ops,
+                })
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("slo row failed")
+
         # dbench analog (reference: tests/test_suites/Benchmarks/
         # test_dbench_throughput.sh — 12 concurrent procs of mixed
         # create/write/read/stat/unlink): N concurrent CLIENT SESSIONS
@@ -463,6 +495,10 @@ def main(argv=None) -> int:
             )
             print(f"{r['goal']:>18s}:  wall {r['wall_ms']:8.1f} ms"
                   f"   coverage {r['coverage_pct']:5.1f}%   [{by_role}]")
+        elif "health_status" in r:
+            print(f"{r['goal']:>18s}:  {r['health_status']}"
+                  f"   breaches {r['slo_breaches']}"
+                  f"   slowops {r['slow_ops']}")
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")

@@ -8,6 +8,8 @@ every master incl. shadows, for floating-IP-less failover: the
 registration loop cycles until the ACTIVE master accepts; overrides
 MASTER_HOST/PORT), LABEL, ENCODER (cpu|cpp|tpu|auto),
 HEARTBEAT_INTERVAL (seconds; also the master-reconnect cadence),
+NATIVE_DATA_PLANE (default true; false serves data ops from the
+asyncio path — needed for the debug_read_delay_ms fault drill),
 ADMIN_PASSWORD (challenge-response auth for privileged admin
 commands), LOG_LEVEL.
 """
@@ -70,6 +72,9 @@ def main() -> None:
         label=cfg.get_str("LABEL", "_"),
         encoder_name=cfg.get_str("ENCODER", "cpu"),
         heartbeat_interval=cfg.get_float("HEARTBEAT_INTERVAL", 5.0, min_value=0.05),
+        # off routes data ops through the asyncio server — needed for
+        # ops drills that use the debug_read_delay_ms fault tweak
+        native_data_plane=cfg.get_bool("NATIVE_DATA_PLANE", True),
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
     )
     asyncio.run(server.run_forever())

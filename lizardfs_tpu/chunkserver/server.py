@@ -98,6 +98,19 @@ class ChunkServer(Daemon):
         self.admin_password = admin_password
         folders = [data_folder] if isinstance(data_folder, str) else list(data_folder)
         self.store = MultiStore(folders)
+        # flight-recorder incidents (breached-SLO trace captures) live
+        # in the first data folder
+        self.slo.recorder.set_dir(os.path.join(folders[0], "incidents"))
+        # damaged chunks found by the scrubber since start — a health
+        # rollup signal alongside damaged folders. Keyed so a bad part
+        # that stays on disk is counted once, not once per scrub lap
+        # (the master only drops it from the registry; the file — and
+        # its re-detection — persists)
+        self.chunks_damaged = 0
+        self._damaged_seen: set[tuple[int, int]] = set()
+        # (total, used) from the last heartbeat's store.space() so the
+        # health snapshot doesn't re-stat the folders
+        self._last_space: tuple[int, int] | None = None
         # native C++ data-plane listener (network_worker_thread analog);
         # its port is registered with the master as data_port
         self.data_server = None
@@ -129,6 +142,11 @@ class ChunkServer(Daemon):
 
         self._repl_bps = self.tweaks.register("replication_bps", 0)
         self._repl_bucket = TokenBucket(0.0)
+        # fault injection for the SLO/flight-recorder e2e path: delays
+        # every asyncio-plane read by this many ms (0 = off). A tweak so
+        # the in-process harness (and an operator drilling incident
+        # response) can trip a latency breach without touching disks.
+        self._read_delay_ms = self.tweaks.register("debug_read_delay_ms", 0)
         # sockets with a native stream in flight; shutdown() on stop so
         # blocked serve threads see EPIPE instead of waiting out their
         # deadline (a ThreadPoolExecutor joins its workers at exit)
@@ -252,6 +270,7 @@ class ChunkServer(Daemon):
             except OSError:
                 return
         total, used = self.store.space()
+        self._last_space = (total, used)
         if self.data_server is not None:
             # fold native-plane counters into the metrics registry so
             # charts/admin/prometheus see one consistent view — incl.
@@ -270,11 +289,17 @@ class ChunkServer(Daemon):
                     self.metrics.gauge(f"native_{key}").set(float(s[key]))
             self._fold_native_trace()
         try:
+            import json as _json
+
             await self.master.call(
                 m.CstomaHeartbeat,
                 cs_id=self.cs_id,
                 total_space=total,
                 used_space=used,
+                # health rollup input: this CS's SLO burn / stall /
+                # span-drop / disk-error snapshot rides the heartbeat
+                # (skew-tolerant trailing field; old masters ignore it)
+                health_json=_json.dumps(self.health_snapshot()),
                 timeout=5.0,
             )
         except (ConnectionError, asyncio.TimeoutError):
@@ -299,12 +324,32 @@ class ChunkServer(Daemon):
                 disk_us=op["disk_us"], net_us=op["net_us"],
                 chunk_id=op["chunk_id"],
             )
+            # SLO accounting for the native plane rides the fold (the
+            # C side has no objective engine): class by op name
+            op_class = "read" if "read" in op["name"] else "write"
+            self.slo.observe(
+                op_class, max(op["t1"] - op["t0"], 0.0),
+                trace_id=op["trace_id"], name=op["name"],
+            )
 
     def trace_spans(self, trace_id: int | None = None) -> list[dict]:
         # pull whatever the native plane recorded since the last
         # heartbeat before dumping, so trace-dump is never stale
         self._fold_native_trace()
         return self.trace_ring.dump(trace_id)
+
+    def _health_disk_errors(self) -> int:
+        # damaged data folders + scrubber-found corrupt parts: either
+        # degrades this daemon's health snapshot (runtime/slo.py)
+        return len(self.store.damaged_folders) + self.chunks_damaged
+
+    def _health_extra(self) -> dict:
+        # reuse the space figures the heartbeat just computed instead
+        # of re-statting every data folder (snapshot and heartbeat run
+        # back to back; the fallback covers ad-hoc admin `health`)
+        total, used = self._last_space or self.store.space()
+        return {"cs_id": self.cs_id, "used_space": used,
+                "total_space": total}
 
     async def _test_chunks(self) -> None:
         """Chunk tester (hdd_test_chunk analog): rotate through every
@@ -336,6 +381,19 @@ class ChunkServer(Daemon):
             if tested_bytes >= self.test_budget_bytes:
                 break
         self._test_cursor %= max(len(parts), 1)
+        fresh = [
+            info for info in damaged
+            if (info.chunk_id, info.part_id) not in self._damaged_seen
+        ]
+        if fresh:
+            self._damaged_seen.update(
+                (info.chunk_id, info.part_id) for info in fresh
+            )
+            self.chunks_damaged += len(fresh)
+            self.metrics.counter(
+                "chunks_damaged",
+                help="chunk parts the background scrubber found corrupt",
+            ).inc(len(fresh))
         if damaged and self.master is not None and not self.master.closed:
             await self.master.send(
                 m.CstomaChunkDamaged(cs_id=self.cs_id, chunks=damaged)
@@ -409,6 +467,7 @@ class ChunkServer(Daemon):
     # --- replication (chunk_replicator.cc analog) -------------------------------
 
     async def _cmd_replicate(self, msg: m.MatocsReplicate):
+        t0 = time.perf_counter()
         try:
             await self._replicate(msg)
             code = st.OK
@@ -417,6 +476,9 @@ class ChunkServer(Daemon):
         except Exception as e:
             self.log.warning("replication failed: %s", e)
             code = st.EIO
+        self.slo.observe(
+            "replicate", time.perf_counter() - t0, name="replicate"
+        )
         await self._ack(msg.req_id, msg.chunk_id, msg.part_id, code)
         if code == st.OK and self.master is not None:
             cf = self.store.get(msg.chunk_id, msg.part_id)
@@ -532,27 +594,34 @@ class ChunkServer(Daemon):
                     # in-flight pipelined writes still owe status frames
                     t0 = time.perf_counter()
                     tw0 = time.time()
+                    await self._debug_read_delay()
                     await self._serve_read(
                         writer, msg,
                         native_ok=not sessions and not pending_writes,
                     )
-                    self.metrics.timing("read").record(
-                        time.perf_counter() - t0
-                    )
+                    dt = time.perf_counter() - t0
+                    self.metrics.timing("read").record(dt)
                     self.trace_ring.record(
                         msg.trace_id, "cs_read", tw0, time.time(),
                         role="chunkserver", bytes=msg.size,
                     )
+                    self.slo.observe(
+                        "read", dt, trace_id=msg.trace_id, name="cs_read"
+                    )
                 elif isinstance(msg, m.CltocsReadBulk):
                     t0 = time.perf_counter()
                     tw0 = time.time()
+                    await self._debug_read_delay()
                     await self._serve_read_bulk(writer, msg)
-                    self.metrics.timing("read_bulk").record(
-                        time.perf_counter() - t0
-                    )
+                    dt = time.perf_counter() - t0
+                    self.metrics.timing("read_bulk").record(dt)
                     self.trace_ring.record(
                         msg.trace_id, "cs_read_bulk", tw0, time.time(),
                         role="chunkserver", bytes=msg.size,
+                    )
+                    self.slo.observe(
+                        "read", dt, trace_id=msg.trace_id,
+                        name="cs_read_bulk",
                     )
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
@@ -584,6 +653,14 @@ class ChunkServer(Daemon):
         finally:
             for session in sessions.values():
                 await session.close()
+
+    async def _debug_read_delay(self) -> None:
+        """Fault injection (tweak ``debug_read_delay_ms``): stall the
+        asyncio-plane read path so SLO breach -> flight-record ->
+        health-degrade can be exercised end to end in-process."""
+        delay = float(self._read_delay_ms.value)
+        if delay > 0:
+            await asyncio.sleep(delay / 1e3)
 
     async def _serve_admin(self, writer, msg, state: dict | None = None) -> None:
         import json
@@ -986,6 +1063,7 @@ class ChunkServer(Daemon):
             await ack(st.EINVAL)
             return
         tw0 = time.time()
+        t0 = time.perf_counter()  # monotonic twin of tw0 for the SLO
         down_ok = st.OK
         down_ev = None
         if session.downstream is not None:
@@ -1039,6 +1117,10 @@ class ChunkServer(Daemon):
         self.trace_ring.record(
             session.trace_id, "cs_write_bulk", tw0, time.time(),
             role="chunkserver", bytes=len(msg.data),
+        )
+        self.slo.observe(
+            "write", time.perf_counter() - t0, trace_id=session.trace_id,
+            name="cs_write_bulk",
         )
         await ack(code)
 

@@ -27,7 +27,12 @@ import time as _time
 
 import numpy as np
 
-from lizardfs_tpu.constants import MFSBLOCKSIZE, MFSCHUNKSIZE
+from lizardfs_tpu.constants import (
+    EATTR_NOCACHE,
+    EATTR_NOENTRYCACHE,
+    MFSBLOCKSIZE,
+    MFSCHUNKSIZE,
+)
 from lizardfs_tpu.core import geometry, plans
 from lizardfs_tpu.core.encoder import ChunkEncoder, get_encoder
 from lizardfs_tpu.core.read_executor import ReadError, execute_plan
@@ -113,6 +118,11 @@ class Client:
         from collections import OrderedDict as _OD
 
         self._dentry: "_OD[tuple[int, str], tuple[int, float]]" = _OD()
+        # last-seen per-inode extra-attribute flags, learned from every
+        # attr-bearing reply (the Attr blob's trailing ``eattr``):
+        # EATTR_NOCACHE bypasses the block cache for the inode,
+        # EATTR_NOENTRYCACHE keeps it out of the dentry cache
+        self._eattr: dict[int, int] = {}
         # reusable stripe-scatter staging buffers, keyed (d, part_len):
         # a fresh 64 MiB allocation pays its page faults inside the
         # scatter copy (~2x measured cost); the write window keeps at
@@ -359,10 +369,26 @@ class Client:
             if tid:
                 fields.setdefault("trace_id", tid)
         try:
-            return await self.master.call_ok(msg_cls, **fields)
+            r = await self.master.call_ok(msg_cls, **fields)
         except (ConnectionError, asyncio.TimeoutError):
             await self._reconnect()
-            return await self.master.call_ok(msg_cls, **fields)
+            r = await self.master.call_ok(msg_cls, **fields)
+        self._note_eattr(getattr(r, "attr", None))
+        return r
+
+    def _note_eattr(self, attr) -> None:
+        """Track per-inode eattr flags from any attr-bearing reply so
+        cache paths can enforce NOCACHE/NOENTRYCACHE without a second
+        RPC. Zero flags still overwrite (a cleared flag must lift)."""
+        if attr is None or not getattr(attr, "inode", 0):
+            return
+        if len(self._eattr) > 65536:
+            # bound by dropping only UNFLAGGED entries: forgetting a
+            # zero costs nothing (0 is the default), while forgetting a
+            # NOCACHE/NOENTRYCACHE flag would silently re-enable the
+            # caches the flag forbids until the next attr reply
+            self._eattr = {k: v for k, v in self._eattr.items() if v}
+        self._eattr[attr.inode] = attr.eattr
 
     async def _reconnect(self) -> None:
         """Cycle the master address list with backoff until one accepts
@@ -579,6 +605,23 @@ class Client:
         await self._call(m.CltomaSetGoal, inode=inode, goal=goal,
                          uid=self._uid(uid))
 
+    async def geteattr(self, inode: int) -> int:
+        """Per-inode extra-attribute flags (constants.EATTR_*)."""
+        return (await self.getattr(inode)).eattr
+
+    async def seteattr(self, inode: int, eattr: int,
+                       uid: int | None = None) -> m.Attr:
+        """Set the inode's extra-attribute flags wholesale (the CLI's
+        +flag/-flag arithmetic happens client-side over geteattr)."""
+        r = await self._call(
+            m.CltomaSetEattr, inode=inode, eattr=eattr, uid=self._uid(uid)
+        )
+        if eattr & EATTR_NOCACHE:
+            # stop serving already-cached blocks the moment the flag
+            # lands — the flag forbids the cache, not just new fills
+            self.cache.invalidate(inode)
+        return r.attr
+
     async def truncate(self, inode: int, length: int, uid: int | None = None,
                        gids: list[int] | None = None) -> m.Attr:
         r = await self._call(
@@ -632,7 +675,9 @@ class Client:
                 parent = hit[0]
                 continue
             attr = await self.lookup(parent, comp)
-            if attr.ftype == m.FTYPE_DIR:
+            if attr.ftype == m.FTYPE_DIR and not (
+                attr.eattr & EATTR_NOENTRYCACHE
+            ):
                 self._dentry[(parent, comp)] = (
                     attr.inode, now + self.DENTRY_TTL
                 )
@@ -1752,8 +1797,13 @@ class Client:
         # bulk reads skip the block cache entirely: probing + filling it
         # costs a per-64KiB-block copy, and streaming workloads would
         # only evict it anyway (the reference's readcache is similarly
-        # bypassed by its readahead path for large requests)
-        bulk = size >= self.CACHE_BYPASS_BYTES
+        # bypassed by its readahead path for large requests). An inode
+        # flagged EATTR_NOCACHE takes the same bypass for every read —
+        # its bytes must never be served from or land in the cache
+        bulk = (
+            size >= self.CACHE_BYPASS_BYTES
+            or bool(self._eattr.get(inode, 0) & EATTR_NOCACHE)
+        )
         lo_b = off // MFSBLOCKSIZE
         hi_b = (off + size - 1) // MFSBLOCKSIZE
         if not bulk:

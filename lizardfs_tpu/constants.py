@@ -39,3 +39,18 @@ EC_MAX_PARITY = 32
 # XOR goal bounds (src/common/slice_traits.h:99-100).
 XOR_MIN_LEVEL = 2
 XOR_MAX_LEVEL = 9
+
+# Per-inode extra-attribute flags (reference: MFSCommunication.h EATTR_*
+# subset; `lizardfs geteattr`/`seteattr`): NOOWNER makes every uid act
+# as the owner for permission checks; NOCACHE forbids client-side data
+# caching of the inode's blocks; NOENTRYCACHE forbids caching its
+# lookup/attr entries (dentry + NFS attr/access caches).
+EATTR_NOOWNER = 0x01
+EATTR_NOCACHE = 0x02
+EATTR_NOENTRYCACHE = 0x04
+
+EATTR_NAMES = {
+    "noowner": EATTR_NOOWNER,
+    "nocache": EATTR_NOCACHE,
+    "noentrycache": EATTR_NOENTRYCACHE,
+}

@@ -53,6 +53,9 @@ class Node:
     ctime: int = 0
     goal: int = 1
     trash_time: int = 86400
+    # extra-attribute flags (constants.py EATTR_*): noowner / nocache /
+    # noentrycache — replicated via the "seteattr" changelog op
+    eattr: int = 0
     # files
     length: int = 0
     chunks: list[int] = field(default_factory=list)  # chunk ids by index, 0 = hole
@@ -96,6 +99,8 @@ class Node:
             "nlink": self.nlink,
             "parents": self.parents,
         }
+        if self.eattr:
+            d["eattr"] = self.eattr
         if self.xattrs:
             d["xattrs"] = {
                 k: base64.b64encode(v).decode() for k, v in self.xattrs.items()
@@ -422,6 +427,12 @@ class FsTree:
     def apply_setgoal(self, inode: int, goal: int, ts: int) -> Node:
         n = self.node(inode)
         n.goal = goal
+        n.ctime = ts
+        return n
+
+    def apply_seteattr(self, inode: int, eattr: int, ts: int) -> Node:
+        n = self.node(inode)
+        n.eattr = eattr & 0xFF
         n.ctime = ts
         return n
 

@@ -130,6 +130,9 @@ class MetadataStore:
     def _op_setgoal(self, op):
         self.fs.apply_setgoal(op["inode"], op["goal"], op["ts"])
 
+    def _op_seteattr(self, op):
+        self.fs.apply_seteattr(op["inode"], op["eattr"], op["ts"])
+
     def _op_set_length(self, op):
         node = self.fs.file_node(op["inode"])
         delta = op["length"] - node.length
@@ -504,7 +507,7 @@ class MetadataStore:
             out |= {("node", op["inode"]), ("node", op["parent"]),
                     ("edge", op["parent"], op["name"]),
                     ("sustained", op["inode"])}
-        elif t in ("setattr", "setgoal", "set_chunk", "set_acl",
+        elif t in ("setattr", "setgoal", "seteattr", "set_chunk", "set_acl",
                    "set_rich_acl", "set_xattr"):
             out.add(("node", op["inode"]))
         elif t == "set_length":

@@ -135,10 +135,16 @@ class MasterServer(Daemon):
         self.lock_grace_seconds = lock_grace_seconds
         self._lock_grace: dict[int, float] = {}  # sid -> release deadline
         self.data_dir = data_dir
+        # flight-recorder incidents (breached-SLO trace captures) live
+        # beside the metadata image
+        self.slo.recorder.set_dir(os.path.join(data_dir, "incidents"))
         self.meta = MetadataStore()
         self.changelog = Changelog(data_dir)
         self.goals = goals or geometry.default_goals()
         self.cs_links: dict[int, _CsLink] = {}
+        # last health snapshot each chunkserver folded into a heartbeat
+        # (CstomaHeartbeat.health_json) — aggregated by cluster_health()
+        self.cs_health: dict[int, dict] = {}
         # tape server links (matotsserv.cc analog): ts_id -> writer/label
         self.ts_links: dict[int, dict] = {}
         self._next_ts_id = 1
@@ -614,15 +620,23 @@ class MasterServer(Daemon):
                     self.log.exception("client op %s failed", type(msg).__name__)
                     reply = self._error_reply(msg, st.EIO)
                 # request_log.h analog: per-op-type latency histograms
-                self.metrics.timing(type(msg).__name__).record(
-                    time.perf_counter() - t0
-                )
+                dt = time.perf_counter() - t0
+                self.metrics.timing(type(msg).__name__).record(dt)
                 # request-scoped tracing: RPCs carrying a trace id land
                 # in the span ring (dumped via admin `trace-dump`)
+                tid = getattr(msg, "trace_id", 0)
                 self.trace_ring.record(
-                    getattr(msg, "trace_id", 0), type(msg).__name__,
-                    tw0, time.time(), role="master",
+                    tid, type(msg).__name__, tw0, time.time(), role="master",
                 )
+                # SLO accounting: chunk grant/locate RPCs are the
+                # master's latency-critical class — a slow one breaches
+                # the "locate" objective and flight-records its trace
+                if isinstance(msg, (m.CltomaReadChunk, m.CltomaWriteChunk,
+                                    m.CltomaWriteChunkEnd)):
+                    self.slo.observe(
+                        "locate", dt, trace_id=tid,
+                        name=type(msg).__name__,
+                    )
                 if reply is not None:
                     await framing.send_message(writer, reply)
         finally:
@@ -743,9 +757,25 @@ class MasterServer(Daemon):
             cur = node.parents[0]
             hops += 1
 
+    def _owns(self, node, uid: int) -> bool:
+        """Ownership test for owner-gated ops (setgoal/seteattr/...):
+        root, the owner, or anyone when the inode carries
+        EATTR_NOOWNER (the flag makes every uid act as the owner)."""
+        from lizardfs_tpu.constants import EATTR_NOOWNER
+
+        return uid == 0 or uid == node.uid or bool(node.eattr & EATTR_NOOWNER)
+
     def _access_ok(self, node, uid: int, gids: list[int], want: int) -> bool:
         """One permission decision for every call site: RichACL if set,
-        else mode bits + POSIX ACL."""
+        else mode bits + POSIX ACL. EATTR_NOOWNER short-circuits to the
+        owner's view for every caller."""
+        from lizardfs_tpu.constants import EATTR_NOOWNER
+
+        if node.eattr & EATTR_NOOWNER and uid != 0:
+            # evaluate as if the caller were the owner (mode/ACL owner
+            # entries apply); root keeps its usual path below
+            uid = node.uid
+            gids = [node.gid]
         if node.rich_acl is not None:
             from lizardfs_tpu.master.richacl import RichAcl
 
@@ -818,7 +848,7 @@ class MasterServer(Daemon):
         "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
         "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
         "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
-        "CltomaSetRichAcl",
+        "CltomaSetRichAcl", "CltomaSetEattr",
     )
 
     _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
@@ -1014,10 +1044,23 @@ class MasterServer(Daemon):
             if msg.goal not in self.goals:
                 return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
             node = fs.node(msg.inode)
-            if msg.uid != 0 and msg.uid != node.uid:
+            if not self._owns(node, msg.uid):
                 raise fsmod.FsError(st.EPERM, "setgoal requires ownership")
             self.commit({"op": "setgoal", "inode": msg.inode, "goal": msg.goal, "ts": now})
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaSetEattr):
+            from lizardfs_tpu import constants as consts
+
+            if msg.eattr & ~sum(consts.EATTR_NAMES.values()):
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.EINVAL)
+            node = fs.node(msg.inode)
+            if not self._owns(node, msg.uid):
+                raise fsmod.FsError(st.EPERM, "seteattr requires ownership")
+            self.commit({
+                "op": "seteattr", "inode": msg.inode, "eattr": msg.eattr,
+                "ts": now,
+            })
+            return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaSetattr):
             node = fs.node(msg.inode)
             caller = getattr(msg, "caller_uid", 0)
@@ -1025,7 +1068,7 @@ class MasterServer(Daemon):
                 if msg.set_mask & (2 | 4):
                     # chown/chgrp are root-only
                     raise fsmod.FsError(st.EPERM, "chown requires root")
-                if caller != node.uid:
+                if not self._owns(node, caller):
                     # mode/times/trash-time changes need ownership
                     raise fsmod.FsError(st.EPERM, f"inode {msg.inode}")
             self.commit({
@@ -1802,6 +1845,16 @@ class MasterServer(Daemon):
                 elif isinstance(msg, m.CstomaHeartbeat):
                     srv.total_space = msg.total_space
                     srv.used_space = msg.used_space
+                    if getattr(msg, "health_json", ""):
+                        # health rollup input: the CS's SLO burn/stall/
+                        # disk snapshot rides the heartbeat (old peers
+                        # send "" and stay health-unknown)
+                        try:
+                            self.cs_health[srv.cs_id] = json.loads(
+                                msg.health_json
+                            )
+                        except ValueError:
+                            pass
                     await framing.send_message(
                         writer, m.MatocsRegisterReply(
                             req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
@@ -1820,6 +1873,10 @@ class MasterServer(Daemon):
                         )
         finally:
             self.cs_links.pop(srv.cs_id, None)
+            # drop the health snapshot with the link: a dead server's
+            # frozen burn/breach figures must not haunt the rollup (a
+            # reconnect re-registers and heartbeats fresh state)
+            self.cs_health.pop(srv.cs_id, None)
             link.fail_all()
             affected = self.meta.registry.server_disconnected(srv.cs_id)
             for cid in affected:
@@ -2045,6 +2102,25 @@ class MasterServer(Daemon):
         self.metrics.gauge("sustained_files").set(
             len(self.meta.fs.sustained)
         )
+        # cluster health rollup as derived Prometheus gauges: status
+        # (0 ok / 1 degraded / 2 critical), fleet-wide SLO breach total,
+        # and how many registered chunkservers report unhealthy/absent
+        report = self.cluster_health(evaluate_chunks=False)
+        from lizardfs_tpu.runtime import slo as slomod
+
+        self.metrics.gauge(
+            "cluster_health_status",
+            help="aggregated cluster health: 0 ok, 1 degraded, 2 critical",
+        ).set(slomod.STATUS_ORDER.index(report["status"]))
+        self.metrics.gauge(
+            "cluster_slo_breaches",
+            help="SLO breaches across master + all reporting chunkservers",
+        ).set(report["summary"]["breaches_total"])
+        self.metrics.gauge(
+            "cluster_cs_unhealthy",
+            help="registered chunkservers down or reporting degraded/"
+                 "critical health",
+        ).set(report["summary"]["cs_unhealthy"])
         # released chunks: delete their on-disk parts
         drained = self.meta.registry.pending_deletes[:16]
         del self.meta.registry.pending_deletes[:16]
@@ -2471,7 +2547,92 @@ class MasterServer(Daemon):
             reply = await self._admin_command(msg)
             await framing.send_message(writer, reply)
 
+    def cluster_health(self, evaluate_chunks: bool = True) -> dict:
+        """The cluster-wide health rollup: this master's own snapshot,
+        every chunkserver's heartbeat-folded snapshot, and chunk-level
+        danger, aggregated to one status. ``evaluate_chunks=False``
+        skips the O(chunks) endangered/lost evaluation (the per-tick
+        gauge path) and uses the endangered queue length instead."""
+        from lizardfs_tpu.runtime import slo as slomod
+
+        master_snap = self.health_snapshot()
+        endangered = lost = 0
+        if evaluate_chunks:
+            # /health is a probe endpoint monitors may poll every few
+            # seconds; the full registry evaluation is O(chunks) on the
+            # event loop, so memoize it briefly — chunk danger moves at
+            # health-tick pace anyway
+            now = time.monotonic()
+            cached = getattr(self, "_chunk_danger_cache", None)
+            if cached is not None and now - cached[0] < 5.0:
+                endangered, lost = cached[1], cached[2]
+            else:
+                for chunk in self.meta.registry.chunks.values():
+                    state = self.meta.registry.evaluate(chunk)
+                    if not state.is_readable:
+                        lost += 1
+                    elif state.is_endangered or state.missing_parts:
+                        endangered += 1
+                self._chunk_danger_cache = (now, endangered, lost)
+        else:
+            endangered = len(self.meta.registry.endangered)
+        servers = {}
+        cs_unhealthy = 0
+        breaches = master_snap.get("breaches_total", 0)
+        worst_burn = 0.0
+        for s in self.meta.registry.servers.values():
+            snap = dict(self.cs_health.get(s.cs_id, {}))
+            snap["connected"] = s.connected
+            if not s.connected:
+                # "down" is the whole signal for a dead server: its
+                # last snapshot's burn/breach figures are frozen at
+                # heartbeat age and must not keep inflating the fleet
+                # aggregates (burn decays, frozen values don't)
+                snap = {"connected": False, "status": "down"}
+                cs_unhealthy += 1
+            elif not snap.get("status"):
+                snap["status"] = "unknown"  # old peer: no health in hb
+            elif snap["status"] != "ok":
+                cs_unhealthy += 1
+            if s.connected:
+                breaches += snap.get("breaches_total", 0)
+                for cls in snap.get("slo", {}).values():
+                    worst_burn = max(worst_burn, cls.get("burn_fast", 0.0))
+            servers[s.cs_id] = snap
+        status = master_snap["status"]
+        for snap in servers.values():
+            if snap["status"] == "down":
+                status = slomod.worst_status(status, "degraded")
+            elif snap["status"] != "unknown":
+                status = slomod.worst_status(status, snap["status"])
+        if endangered:
+            status = slomod.worst_status(status, "degraded")
+        if lost:
+            status = slomod.worst_status(status, "critical")
+        for cls in master_snap.get("slo", {}).values():
+            worst_burn = max(worst_burn, cls.get("burn_fast", 0.0))
+        return {
+            "status": status,
+            "master": master_snap,
+            "chunkservers": servers,
+            "summary": {
+                "endangered": endangered,
+                "lost": lost,
+                "cs_unhealthy": cs_unhealthy,
+                "breaches_total": breaches,
+                "worst_burn_fast": round(worst_burn, 3),
+            },
+        }
+
     async def _admin_command(self, msg: m.AdminCommand) -> m.AdminReply:
+        if msg.command == "health":
+            # cluster-wide rollup (overrides the base daemon's
+            # single-process snapshot): one command answers "is the
+            # cluster healthy" — also served at the webui /health
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self.cluster_health()),
+            )
         basic = self.handle_admin_basics(msg)
         if basic is not None:
             return basic
@@ -2565,7 +2726,7 @@ def _attr_of(node) -> m.Attr:
         inode=node.inode, ftype=node.ftype, mode=node.mode, uid=node.uid,
         gid=node.gid, atime=node.atime, mtime=node.mtime, ctime=node.ctime,
         nlink=node.nlink, length=node.length, goal=node.goal,
-        trash_time=node.trash_time,
+        trash_time=node.trash_time, eattr=node.eattr,
     )
 
 

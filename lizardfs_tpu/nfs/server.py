@@ -25,11 +25,14 @@ import time
 
 from lizardfs_tpu.client.cache import ReadaheadAdviser
 from lizardfs_tpu.client.client import Client
-from lizardfs_tpu.constants import MFSBLOCKSIZE
+from lizardfs_tpu.constants import EATTR_NOENTRYCACHE, MFSBLOCKSIZE
 from lizardfs_tpu.nfs import rpc
 from lizardfs_tpu.nfs.xdr import Packer, Unpacker
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import slo as slomod
+from lizardfs_tpu.runtime import tracing
+from lizardfs_tpu.runtime.metrics import Metrics
 from lizardfs_tpu.runtime.tweaks import Tweaks
 
 log = logging.getLogger("lizardfs.nfs")
@@ -311,6 +314,19 @@ class NfsGateway:
         self.tweaks = Tweaks()
         self._meta_ttl = self.tweaks.register("meta_ttl_s", 1.0)
         self.client.cache.add_invalidate_listener(self._on_invalidate)
+        # NFS joins the trace domain: every dispatched proc begins (or
+        # joins) a trace at the wire boundary, so the id propagates
+        # through the shared Client into master RPCs and the data
+        # plane — the last anonymous entry point closed. The op's
+        # boundary span lands in the client's ring under role "nfs".
+        # The "nfs" SLO class accounts per-proc latency; the registry
+        # is gateway-local (no admin port on the gateway), the flight
+        # recorder's slowops stay queryable in-process.
+        self.metrics = Metrics()
+        self.slo = slomod.SloEngine(
+            self.metrics, role="nfs",
+            span_source=self.client.trace_ring.dump,
+        )
 
     @property
     def port(self) -> int:
@@ -494,12 +510,28 @@ class NfsGateway:
         handler = self._PROCS.get(proc)
         if handler is None:
             raise rpc.ProcUnavail
+        # trace boundary: the NFS proc is the request's root — the id
+        # issued here rides every client->master RPC and data-plane
+        # frame this op triggers (tracing.begin joins a caller-held
+        # trace, which never exists on a fresh RPC task)
+        tid, fresh = tracing.begin()
+        name = "nfs_" + handler.__name__.removeprefix("_proc_")
+        t0 = time.perf_counter()
+        tw0 = time.time()
         try:
             return await handler(self, cred, u)
         except _NfsError as e:
             return self._plain_error(proc, e.code)
         except st.StatusError as e:
             return self._plain_error(proc, _nfs_code(e))
+        finally:
+            self.client.trace_ring.record(
+                tid, name, tw0, time.time(), role="nfs"
+            )
+            self.slo.observe(
+                "nfs", time.perf_counter() - t0, trace_id=tid, name=name
+            )
+            tracing.end(fresh)
 
     def _plain_error(self, proc: int, code: int) -> bytes:
         """Error reply with empty/absent optional attr fields, shaped per
@@ -535,9 +567,23 @@ class NfsGateway:
 
     async def _attr(self, inode: int) -> m.Attr:
         e = self._attr_cache.get(inode)
-        if e is not None and time.monotonic() - e[1] <= self.META_TTL_S:
+        if (
+            e is not None
+            and time.monotonic() - e[1] <= self.META_TTL_S
+            # serve-time flag check: a snapshot cached BEFORE a
+            # seteattr flagged the inode must stop being served now,
+            # not at TTL expiry
+            and not (
+                self.client._eattr.get(inode, 0) & EATTR_NOENTRYCACHE
+            )
+        ):
             return e[0]
         attr = await self.client.getattr(inode)
+        if attr.eattr & EATTR_NOENTRYCACHE:
+            # the inode opted out of entry caching: serve fresh, keep
+            # any stale cached snapshot from resurfacing
+            self._attr_cache.pop(inode, None)
+            return attr
         self._attr_cache[inode] = (attr, time.monotonic())
         if len(self._attr_cache) > 65536:
             self._attr_cache.clear()  # crude bound; refills on demand
@@ -550,6 +596,19 @@ class NfsGateway:
             return None
 
     async def _access(self, inode: int, cred, mask: int) -> bool:
+        # entry-cache opt-out covers access decisions too. The flag
+        # comes from the client's _eattr map (fed by every attr reply;
+        # NFS procs fetch post-op attrs constantly, so it is hot for
+        # any inode a client touches) — checked BEFORE serving so
+        # decisions cached before a seteattr stop being served, and
+        # any stale sub-cache is dropped on the spot
+        if self.client._eattr.get(inode, 0) & EATTR_NOENTRYCACHE:
+            dropped = self._access_cache.pop(inode, None)
+            if dropped:
+                self._access_cache_n -= len(dropped)
+            return await self.client.access(
+                inode, cred.uid, cred.all_gids, mask
+            )
         sub = self._access_cache.get(inode)
         key = (cred.uid, tuple(cred.all_gids), mask)
         now = time.monotonic()

@@ -106,6 +106,49 @@ def _unpack_value(ftype: str, buf: memoryview, off: int) -> tuple[Any, int]:
     raise TypeError(f"unknown field type {ftype!r}")
 
 
+def _tail_elides(cls) -> bool:
+    """Does this message's encoding have a skew-variable length (its
+    own optional tail, or transitively via a terminal nested message)?"""
+    if cls.SKEW_TOLERANT_FROM is not None:
+        return True
+    if cls.FIELDS:
+        _, ftype = cls.FIELDS[-1]
+        if ftype.startswith("msg:"):
+            inner = _MESSAGE_CLASSES.get(ftype[4:])
+            return inner is not None and _tail_elides(inner)
+    return False
+
+
+def _nested_msg_refs(cls):
+    """Yield (inner class name, is_nonterminal) for every nested-message
+    field; list elements are never buffer-terminal."""
+    for i, (_, ftype) in enumerate(cls.FIELDS):
+        if ftype.startswith("list:msg:"):
+            yield ftype[9:], True
+        elif ftype.startswith("msg:"):
+            yield ftype[4:], i != len(cls.FIELDS) - 1
+
+
+def _check_skew_nesting(cls) -> None:
+    for inner_name, nonterminal in _nested_msg_refs(cls):
+        inner = _MESSAGE_CLASSES.get(inner_name)
+        if inner is not None and nonterminal and _tail_elides(inner):
+            raise TypeError(
+                f"{cls.__name__}: skew-tolerant {inner_name} may only be "
+                "nested as the final field (its optional tail elides)"
+            )
+    if _tail_elides(cls):
+        # the other definition order: this class just became
+        # variable-length; nobody may already nest it non-terminally
+        for other in _MESSAGE_CLASSES.values():
+            for inner_name, nonterminal in _nested_msg_refs(other):
+                if inner_name == cls.__name__ and nonterminal:
+                    raise TypeError(
+                        f"{other.__name__} nests skew-tolerant "
+                        f"{cls.__name__} non-terminally"
+                    )
+
+
 class Message:
     """Base class; subclasses define MSG_TYPE (int or None) and FIELDS."""
 
@@ -131,6 +174,15 @@ class Message:
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
+        # skew-nesting guard (registration-time, zero hot-path cost):
+        # pack_body elides default-valued optional trailing fields, so
+        # a message with a skew-variable tail has no fixed encoded
+        # length — it may only be nested as the LAST field of its
+        # container (where the decoder's off==len(buf) default-fill
+        # applies). Nesting one non-terminally (or in a list) would
+        # silently misalign every field after it; fail the class
+        # definition instead.
+        _check_skew_nesting(cls)
         _MESSAGE_CLASSES[cls.__name__] = cls
         if cls.MSG_TYPE is not None:
             existing = _TYPE_REGISTRY.get(cls.MSG_TYPE)

@@ -34,8 +34,14 @@ class Addr(Message):
 
 
 class Attr(Message):
-    """File attributes (subset of the reference's 35-byte attr blob)."""
+    """File attributes (subset of the reference's 35-byte attr blob).
 
+    ``eattr`` (trailing, skew-tolerant): the per-inode extra-attribute
+    flags (EATTR_NOOWNER/NOCACHE/NOENTRYCACHE, constants.py) — carried
+    on every attr reply so clients can enforce cache semantics without
+    an extra RPC; peers predating the field read/serve 0."""
+
+    SKEW_TOLERANT_FROM = 12
     FIELDS = (
         ("inode", "u32"),
         ("ftype", "u8"),  # 1=file, 2=directory, 3=symlink
@@ -49,6 +55,7 @@ class Attr(Message):
         ("length", "u64"),
         ("goal", "u8"),
         ("trash_time", "u32"),
+        ("eattr", "u8"),
     )
 
 
@@ -207,6 +214,20 @@ class CltomaSetGoal(Message):
         ("req_id", "u32"),
         ("inode", "u32"),
         ("goal", "u8"),
+        ("uid", "u32"),
+    )
+
+
+class CltomaSetEattr(Message):
+    """Set the per-inode extra-attribute flags (geteattr reads them
+    from any attr reply's trailing ``eattr``). Replied with
+    MatoclAttrReply carrying the updated attr."""
+
+    MSG_TYPE = 1070
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("eattr", "u8"),
         ("uid", "u32"),
     )
 
@@ -680,12 +701,20 @@ class MatocsRegisterReply(Message):
 
 
 class CstomaHeartbeat(Message):
+    """``health_json`` (trailing, skew-tolerant): the chunkserver's
+    health snapshot (runtime/slo.py health_from — SLO burn, stall
+    hits, span drops, disk errors) folded into the heartbeat so the
+    master's cluster `health` rollup needs no extra link; an old peer
+    sends/receives "" and reads as health-unknown."""
+
     MSG_TYPE = 1102
+    SKEW_TOLERANT_FROM = 4
     FIELDS = (
         ("req_id", "u32"),
         ("cs_id", "u32"),
         ("total_space", "u64"),
         ("used_space", "u64"),
+        ("health_json", "str"),
     )
 
 

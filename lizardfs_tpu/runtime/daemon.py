@@ -13,6 +13,7 @@ import logging
 import signal
 import sys
 
+from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import Metrics
 from lizardfs_tpu.runtime.tweaks import Tweaks
@@ -47,6 +48,23 @@ class Daemon:
         # link via `trace-dump` and merged client-side into per-request
         # timelines (runtime/tracing.py)
         self.trace_ring = tracing.SpanRing()
+        # silent trace loss under load must be visible: ring evictions
+        # ride /metrics as lizardfs_span_ring_dropped_total
+        self.trace_ring.attach_drop_counter(
+            self.metrics.counter(
+                "span_ring_dropped",
+                help="trace spans evicted from the bounded span ring "
+                     "before any dump read them",
+            )
+        )
+        # SLO engine + flight recorder (runtime/slo.py): per-op-class
+        # latency objectives whose burn rates/breach counts live in this
+        # registry; breaches auto-capture their trace timeline.
+        # Subclasses with a disk home point the recorder at an
+        # incidents/ dir (slo.recorder.set_dir)
+        self.slo = slomod.SloEngine(
+            self.metrics, role=self.name, span_source=self.trace_spans
+        )
         # challenge-response admin password (None = open admin port)
         self.admin_password: str | None = None
         self.add_timer(1.0, self._sample_metrics)
@@ -117,6 +135,9 @@ class Daemon:
     async def _sample_metrics(self) -> None:
         self.metrics.gauge("loop_lag_ms").set(self._wd_max_lag * 1000)
         self._wd_max_lag = 0.0
+        # burn gauges must decay with the windows, not freeze at the
+        # last observed value when traffic stops
+        self.slo.refresh_gauges()
         self.metrics.sample_all()
 
     def handle_admin_basics(self, msg) -> object | None:
@@ -211,11 +232,28 @@ class Daemon:
                 return m.AdminReply(
                     req_id=msg.req_id, status=st.EINVAL, json="{}"
                 )
+            spans = self.trace_spans(trace_id or None)
+            if trace_id and not spans:
+                # flight-recorder fallback: a breached op's spans were
+                # captured into the incident ring at breach time, so
+                # any id listed by `slowops` renders even after the
+                # live span ring moved on
+                spans = self.slo.recorder.incident_spans(trace_id) or []
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
-                json=json.dumps(
-                    {"spans": self.trace_spans(trace_id or None)}
-                ),
+                json=json.dumps({"spans": spans}),
+            )
+        if command == "slowops":
+            # in-memory top-N slowest ops (flight recorder); each entry
+            # names the trace id `trace-dump` renders
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({"slowops": self.slo.recorder.slowops()}),
+            )
+        if command == "health":
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self.health_snapshot()),
             )
         if getattr(msg, "command", None) == "tweaks":
             return m.AdminReply(
@@ -240,6 +278,25 @@ class Daemon:
         the ring (the chunkserver's native data plane) fold them in
         here before dumping."""
         return self.trace_ring.dump(trace_id)
+
+    def health_snapshot(self) -> dict:
+        """This daemon's health: SLO burn + stall/span-drop/disk
+        signals (runtime/slo.py health_from). Subclasses extend via
+        ``_health_extra``; the master aggregates the fleet's snapshots
+        into the cluster `health` rollup."""
+        return slomod.health_from(
+            self.name, self.slo,
+            loop_stalls=self.metrics.counter("loop_stalls").total,
+            span_ring_dropped=self.trace_ring.dropped,
+            disk_errors=self._health_disk_errors(),
+            extra=self._health_extra(),
+        )
+
+    def _health_disk_errors(self) -> int:
+        return 0
+
+    def _health_extra(self) -> dict:
+        return {}
 
     # --- admin authentication (registered_admin_connection.cc analog) -------
     #

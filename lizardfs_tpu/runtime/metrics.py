@@ -50,6 +50,12 @@ def _prom_value(v: float) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
+def _prom_help(text: str) -> str:
+    """Escape a HELP string per exposition format 0.0.4 (backslash and
+    line feed are the only escapes on HELP lines)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Series:
     def __init__(self, name: str, kind: str = "counter"):
         self.name = name
@@ -174,26 +180,40 @@ class Metrics:
         self.series: dict[str, Series] = {}
         self.derived: dict[str, str] = {}  # name -> RPN expression
         self.timings: dict[str, Timing] = {}
+        # per-series HELP text (Prometheus exposition); series without
+        # an explicit entry export an auto-generated line so every
+        # scraped metric carries help (the metrics-lint contract)
+        self.help: dict[str, str] = {}
 
-    def timing(self, name: str) -> Timing:
+    def describe(self, name: str, help: str | None) -> None:
+        if help:
+            self.help[name] = help
+
+    def help_for(self, name: str, kind: str = "series") -> str:
+        return self.help.get(name) or f"lizardfs {kind} {name}"
+
+    def timing(self, name: str, help: str | None = None) -> Timing:
         t = self.timings.get(name)
         if t is None:
             t = self.timings[name] = Timing(name)
+        self.describe(name, help)
         return t
 
-    def counter(self, name: str) -> Series:
+    def counter(self, name: str, help: str | None = None) -> Series:
         s = self.series.get(name)
         if s is None:
             s = self.series[name] = Series(name, "counter")
+        self.describe(name, help)
         return s
 
-    def gauge(self, name: str) -> Series:
+    def gauge(self, name: str, help: str | None = None) -> Series:
         s = self.series.get(name)
         if s is None:
             s = self.series[name] = Series(name, "gauge")
+        self.describe(name, help)
         return s
 
-    def define(self, name: str, expr: str) -> None:
+    def define(self, name: str, expr: str, help: str | None = None) -> None:
         """Register a derived series: RPN over series names/constants,
         e.g. ``"bytes_read bytes_written ADD"``. Validated eagerly by a
         full evaluation (shape errors, unknown names, nesting depth)."""
@@ -201,6 +221,7 @@ class Metrics:
             raise ValueError(f"{name!r} is an existing series")
         self.eval_rpn(expr)  # raises ValueError on malformed exprs
         self.derived[name] = expr
+        self.describe(name, help)
 
     def sample_all(self, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -290,25 +311,33 @@ class Metrics:
         endpoint and over the admin link (``metrics-prom``)."""
         lines: list[str] = []
 
-        def emit(name: str, mtype: str, value, suffix: str = "") -> None:
+        def emit(name: str, mtype: str, value, help_text: str = "",
+                 suffix: str = "") -> None:
+            lines.append(f"# HELP {name} {_prom_help(help_text or name)}")
             lines.append(f"# TYPE {name} {mtype}")
             lines.append(f"{name}{suffix} {_prom_value(value)}")
 
         for name, s in sorted(self.series.items()):
             pname = f"{prefix}_{_prom_name(name)}"
             if s.kind == "counter":
-                emit(pname + "_total", "counter", s.total)
+                emit(pname + "_total", "counter", s.total,
+                     self.help_for(name, "counter"))
             else:
-                emit(pname, "gauge", s.value)
+                emit(pname, "gauge", s.value, self.help_for(name, "gauge"))
         for name, expr in sorted(self.derived.items()):
             pname = f"{prefix}_{_prom_name(name)}"
             try:
                 points = self.eval_rpn(expr)
             except ValueError:
                 continue  # a bad redefinition must not poison the page
-            emit(pname, "gauge", points[-1] if points else 0.0)
+            emit(pname, "gauge", points[-1] if points else 0.0,
+                 self.help_for(name, "derived series"))
         for name, t in sorted(self.timings.items()):
             pname = f"{prefix}_timing_{_prom_name(name)}_us"
+            lines.append(
+                f"# HELP {pname} "
+                f"{_prom_help(self.help_for(name, 'latency histogram'))}"
+            )
             lines.append(f"# TYPE {pname} histogram")
             cum = 0
             for i, n in enumerate(t.buckets):

@@ -117,10 +117,25 @@ def clear_trace() -> None:
 class SpanRing:
     """Bounded in-memory span ring, one per daemon/client (the oplog
     model applied to spans). Records are plain dicts so dumps are
-    JSON-ready for the admin link."""
+    JSON-ready for the admin link.
+
+    ``dropped`` counts spans evicted by the bound — observability of
+    the observability layer: silent trace loss under load would
+    otherwise read as "the op recorded nothing". Daemons mirror it
+    into their registry as ``span_ring_dropped`` so it rides
+    ``/metrics`` (``lizardfs_span_ring_dropped_total``)."""
 
     def __init__(self, maxlen: int = 2048):
         self._ring: deque = deque(maxlen=maxlen)
+        self.dropped = 0
+        self._drop_counter = None  # optional Metrics counter mirror
+
+    def attach_drop_counter(self, counter) -> None:
+        """Mirror evictions into a ``Metrics`` counter (daemon wiring);
+        evictions that predate the attach are folded in once."""
+        self._drop_counter = counter
+        if self.dropped > counter.total:
+            counter.inc(self.dropped - counter.total)
 
     def record(
         self,
@@ -136,6 +151,10 @@ class SpanRing:
         which is what every call site passes when tracing is off."""
         if not trace_id:
             return 0
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped += 1
+            if self._drop_counter is not None:
+                self._drop_counter.inc()
         span_id = new_id()
         rec = {
             "trace_id": trace_id,

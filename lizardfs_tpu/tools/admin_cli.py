@@ -75,7 +75,7 @@ async def _amain(argv) -> int:
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
-            "trace-dump",
+            "trace-dump", "health", "slowops",
         ],
     )
     p.add_argument("extra", nargs="*",
@@ -136,7 +136,18 @@ async def _amain(argv) -> int:
               file=sys.stderr)
         return 1
     doc = json.loads(reply.json) if reply.json else {}
-    if cmd == "list-chunkservers":
+    if cmd == "health":
+        _print_health(doc)
+    elif cmd == "slowops":
+        for e in doc.get("slowops", []):
+            cap = "captured" if e.get("captured") else "uncaptured"
+            print(
+                f"{e['ms']:>10.1f} ms  {e['op_class']:<10s} "
+                f"{e['name']:<20s} trace 0x{e['trace_id']:x}  ({cap})"
+            )
+        if not doc.get("slowops"):
+            print("(no SLO breaches recorded)")
+    elif cmd == "list-chunkservers":
         for srv in doc.get("chunkservers", []):
             state = "up" if srv["connected"] else "DOWN"
             used = srv["used_space"] / 2**30
@@ -151,6 +162,48 @@ async def _amain(argv) -> int:
     else:
         print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
+
+
+def _print_health(doc: dict) -> None:
+    """Render a health report: the master's cluster rollup, or a single
+    daemon's snapshot when pointed at a chunkserver."""
+    if "summary" not in doc:  # single-daemon snapshot
+        print(f"{doc.get('role', '?')}: {doc.get('status', '?')}")
+        for cls, s in sorted(doc.get("slo", {}).items()):
+            print(
+                f"  slo {cls:<10s} {s['status']:<9s} "
+                f"burn {s['burn_fast']:.2f}/{s['burn_slow']:.2f}  "
+                f"breaches {s['breaches']}/{s['ops']}"
+            )
+        print(
+            f"  stalls {doc.get('loop_stalls', 0)}  "
+            f"span-drops {doc.get('span_ring_dropped', 0)}  "
+            f"disk-errors {doc.get('disk_errors', 0)}"
+        )
+        return
+    s = doc["summary"]
+    print(
+        f"cluster: {doc['status'].upper()}  "
+        f"(endangered {s['endangered']}, lost {s['lost']}, "
+        f"cs-unhealthy {s['cs_unhealthy']}, "
+        f"breaches {s['breaches_total']}, "
+        f"worst-burn {s['worst_burn_fast']:.2f})"
+    )
+    master = doc.get("master", {})
+    print(
+        f"  master        {master.get('status', '?'):<9s} "
+        f"breaches {master.get('breaches_total', 0)}  "
+        f"stalls {master.get('loop_stalls', 0)}  "
+        f"span-drops {master.get('span_ring_dropped', 0)}"
+    )
+    for cs_id, snap in sorted(doc.get("chunkservers", {}).items(),
+                              key=lambda kv: int(kv[0])):
+        print(
+            f"  cs{cs_id:<12s} {snap.get('status', '?'):<9s} "
+            f"breaches {snap.get('breaches_total', 0)}  "
+            f"stalls {snap.get('loop_stalls', 0)}  "
+            f"disk-errors {snap.get('disk_errors', 0)}"
+        )
 
 
 def main(argv=None) -> int:

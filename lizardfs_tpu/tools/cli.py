@@ -7,8 +7,8 @@ library (no FUSE mount needed):
     python -m lizardfs_tpu.tools.cli --master host:port <command> [...]
 
 Commands: ls, mkdir, rmdir, rm, mv, ln, symlink, readlink, put, get,
-cat, stat, setgoal, getgoal, settrashtime, gettrashtime, fileinfo,
-dirinfo, checkfile, rremove, truncate.
+cat, stat, setgoal, getgoal, geteattr, seteattr, settrashtime,
+gettrashtime, fileinfo, dirinfo, checkfile, rremove, truncate.
 """
 
 from __future__ import annotations
@@ -163,6 +163,7 @@ async def cmd_stat(c: Client, args) -> int:
         "inode": a.inode, "type": a.ftype, "mode": oct(a.mode),
         "uid": a.uid, "gid": a.gid, "nlink": a.nlink, "length": a.length,
         "goal": a.goal, "trash_time": a.trash_time,
+        "eattr": _eattr_flags(a.eattr),
         "atime": a.atime, "mtime": a.mtime, "ctime": a.ctime,
     }, indent=2))
     return 0
@@ -177,6 +178,42 @@ async def cmd_setgoal(c: Client, args) -> int:
 async def cmd_getgoal(c: Client, args) -> int:
     a = await c.resolve(args.path)
     print(f"{args.path}: goal {a.goal}")
+    return 0
+
+
+def _eattr_flags(eattr: int) -> str:
+    from lizardfs_tpu.constants import EATTR_NAMES
+
+    names = [n for n, bit in EATTR_NAMES.items() if eattr & bit]
+    return ",".join(names) if names else "-"
+
+
+async def cmd_geteattr(c: Client, args) -> int:
+    a = await c.resolve(args.path)
+    print(f"{args.path}: eattr {_eattr_flags(a.eattr)}")
+    return 0
+
+
+async def cmd_seteattr(c: Client, args) -> int:
+    """FLAGS: comma list of [+|-]name over noowner/nocache/noentrycache.
+    Bare names replace the whole set; +name/-name edit the current one
+    (mfsseteattr -f style)."""
+    from lizardfs_tpu.constants import EATTR_NAMES
+
+    a = await c.resolve(args.path)
+    tokens = [t.strip() for t in args.flags.split(",") if t.strip()]
+    relative = all(t[0] in "+-" for t in tokens) and tokens
+    eattr = a.eattr if relative else 0
+    for t in tokens:
+        op, name = (t[0], t[1:]) if t[0] in "+-" else ("+", t)
+        bit = EATTR_NAMES.get(name)
+        if bit is None:
+            print(f"unknown eattr flag {name!r} "
+                  f"(known: {', '.join(EATTR_NAMES)})", file=sys.stderr)
+            return 2
+        eattr = (eattr | bit) if op == "+" else (eattr & ~bit)
+    attr = await c.seteattr(a.inode, eattr)
+    print(f"{args.path}: eattr {_eattr_flags(attr.eattr)}")
     return 0
 
 
@@ -449,6 +486,8 @@ COMMANDS = {
     "stat": (cmd_stat, [("path", {})]),
     "setgoal": (cmd_setgoal, [("goal", {"type": int}), ("path", {})]),
     "getgoal": (cmd_getgoal, [("path", {})]),
+    "geteattr": (cmd_geteattr, [("path", {})]),
+    "seteattr": (cmd_seteattr, [("flags", {}), ("path", {})]),
     "settrashtime": (cmd_settrashtime, [("seconds", {"type": int}), ("path", {})]),
     "gettrashtime": (cmd_gettrashtime, [("path", {})]),
     "truncate": (cmd_truncate, [("size", {"type": int}), ("path", {})]),

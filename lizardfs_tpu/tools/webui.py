@@ -8,7 +8,8 @@ live HTML dashboard plus raw JSON endpoints.
     python -m lizardfs_tpu.tools.webui --master 127.0.0.1:9420 --port 9425
 
 Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics,
-/metrics (Prometheus text exposition of the master's registry)
+/metrics (Prometheus text exposition of the master's registry),
+/health (cluster health rollup JSON — SLO burn, per-CS snapshots)
 """
 
 from __future__ import annotations
@@ -114,6 +115,15 @@ class Dashboard:
         return json.loads(
             self._call(
                 m.AdminCommand(req_id=1, command="chunks-health", json="{}")
+            ).json
+        )
+
+    def cluster_health(self) -> dict:
+        """The master's cluster-wide health rollup (SLO burn, breach
+        counts, per-chunkserver snapshots, endangered/lost chunks)."""
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="health", json="{}")
             ).json
         )
 
@@ -259,6 +269,13 @@ def make_handler(dash: Dashboard):
                     self._send(
                         dash.metrics_prom(),
                         "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/health":
+                    # cluster health rollup — the load-balancer/monitor
+                    # probe endpoint ("is the cluster healthy?")
+                    self._send(
+                        json.dumps(dash.cluster_health()),
+                        "application/json",
                     )
                 elif self.path == "/api/info":
                     self._send(json.dumps(dash.info()), "application/json")

@@ -65,6 +65,15 @@ def _fat_row() -> dict:
     row["cluster_dbench8_MBps_reps"] = [351.6, 330.3, 324.6]
     row["cluster_4k_read_native_us"] = 184.8
     row["cluster_4k_read_loop_us"] = 484.6
+    # slo/flight-recorder fiducials (PR 3): worst-case-ish shape — a
+    # degraded round with breaches in every class
+    row["cluster_health_status"] = "degraded"
+    row["cluster_slo_breaches"] = 1234
+    row["cluster_slow_ops"] = 48
+    row["cluster_slo_breaches_by_class"] = {
+        "read": 400, "write": 400, "locate": 234, "replicate": 100,
+        "nfs": 100,
+    }
     return row
 
 
@@ -78,6 +87,10 @@ def test_summary_line_fits_driver_tail():
     assert parsed["cluster_ec8_4_write_target_met"] is False
     assert "cluster_ec8_4_write_phases" in parsed
     assert parsed["cluster_ec8_4_write_trace"]["coverage_pct"] == 94.7
+    # slo fiducials ride the tail: noise attribution from the artifact
+    assert parsed["cluster_health_status"] == "degraded"
+    assert parsed["cluster_slo_breaches"] == 1234
+    assert parsed["cluster_slow_ops"] == 48
 
 
 def test_summary_budget_guard_drops_not_truncates():
@@ -101,3 +114,7 @@ def test_summary_keeps_targets_under_any_drop():
     # target verdicts are never on the drop ladder
     assert "cluster_ec8_4_write_target_met" in s
     assert "cluster_goal_2_2_copies_write_target_met" in s
+    # nor are the scalar slo fiducials (only the per-class split may
+    # drop under pressure)
+    assert "cluster_health_status" in s
+    assert "cluster_slo_breaches" in s

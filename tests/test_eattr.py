@@ -1,0 +1,186 @@
+"""Per-inode extra-attribute flags (geteattr/seteattr).
+
+Covers the full path: wire schema skew (trailing Attr.eattr), master
+op + changelog/image persistence, CLI verbs, and enforcement in the
+client cache paths (NOCACHE bypasses the block cache, NOENTRYCACHE
+keeps inodes out of the dentry + NFS attr caches, NOOWNER makes every
+uid act as the owner).
+"""
+
+import pytest
+
+from lizardfs_tpu.constants import (
+    EATTR_NOCACHE,
+    EATTR_NOENTRYCACHE,
+    EATTR_NOOWNER,
+)
+from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.proto import messages as m
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.tools import cli
+
+from tests.test_cluster import Cluster, make_goals
+
+
+def test_attr_eattr_version_skew():
+    """Old peers (no trailing eattr) decode as 0; an untraced new attr
+    packs byte-identically to the old schema."""
+    attr = m.Attr(
+        inode=5, ftype=1, mode=0o644, uid=0, gid=0, atime=0, mtime=0,
+        ctime=0, nlink=1, length=10, goal=1, trash_time=0,
+        eattr=EATTR_NOCACHE,
+    )
+    body = attr.pack_body()
+    old = body[:-1]
+    assert m.Attr.parse(old).eattr == 0
+    assert m.Attr.parse(body).eattr == EATTR_NOCACHE
+    plain = m.Attr(
+        inode=5, ftype=1, mode=0o644, uid=0, gid=0, atime=0, mtime=0,
+        ctime=0, nlink=1, length=10, goal=1, trash_time=0,
+    )
+    assert plain.eattr == 0 and plain.pack_body() == old
+
+
+@pytest.mark.asyncio
+async def test_seteattr_roundtrip_persistence_and_perms(tmp_path):
+    master = MasterServer(str(tmp_path / "master"), goals=make_goals())
+    await master.start()
+    c = Client("127.0.0.1", master.port)
+    await c.connect()
+    try:
+        f = await c.create(1, "flags.bin")
+        # root chowns it to 1000 so the ownership gate has a subject
+        await c.setattr(f.inode, 2 | 4, uid=1000, gid=1000)
+        assert await c.geteattr(f.inode) == 0
+        # non-owner non-root cannot set
+        with pytest.raises(st.StatusError) as e:
+            await c.seteattr(f.inode, EATTR_NOCACHE, uid=2000)
+        assert e.value.code == st.EPERM
+        # owner can; reply carries the updated attr
+        attr = await c.seteattr(
+            f.inode, EATTR_NOCACHE | EATTR_NOOWNER, uid=1000
+        )
+        assert attr.eattr == EATTR_NOCACHE | EATTR_NOOWNER
+        # with NOOWNER set, a stranger may now mutate owner-gated state
+        await c.seteattr(f.inode, EATTR_NOOWNER, uid=2000)
+        await c.setgoal(f.inode, 2, uid=2000)
+        # invalid bits are rejected
+        with pytest.raises(st.StatusError):
+            await c.seteattr(f.inode, 0x80)
+    finally:
+        await c.close()
+        await master.stop()  # dumps the image
+    # restart: the flag replayed from changelog/image
+    master2 = MasterServer(str(tmp_path / "master"), goals=make_goals())
+    await master2.start()
+    c2 = Client("127.0.0.1", master2.port)
+    await c2.connect()
+    try:
+        a = await c2.lookup(1, "flags.bin")
+        assert a.eattr == EATTR_NOOWNER and a.goal == 2
+    finally:
+        await c2.close()
+        await master2.stop()
+
+
+@pytest.mark.asyncio
+async def test_nocache_bypasses_block_cache(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        payload = b"n" * 200_000
+        cached = await c.create(1, "cached.bin")
+        await c.write_file(cached.inode, payload)
+        bypass = await c.create(1, "nocache.bin")
+        await c.write_file(bypass.inode, payload)
+        await c.seteattr(bypass.inode, EATTR_NOCACHE)
+        c.cache.invalidate(cached.inode)
+        c.cache.invalidate(bypass.inode)
+        # plain inode: a small read fills the block cache
+        assert await c.read_file(cached.inode, 0, 65536) == payload[:65536]
+        assert any(
+            k[0] == cached.inode for k in c.cache._entries
+        ), "control inode should have cached blocks"
+        # flagged inode: same read leaves the cache untouched
+        assert await c.read_file(bypass.inode, 0, 65536) == payload[:65536]
+        assert not any(k[0] == bypass.inode for k in c.cache._entries)
+        # and repeat reads never hit (they bypass the probe entirely)
+        hits = c.cache.hits
+        assert await c.read_file(bypass.inode, 0, 65536) == payload[:65536]
+        assert c.cache.hits == hits
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_noentrycache_keeps_dentry_out(tmp_path):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        d = await c.mkdir(1, "private")
+        await c.create(d.inode, "f.txt")
+        await c.resolve("/private/f.txt")
+        assert (1, "private") in c._dentry  # normally cached
+        await c.seteattr(d.inode, EATTR_NOENTRYCACHE)
+        c._dentry.clear()
+        await c.resolve("/private/f.txt")
+        assert (1, "private") not in c._dentry
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_noentrycache_in_nfs_attr_cache(tmp_path):
+    from lizardfs_tpu.nfs import server as nfs
+
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    gw = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw.start()
+    try:
+        plain = await gw.client.create(1, "plain.txt")
+        flagged = await gw.client.create(1, "flagged.txt")
+        await gw.client.seteattr(flagged.inode, EATTR_NOENTRYCACHE)
+        await gw._attr(plain.inode)
+        assert plain.inode in gw._attr_cache
+        await gw._attr(flagged.inode)
+        assert flagged.inode not in gw._attr_cache
+    finally:
+        await gw.stop()
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_cli_geteattr_seteattr(tmp_path, capsys):
+    cluster = Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    master = f"127.0.0.1:{cluster.master.port}"
+
+    async def run(*argv):
+        return await cli._amain(["--master", master, *argv])
+
+    try:
+        c = await cluster.client()
+        await c.create(1, "x.bin")
+        assert await run("geteattr", "/x.bin") == 0
+        assert "eattr -" in capsys.readouterr().out
+        # absolute set
+        assert await run("seteattr", "nocache,noowner", "/x.bin") == 0
+        out = capsys.readouterr().out
+        assert "noowner" in out and "nocache" in out
+        # relative edit (leading '+' keeps argparse from reading the
+        # token as an option; '-flag' works after a '+' first token)
+        assert await run("seteattr", "+noentrycache,-noowner", "/x.bin") == 0
+        out = capsys.readouterr().out
+        assert "noowner" not in out and "noentrycache" in out \
+            and "nocache" in out
+        # unknown flag refused
+        assert await run("seteattr", "bogus", "/x.bin") == 2
+        # stat shows the flags
+        assert await run("stat", "/x.bin") == 0
+        assert '"nocache,noentrycache"' in capsys.readouterr().out
+    finally:
+        await cluster.stop()

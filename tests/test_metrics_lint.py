@@ -1,0 +1,177 @@
+"""Metrics lint: every registry series exports valid Prometheus.
+
+The contract (CI-enforced so the scrape surface can't rot):
+* every exported series has a ``# HELP`` line with non-empty text,
+  followed by its ``# TYPE`` line, before any sample;
+* metric names match the Prometheus name grammar;
+* the whole page passes a strict text-format 0.0.4 structural parse
+  (sample values parse, histogram buckets are cumulative-monotone and
+  end at ``+Inf`` == count, ``_sum``/``_count`` present).
+
+Checked against a synthetic registry holding every metric kind AND the
+live master/chunkserver registries of an in-process cluster (the real
+scrape surface, SLO gauges included).
+"""
+
+import asyncio
+import json
+import re
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.runtime import slo as slomod
+from lizardfs_tpu.runtime.metrics import Metrics
+
+from tests.test_cluster import Cluster
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})? (?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"$')
+
+
+def lint_prometheus(text: str) -> dict:
+    """Strict structural parse of exposition-format 0.0.4; returns
+    {metric family name: type}. Raises AssertionError on any violation."""
+    assert text.endswith("\n"), "page must end with a newline"
+    helped: set[str] = set()
+    typed: dict[str, str] = {}
+    histograms: dict[str, list] = {}
+    sampled: set[str] = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            assert _NAME_RE.match(name), f"line {lineno}: bad name {name!r}"
+            assert help_text.strip(), f"line {lineno}: empty HELP for {name}"
+            assert name not in helped, f"line {lineno}: duplicate HELP {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"line {lineno}: malformed TYPE"
+            name, mtype = parts[2], parts[3]
+            assert _NAME_RE.match(name), f"line {lineno}: bad name {name!r}"
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), f"line {lineno}: bad type {mtype}"
+            assert name in helped, f"line {lineno}: TYPE before HELP: {name}"
+            assert name not in typed, f"line {lineno}: duplicate TYPE {name}"
+            typed[name] = mtype
+            if mtype == "histogram":
+                histograms[name] = []
+            continue
+        assert not line.startswith("#"), f"line {lineno}: stray comment"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"line {lineno}: unparseable sample {line!r}"
+        value = match.group("value")
+        if value not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(value)
+            except ValueError:
+                raise AssertionError(
+                    f"line {lineno}: bad value {value!r}"
+                ) from None
+        labels = match.group("labels")
+        if labels:
+            for pair in labels[1:-1].split(","):
+                assert _LABEL_RE.match(pair), \
+                    f"line {lineno}: bad label {pair!r}"
+        name = match.group("name")
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                family = name[: -len(suffix)]
+        assert family in typed, f"line {lineno}: sample without TYPE: {name}"
+        sampled.add(family)
+        if typed.get(family) == "histogram":
+            histograms[family].append((name, labels, value))
+        else:
+            assert name == family, \
+                f"line {lineno}: suffixed sample on non-histogram {name}"
+    assert typed, "no metric families"
+    for family, mtype in typed.items():
+        assert family in sampled, f"TYPE {family} has no samples"
+    for family, samples in histograms.items():
+        buckets = [s for s in samples if s[0] == family + "_bucket"]
+        assert buckets, f"histogram {family} has no buckets"
+        counts = [float(v) for _, _, v in buckets]
+        assert counts == sorted(counts), f"{family} buckets not cumulative"
+        assert 'le="+Inf"' in buckets[-1][1], f"{family} missing +Inf"
+        count_rows = [s for s in samples if s[0] == family + "_count"]
+        assert count_rows and float(count_rows[0][2]) == counts[-1], \
+            f"{family}: +Inf bucket != _count"
+        assert any(s[0] == family + "_sum" for s in samples), \
+            f"{family} missing _sum"
+    return typed
+
+
+def test_lint_synthetic_registry_all_kinds():
+    mt = Metrics()
+    mt.counter("bytes_read", help="bytes served to clients").inc(10)
+    mt.gauge("depth").set(1.5)  # auto-help path must still lint
+    mt.counter("op.read").inc(3)  # dotted name must sanitize
+    mt.sample_all(1.0)
+    mt.define("total", "bytes_read 2 MUL", help="derived doubling")
+    mt.timing("CltomaCreate", help="create latency").record(0.001)
+    slomod.SloEngine(mt, role="test")  # the full SLO gauge family
+    typed = lint_prometheus(mt.to_prometheus())
+    assert typed["lizardfs_bytes_read_total"] == "counter"
+    assert typed["lizardfs_op_read_total"] == "counter"
+    assert typed["lizardfs_total"] == "gauge"  # derived exports as gauge
+    assert typed["lizardfs_timing_CltomaCreate_us"] == "histogram"
+    assert typed["lizardfs_slo_read_burn_fast"] == "gauge"
+    # the explicit help text made it to the page verbatim
+    text = mt.to_prometheus()
+    assert "# HELP lizardfs_bytes_read_total bytes served to clients" in text
+
+
+def test_lint_rejects_violations():
+    with pytest.raises(AssertionError):
+        lint_prometheus("no_type_line 1\n")
+    with pytest.raises(AssertionError):  # TYPE without HELP
+        lint_prometheus("# TYPE x counter\nx 1\n")
+    with pytest.raises(AssertionError):  # unparseable value
+        lint_prometheus("# HELP x h\n# TYPE x gauge\nx one\n")
+    with pytest.raises(AssertionError):  # bad metric name
+        lint_prometheus("# HELP 1x h\n# TYPE 1x gauge\n1x 1\n")
+
+
+@pytest.mark.asyncio
+async def test_lint_live_daemon_registries(tmp_path):
+    """The real scrape surfaces: master + chunkserver pages after real
+    traffic (SLO gauges, timings, native folds included) pass lint —
+    both read in-process and as served over the admin link."""
+    cluster = Cluster(tmp_path, n_cs=2)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "lint.bin")
+        await c.write_file(f.inode, b"x" * 300_000)
+        c.cache.invalidate(f.inode)
+        await c.read_file(f.inode, 0, 300_000)
+        await cluster.master._health_tick()
+        for daemon in [cluster.master, *cluster.chunkservers]:
+            lint_prometheus(daemon.metrics.to_prometheus())
+        # over the wire (metrics-prom relays the same render)
+        r, w = await asyncio.open_connection(
+            "127.0.0.1", cluster.master.port
+        )
+        try:
+            await framing.send_message(
+                w, m.AdminCommand(req_id=1, command="metrics-prom", json="{}")
+            )
+            reply = await framing.read_message(r)
+        finally:
+            w.close()
+        assert reply.status == 0
+        text = json.loads(reply.json)["text"]
+        typed = lint_prometheus(text)
+        assert "lizardfs_cluster_health_status" in typed
+        assert "lizardfs_span_ring_dropped_total" in typed
+    finally:
+        await cluster.stop()

@@ -530,3 +530,60 @@ async def test_nfs_chmod_drops_cached_access_immediately(tmp_path):
     finally:
         await gw.stop()
         await cluster.stop()
+
+
+async def test_nfs_trace_propagation_to_chunkserver(tmp_path):
+    """NFS joins the trace domain (PR 3): a wire READ starts a trace at
+    the gateway's dispatch boundary and the id propagates through the
+    shared Client into the master RPCs and the chunkserver data plane —
+    end to end into the CS span ring (satellite coverage)."""
+    from lizardfs_tpu.runtime import tracing
+
+    cluster = Cluster(tmp_path, n_cs=3, native_data_plane=False)
+    await cluster.start()
+    gw = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw.start()
+    try:
+        async with Nfs3Client("127.0.0.1", gw.port) as c:
+            root = await c.mnt("/")
+            code, fh = await c.create(root, "traced.bin")
+            assert code == nfs.NFS3_OK
+            payload = b"t" * 200_000
+            assert await c.write(fh, 0, payload, stable=2) == len(payload)
+            # drop caches so the READ reaches the chunkservers
+            inode = nfs.fh_unpack(fh)
+            gw.client.cache.invalidate(inode)
+            gw._ra_drop(inode)
+            data, _eof = await c.read(fh, 0, 65536)
+            assert data == payload[:65536]
+        # the gateway recorded the op boundary span under role "nfs"
+        reads = [
+            s for s in gw.client.trace_ring.dump()
+            if s["name"] == "nfs_read" and s["role"] == "nfs"
+        ]
+        assert reads, "gateway recorded no nfs_read boundary span"
+        tid = reads[-1]["trace_id"]
+        assert tid != 0
+        # the same id reached the master's RPC ring...
+        master_spans = cluster.master.trace_spans(tid)
+        assert any(
+            s["name"] == "CltomaReadChunk" for s in master_spans
+        ), master_spans
+        # ...and a chunkserver's span ring (the data plane)
+        cs_spans = [
+            s for cs in cluster.chunkservers for s in cs.trace_spans(tid)
+        ]
+        assert cs_spans, "trace id never reached a chunkserver ring"
+        assert all(s["role"] == "chunkserver" for s in cs_spans)
+        # merged, the timeline attributes the op across all three roles
+        merged = tracing.merge_timeline(
+            gw.client.trace_ring.dump(tid) + master_spans + cs_spans,
+            tid, wall_name="nfs_read",
+        )
+        assert merged["wall_ms"] > 0
+        assert {"chunkserver", "master"} <= set(merged["by_role_ms"])
+        # the nfs SLO class accounted the dispatched procs
+        assert gw.slo.objectives["nfs"].ops > 0
+    finally:
+        await gw.stop()
+        await cluster.stop()

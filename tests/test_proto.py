@@ -173,3 +173,26 @@ ENABLE = yes
     p.write_text("PORT = 1\n")
     cfg.reload()
     assert cfg.get_int("PORT") == 1
+
+
+def test_skew_tolerant_nesting_guard():
+    """A skew-tolerant message's optional tail elides at pack time, so
+    its encoding has no fixed length — nesting one anywhere but the
+    final field (or in a list) must fail at class-definition time, not
+    misalign decodes at runtime."""
+    from lizardfs_tpu.proto.codec import Message
+
+    # terminal nesting is fine (MatoclAttrReply's real shape)
+    class _OkTailNest(Message):
+        MSG_TYPE = None
+        FIELDS = (("req_id", "u32"), ("attr", "msg:Attr"))
+
+    with pytest.raises(TypeError):
+        class _BadMidNest(Message):
+            MSG_TYPE = None
+            FIELDS = (("attr", "msg:Attr"), ("req_id", "u32"))
+
+    with pytest.raises(TypeError):
+        class _BadListNest(Message):
+            MSG_TYPE = None
+            FIELDS = (("req_id", "u32"), ("attrs", "list:msg:Attr"))

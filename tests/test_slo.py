@@ -1,0 +1,308 @@
+"""SLO engine, flight recorder, and the cluster health rollup.
+
+Unit coverage for runtime/slo.py (burn windows, top-N/incident rings,
+the LZ_SLO kill switch) plus the PR-3 acceptance e2e: a fault-injected
+slow chunkserver read is auto-captured — it appears in
+``lizardfs-admin slowops``, its incident renders via ``trace-dump``
+after the live ring moved on, the breach shows in the /metrics text,
+and the master's ``health`` rollup degrades — and disabling SLOs
+short-circuits all of it.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.runtime import slo as slomod
+from lizardfs_tpu.runtime import tracing
+from lizardfs_tpu.runtime.metrics import Metrics
+
+from tests.test_cluster import Cluster, EC_GOAL
+
+
+# --- objective / burn-rate math --------------------------------------------
+
+
+def test_objective_burn_and_status():
+    obj = slomod.Objective("read", threshold_ms=100.0, target=0.999)
+    now = 1000.0
+    for _ in range(99):
+        assert obj.observe(0.010, now) is False
+    assert obj.observe(0.500, now) is True  # breach
+    fast, slow = obj.burn(now)
+    # 1 breach in 100 ops over a 0.1% budget -> burn 10x in both windows
+    assert fast == pytest.approx(10.0)
+    assert slow == pytest.approx(10.0)
+    assert obj.status(now) == "critical"  # fast >= 6 and slow corroborates
+    # the fast window forgets, the slow window remembers
+    later = now + slomod.FAST_WINDOW_S + slomod._BUCKET_S * 2
+    for _ in range(100):
+        obj.observe(0.010, later)
+    fast2, slow2 = obj.burn(later)
+    assert fast2 == 0.0 and slow2 > 0.0
+    assert obj.status(later) == "ok"
+
+
+def test_engine_registers_and_observes():
+    mt = Metrics()
+    eng = slomod.SloEngine(mt, role="test")
+    assert set(eng.objectives) == set(slomod.OP_CLASSES)
+    # registration alone puts the series on the prometheus page
+    text = mt.to_prometheus()
+    assert "lizardfs_slo_read_breaches_total 0" in text
+    assert "lizardfs_slo_read_burn_fast 0" in text
+    eng.set_threshold("read", 50)
+    assert eng.observe("read", 0.010) is False
+    assert eng.observe("read", 0.200, trace_id=7, name="cs_read") is True
+    assert mt.counter("slo_read_breaches").total == 1
+    assert mt.gauge("slo_read_burn_fast").value > 0
+    snap = eng.snapshot()
+    assert snap["read"]["breaches"] == 1 and snap["read"]["ops"] == 2
+    assert eng.status() != "ok"
+    # unknown class: accounted nowhere, never raises
+    assert eng.observe("no-such-class", 99.0) is False
+    # the 1 Hz sampler hook recomputes burn from the windows (so an
+    # idle daemon's gauges decay instead of freezing at the last value)
+    mt.gauge("slo_read_burn_fast").set(999.0)  # simulate a stale export
+    eng.refresh_gauges()
+    assert mt.gauge("slo_read_burn_fast").value != 999.0
+    slomod.set_enabled(False)
+    try:
+        eng.refresh_gauges()  # disabled: must not touch anything
+    finally:
+        slomod.set_enabled(True)
+
+
+def test_kill_switch_short_circuits():
+    mt = Metrics()
+    eng = slomod.SloEngine(mt, role="test")
+    eng.set_threshold("read", 1)
+    slomod.set_enabled(False)
+    try:
+        assert eng.observe("read", 9.9, trace_id=5) is False
+        assert mt.counter("slo_read_breaches").total == 0
+        assert eng.recorder.slowops() == []
+        # health reads ok (no stale burn state leaks through)
+        snap = slomod.health_from("test", eng)
+        assert snap["status"] == "ok" and snap["slo"] == {}
+    finally:
+        slomod.set_enabled(True)
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+def test_recorder_top_n_and_incident_rotation(tmp_path):
+    rec = slomod.FlightRecorder(str(tmp_path / "inc"), top_n=3,
+                                max_incidents=2)
+    rec.min_write_interval_s = 0.0  # exercise the disk ring itself
+    spans = [{"trace_id": 1, "span_id": 1, "parent_id": 0, "role": "x",
+              "name": "op", "t0": 0.0, "t1": 1.0}]
+    for i in range(1, 6):
+        rec.record("read", f"op{i}", i / 10.0, i, spans)
+    ops = rec.slowops()
+    # top-N slowest survive, slowest first
+    assert [e["name"] for e in ops] == ["op5", "op4", "op3"]
+    # on-disk ring rotated down to max_incidents
+    files = os.listdir(tmp_path / "inc")
+    assert len(files) == 2
+    # the newest incident loads back; rotated-out ones return None
+    assert rec.incident_spans(5) == spans
+    assert rec.incident_spans(1) is None
+    # memory-only recorder (no dir): slowops work, no incident lookup
+    mem = slomod.FlightRecorder(None)
+    mem.record("write", "w", 1.0, 9, spans)
+    assert mem.incident_spans(9) is None
+    # disk writes are rate-limited (a breach storm must not hammer a
+    # slow disk from the serving loop); the slowops ring still records
+    rl = slomod.FlightRecorder(str(tmp_path / "rl"))
+    e1 = rl.record("read", "a", 0.5, 21, spans)
+    e2 = rl.record("read", "b", 0.6, 22, spans)
+    assert e1["captured"] and not e2["captured"]
+    assert len(rl.slowops()) == 2
+    assert rl.incident_spans(21) and rl.incident_spans(22) is None
+
+
+def test_disabled_engine_registers_no_series():
+    slomod.set_enabled(False)
+    try:
+        mt = Metrics()
+        slomod.SloEngine(mt, role="test")
+        assert not any(n.startswith("slo_") for n in mt.series)
+    finally:
+        slomod.set_enabled(True)
+
+
+def test_health_from_disk_errors_degrade():
+    eng = slomod.SloEngine(Metrics(), role="cs")
+    snap = slomod.health_from("cs", eng, disk_errors=2)
+    assert snap["status"] == "degraded" and snap["disk_errors"] == 2
+    assert slomod.worst_status("ok", "critical", "degraded") == "critical"
+
+
+# --- heartbeat health_json version skew -------------------------------------
+
+
+def test_heartbeat_health_field_skew():
+    hb = m.CstomaHeartbeat(
+        req_id=1, cs_id=2, total_space=100, used_space=10,
+        health_json='{"status": "ok"}',
+    )
+    old = hb.pack_body()
+    # old peer encoding (no health field) still decodes, as ""
+    stripped = m.CstomaHeartbeat(
+        req_id=1, cs_id=2, total_space=100, used_space=10
+    ).pack_body()
+    decoded = m.CstomaHeartbeat.parse(stripped)
+    assert decoded.health_json == "" and decoded.used_space == 10
+    assert m.CstomaHeartbeat.parse(old).health_json == '{"status": "ok"}'
+
+
+# --- the acceptance e2e -----------------------------------------------------
+
+
+async def _admin(port, command, payload="{}"):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        await framing.send_message(
+            w, m.AdminCommand(req_id=1, command=command, json=payload)
+        )
+        return await framing.read_message(r)
+    finally:
+        w.close()
+
+
+@pytest.mark.asyncio
+async def test_slow_op_auto_capture_end_to_end(tmp_path):
+    """Delayed chunkserver response -> SLO breach -> flight-recorded:
+    slowops lists it, trace-dump renders the incident, /metrics shows
+    the burn, master health degrades; LZ_SLO=0 kills every hook."""
+    cluster = Cluster(tmp_path, n_cs=1, native_data_plane=False)
+    await cluster.start()
+    try:
+        cs = cluster.chunkservers[0]
+        c = await cluster.client()
+        f = await c.create(1, "slow.bin")
+        await c.write_file(f.inode, b"s" * 300_000)
+
+        # fault injection: the asyncio read path stalls 200 ms against
+        # a 50 ms objective
+        cs.slo.set_threshold("read", 50)
+        assert cs.tweaks.set("debug_read_delay_ms", "200")
+        c.cache.invalidate(f.inode)
+        tid = tracing.start_trace()
+        try:
+            assert await c.read_file(f.inode, 0, 300_000) == b"s" * 300_000
+        finally:
+            tracing.clear_trace()
+
+        # 1) the breach is in the slowops ring, naming our trace
+        reply = await _admin(cs.port, "slowops")
+        assert reply.status == 0
+        slow = json.loads(reply.json)["slowops"]
+        assert any(e["trace_id"] == tid and e["captured"] for e in slow), slow
+
+        # 2) the incident renders via trace-dump even after the live
+        # span ring has moved on (flight-recorder fallback)
+        cs.trace_ring.clear()
+        reply = await _admin(
+            cs.port, "trace-dump", json.dumps({"trace_id": tid})
+        )
+        spans = json.loads(reply.json)["spans"]
+        assert spans and all(s["trace_id"] == tid for s in spans)
+        rendered = tracing.format_timeline(
+            tracing.merge_timeline(spans, tid)
+        )
+        assert f"trace 0x{tid:x}" in rendered and "cs_read" in rendered
+        # and the incident file exists on disk under the CS data folder
+        inc = tmp_path / "cs0" / "incidents" / f"inc_{tid:016x}.json"
+        assert inc.exists()
+
+        # 3) the breach moved the matching burn gauge + counter on the
+        # prometheus page
+        reply = await _admin(cs.port, "metrics-prom")
+        text = json.loads(reply.json)["text"]
+        breach_line = next(
+            line for line in text.splitlines()
+            if line.startswith("lizardfs_slo_read_breaches_total ")
+        )
+        assert float(breach_line.split()[-1]) >= 1
+        burn_line = next(
+            line for line in text.splitlines()
+            if line.startswith("lizardfs_slo_read_burn_fast ")
+        )
+        assert float(burn_line.split()[-1]) > 0
+
+        # 4) the master's cluster rollup degrades once the heartbeat
+        # folds the CS health in
+        await cs._heartbeat()
+        reply = await _admin(cluster.master.port, "health")
+        report = json.loads(reply.json)
+        assert report["status"] != "ok", report
+        cs_snap = report["chunkservers"][str(cs.cs_id)]
+        assert cs_snap["status"] != "ok"
+        assert report["summary"]["breaches_total"] >= 1
+        # ...and the derived gauges follow on the next health tick
+        await cluster.master._health_tick()
+        prom = cluster.master.metrics.to_prometheus()
+        status_line = next(
+            line for line in prom.splitlines()
+            if line.startswith("lizardfs_cluster_health_status ")
+        )
+        assert float(status_line.split()[-1]) >= 1
+        assert "lizardfs_cluster_slo_breaches" in prom
+
+        # 5) kill switch: same slow read, nothing new is accounted
+        before = cs.metrics.counter("slo_read_breaches").total
+        slomod.set_enabled(False)
+        try:
+            c.cache.invalidate(f.inode)
+            await c.read_file(f.inode, 0, 300_000)
+            assert cs.metrics.counter("slo_read_breaches").total == before
+        finally:
+            slomod.set_enabled(True)
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_degraded_read_trace_propagates(tmp_path):
+    """Trace-id propagation through a RECOVERY read: with a data part's
+    server down, the ec(3,2) read recovers from the survivors and the
+    trace id still lands in their span rings (satellite: degraded-read
+    trace coverage, end to end into the chunkserver ring)."""
+    cluster = Cluster(tmp_path, n_cs=6, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "deg.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = bytes(range(256)) * 2048  # 512 KiB across the stripe
+        await c.write_file(f.inode, payload)
+        # drop one chunkserver that holds a part of the chunk
+        loc = await c.chunk_info(f.inode, 0)
+        assert loc.locations
+        victim_port = loc.locations[0].addr.port
+        victim = next(
+            cs for cs in cluster.chunkservers if cs.port == victim_port
+        )
+        await victim.stop()
+        cluster.chunkservers.remove(victim)
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        tid = tracing.start_trace()
+        try:
+            got = await c.read_file(f.inode, 0, len(payload))
+        finally:
+            tracing.clear_trace()
+        assert got == payload  # recovered correctly
+        traced = [
+            s for cs in cluster.chunkservers for s in cs.trace_spans(tid)
+        ]
+        assert traced, "no chunkserver span carried the degraded trace"
+        assert all(s["role"] == "chunkserver" for s in traced)
+    finally:
+        await cluster.stop()

@@ -297,12 +297,20 @@ def _validate_prometheus(text: str) -> None:
     """Structural validation of exposition-format 0.0.4 text."""
     assert text.endswith("\n")
     seen_types = {}
+    seen_help = set()
     for line in text.splitlines():
         if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            assert help_text, f"empty HELP for {name}"
+            seen_help.add(name)
             continue
         if line.startswith("# TYPE "):
             _, _, name, mtype = line.split(" ")
             assert mtype in ("counter", "gauge", "histogram")
+            # HELP precedes TYPE for every series (metrics-lint rule)
+            assert name in seen_help, f"TYPE without HELP: {name}"
             seen_types[name] = mtype
             continue
         assert not line.startswith("#")
