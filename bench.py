@@ -427,6 +427,11 @@ def cluster_throughput() -> dict:
                 for extra in ("MBps_reps", "ops_reps"):
                     if extra in r:
                         out[f"cluster_{key}_{extra}"] = r[extra]
+            elif "rebuild_MBps" in r:
+                # RebuildEngine convergence after a chunkserver loss
+                out["cluster_rebuild_MBps"] = r["rebuild_MBps"]
+                out["cluster_rebuild_s"] = r["rebuild_s"]
+                out["cluster_rebuild_parts"] = r["parts_rebuilt"]
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]
@@ -671,6 +676,10 @@ def _summary_row(row: dict) -> dict:
         # round name the degraded role+class from the tail alone
         "cluster_health_status", "cluster_slo_breaches",
         "cluster_slow_ops", "cluster_slo_breaches_by_class",
+        # rebuild subsystem fiducials: how fast a lost chunkserver's
+        # parts came back through the RebuildEngine (part count lives
+        # in BENCH_FULL.json)
+        "cluster_rebuild_MBps", "cluster_rebuild_s",
     ):
         if key in row:
             s[key] = row[key]
@@ -681,6 +690,11 @@ def _summary_row(row: dict) -> dict:
     }
     for key, value in row.items():
         if not key.startswith("cluster_"):
+            continue
+        if key.startswith("cluster_nfs_gateway_C_client"):
+            # decision-note input (Python-vs-C measuring client), not a
+            # target verdict: BENCH_FULL.json + benches/README.md carry
+            # it; the tail budget goes to verdict-bearing rows
             continue
         if key.endswith((
             "_write_MBps", "_read_MBps", "_target_MBps", "_target_met",

@@ -404,6 +404,58 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                     "read_target_MBps": w_med,
                     "read_target_met": bool(r_med >= w_med),
                 })
+
+                # the NON-PYTHON measuring client (VERDICT weak #4): the
+                # C NFS3 client (native liz_nfs_*) drives the same
+                # gateway, blocking single-stream from a worker thread —
+                # no asyncio, no Python on the wire path. Comparing the
+                # two rows separates gateway cost from measuring-client
+                # cost (see benches/README.md decision note).
+                from lizardfs_tpu.nfs import cnfs
+
+                if cnfs.available():
+                    blob_c = payload[: nfs_mb * 2**20]
+                    wts, rts = [], []
+
+                    def drive(rep: int) -> tuple[float, float]:
+                        with cnfs.CNfs3Client("127.0.0.1", gw.port) as nc2:
+                            root = nc2.mnt("/")
+                            fh = nc2.create(root, f"nfs_c_{rep}.bin")
+                            t0 = time.perf_counter()
+                            off = 0
+                            while off < len(blob_c):
+                                off += nc2.write(
+                                    fh, off, blob_c[off:off + 65536],
+                                    stable=0,
+                                )
+                            nc2.commit(fh)
+                            wt = time.perf_counter() - t0
+                            got = bytearray()
+                            t0 = time.perf_counter()
+                            while len(got) < len(blob_c):
+                                got += nc2.read(fh, len(got), 65536)
+                            rt = time.perf_counter() - t0
+                            assert bytes(got) == blob_c, \
+                                "nfs C-client mismatch"
+                            return wt, rt
+
+                    for rep in range(REPS):
+                        wt, rt = await asyncio.to_thread(drive, rep)
+                        wts.append(wt)
+                        rts.append(rt)
+                    w_reps = [round(nfs_mb / t, 1) for t in wts]
+                    r_reps = [round(nfs_mb / t, 1) for t in rts]
+                    w_med, w_spread = _median_spread(w_reps)
+                    r_med, r_spread = _median_spread(r_reps)
+                    rows.append({
+                        "goal": "nfs gateway (C client)",
+                        "write_MBps": w_med,
+                        "read_MBps": r_med,
+                        "write_spread_pct": w_spread,
+                        "read_spread_pct": r_spread,
+                        "write_reps_MBps": w_reps,
+                        "read_reps_MBps": r_reps,
+                    })
             finally:
                 await gw.stop()
         except AssertionError:
@@ -466,6 +518,58 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 })
             finally:
                 await asyncio.to_thread(pool.close)
+
+        # RebuildEngine throughput: kill one chunkserver under an
+        # ec(8,4) data set and time the engine restoring full
+        # redundancy (the reference replicator's hot loop, now a
+        # scheduled subsystem). LAST row: it permanently removes a
+        # chunkserver from the cluster.
+        try:
+            reb_mb = min(size_mb, 32)
+            f = await client.create(1, "rebuild_bench.bin")
+            await client.setgoal(f.inode, 12)  # ec(8,4)
+            await client.write_file(f.inode, payload[: reb_mb * 2**20])
+            loc = await client.chunk_info(f.inode, 0)
+            victim = next(
+                cs for cs in servers
+                if any(l.addr.port in (cs.port, getattr(
+                    cs.data_server, "port", -1)) for l in loc.locations)
+            )
+            before_bytes = master.rebuild.bytes_rebuilt
+            before_done = master.rebuild.completed
+            t0 = time.perf_counter()
+            await victim.stop()
+            servers.remove(victim)
+            reg = master.meta.registry
+
+            def healthy() -> bool:
+                return not master.rebuild.active and all(
+                    not reg.evaluate(ch).needs_work
+                    for ch in reg.chunks.values()
+                )
+
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if master.rebuild.completed > before_done and healthy():
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"rebuild never converged: {master.rebuild.status()}"
+                )
+            wall = time.perf_counter() - t0
+            rebuilt = master.rebuild.bytes_rebuilt - before_bytes
+            rows.append({
+                "goal": "rebuild",
+                "rebuild_MBps": round(rebuilt / wall / 2**20, 1),
+                "rebuild_s": round(wall, 2),
+                "parts_rebuilt": master.rebuild.completed - before_done,
+            })
+            await drop_bench_files(["rebuild_bench.bin"])
+        except Exception:  # noqa: BLE001 — infra failure must not kill it
+            import logging
+
+            logging.getLogger("bench").exception("rebuild row failed")
     finally:
         await client.close()
         for cs in servers:
@@ -499,6 +603,9 @@ def main(argv=None) -> int:
             print(f"{r['goal']:>18s}:  {r['health_status']}"
                   f"   breaches {r['slo_breaches']}"
                   f"   slowops {r['slow_ops']}")
+        elif "rebuild_MBps" in r:
+            print(f"{r['goal']:>18s}:  {r['rebuild_MBps']:8.1f} MB/s"
+                  f"   ({r['parts_rebuilt']} parts in {r['rebuild_s']}s)")
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")

@@ -125,6 +125,11 @@ class ChunkServer(Daemon):
         self.cs_id = 0
         self.master: RpcConnection | None = None
         self.encoder = get_encoder(encoder_name)
+        # replicator recovery backend, resolved lazily on first rebuild:
+        # the auto ladder's mesh-sharded backend when real multichip
+        # silicon is attached (LZ_SHARDED_RECOVERY=0 kills it), else
+        # the configured encoder
+        self._recovery_encoder = None
         self.wave_timeout = wave_timeout
         self.heartbeat_interval = heartbeat_interval
         # chunk-tester pacing (hdd_test_chunk analog: the reference
@@ -468,6 +473,13 @@ class ChunkServer(Daemon):
 
     async def _cmd_replicate(self, msg: m.MatocsReplicate):
         t0 = time.perf_counter()
+        tw0 = time.time()
+        # join the RebuildEngine's per-rebuild trace: the source reads
+        # this replica issues carry the id into the peers' span rings,
+        # and this executor span merges with the master's scheduler
+        # span into one rebuild timeline
+        tid = getattr(msg, "trace_id", 0)
+        tracing.adopt_trace(tid)
         try:
             await self._replicate(msg)
             code = st.OK
@@ -476,8 +488,15 @@ class ChunkServer(Daemon):
         except Exception as e:
             self.log.warning("replication failed: %s", e)
             code = st.EIO
+        finally:
+            tracing.clear_trace()
+        self.trace_ring.record(
+            tid, "cs_replicate", tw0, time.time(), role="chunkserver",
+            chunk_id=msg.chunk_id,
+        )
         self.slo.observe(
-            "replicate", time.perf_counter() - t0, name="replicate"
+            "replicate", time.perf_counter() - t0, trace_id=tid,
+            name="cs_replicate",
         )
         await self._ack(msg.req_id, msg.chunk_id, msg.part_id, code)
         if code == st.OK and self.master is not None:
@@ -495,6 +514,22 @@ class ChunkServer(Daemon):
                         ],
                     )
                 )
+
+    def _replicator_encoder(self):
+        """The rebuild compute backend: try the encoder auto-ladder's
+        mesh-sharded wide-stripe decoder (parallel/recovery.py) — it
+        binds only on a real multi-device mesh with the
+        LZ_SHARDED_RECOVERY switch open — and degrade to the configured
+        single-chip encoder everywhere else."""
+        if self._recovery_encoder is None:
+            try:
+                self._recovery_encoder = get_encoder("sharded")
+                self.log.info(
+                    "replicator: mesh-sharded recovery backend active"
+                )
+            except Exception:  # no mesh / no silicon / kill switch
+                self._recovery_encoder = self.encoder
+        return self._recovery_encoder
 
     async def _replicate(self, msg: m.MatocsReplicate) -> None:
         target = geometry.ChunkPartType.from_id(msg.part_id)
@@ -520,7 +555,7 @@ class ChunkServer(Daemon):
                 slice_type, list(locations.keys()),
                 scores={p: GLOBAL_STATS.score(a)
                         for p, (a, _) in locations.items()},
-                encoder=self.encoder,
+                encoder=self._replicator_encoder(),
             )
             if not planner.is_readable([target.part]):
                 raise ChunkStoreError(st.NO_CHUNK, "not enough source parts")

@@ -714,6 +714,32 @@ class Client:
         )
         return r.attr
 
+    async def filerepair(self, inode: int,
+                         uid: int | None = None,
+                         gids: list[int] | None = None) -> dict:
+        """Repair a file with unrecoverable chunks (file_repair.cc
+        analog): returns {"repaired_versions", "zeroed",
+        "queued_rebuild", "ok_chunks"} counts."""
+        import json as _json
+
+        r = await self._call(
+            m.CltomaFileRepair, inode=inode, **self._ident(uid, gids)
+        )
+        return _json.loads(r.json)
+
+    async def append_chunks(self, inode_dst: int, inode_src: int,
+                            uid: int | None = None,
+                            gids: list[int] | None = None) -> m.Attr:
+        """O(1) chunk-level concatenation of src onto dst (appendchunks
+        verb; chunks are shared + refcounted, COW on later writes)."""
+        r = await self._call(
+            m.CltomaAppendChunks, inode_dst=inode_dst,
+            inode_src=inode_src, **self._ident(uid, gids),
+        )
+        self._drop_locates(inode_dst)
+        self.cache.invalidate(inode_dst)
+        return r.attr
+
     async def set_xattr(self, inode: int, name: str, value: bytes,
                         uid: int | None = None,
                         gids: list[int] | None = None) -> None:

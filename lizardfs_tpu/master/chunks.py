@@ -104,6 +104,13 @@ class ChunkRegistry:
 
         self.endangered: deque[int] = deque()
         self._endangered_set: set[int] = set()
+        # stale-version parts kept as repair material: when a
+        # chunkserver registers parts at the wrong version for a chunk
+        # that is currently UNREADABLE, deleting them would destroy the
+        # only bytes `filerepair` can version-fix from (the reference
+        # keeps "wrong version" copies for repair too).
+        # chunk_id -> {(cs_id, wire part_id): version}; volatile.
+        self.stale_versions: dict[int, dict[tuple[int, int], int]] = {}
         # per-server part index: cs_id -> {(chunk_id, part): ChunkInfo}
         # — the reference keeps per-server chunk lists (matocsserv.cc
         # server entries) so a disconnect touches only that server's
@@ -164,6 +171,13 @@ class ChunkRegistry:
         ).items():
             chunk.parts.discard((cs_id, part))
             append(chunk_id)
+        # a dead server's stale-version parts are gone with it
+        for cid in list(self.stale_versions):
+            entries = self.stale_versions[cid]
+            for key in [k for k in entries if k[0] == cs_id]:
+                del entries[key]
+            if not entries:
+                del self.stale_versions[cid]
         return affected
 
     def connected_servers(self) -> list[ChunkServerInfo]:
@@ -248,7 +262,18 @@ class ChunkRegistry:
         if idx is not None:
             idx.pop((chunk_id, cpt.part), None)
 
+    def record_stale(
+        self, chunk_id: int, cs_id: int, part_id: int, version: int
+    ) -> None:
+        """Remember a wrong-version part as repair material (see
+        stale_versions). Bounded per chunk by construction (one entry
+        per (server, part))."""
+        self.stale_versions.setdefault(chunk_id, {})[
+            (cs_id, part_id)
+        ] = version
+
     def delete_chunk(self, chunk_id: int) -> ChunkInfo | None:
+        self.stale_versions.pop(chunk_id, None)
         chunk = self.chunks.pop(chunk_id, None)
         if chunk is not None and chunk.parts:
             for cs_id, part in chunk.parts:

@@ -552,6 +552,55 @@ class FsTree:
             n.xattrs[name] = base64.b64decode(value_b64)
         n.ctime = ts
 
+    def apply_append_chunks(
+        self, inode_dst: int, inode_src: int, ts: int
+    ) -> list[int]:
+        """O(1)-per-chunk concatenation (append_file.cc analog): pad
+        the destination to a chunk boundary, then share the source's
+        chunk ids onto its tail. Returns the shared chunk ids (the
+        caller bumps refcounts — COW on a later write keeps the files
+        independent)."""
+        dst = self.file_node(inode_dst)
+        src = self.file_node(inode_src)
+        if inode_dst == inode_src:
+            raise FsError(st.EINVAL, "append onto itself")
+        padded = (
+            (dst.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE * MFSCHUNKSIZE
+        )
+        pad_chunks = padded // MFSCHUNKSIZE
+        if len(dst.chunks) > pad_chunks:
+            # a chunk attached past the length boundary = a write in
+            # flight (the master handler refuses CHUNK_BUSY before
+            # committing, so apply/replay must never see this)
+            raise FsError(st.CHUNK_BUSY, "append under in-flight write")
+        while len(dst.chunks) < pad_chunks:
+            dst.chunks.append(0)  # holes read as zeros
+        shared = list(src.chunks)
+        # a source shorter than its chunk count never happens, but a
+        # trailing hole does: share slots verbatim (0 stays a hole)
+        dst.chunks.extend(shared)
+        new_length = padded + src.length
+        delta = new_length - dst.length
+        dst.length = new_length
+        dst.mtime = dst.ctime = ts
+        for parent in dst.parents:
+            self._add_stats(parent, 0, delta)
+        return [c for c in shared if c]
+
+    def apply_repair_zero_chunk(
+        self, inode: int, chunk_index: int, ts: int
+    ) -> int:
+        """filerepair's last resort: zero-fill an unrecoverable chunk
+        by turning its slot into a hole. Returns the released chunk id
+        (0 when the slot was already a hole)."""
+        n = self.file_node(inode)
+        if chunk_index >= len(n.chunks):
+            return 0
+        cid = n.chunks[chunk_index]
+        n.chunks[chunk_index] = 0
+        n.mtime = n.ctime = ts
+        return cid
+
     def apply_snapshot(
         self, src_inode: int, dst_parent: int, dst_name: str,
         inode_map: dict[str, int], ts: int,

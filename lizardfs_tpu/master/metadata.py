@@ -213,6 +213,29 @@ class MetadataStore:
         wi, wb = self.fs._node_weight(src)
         self.quotas.charge(src.uid, src.gid, wi, wb)
 
+    def _op_append_chunks(self, op):
+        dst = self.fs.file_node(op["inode_dst"])
+        old_len = dst.length
+        shared = self.fs.apply_append_chunks(
+            op["inode_dst"], op["inode_src"], op["ts"]
+        )
+        for cid in shared:
+            chunk = self.registry.chunks.get(cid)
+            if chunk is not None:
+                chunk.refcount += 1
+        self.quotas.charge(dst.uid, dst.gid, 0, dst.length - old_len)
+        self.content_gen[op["inode_dst"]] = \
+            self.content_gen.get(op["inode_dst"], 0) + 1
+
+    def _op_repair_zero_chunk(self, op):
+        cid = self.fs.apply_repair_zero_chunk(
+            op["inode"], op["chunk_index"], op["ts"]
+        )
+        if cid:
+            self.registry.release_chunk(cid)
+        self.content_gen[op["inode"]] = \
+            self.content_gen.get(op["inode"], 0) + 1
+
     def _op_cow_chunk(self, op):
         """Copy-on-write: a file's shared chunk was duplicated; point the
         file at the private copy."""
@@ -568,6 +591,14 @@ class MetadataStore:
         elif t == "cow_chunk":
             out |= {("chunk", op["old_chunk_id"]),
                     ("chunk", op["new_chunk_id"]), ("node", op["inode"])}
+        elif t == "append_chunks":
+            out |= {("node", op["inode_dst"]), ("node", op["inode_src"])}
+            node_chunks(op["inode_dst"])
+            node_chunks(op["inode_src"])
+            node_quota(op["inode_dst"])
+        elif t == "repair_zero_chunk":
+            out.add(("node", op["inode"]))
+            node_chunks(op["inode"])
         elif t in ("lock_posix", "lock_flock"):
             kind = "posix" if t == "lock_posix" else "flock"
             out.add(("locks", kind, op["inode"]))

@@ -33,9 +33,12 @@ from lizardfs_tpu.master.chunks import ChunkServerInfo
 from lizardfs_tpu.master.locks import LOCK_UNLOCK, MAX_OFFSET
 from lizardfs_tpu.master.metadata import MetadataStore
 from lizardfs_tpu.master.quotas import KIND_DIR, KIND_GROUP, KIND_USER
+from lizardfs_tpu.constants import MFSBLOCKSIZE, MFSCHUNKSIZE
+from lizardfs_tpu.master import rebuild as rebuild_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
 
 
@@ -174,7 +177,10 @@ class MasterServer(Daemon):
         self.topology = topology if topology is not None else Topology()
         self.health_interval = health_interval
         self.image_interval = image_interval
-        self._replicating: set[tuple[int, int]] = set()  # (chunk_id, part)
+        # explicit rebuild scheduler (priority classes, token-bucket
+        # throttle, progress/ETA) — the endangered FIFO feeds it, the
+        # health tick launches what it admits (master/rebuild.py)
+        self.rebuild = rebuild_mod.RebuildEngine(self.metrics, self.tweaks)
         # repair-failure backoff: chunk_id -> monotonic deadline before
         # the next replicate attempt (a source at a stale version fails
         # fast, and retrying it at tick rate floods the log and the net)
@@ -712,10 +718,13 @@ class MasterServer(Daemon):
             return m.MatoclLockReply(req_id=msg.req_id, status=code)
         if isinstance(msg, m.CltomaTrashList):
             return m.MatoclTrashList(req_id=msg.req_id, status=code, json="[]")
+        if isinstance(msg, m.CltomaFileRepair):
+            return m.MatoclFileRepair(req_id=msg.req_id, status=code, json="{}")
         if isinstance(
             msg,
             (m.CltomaLookup, m.CltomaGetattr, m.CltomaMkdir, m.CltomaCreate,
-             m.CltomaSetattr, m.CltomaSymlink, m.CltomaLink, m.CltomaSnapshot),
+             m.CltomaSetattr, m.CltomaSymlink, m.CltomaLink, m.CltomaSnapshot,
+             m.CltomaAppendChunks),
         ):
             return m.MatoclAttrReply(
                 req_id=msg.req_id, status=code, attr=_null_attr()
@@ -848,7 +857,8 @@ class MasterServer(Daemon):
         "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
         "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
         "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
-        "CltomaSetRichAcl", "CltomaSetEattr",
+        "CltomaSetRichAcl", "CltomaSetEattr", "CltomaFileRepair",
+        "CltomaAppendChunks",
     )
 
     _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
@@ -1135,6 +1145,10 @@ class MasterServer(Daemon):
             # (apply_snapshot raises EEXIST on an existing name), so no
             # client can hold cached blocks for it
             return await self._snapshot(msg, now)
+        if isinstance(msg, m.CltomaFileRepair):
+            return self._file_repair(msg, now)
+        if isinstance(msg, m.CltomaAppendChunks):
+            return self._append_chunks(msg, now)
         if isinstance(msg, m.CltomaSetXattr):
             import base64
 
@@ -1382,6 +1396,148 @@ class MasterServer(Daemon):
         return m.MatoclLockReply(
             req_id=msg.req_id, status=st.OK if ok else st.LOCKED
         )
+
+    def _file_repair(self, msg: m.CltomaFileRepair, now: int):
+        """`lizardfs filerepair` (file_repair.cc analog): walk the
+        file's chunks; readable-but-degraded chunks route through the
+        RebuildEngine (rebuilt, never zeroed), unreadable chunks are
+        version-fixed from retained stale-version parts when coverage
+        allows, and only truly unrecoverable chunks are zero-filled."""
+        fs = self.meta.fs
+        node = fs.file_node(msg.inode)
+        if not self._owns(node, msg.uid):
+            raise fsmod.FsError(st.EPERM, "filerepair requires ownership")
+        registry = self.meta.registry
+        counts = {"repaired_versions": 0, "zeroed": 0,
+                  "queued_rebuild": 0, "ok_chunks": 0}
+        mutated = False
+        for idx, cid in enumerate(list(node.chunks)):
+            if cid == 0:
+                continue
+            chunk = registry.chunks.get(cid)
+            if chunk is None:
+                # metadata references a chunk the registry no longer
+                # knows — the slot can only be zero-filled
+                self.commit({"op": "repair_zero_chunk",
+                             "inode": msg.inode, "chunk_index": idx,
+                             "ts": now})
+                counts["zeroed"] += 1
+                mutated = True
+                continue
+            state = registry.evaluate(chunk)
+            if state.is_readable:
+                if state.needs_work:
+                    # repairable: rebuilt through the engine, not zeroed
+                    registry.mark_endangered(cid)
+                    counts["queued_rebuild"] += 1
+                else:
+                    counts["ok_chunks"] += 1
+                continue
+            if self._repair_chunk_version(chunk):
+                counts["repaired_versions"] += 1
+                registry.mark_endangered(cid)
+                mutated = True
+                continue
+            self.commit({"op": "repair_zero_chunk", "inode": msg.inode,
+                         "chunk_index": idx, "ts": now})
+            counts["zeroed"] += 1
+            mutated = True
+        if mutated:
+            self._invalidate_client_caches(msg.inode)
+        return m.MatoclFileRepair(
+            req_id=msg.req_id, status=st.OK, json=json.dumps(counts)
+        )
+
+    def _repair_chunk_version(self, chunk) -> bool:
+        """Version-fix an unreadable chunk: adopt the newest retained
+        stale version whose surviving parts restore readability
+        (file_repair.cc correct-version mode). The parts are already on
+        disk at that version, so adopting is pure metadata."""
+        registry = self.meta.registry
+        stale = registry.stale_versions.get(chunk.chunk_id)
+        if not stale:
+            return False
+        t = geometry.SliceType(chunk.slice_type)
+        need = 1 if t.is_standard else geometry.required_parts_to_recover(t)
+        by_ver: dict[int, list[tuple[int, int]]] = {}
+        for (cs_id, part_id), ver in stale.items():
+            srv = registry.servers.get(cs_id)
+            if srv is None or not srv.connected:
+                continue
+            cpt = geometry.ChunkPartType.from_id(part_id)
+            if int(cpt.type) != chunk.slice_type:
+                continue
+            by_ver.setdefault(ver, []).append((cs_id, cpt.part))
+        for ver in sorted(by_ver, reverse=True):
+            if len({p for _, p in by_ver[ver]}) < need:
+                continue
+            # parts still registered at the CURRENT version become the
+            # wrong-version ones after the adoption: unregister them
+            # (a mixed-version location set would serve WRONG_VERSION
+            # on reads while evaluate() counts the chunk healthy) and
+            # retain them as stale material in their turn
+            old_holders = set(chunk.parts)
+            if old_holders:
+                t_cur = geometry.SliceType(chunk.slice_type)
+                registry.unregister_parts(chunk, old_holders)
+                for cs_id, part in old_holders:
+                    registry.record_stale(
+                        chunk.chunk_id, cs_id,
+                        geometry.ChunkPartType(t_cur, part).id,
+                        chunk.version,
+                    )
+            self.commit({"op": "bump_chunk_version",
+                         "chunk_id": chunk.chunk_id, "version": ver})
+            for cs_id, part in by_ver[ver]:
+                registry.record_part(chunk, cs_id, part)
+            for key in [k for k, v in stale.items() if v == ver]:
+                del stale[key]
+            if not stale:
+                registry.stale_versions.pop(chunk.chunk_id, None)
+            self.log.info(
+                "filerepair: chunk %d version-fixed to v%d (%d parts)",
+                chunk.chunk_id, ver, len(by_ver[ver]),
+            )
+            return True
+        return False
+
+    def _append_chunks(self, msg: m.CltomaAppendChunks, now: int):
+        """`lizardfs appendchunks` (append_file.cc analog): O(1)
+        concatenation — dst is padded to a chunk boundary and src's
+        chunks are SHARED onto its tail through the snapshot refcount
+        machinery; a later write to either side COWs the chunk."""
+        fs = self.meta.fs
+        src = fs.file_node(msg.inode_src)
+        dst = fs.file_node(msg.inode_dst)
+        if msg.inode_src == msg.inode_dst:
+            return self._error_reply(msg, st.EINVAL)
+        ident = (msg.uid, list(msg.gids))
+        self._check_perm(src, *ident, 4)
+        self._check_perm(dst, *ident, 2)
+        padded = (
+            (dst.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE * MFSCHUNKSIZE
+        )
+        parent = dst.parents[0] if dst.parents else fsmod.ROOT_INODE
+        self._check_quota(
+            parent, dst.uid, dst.gid, 0, padded + src.length - dst.length
+        )
+        # a write in flight on EITHER file must not race the concat:
+        # a locked chunk is mid-mutation, and a dst chunk attached past
+        # the length-implied boundary is a concurrent write that
+        # WriteChunkEnd has not sealed yet — the padding would land on
+        # top of it (set_length's "never drop chunks" invariant)
+        if len(dst.chunks) > (
+            (dst.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE
+        ):
+            return self._error_reply(msg, st.CHUNK_BUSY)
+        for cid in (*src.chunks, *dst.chunks):
+            chunk = self.meta.registry.chunks.get(cid) if cid else None
+            if chunk is not None and chunk.locked_until > time.monotonic():
+                return self._error_reply(msg, st.CHUNK_BUSY)
+        self.commit({"op": "append_chunks", "inode_dst": msg.inode_dst,
+                     "inode_src": msg.inode_src, "ts": now})
+        self._invalidate_client_caches(msg.inode_dst, exclude_sid=None)
+        return self._attr_reply(msg.req_id, fs.node(msg.inode_dst))
 
     async def _snapshot(self, msg: m.CltomaSnapshot, now: int):
         fs = self.meta.fs
@@ -1833,6 +1989,20 @@ class MasterServer(Daemon):
             srv.cs_id, srv.host, srv.port, len(first.chunks), len(stale),
         )
         for info in stale:
+            # a wrong-version part of a chunk that is currently
+            # UNREADABLE is the only repair material `filerepair` has —
+            # keep it on disk and remember it instead of deleting
+            # (normal stale copies, e.g. bump stragglers of a healthy
+            # chunk, are reclaimed as before)
+            chunk = self.meta.registry.chunks.get(info.chunk_id)
+            if (
+                chunk is not None
+                and not self.meta.registry.evaluate(chunk).is_readable
+            ):
+                self.meta.registry.record_stale(
+                    info.chunk_id, srv.cs_id, info.part_id, info.version
+                )
+                continue
             self.spawn(self._delete_stale(link, info))
         try:
             while True:
@@ -2142,8 +2312,7 @@ class MasterServer(Daemon):
         for item in work:
             if item[0] == "replicate":
                 _, chunk, part = item
-                key = (chunk.chunk_id, part)
-                if key in self._replicating or chunk.locked_until > time.monotonic():
+                if chunk.locked_until > time.monotonic():
                     continue
                 if self._repl_fail_until.get(chunk.chunk_id, 0) > time.monotonic():
                     # keep it in the priority FIFO (cheap: one pop +
@@ -2151,17 +2320,81 @@ class MasterServer(Daemon):
                     # backoff expires, not a full scan cycle later
                     self.meta.registry.mark_endangered(chunk.chunk_id)
                     continue
-                self._replicating.add(key)
-                self.spawn(self._replicate_part(chunk, part))
+                t = geometry.SliceType(chunk.slice_type)
+                state = self.meta.registry.evaluate(chunk)
+                self.rebuild.submit(rebuild_mod.Rebuild(
+                    chunk_id=chunk.chunk_id, part=part,
+                    priority=rebuild_mod.classify(chunk, state),
+                    kind="replicate",
+                    bytes_est=geometry.number_of_blocks_in_part(
+                        geometry.ChunkPartType(t, part)
+                    ) * MFSBLOCKSIZE,
+                ))
             elif item[0] == "delete":
                 _, chunk, cs_id, part = item
                 self.spawn(self._delete_redundant(chunk, cs_id, part))
             elif item[0] == "move":
                 _, chunk, src_cs, part, dst_cs = item
-                key = (chunk.chunk_id, part)
-                if key not in self._replicating:
-                    self._replicating.add(key)
-                    self.spawn(self._move_part(chunk, src_cs, part, dst_cs))
+                t = geometry.SliceType(chunk.slice_type)
+                self.rebuild.submit(rebuild_mod.Rebuild(
+                    chunk_id=chunk.chunk_id, part=part,
+                    priority=rebuild_mod.PRIORITY_REBALANCE,
+                    kind="move", src_cs=src_cs, dst_cs=dst_cs,
+                    bytes_est=geometry.number_of_blocks_in_part(
+                        geometry.ChunkPartType(t, part)
+                    ) * MFSBLOCKSIZE,
+                ))
+        # launch what the scheduler admits (priority order under the
+        # concurrency cap); every launch reports back via finished()
+        for rb in self.rebuild.next_batch():
+            chunk = self.meta.registry.chunks.get(rb.chunk_id)
+            if chunk is None:
+                self.rebuild.skipped(rb)
+                continue
+            if chunk.locked_until > time.monotonic():
+                # a client write was granted while the rebuild sat
+                # queued: step aside and retry when the lock clears
+                self.rebuild.skipped(rb)
+                self.meta.registry.mark_endangered(rb.chunk_id)
+                continue
+            rb.trace_id = tracing.new_id() if tracing.enabled() else 0
+            if rb.kind == "move":
+                self.spawn(
+                    self._move_part(chunk, rb.src_cs, rb.part, rb.dst_cs, rb)
+                )
+            else:
+                self.spawn(self._replicate_part(chunk, rb.part, rb))
+        self.metrics.gauge("rebuilds_active").set(
+            float(len(self.rebuild.active))
+        )
+        await self._reclaim_stale_parts()
+
+    async def _reclaim_stale_parts(self) -> None:
+        """Retained stale-version parts are repair material only while
+        their chunk is unreadable; once it recovers (e.g. the rest of a
+        rolling restart re-registered the real parts) they are disk
+        waste — reclaim a bounded batch per tick so a restart's
+        transient retentions can't accumulate forever."""
+        registry = self.meta.registry
+        if not registry.stale_versions:
+            return
+        reclaimed = 0
+        for cid in list(registry.stale_versions):
+            if reclaimed >= 16:
+                break
+            chunk = registry.chunks.get(cid)
+            if chunk is not None and \
+                    not registry.evaluate(chunk).is_readable:
+                continue  # still the only hope of a version-fix
+            reclaimed += 1
+            entries = registry.stale_versions.pop(cid, {})
+            for (cs_id, part_id), version in entries.items():
+                link = self.cs_links.get(cs_id)
+                if link is None:
+                    continue
+                self.spawn(self._delete_stale(link, m.ChunkPartInfo(
+                    chunk_id=cid, version=version, part_id=part_id,
+                )))
 
     async def _delete_orphan(self, link, dead, t, part: int) -> None:
         try:
@@ -2172,7 +2405,20 @@ class MasterServer(Daemon):
         except (ConnectionError, asyncio.TimeoutError):
             pass
 
-    async def _replicate_part(self, chunk, part: int) -> None:
+    async def _replicate_part(
+        self, chunk, part: int, rb: rebuild_mod.Rebuild | None = None
+    ) -> None:
+        if rb is None:  # direct callers (tests) bypass the scheduler
+            rb = rebuild_mod.Rebuild(
+                chunk_id=chunk.chunk_id, part=part,
+                priority=rebuild_mod.PRIORITY_ENDANGERED,
+            )
+            rb.started_at = time.monotonic()
+            self.rebuild.active[rb.key] = rb
+        ok = False
+        attempted = False
+        t0 = time.perf_counter()
+        tw0 = time.time()
         try:
             t = geometry.SliceType(chunk.slice_type)
             holders = {cs for cs, _ in chunk.parts}
@@ -2198,16 +2444,27 @@ class MasterServer(Daemon):
             if link is None:
                 return
             sources = self._locations_of(chunk)
+            # cluster rebuild throttle: pace this part's bytes against
+            # the admin-tunable budget BEFORE commanding the rebuild
+            await self.rebuild.throttle(rb.bytes_est)
+            # re-check the write lock: the chunk may have been queued
+            # across ticks (concurrency cap) and throttled across
+            # awaits — a client write granted meanwhile must not race
+            # a rebuild assembled from parts it is mutating
+            if chunk.locked_until > time.monotonic():
+                return
+            attempted = True
             try:
                 reply = await link.command(
                     m.MatocsReplicate,
                     chunk_id=chunk.chunk_id, version=chunk.version,
                     part_id=geometry.ChunkPartType(t, part).id,
-                    sources=sources, timeout=60.0,
+                    sources=sources, trace_id=rb.trace_id, timeout=60.0,
                 )
             except (ConnectionError, asyncio.TimeoutError):
                 return
             if reply.status == st.OK:
+                ok = True
                 self._repl_fail_until.pop(chunk.chunk_id, None)
             else:
                 self.log.warning(
@@ -2226,7 +2483,25 @@ class MasterServer(Daemon):
                     time.monotonic() + 5.0
                 )
         finally:
-            self._replicating.discard((chunk.chunk_id, part))
+            if attempted:
+                # scheduler-side accounting: the span names the rebuild
+                # in trace-dump, the replicate SLO class catches slow
+                # rebuilds (flight-recording their timeline), the
+                # engine folds the outcome into progress/ETA
+                dt = time.perf_counter() - t0
+                self.trace_ring.record(
+                    rb.trace_id, "rebuild", tw0, time.time(),
+                    role="master", bytes=rb.bytes_est,
+                    chunk_id=chunk.chunk_id,
+                )
+                self.slo.observe(
+                    "replicate", dt, trace_id=rb.trace_id, name="rebuild"
+                )
+                self.rebuild.finished(rb, ok, rb.bytes_est if ok else 0)
+            else:
+                # never attempted (no target / link gone / re-locked):
+                # free the slot without polluting failure telemetry
+                self.rebuild.skipped(rb)
             # re-evaluate on the next tick until healthy — but only hot-
             # requeue chunks that can actually be repaired: an
             # unreadable chunk (fewer than k live parts) has no sources,
@@ -2236,12 +2511,25 @@ class MasterServer(Daemon):
             if state.needs_work and state.is_readable:
                 self.meta.registry.mark_endangered(chunk.chunk_id)
 
-    async def _move_part(self, chunk, src_cs: int, part: int, dst_cs: int) -> None:
+    async def _move_part(
+        self, chunk, src_cs: int, part: int, dst_cs: int,
+        rb: rebuild_mod.Rebuild | None = None,
+    ) -> None:
         """Rebalancing migration: replicate the part onto the target,
         then drop the source copy. The replicate window is long (up to
         60 s) and does NOT lock the chunk; if a client write bumped the
         version meanwhile, the fresh copy is stale — drop it and abort
         instead of registering it."""
+        if rb is None:  # direct callers (tests) bypass the scheduler
+            rb = rebuild_mod.Rebuild(
+                chunk_id=chunk.chunk_id, part=part,
+                priority=rebuild_mod.PRIORITY_REBALANCE, kind="move",
+                src_cs=src_cs, dst_cs=dst_cs,
+            )
+            rb.started_at = time.monotonic()
+            self.rebuild.active[rb.key] = rb
+        moved = False
+        attempted = False
         v0 = chunk.version
         try:
             t = geometry.SliceType(chunk.slice_type)
@@ -2249,12 +2537,14 @@ class MasterServer(Daemon):
             if link is None:
                 return
             part_id = geometry.ChunkPartType(t, part).id
+            await self.rebuild.throttle(rb.bytes_est)
+            attempted = True
             try:
                 reply = await link.command(
                     m.MatocsReplicate,
                     chunk_id=chunk.chunk_id, version=v0,
                     part_id=part_id, sources=self._locations_of(chunk),
-                    timeout=60.0,
+                    trace_id=rb.trace_id, timeout=60.0,
                 )
             except (ConnectionError, asyncio.TimeoutError):
                 return
@@ -2278,8 +2568,14 @@ class MasterServer(Daemon):
             self.meta.registry.record_part(chunk, dst_cs, part)
             await self._delete_redundant(chunk, src_cs, part)
             self.metrics.counter("rebalance_moves").inc()
+            moved = True
         finally:
-            self._replicating.discard((chunk.chunk_id, part))
+            if attempted:
+                self.rebuild.finished(
+                    rb, moved, rb.bytes_est if moved else 0
+                )
+            else:
+                self.rebuild.skipped(rb)
 
     async def _delete_redundant(self, chunk, cs_id: int, part: int) -> None:
         link = self.cs_links.get(cs_id)
@@ -2648,6 +2944,18 @@ class MasterServer(Daemon):
                 # a partial reload is a failure, details in the JSON
                 status=st.OK if not result.get("failed") else st.EINVAL,
                 json=json.dumps(result),
+            )
+        if msg.command == "rebuild-status":
+            # RebuildEngine progress: queue depths by priority class,
+            # active rebuilds, throttle config, rate + backlog ETA —
+            # plus the endangered FIFO feeding it
+            doc = self.rebuild.status()
+            doc["endangered_queue"] = len(self.meta.registry.endangered)
+            doc["stale_version_chunks"] = len(
+                self.meta.registry.stale_versions
+            )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if msg.command == "chunks-health":
             healthy = endangered = lost = 0

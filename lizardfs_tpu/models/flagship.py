@@ -60,6 +60,19 @@ def make_multichip_step(
     return sharded.sharded_encode_with_crcs(mesh, k, m, block_size)
 
 
+def make_multichip_reconstruct_step(
+    mesh, k: int, m: int, available: list[int], wanted: list[int],
+    block_size: int = MFSBLOCKSIZE,
+):
+    """Mesh-sharded rebuild of ``wanted`` lost parts from survivors —
+    the decode leg of the multichip story (see parallel.recovery)."""
+    from lizardfs_tpu.parallel import recovery
+
+    return recovery.sharded_reconstruct_with_crcs(
+        mesh, k, m, available, wanted, block_size
+    )
+
+
 def example_chunk(k: int, nbytes_per_part: int, seed: int = 0) -> np.ndarray:
     """Deterministic example data (k, nbytes_per_part) uint8."""
     rng = np.random.default_rng(seed)

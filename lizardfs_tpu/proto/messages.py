@@ -675,6 +675,46 @@ class CltomaUndelete(Message):
     )
 
 
+class CltomaFileRepair(Message):
+    """Repair a file with unrecoverable chunks (src/tools/file_repair.cc
+    analog): version-fix chunks whose only surviving parts are at a
+    stale version, zero-fill chunks with no parts at all, and route
+    still-repairable (readable) chunks through the RebuildEngine rather
+    than zeroing them."""
+
+    MSG_TYPE = 1072
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
+
+
+class MatoclFileRepair(Message):
+    """Repair verdict: json carries {"repaired_versions", "zeroed",
+    "queued_rebuild", "ok_chunks"} counts."""
+
+    MSG_TYPE = 1073
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
+
+
+class CltomaAppendChunks(Message):
+    """O(1) chunk-level concatenation (src/tools/append_file.cc
+    analog): pad ``inode_dst`` to a chunk boundary and share
+    ``inode_src``'s chunks onto its tail via the snapshot refcount
+    machinery (COW on later writes)."""
+
+    MSG_TYPE = 1074
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode_dst", "u32"),
+        ("inode_src", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
+
+
 # --------------------------------------------------------------------------
 # chunkserver <-> master
 # --------------------------------------------------------------------------
@@ -767,15 +807,23 @@ class MatocsSetVersion(Message):
 
 
 class MatocsReplicate(Message):
-    """Recover/copy a part from source parts (EC recovery engine)."""
+    """Recover/copy a part from source parts (EC recovery engine).
+
+    ``trace_id`` (trailing, skew-tolerant): the RebuildEngine's
+    per-rebuild trace — the executing chunkserver records its
+    replication span under the same id so `trace-dump` renders the
+    master-scheduler + chunkserver-executor timeline as one rebuild;
+    old peers decode/serve trace 0 = untraced."""
 
     MSG_TYPE = 1116
+    SKEW_TOLERANT_FROM = 5
     FIELDS = (
         ("req_id", "u32"),
         ("chunk_id", "u64"),
         ("version", "u32"),
         ("part_id", "u32"),
         ("sources", "list:msg:PartLocation"),
+        ("trace_id", "u64"),
     )
 
 

@@ -110,6 +110,14 @@ def end(started: bool) -> None:
         clear_trace()
 
 
+def adopt_trace(tid: int) -> None:
+    """Join an existing trace whose id arrived on the wire (e.g. the
+    RebuildEngine's per-rebuild id riding MatocsReplicate) so every
+    downstream op in this task propagates it."""
+    if _ENABLED and tid:
+        CURRENT.set((tid, 0))
+
+
 def clear_trace() -> None:
     CURRENT.set(None)
 

@@ -75,7 +75,7 @@ async def _amain(argv) -> int:
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
-            "trace-dump", "health", "slowops",
+            "trace-dump", "health", "slowops", "rebuild-status",
         ],
     )
     p.add_argument("extra", nargs="*",
@@ -138,6 +138,8 @@ async def _amain(argv) -> int:
     doc = json.loads(reply.json) if reply.json else {}
     if cmd == "health":
         _print_health(doc)
+    elif cmd == "rebuild-status":
+        _print_rebuild(doc)
     elif cmd == "slowops":
         for e in doc.get("slowops", []):
             cap = "captured" if e.get("captured") else "uncaptured"
@@ -162,6 +164,44 @@ async def _amain(argv) -> int:
     else:
         print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
+
+
+def _print_rebuild(doc: dict) -> None:
+    """Render the master RebuildEngine's progress report."""
+    q = doc.get("queued", {})
+    thr = doc.get("throttle", {})
+    bps = thr.get("rebuild_bps", 0)
+    eta = doc.get("eta_s")
+    print(
+        f"queued: lost {q.get('lost', 0)}  "
+        f"endangered {q.get('endangered', 0)}  "
+        f"rebalance {q.get('rebalance', 0)}  "
+        f"(endangered-fifo {doc.get('endangered_queue', 0)})"
+    )
+    print(
+        f"active {len(doc.get('active', []))}/"
+        f"{thr.get('rebuild_concurrency', 0)}  "
+        f"throttle {bps if bps else 'unlimited'} B/s  "
+        f"rate {doc.get('rate_bps', 0):.0f} B/s  "
+        f"eta {f'{eta:.0f}s' if eta is not None else '-'}"
+    )
+    print(
+        f"completed {doc.get('completed', 0)}  "
+        f"failed {doc.get('failed', 0)}  "
+        f"bytes {doc.get('bytes_rebuilt', 0)}"
+    )
+    for rb in doc.get("active", []):
+        print(
+            f"  active {rb['kind']:<9s} chunk {rb['chunk_id']:016X} "
+            f"part {rb['part']:<3d} [{rb['class']}] "
+            f"{rb['running_s']:.1f}s trace 0x{rb['trace_id']:x}"
+        )
+    for e in doc.get("recent", [])[:8]:
+        state = "ok" if e["ok"] else "FAILED"
+        print(
+            f"  recent {e['kind']:<9s} chunk {e['chunk_id']:016X} "
+            f"part {e['part']:<3d} [{e['class']}] {state} {e['ms']:.0f}ms"
+        )
 
 
 def _print_health(doc: dict) -> None:

@@ -291,6 +291,33 @@ async def cmd_checkfile(c: Client, args) -> int:
     return 0 if problems == 0 else 1
 
 
+async def cmd_filerepair(c: Client, args) -> int:
+    """Repair a file with missing/unrecoverable chunks: repairable
+    chunks are rebuilt through the master's RebuildEngine, stale-version
+    survivors are version-fixed, and only truly unrecoverable chunks
+    are zero-filled (reference: mfsfilerepair)."""
+    a = await c.resolve(args.path)
+    counts = await c.filerepair(a.inode)
+    print(
+        f"{args.path}: ok {counts['ok_chunks']}, "
+        f"queued-rebuild {counts['queued_rebuild']}, "
+        f"version-fixed {counts['repaired_versions']}, "
+        f"zeroed {counts['zeroed']}"
+    )
+    return 0 if counts["zeroed"] == 0 else 1
+
+
+async def cmd_appendchunks(c: Client, args) -> int:
+    """Append SRC file(s) onto DST chunk-wise in O(1) per chunk (the
+    chunks are shared, not copied; reference: mfsappendchunks)."""
+    dst = await c.resolve(args.dst)
+    for src_path in args.srcs:
+        src = await c.resolve(src_path)
+        attr = await c.append_chunks(dst.inode, src.inode)
+    print(f"{args.dst}: now {attr.length} bytes")
+    return 0
+
+
 async def _walk_size(c: Client, inode: int) -> tuple[int, int, int]:
     """(files, dirs, bytes) under a directory (dirinfo analog)."""
     files = dirs = total = 0
@@ -493,6 +520,10 @@ COMMANDS = {
     "truncate": (cmd_truncate, [("size", {"type": int}), ("path", {})]),
     "fileinfo": (cmd_fileinfo, [("path", {})]),
     "checkfile": (cmd_checkfile, [("path", {})]),
+    "filerepair": (cmd_filerepair, [("path", {})]),
+    "appendchunks": (cmd_appendchunks, [
+        ("dst", {}), ("srcs", {"nargs": "+"}),
+    ]),
     "dirinfo": (cmd_dirinfo, [("path", {})]),
     "rremove": (cmd_rremove, [("path", {})]),
     "snapshot": (cmd_snapshot, [("src", {}), ("dst", {})]),

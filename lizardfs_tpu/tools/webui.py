@@ -8,6 +8,7 @@ live HTML dashboard plus raw JSON endpoints.
     python -m lizardfs_tpu.tools.webui --master 127.0.0.1:9420 --port 9425
 
 Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics,
+/api/rebuild (RebuildEngine progress/ETA JSON),
 /metrics (Prometheus text exposition of the master's registry),
 /health (cluster health rollup JSON — SLO burn, per-CS snapshots)
 """
@@ -49,6 +50,16 @@ PAGE = """<!doctype html>
 <h2>chunkservers</h2>
 <table><tr><th>id</th><th>address</th><th>label</th><th>state</th>
 <th>used / total GiB</th></tr>{servers}</table>
+<h2>rebuild engine</h2>
+<table>
+<tr><th>queued (lost / endangered / rebalance)</th>
+    <td><span class="{lostq_cls}">{q_lost}</span> /
+        {q_endangered} / {q_rebalance}</td></tr>
+<tr><th>active / cap</th><td>{rb_active} / {rb_cap}</td></tr>
+<tr><th>throttle</th><td>{rb_throttle}</td></tr>
+<tr><th>completed / failed</th><td>{rb_completed} / {rb_failed}</td></tr>
+<tr><th>rate / ETA</th><td>{rb_rate} MB/s &mdash; {rb_eta}</td></tr>
+</table>
 <h2>metadata ops (last 120 s)</h2>
 <pre>{ops}</pre>
 <h2>charts &mdash; range: {range_links} (showing {span})</h2>
@@ -127,6 +138,14 @@ class Dashboard:
             ).json
         )
 
+    def rebuild_status(self) -> dict:
+        """The master RebuildEngine's progress/ETA document."""
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="rebuild-status", json="{}")
+            ).json
+        )
+
     def metrics(self, resolution: str = "sec") -> dict:
         return json.loads(
             self._call(
@@ -173,6 +192,10 @@ class Dashboard:
     def render(self, res: str = "sec") -> str:
         info = self.info()
         health = self.health()
+        try:
+            rb = self.rebuild_status()
+        except Exception:  # noqa: BLE001 — older master: no verb
+            rb = {}
         rows = []
         for s in info.get("chunkservers", []):
             state = (
@@ -229,7 +252,29 @@ class Dashboard:
              else f'<a style="color:#8ab4f8" href="/?res={r}">{r}</a>')
             for r in SPANS
         )
+        rb_q = rb.get("queued", {})
+        rb_thr = rb.get("throttle", {})
+        rb_eta = rb.get("eta_s")
+        rb_bps = rb_thr.get("rebuild_bps", 0)
         return PAGE.format(
+            q_lost=rb_q.get("lost", 0),
+            q_endangered=rb_q.get("endangered", 0),
+            q_rebalance=rb_q.get("rebalance", 0),
+            lostq_cls="bad" if rb_q.get("lost") else "ok",
+            rb_active=len(rb.get("active", [])),
+            rb_cap=rb_thr.get("rebuild_concurrency", 0),
+            rb_throttle=(f"{rb_bps / 1e6:.1f} MB/s" if rb_bps
+                         else "unlimited"),
+            rb_completed=rb.get("completed", 0),
+            rb_failed=rb.get("failed", 0),
+            rb_rate=f"{rb.get('rate_bps', 0) / 1e6:.1f}",
+            # eta None means EITHER no backlog (idle) or a backlog with
+            # no completions in the rate window yet (stalled/starting)
+            # — during an incident the second reading is the one that
+            # matters, so never render it as "idle"
+            rb_eta=(f"{rb_eta:.0f} s backlog" if rb_eta is not None
+                    else ("stalled backlog, no recent completions"
+                          if rb.get("pending_bytes", 0) else "idle")),
             personality=info.get("personality", "?"),
             version=info.get("version", 0),
             inodes=info.get("inodes", 0),
@@ -275,6 +320,12 @@ def make_handler(dash: Dashboard):
                     # probe endpoint ("is the cluster healthy?")
                     self._send(
                         json.dumps(dash.cluster_health()),
+                        "application/json",
+                    )
+                elif self.path == "/api/rebuild":
+                    # RebuildEngine progress/ETA (rebuild-status verb)
+                    self._send(
+                        json.dumps(dash.rebuild_status()),
                         "application/json",
                     )
                 elif self.path == "/api/info":

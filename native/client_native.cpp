@@ -694,3 +694,285 @@ const char* liz_strerror(int code) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Minimal NFSv3 wire client (RFC 1813 over ONC-RPC/RFC 5531, TCP record
+// marking, AUTH_SYS) — the non-Python measuring client for the NFS
+// gateway. Scope: MNT + LOOKUP + CREATE + READ + WRITE + COMMIT, enough
+// to drive dd-style throughput against the gateway without Python
+// anywhere on the client side (the gateway bench's other row uses the
+// asyncio client; comparing the two separates server cost from
+// measuring-client cost).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class XdrW {
+  public:
+    XdrW& u32(uint32_t v) {
+        buf_.push_back(static_cast<uint8_t>(v >> 24));
+        buf_.push_back(static_cast<uint8_t>(v >> 16));
+        buf_.push_back(static_cast<uint8_t>(v >> 8));
+        buf_.push_back(static_cast<uint8_t>(v));
+        return *this;
+    }
+    XdrW& u64(uint64_t v) {
+        u32(static_cast<uint32_t>(v >> 32));
+        return u32(static_cast<uint32_t>(v));
+    }
+    XdrW& opaque(const uint8_t* p, uint32_t n) {
+        u32(n);
+        buf_.insert(buf_.end(), p, p + n);
+        while (buf_.size() % 4) buf_.push_back(0);
+        return *this;
+    }
+    XdrW& str(const char* s) {
+        return opaque(reinterpret_cast<const uint8_t*>(s),
+                      static_cast<uint32_t>(strlen(s)));
+    }
+    const std::vector<uint8_t>& bytes() const { return buf_; }
+
+  private:
+    std::vector<uint8_t> buf_;
+};
+
+class XdrR {
+  public:
+    XdrR(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+    bool ok() const { return ok_; }
+    uint32_t u32() {
+        if (pos_ + 4 > n_) { ok_ = false; return 0; }
+        uint32_t v = (uint32_t(p_[pos_]) << 24) |
+                     (uint32_t(p_[pos_ + 1]) << 16) |
+                     (uint32_t(p_[pos_ + 2]) << 8) | p_[pos_ + 3];
+        pos_ += 4;
+        return v;
+    }
+    uint64_t u64() {
+        uint64_t hi = u32();
+        return (hi << 32) | u32();
+    }
+    void skip(size_t n) {
+        n = (n + 3) & ~size_t(3);
+        if (pos_ + n > n_) { ok_ = false; return; }
+        pos_ += n;
+    }
+    // var-length opaque into out (bounded by cap); returns length
+    uint32_t opaque(uint8_t* out, uint32_t cap) {
+        uint32_t len = u32();
+        if (!ok_ || pos_ + ((len + 3) & ~3u) > n_ || len > cap) {
+            ok_ = false;
+            return 0;
+        }
+        memcpy(out, p_ + pos_, len);
+        pos_ += (len + 3) & ~3u;
+        return len;
+    }
+    void skip_post_op_attr() {
+        if (u32()) skip(84);  // fattr3 is 84 fixed bytes
+    }
+    void skip_wcc_data() {
+        if (u32()) skip(24);  // pre_op wcc_attr
+        skip_post_op_attr();
+    }
+
+  private:
+    const uint8_t* p_;
+    size_t n_;
+    size_t pos_ = 0;
+    bool ok_ = true;
+};
+
+enum : uint32_t {
+    kProgNfs = 100003,
+    kProgMount = 100005,
+    kNfsLookup = 3,
+    kNfsRead = 6,
+    kNfsWrite = 7,
+    kNfsCreate = 8,
+    kNfsCommit = 21,
+    kMntMnt = 1,
+};
+
+}  // namespace
+
+struct liz_nfs {
+    int fd = -1;
+    uint32_t xid = 1;
+    uint32_t uid = 0, gid = 0;
+    std::vector<uint8_t> reply;
+    std::mutex mu;
+
+    ~liz_nfs() {
+        if (fd >= 0) ::close(fd);
+    }
+
+    // one RPC round trip; returns the XDR results region (after the
+    // rpc reply header) in `reply` via XdrR, or nullptr on failure
+    bool call(uint32_t prog, uint32_t vers, uint32_t proc,
+              const std::vector<uint8_t>& args) {
+        XdrW hdr;
+        uint32_t this_xid = xid++;
+        hdr.u32(this_xid).u32(0).u32(2).u32(prog).u32(vers).u32(proc);
+        // AUTH_SYS credential: stamp, machine, uid, gid, gids<1>
+        XdrW cred;
+        cred.u32(0).str("cclient").u32(uid).u32(gid).u32(1).u32(gid);
+        hdr.u32(1).opaque(cred.bytes().data(),
+                          static_cast<uint32_t>(cred.bytes().size()));
+        hdr.u32(0).u32(0);  // verf AUTH_NONE
+        std::vector<uint8_t> rec;
+        uint32_t total =
+            static_cast<uint32_t>(hdr.bytes().size() + args.size());
+        rec.reserve(4 + total);
+        uint32_t mark = 0x80000000u | total;  // single last fragment
+        rec.push_back(static_cast<uint8_t>(mark >> 24));
+        rec.push_back(static_cast<uint8_t>(mark >> 16));
+        rec.push_back(static_cast<uint8_t>(mark >> 8));
+        rec.push_back(static_cast<uint8_t>(mark));
+        rec.insert(rec.end(), hdr.bytes().begin(), hdr.bytes().end());
+        rec.insert(rec.end(), args.begin(), args.end());
+        if (!send_all(fd, rec.data(), rec.size())) return false;
+        // reassemble the reply record (fragments until the last bit)
+        reply.clear();
+        for (;;) {
+            uint8_t mh[4];
+            if (!recv_all(fd, mh, 4)) return false;
+            uint32_t m = (uint32_t(mh[0]) << 24) | (uint32_t(mh[1]) << 16) |
+                         (uint32_t(mh[2]) << 8) | mh[3];
+            uint32_t len = m & 0x7fffffffu;
+            size_t base = reply.size();
+            reply.resize(base + len);
+            if (len && !recv_all(fd, reply.data() + base, len)) return false;
+            if (m & 0x80000000u) break;
+        }
+        // rpc reply header: xid, REPLY(1), MSG_ACCEPTED(0),
+        // verf(flavor+opaque), SUCCESS(0)
+        XdrR r(reply.data(), reply.size());
+        if (r.u32() != this_xid || r.u32() != 1 || r.u32() != 0)
+            return false;
+        r.u32();
+        uint32_t vlen = r.u32();
+        r.skip(vlen);
+        if (r.u32() != 0 || !r.ok()) return false;
+        // record where the XDR results start (behind xid + REPLY +
+        // accepted + verf(flavor + padded opaque) + accept_stat) so
+        // result parsers never re-derive the header layout
+        results_off = 5 * 4 + ((vlen + 3) & ~3u) + 4;
+        return true;
+    }
+
+    size_t results_off = 0;  // set by call(): start of the results region
+};
+
+extern "C" {
+
+liz_nfs_t* liz_nfs_connect(const char* host, int port, uint32_t uid,
+                           uint32_t gid) {
+    auto* h = new liz_nfs();
+    h->fd = connect_tcp(host, static_cast<uint16_t>(port));
+    if (h->fd < 0) {
+        delete h;
+        return nullptr;
+    }
+    set_recv_timeout(h->fd, 30);
+    h->uid = uid;
+    h->gid = gid;
+    return h;
+}
+
+void liz_nfs_close(liz_nfs_t* h) { delete h; }
+
+int liz_nfs_mount(liz_nfs_t* h, const char* path, uint8_t* fh_out,
+                  uint32_t* fh_len) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.str(path);
+    if (!h->call(kProgMount, 3, kMntMnt, args.bytes())) return -1;
+    size_t off = h->results_off;
+    XdrR r(h->reply.data() + off, h->reply.size() - off);
+    uint32_t status = r.u32();
+    if (status != 0) return static_cast<int>(status);
+    *fh_len = r.opaque(fh_out, 64);
+    return r.ok() ? 0 : -1;
+}
+
+static int nfs_fh_result(liz_nfs_t* h, uint8_t* fh_out, uint32_t* fh_len,
+                         bool post_op_fh) {
+    size_t off = h->results_off;
+    XdrR r(h->reply.data() + off, h->reply.size() - off);
+    uint32_t status = r.u32();
+    if (status != 0) return static_cast<int>(status);
+    if (post_op_fh && r.u32() == 0) return -1;  // handle must follow
+    *fh_len = r.opaque(fh_out, 64);
+    return r.ok() ? 0 : -1;
+}
+
+int liz_nfs_lookup(liz_nfs_t* h, const uint8_t* dirfh, uint32_t dlen,
+                   const char* name, uint8_t* fh_out, uint32_t* fh_len) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.opaque(dirfh, dlen).str(name);
+    if (!h->call(kProgNfs, 3, kNfsLookup, args.bytes())) return -1;
+    return nfs_fh_result(h, fh_out, fh_len, false);
+}
+
+int liz_nfs_create(liz_nfs_t* h, const uint8_t* dirfh, uint32_t dlen,
+                   const char* name, uint8_t* fh_out, uint32_t* fh_len) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.opaque(dirfh, dlen).str(name);
+    args.u32(0);  // how = UNCHECKED + sattr3
+    args.u32(1).u32(0644);  // mode set
+    args.u32(0).u32(0).u32(0);  // uid/gid/size unset
+    args.u32(0).u32(0);  // atime/mtime: don't change
+    if (!h->call(kProgNfs, 3, kNfsCreate, args.bytes())) return -1;
+    return nfs_fh_result(h, fh_out, fh_len, true);
+}
+
+int64_t liz_nfs_write(liz_nfs_t* h, const uint8_t* fh, uint32_t fhlen,
+                      uint64_t offset, uint32_t count, const uint8_t* buf,
+                      int stable) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.opaque(fh, fhlen).u64(offset).u32(count).u32(
+        static_cast<uint32_t>(stable));
+    args.opaque(buf, count);
+    if (!h->call(kProgNfs, 3, kNfsWrite, args.bytes())) return -1;
+    size_t off = h->results_off;
+    XdrR r(h->reply.data() + off, h->reply.size() - off);
+    uint32_t status = r.u32();
+    r.skip_wcc_data();
+    if (status != 0) return -static_cast<int64_t>(status);
+    uint32_t written = r.u32();
+    return r.ok() ? static_cast<int64_t>(written) : -1;
+}
+
+int64_t liz_nfs_read(liz_nfs_t* h, const uint8_t* fh, uint32_t fhlen,
+                     uint64_t offset, uint32_t count, uint8_t* buf) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.opaque(fh, fhlen).u64(offset).u32(count);
+    if (!h->call(kProgNfs, 3, kNfsRead, args.bytes())) return -1;
+    size_t off = h->results_off;
+    XdrR r(h->reply.data() + off, h->reply.size() - off);
+    uint32_t status = r.u32();
+    r.skip_post_op_attr();
+    if (status != 0) return -static_cast<int64_t>(status);
+    r.u32();  // count (the opaque length is authoritative)
+    r.u32();  // eof
+    uint32_t got = r.opaque(buf, count);
+    return r.ok() ? static_cast<int64_t>(got) : -1;
+}
+
+int liz_nfs_commit(liz_nfs_t* h, const uint8_t* fh, uint32_t fhlen) {
+    std::lock_guard<std::mutex> g(h->mu);
+    XdrW args;
+    args.opaque(fh, fhlen).u64(0).u32(0);
+    if (!h->call(kProgNfs, 3, kNfsCommit, args.bytes())) return -1;
+    size_t off = h->results_off;
+    XdrR r(h->reply.data() + off, h->reply.size() - off);
+    uint32_t status = r.u32();
+    return status == 0 ? 0 : static_cast<int>(status);
+}
+
+}  // extern "C"

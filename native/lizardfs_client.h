@@ -89,6 +89,32 @@ int64_t liz_write(liz_t* fs, uint32_t inode, uint64_t offset, uint64_t size,
 
 const char* liz_strerror(int code);
 
+/* --- minimal NFSv3 wire client (RFC 1813 over ONC-RPC, AUTH_SYS) ----
+ * The non-Python measuring client for the NFS gateway: MNT + LOOKUP +
+ * CREATE + READ + WRITE + COMMIT, blocking, one connection per handle.
+ * File handles are opaque blobs up to 64 bytes (fh_out buffers must
+ * hold 64). Return codes: 0 = OK, >0 = nfsstat3, -1 = connection /
+ * protocol failure; read/write return the byte count, a negated
+ * nfsstat3, or -1. */
+typedef struct liz_nfs liz_nfs_t;
+
+liz_nfs_t* liz_nfs_connect(const char* host, int port, uint32_t uid,
+                           uint32_t gid);
+void liz_nfs_close(liz_nfs_t* h);
+int liz_nfs_mount(liz_nfs_t* h, const char* path, uint8_t* fh_out,
+                  uint32_t* fh_len);
+int liz_nfs_lookup(liz_nfs_t* h, const uint8_t* dirfh, uint32_t dirfh_len,
+                   const char* name, uint8_t* fh_out, uint32_t* fh_len);
+int liz_nfs_create(liz_nfs_t* h, const uint8_t* dirfh, uint32_t dirfh_len,
+                   const char* name, uint8_t* fh_out, uint32_t* fh_len);
+int64_t liz_nfs_read(liz_nfs_t* h, const uint8_t* fh, uint32_t fh_len,
+                     uint64_t offset, uint32_t count, uint8_t* buf);
+/* stable: 0 = UNSTABLE (pair with liz_nfs_commit), 2 = FILE_SYNC */
+int64_t liz_nfs_write(liz_nfs_t* h, const uint8_t* fh, uint32_t fh_len,
+                      uint64_t offset, uint32_t count, const uint8_t* buf,
+                      int stable);
+int liz_nfs_commit(liz_nfs_t* h, const uint8_t* fh, uint32_t fh_len);
+
 #ifdef __cplusplus
 }
 #endif

@@ -35,7 +35,7 @@ def _fat_row() -> dict:
         "box_cpus": 8, "box_memcpy_GBps": 11.2, "box_pyloop_ms": 102.4,
     }
     goals = ("goal_1_1_copy", "goal_2_2_copies", "xor3", "ec3_2", "ec8_4",
-             "nfs_gateway")
+             "nfs_gateway", "nfs_gateway_C_client")
     for g in goals:
         row[f"cluster_{g}_write_MBps"] = 1234.5
         row[f"cluster_{g}_read_MBps"] = 2345.6
@@ -74,6 +74,10 @@ def _fat_row() -> dict:
         "read": 400, "write": 400, "locate": 234, "replicate": 100,
         "nfs": 100,
     }
+    # rebuild subsystem fiducials (round 6: RebuildEngine bench row)
+    row["cluster_rebuild_MBps"] = 1234.5
+    row["cluster_rebuild_s"] = 12.34
+    row["cluster_rebuild_parts"] = 48
     return row
 
 
@@ -91,6 +95,12 @@ def test_summary_line_fits_driver_tail():
     assert parsed["cluster_health_status"] == "degraded"
     assert parsed["cluster_slo_breaches"] == 1234
     assert parsed["cluster_slow_ops"] == 48
+    # the rebuild row survives compaction (RebuildEngine fiducials)
+    assert parsed["cluster_rebuild_MBps"] == 1234.5
+    assert parsed["cluster_rebuild_s"] == 12.34
+    # the C-client NFS row is full-file-only (decision-note input):
+    # it must never crowd verdict-bearing rows out of the tail
+    assert not any("C_client" in k for k in parsed)
 
 
 def test_summary_budget_guard_drops_not_truncates():

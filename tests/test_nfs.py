@@ -587,3 +587,47 @@ async def test_nfs_trace_propagation_to_chunkserver(tmp_path):
     finally:
         await gw.stop()
         await cluster.stop()
+
+
+async def test_nfs_native_c_client_roundtrip(tmp_path):
+    """The non-Python measuring client: the C NFS3 client
+    (native/client_native.cpp liz_nfs_* over ONC-RPC/AUTH_SYS) drives
+    MNT/CREATE/WRITE/COMMIT/LOOKUP/READ against the gateway and the
+    bytes roundtrip — so the gateway bench's C-client row measures a
+    real wire client, not this package's own asyncio codec."""
+    import asyncio
+
+    from lizardfs_tpu.nfs import cnfs
+
+    if not cnfs.available():
+        pytest.skip("liblizardfs_client.so not built with liz_nfs_*")
+    cluster, gw = await gateway_cluster(tmp_path)
+    try:
+        blob = bytes(range(256)) * 1024  # 256 KiB
+
+        def drive() -> bytes:
+            with cnfs.CNfs3Client("127.0.0.1", gw.port) as c:
+                root = c.mnt("/")
+                fh = c.create(root, "cclient.bin")
+                for off in range(0, len(blob), 65536):
+                    piece = blob[off:off + 65536]
+                    assert c.write(fh, off, piece, stable=0) == len(piece)
+                c.commit(fh)
+                assert c.lookup(root, "cclient.bin") == fh
+                out = b""
+                while len(out) < len(blob):
+                    out += c.read(fh, len(out), 65536)
+                return out
+
+        got = await asyncio.to_thread(drive)
+        assert got == blob
+        # and the file is the same one the Python stack sees
+        async with Nfs3Client("127.0.0.1", gw.port) as pc:
+            root = await pc.mnt("/")
+            code, fh, _attr = await pc.lookup(root, "cclient.bin")
+            assert code == nfs.NFS3_OK
+            data, _eof = await pc.read(fh, 0, 1024)
+            assert data == blob[:1024]
+    finally:
+        await gw.stop()
+        await cluster.stop()

@@ -1,0 +1,524 @@
+"""RebuildEngine scheduler + the cluster-facing rebuild subsystem.
+
+Unit coverage for master/rebuild.py (priority classes, dedupe,
+concurrency cap, throttle plumbing, progress/ETA accounting) plus the
+acceptance e2e: a stopped chunkserver's parts are rebuilt through the
+engine — under a byte/s throttle, with per-rebuild trace spans on both
+the master (scheduler) and the executing chunkserver, `replicate` SLO
+accounting, and `rebuild-status` progress visible over the admin link.
+Also covers the filerepair and appendchunks verbs end to end.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from lizardfs_tpu.core import geometry
+from lizardfs_tpu.master import rebuild as rbmod
+from lizardfs_tpu.master.chunks import ChunkRegistry
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster, EC_GOAL
+
+
+# --- engine unit tests ------------------------------------------------------
+
+
+def _rb(cid, part, prio, **kw):
+    return rbmod.Rebuild(chunk_id=cid, part=part, priority=prio, **kw)
+
+
+def test_priority_order_and_dedupe():
+    eng = rbmod.RebuildEngine()
+    assert eng.submit(_rb(1, 0, rbmod.PRIORITY_REBALANCE, kind="move"))
+    assert eng.submit(_rb(2, 0, rbmod.PRIORITY_ENDANGERED))
+    assert eng.submit(_rb(3, 0, rbmod.PRIORITY_LOST))
+    # duplicates (same chunk, part) are refused while queued
+    assert not eng.submit(_rb(2, 0, rbmod.PRIORITY_LOST))
+    batch = eng.next_batch()
+    assert [rb.chunk_id for rb in batch] == [3, 2, 1]  # lost first
+    # active rebuilds also block resubmission
+    assert not eng.submit(_rb(3, 0, rbmod.PRIORITY_LOST))
+    for rb in batch:
+        eng.finished(rb, ok=True, nbytes=100)
+    assert eng.completed == 3 and eng.bytes_rebuilt == 300
+    assert eng.submit(_rb(3, 0, rbmod.PRIORITY_LOST))  # free again
+
+
+def test_concurrency_cap_and_status():
+    eng = rbmod.RebuildEngine()
+    eng._max_active.value = 2
+    for cid in range(5):
+        eng.submit(_rb(cid, 0, rbmod.PRIORITY_ENDANGERED, bytes_est=1000))
+    first = eng.next_batch()
+    assert len(first) == 2
+    assert eng.next_batch() == []  # cap reached
+    st = eng.status()
+    assert st["queued"]["endangered"] == 3
+    assert len(st["active"]) == 2
+    assert st["pending_bytes"] == 5000
+    assert st["throttle"]["rebuild_concurrency"] == 2
+    eng.finished(first[0], ok=False)
+    assert eng.failed == 1
+    assert len(eng.next_batch()) == 1  # slot freed
+    st = eng.status()
+    assert st["recent"][0]["ok"] is False
+
+
+def test_rate_and_eta_accounting():
+    eng = rbmod.RebuildEngine()
+    rb = _rb(1, 0, rbmod.PRIORITY_LOST, bytes_est=1 << 20)
+    eng.submit(rb)
+    (launched,) = eng.next_batch()
+    eng.finished(launched, ok=True, nbytes=1 << 20)
+    assert eng.rate_bps() > 0
+    eng.submit(_rb(2, 0, rbmod.PRIORITY_LOST, bytes_est=1 << 20))
+    st = eng.status()
+    assert st["eta_s"] is not None and st["eta_s"] > 0
+    assert st["bytes_rebuilt"] == 1 << 20
+
+
+@pytest.mark.asyncio
+async def test_throttle_paces_bytes():
+    eng = rbmod.RebuildEngine()
+    # unlimited: returns immediately
+    await asyncio.wait_for(eng.throttle(10 << 20), 0.5)
+    # limited: a request 1.5x the burst must sleep its debt off (the
+    # debt model — big parts pace at rate instead of deadlocking)
+    eng._bps.value = 50_000_000
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    await eng.throttle(75_000_000)  # >= 0.5 s of debt at 50 MB/s
+    assert loop.time() - t0 > 0.2
+
+
+def test_classify_priorities():
+    reg = ChunkRegistry()
+    a = reg.register_server("h", 1, "_", 100, 0)
+    b = reg.register_server("h", 2, "_", 100, 0)
+    ec = geometry.ec_type(3, 2)
+    # ec(3,2) with exactly k live parts: next loss loses data -> lost
+    chunk = reg.create_chunk(int(ec))
+    reg.record_part(chunk, a.cs_id, 0)
+    reg.record_part(chunk, b.cs_id, 1)
+    reg.record_part(chunk, a.cs_id, 2)
+    state = reg.evaluate(chunk)
+    assert rbmod.classify(chunk, state) == rbmod.PRIORITY_LOST
+    # with 4 live parts on 4 DISTINCT servers (one part missing, but
+    # any single server loss still leaves k): endangered, not lost
+    c = reg.register_server("h", 3, "_", 100, 0)
+    d = reg.register_server("h", 4, "_", 100, 0)
+    chunk.parts.discard((a.cs_id, 2))
+    reg.record_part(chunk, d.cs_id, 2)
+    reg.record_part(chunk, c.cs_id, 3)
+    state = reg.evaluate(chunk)
+    assert state.missing_parts
+    assert rbmod.classify(chunk, state) == rbmod.PRIORITY_ENDANGERED
+    # standard 2-copy goal down to one copy: lost-class work
+    std = reg.create_chunk(geometry.STANDARD, copies=2)
+    reg.record_part(std, a.cs_id, 0)
+    state = reg.evaluate(std)
+    assert rbmod.classify(std, state) == rbmod.PRIORITY_LOST
+
+
+# --- admin helper -----------------------------------------------------------
+
+
+async def _admin(port, command, payload="{}"):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        await framing.send_message(
+            w, m.AdminCommand(req_id=1, command=command, json=payload)
+        )
+        return await framing.read_message(r)
+    finally:
+        w.close()
+
+
+# --- the acceptance e2e -----------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_rebuild_engine_end_to_end(tmp_path):
+    """Stop a chunkserver holding ec(3,2) parts: the endangered chunks
+    flow through the RebuildEngine (throttled, traced, SLO-accounted)
+    and redundancy is restored; rebuild-status reports the progress."""
+    cluster = Cluster(tmp_path, n_cs=6, native_data_plane=False)
+    await cluster.start()
+    try:
+        master = cluster.master
+        # throttle knobs: generous bps (the test must stay fast) but
+        # LOW concurrency so the cap is observable scheduling, plus the
+        # token bucket actually engages on every rebuild
+        assert master.tweaks.set("rebuild_bps", "200000000")
+        assert master.tweaks.set("rebuild_concurrency", "2")
+        c = await cluster.client()
+        f = await c.create(1, "rebuild.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(7, 2 * 65536 * 3 + 777).tobytes()
+        await c.write_file(f.inode, payload)
+
+        loc = await c.chunk_info(f.inode, 0)
+        victim_port = loc.locations[0].addr.port
+        victim = next(
+            cs for cs in cluster.chunkservers if cs.port == victim_port
+        )
+        await victim.stop()
+        cluster.chunkservers.remove(victim)
+
+        async def all_healthy() -> bool:
+            reg = master.meta.registry
+            return all(
+                not reg.evaluate(ch).needs_work
+                for ch in reg.chunks.values()
+            )
+
+        for _ in range(300):
+            if master.rebuild.completed >= 1 and await all_healthy():
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"rebuild never completed: {master.rebuild.status()}"
+            )
+
+        # 1) progress surfaced over the admin link
+        reply = await _admin(master.port, "rebuild-status")
+        assert reply.status == 0
+        doc = json.loads(reply.json)
+        assert doc["completed"] >= 1
+        assert doc["bytes_rebuilt"] > 0
+        assert doc["throttle"] == {
+            "rebuild_bps": 200000000, "rebuild_concurrency": 2,
+        }
+        assert doc["recent"] and doc["recent"][0]["trace_id"]
+        done = next(e for e in doc["recent"] if e["ok"])
+
+        # 2) per-rebuild trace: the master's scheduler span and the
+        # executing chunkserver's cs_replicate span share the trace id
+        tid = done["trace_id"]
+        master_spans = [
+            s for s in master.trace_spans(tid) if s["name"] == "rebuild"
+        ]
+        assert master_spans, "master never recorded the rebuild span"
+        cs_spans = [
+            s for cs in cluster.chunkservers
+            for s in cs.trace_spans(tid)
+            if s["name"] == "cs_replicate"
+        ]
+        assert cs_spans, "no chunkserver recorded the rebuild trace"
+
+        # 3) SLO integration: the replicate class accounted the rebuild
+        # on both roles
+        assert master.slo.objectives["replicate"].ops >= 1
+        assert any(
+            cs.slo.objectives["replicate"].ops >= 1
+            for cs in cluster.chunkservers
+        )
+
+        # 4) engine counters ride the metrics registry
+        assert master.metrics.counter("rebuilds_completed").total >= 1
+
+        # 5) the bytes survive the rebuild
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        assert await c.read_file(f.inode) == payload
+    finally:
+        await cluster.stop()
+
+
+# --- filerepair -------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_filerepair_zero_fills_unrecoverable(tmp_path):
+    """A goal-1 file whose only holder died: filerepair zero-fills the
+    chunk (hole) so the file reads again — zeros, but readable."""
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "dead.bin")
+        payload = b"x" * 200_000
+        await c.write_file(f.inode, payload)
+        loc = await c.chunk_info(f.inode, 0)
+        victim = next(
+            cs for cs in cluster.chunkservers
+            if cs.port == loc.locations[0].addr.port
+        )
+        await victim.stop()
+        cluster.chunkservers.remove(victim)
+        counts = await c.filerepair(f.inode)
+        assert counts["zeroed"] == 1 and counts["ok_chunks"] == 0
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        got = await c.read_file(f.inode)
+        assert got == b"\x00" * len(payload)  # zero-filled, readable
+        # idempotent: a second pass finds nothing to do
+        counts = await c.filerepair(f.inode)
+        assert counts == {"repaired_versions": 0, "zeroed": 0,
+                          "queued_rebuild": 0, "ok_chunks": 0}
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_filerepair_routes_repairable_to_rebuild(tmp_path):
+    """A degraded-but-readable ec(3,2) chunk is queued for rebuild —
+    never zeroed — and comes back healthy with its bytes intact."""
+    cluster = Cluster(tmp_path, n_cs=6, native_data_plane=False)
+    await cluster.start(health_interval=3600.0)  # manual ticks only
+    try:
+        master = cluster.master
+        c = await cluster.client()
+        f = await c.create(1, "deg.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(8, 3 * 65536).tobytes()
+        await c.write_file(f.inode, payload)
+        loc = await c.chunk_info(f.inode, 0)
+        victim = next(
+            cs for cs in cluster.chunkservers
+            if cs.port == loc.locations[0].addr.port
+        )
+        await victim.stop()
+        cluster.chunkservers.remove(victim)
+        counts = await c.filerepair(f.inode)
+        assert counts["queued_rebuild"] == 1 and counts["zeroed"] == 0
+        for _ in range(300):
+            await master._health_tick()
+            reg = master.meta.registry
+            if all(not reg.evaluate(ch).needs_work
+                   for ch in reg.chunks.values()):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("repairable chunk was never rebuilt")
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        assert await c.read_file(f.inode) == payload
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_filerepair_version_fix_from_stale_parts(tmp_path):
+    """Version-fix: when every live copy missed a version bump (the
+    registry retained them as stale material), filerepair adopts the
+    newest readable stale version instead of zeroing."""
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
+    await cluster.start(health_interval=3600.0)
+    try:
+        master = cluster.master
+        reg = master.meta.registry
+        c = await cluster.client()
+        f = await c.create(1, "stale.bin")
+        payload = b"v" * 100_000
+        await c.write_file(f.inode, payload)
+        node = master.meta.fs.file_node(f.inode)
+        cid = node.chunks[0]
+        chunk = reg.chunk(cid)
+        old_version = chunk.version
+        holders = sorted(chunk.parts)
+        # simulate "every copy missed the bump": unregister the live
+        # parts, bump the version, and retain the copies as stale
+        reg.unregister_parts(chunk, set(holders))
+        master.commit({"op": "bump_chunk_version", "chunk_id": cid,
+                       "version": old_version + 7})
+        t = geometry.SliceType(chunk.slice_type)
+        for cs_id, part in holders:
+            reg.record_stale(
+                cid, cs_id, geometry.ChunkPartType(t, part).id, old_version
+            )
+        assert not reg.evaluate(chunk).is_readable
+        counts = await c.filerepair(f.inode)
+        assert counts["repaired_versions"] == 1 and counts["zeroed"] == 0
+        assert chunk.version == old_version  # adopted the stale version
+        assert reg.evaluate(chunk).is_readable
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        assert await c.read_file(f.inode) == payload
+    finally:
+        await cluster.stop()
+
+
+# --- appendchunks -----------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_appendchunks_shares_chunks(tmp_path):
+    """O(1) concat: dst is padded to a chunk boundary, src's chunks are
+    shared (refcount), and a later write to the shared region COWs —
+    the source stays intact."""
+    from lizardfs_tpu.constants import MFSCHUNKSIZE
+
+    cluster = Cluster(tmp_path, n_cs=3, native_data_plane=False)
+    await cluster.start()
+    try:
+        master = cluster.master
+        c = await cluster.client()
+        dst = await c.create(1, "dst.bin")
+        src = await c.create(1, "src.bin")
+        dst_data = b"d" * 150_000
+        src_data = b"s" * 90_000
+        await c.write_file(dst.inode, dst_data)
+        await c.write_file(src.inode, src_data)
+        src_cid = master.meta.fs.file_node(src.inode).chunks[0]
+
+        attr = await c.append_chunks(dst.inode, src.inode)
+        assert attr.length == MFSCHUNKSIZE + len(src_data)
+        # the chunk is SHARED, not copied
+        assert master.meta.fs.file_node(dst.inode).chunks[1] == src_cid
+        assert master.meta.registry.chunk(src_cid).refcount == 2
+
+        # dst reads: original bytes, zero padding, then src bytes
+        assert await c.read_file(dst.inode, 0, len(dst_data)) == dst_data
+        pad = await c.read_file(dst.inode, len(dst_data), 4096)
+        assert pad == b"\x00" * 4096
+        tail = await c.read_file(dst.inode, MFSCHUNKSIZE, len(src_data))
+        assert tail == src_data
+
+        # write into dst's shared tail: COW — src must not change
+        await c.pwrite(dst.inode, MFSCHUNKSIZE, b"Z" * 1000)
+        assert master.meta.fs.file_node(dst.inode).chunks[1] != src_cid
+        assert master.meta.registry.chunk(src_cid).refcount == 1
+        c.cache.invalidate(src.inode)
+        assert await c.read_file(src.inode) == src_data
+
+        # self-append is refused
+        from lizardfs_tpu.proto import status as st
+
+        with pytest.raises(st.StatusError):
+            await c.append_chunks(dst.inode, dst.inode)
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_appendchunks_replays_on_shadow(tmp_path):
+    """The append_chunks changelog op replays deterministically: a
+    shadow applying the stream converges (digest check passes)."""
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
+    await cluster.start()
+    try:
+        master = cluster.master
+        c = await cluster.client()
+        dst = await c.create(1, "a.bin")
+        src = await c.create(1, "b.bin")
+        await c.write_file(dst.inode, b"1" * 50_000)
+        await c.write_file(src.inode, b"2" * 50_000)
+        await c.append_chunks(dst.inode, src.inode)
+        # incremental digest must agree with a full recompute after the
+        # new ops (the shadow-divergence guard for the new op types)
+        assert master.meta.full_digest() == master.meta._digest
+        counts = await c.filerepair(dst.inode)
+        assert counts["zeroed"] == 0
+        assert master.meta.full_digest() == master.meta._digest
+    finally:
+        await cluster.stop()
+
+
+def test_skipped_frees_slot_without_failure():
+    """A launched rebuild that never attempted work (no target / link
+    gone / chunk re-locked) releases its slot without touching the
+    failure counters — no-ops must not page anyone."""
+    eng = rbmod.RebuildEngine()
+    eng.submit(_rb(1, 0, rbmod.PRIORITY_LOST))
+    (launched,) = eng.next_batch()
+    eng.skipped(launched)
+    assert eng.failed == 0 and eng.completed == 0
+    assert not eng.active and not eng.recent
+    assert eng.submit(_rb(1, 0, rbmod.PRIORITY_LOST))  # slot free again
+
+
+@pytest.mark.asyncio
+async def test_stale_parts_reclaimed_once_chunk_readable(tmp_path):
+    """Stale-version parts retained while a chunk was unreadable are
+    disk waste once it recovers (rolling-restart pattern): the health
+    tick reclaims them."""
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
+    await cluster.start(health_interval=3600.0)  # manual ticks
+    try:
+        master = cluster.master
+        reg = master.meta.registry
+        c = await cluster.client()
+        f = await c.create(1, "r.bin")
+        await c.write_file(f.inode, b"x" * 10_000)
+        cid = master.meta.fs.file_node(f.inode).chunks[0]
+        cs_id = next(iter(reg.chunk(cid).parts))[0]
+        # a wrong-version copy recorded while the chunk LOOKED
+        # unreadable; the chunk is healthy now
+        reg.record_stale(cid, cs_id, 0, 1)
+        assert reg.evaluate(reg.chunk(cid)).is_readable
+        await master._health_tick()
+        assert cid not in reg.stale_versions
+        # unreadable chunks keep their repair material
+        dead = reg.create_chunk(geometry.ec_type(3, 2))
+        reg.record_stale(dead.chunk_id, cs_id, 0, 1)
+        await master._health_tick()
+        assert dead.chunk_id in reg.stale_versions
+    finally:
+        await cluster.stop()
+
+
+def test_submit_upgrades_priority_in_place():
+    """A chunk that degrades further while queued moves up a class
+    instead of waiting behind the backlog it no longer belongs to."""
+    eng = rbmod.RebuildEngine()
+    eng.submit(_rb(1, 0, rbmod.PRIORITY_ENDANGERED))
+    eng.submit(_rb(2, 0, rbmod.PRIORITY_ENDANGERED))
+    # chunk 2 degrades to lost-class: resubmission upgrades in place
+    assert not eng.submit(_rb(2, 0, rbmod.PRIORITY_LOST))
+    batch = eng.next_batch()
+    assert [rb.chunk_id for rb in batch] == [2, 1]
+    # a LOWER-priority resubmission never downgrades
+    eng2 = rbmod.RebuildEngine()
+    eng2.submit(_rb(3, 0, rbmod.PRIORITY_LOST))
+    assert not eng2.submit(_rb(3, 0, rbmod.PRIORITY_REBALANCE))
+    assert [rb.priority for rb in eng2.next_batch()] == [rbmod.PRIORITY_LOST]
+
+
+@pytest.mark.asyncio
+async def test_version_fix_unregisters_mixed_version_parts(tmp_path):
+    """Version-fix with a part still registered at the current (bumped)
+    version: adopting the stale version must unregister it — a
+    mixed-version location set serves WRONG_VERSION on reads while
+    evaluate() counts the chunk healthy — and retain it as stale
+    material in its turn."""
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
+    await cluster.start(health_interval=3600.0)
+    try:
+        master = cluster.master
+        reg = master.meta.registry
+        c = await cluster.client()
+        f = await c.create(1, "mixed.bin")
+        await c.setgoal(f.inode, 2)  # 2 copies -> 2 holders
+        await c.write_file(f.inode, b"m" * 50_000)
+        cid = master.meta.fs.file_node(f.inode).chunks[0]
+        chunk = reg.chunk(cid)
+        old_version = chunk.version
+        t = geometry.SliceType(chunk.slice_type)
+        hold_a, hold_b = sorted(chunk.parts)[:2]
+        # holder B missed nothing but gets re-registered stale at the
+        # old version after the bump; holder A stays registered at the
+        # NEW version (the mixed state under test)
+        reg.unregister_parts(chunk, {hold_b})
+        master.commit({"op": "bump_chunk_version", "chunk_id": cid,
+                       "version": old_version + 7})
+        reg.record_stale(
+            cid, hold_b[0],
+            geometry.ChunkPartType(t, hold_b[1]).id, old_version,
+        )
+        assert master._repair_chunk_version(chunk)
+        assert chunk.version == old_version
+        # the v+7 holder left the live set and became stale material
+        assert hold_a not in chunk.parts
+        assert hold_b in chunk.parts
+        retained = reg.stale_versions.get(cid, {})
+        assert retained.get(
+            (hold_a[0], geometry.ChunkPartType(t, hold_a[1]).id)
+        ) == old_version + 7
+    finally:
+        await cluster.stop()

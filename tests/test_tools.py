@@ -59,6 +59,20 @@ async def test_cli_end_to_end(tmp_path, capsys):
         st_doc = json.loads(capsys.readouterr().out)
         assert st_doc["length"] == 200_000
 
+        # a healthy file repairs to a no-op verdict
+        assert await run("filerepair", "/b.bin") == 0
+        assert "zeroed 0" in capsys.readouterr().out
+
+        # O(1) concat: dst grows to a chunk boundary + src's length
+        from lizardfs_tpu.constants import MFSCHUNKSIZE
+
+        small = tmp_path / "tail.bin"
+        small.write_bytes(b"tail-bytes" * 100)
+        assert await run("put", str(small), "/docs/tail.bin") == 0
+        capsys.readouterr()
+        assert await run("appendchunks", "/b.bin", "/docs/tail.bin") == 0
+        assert f"now {MFSCHUNKSIZE + 1000}" in capsys.readouterr().out
+
         # degraded checkfile: kill a chunkserver holding a part
         victim = cluster.chunkservers[0]
         await victim.stop()
@@ -102,6 +116,11 @@ async def test_admin_cli(tmp_path, capsys):
 
         # promote on an active master is an error
         assert await admin_cli._amain([master, "promote-shadow"]) == 1
+        capsys.readouterr()
+
+        assert await admin_cli._amain([master, "rebuild-status"]) == 0
+        out = capsys.readouterr().out
+        assert "queued: lost 0" in out and "throttle unlimited" in out
     finally:
         await cluster.stop()
 
@@ -174,10 +193,16 @@ async def test_webui_endpoints(tmp_path):
 
         html = await asyncio.to_thread(fetch, "/")
         assert "lizardfs-tpu" in html and "chunkservers" in html
+        assert "rebuild engine" in html
         info = json.loads(await asyncio.to_thread(fetch, "/api/info"))
         assert info["personality"] == "master"
         health = json.loads(await asyncio.to_thread(fetch, "/api/health"))
         assert set(health) == {"healthy", "endangered", "lost"}
+        rebuild = json.loads(await asyncio.to_thread(fetch, "/api/rebuild"))
+        assert rebuild["queued"] == {
+            "lost": 0, "endangered": 0, "rebalance": 0,
+        }
+        assert "eta_s" in rebuild and "throttle" in rebuild
         httpd.shutdown()
     finally:
         await cluster.stop()
