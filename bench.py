@@ -398,6 +398,10 @@ def cluster_throughput() -> dict:
                     # over the row's write reps — the instrument the
                     # 4-round ec(8,4) miss has been waiting for
                     out[f"cluster_{key}_write_phases"] = r["write_phases_ms"]
+                if "write_window" in r:
+                    # adaptive write-window fiducials (depth settled,
+                    # segments sent, credit stalls, coalesced commits)
+                    out[f"cluster_{key}_write_window"] = r["write_window"]
             elif "coverage_pct" in r:
                 # cross-role trace attribution of one ec(8,4) write rep
                 # (benches/bench_cluster.py traced rep): wall, how much
@@ -715,6 +719,10 @@ def _summary_row(row: dict) -> dict:
                 k: (int(round(v)) if isinstance(v, float) else v)
                 for k, v in value.items()
             }
+        elif key.endswith("_write_window") and "_ec8_4_" in key:
+            # window fiducials for the target row: did the adaptive
+            # depth actually deepen, and did credits ever stall it
+            s[key] = value
         elif key.endswith("_write_trace") and isinstance(value, dict):
             # the traced rep's verdict: coverage + per-role split,
             # integer ms (segment detail lives in BENCH_FULL.json)
@@ -739,7 +747,7 @@ SUMMARY_BUDGET_BYTES = 1900
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "kernel_ladder",
-    "cluster_ec3_2_write_phases",
+    "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
     "cluster_ec8_4_write_phases",
 )

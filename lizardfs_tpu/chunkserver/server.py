@@ -604,8 +604,20 @@ class ChunkServer(Daemon):
 
     # --- serving ---------------------------------------------------------------
 
+    @staticmethod
+    def _chunk_session(sessions: dict, chunk_id: int):
+        """Resolve a frame that predates part addressing (1211/1214) to
+        the connection's sole write session for the chunk. Sessions key
+        on (chunk_id, part_id) because the vectored client multiplexes
+        several parts of one chunk over a single connection."""
+        for (cid, _part), session in sessions.items():
+            if cid == chunk_id:
+                return session
+        return None
+
     async def handle_connection(self, reader, writer) -> None:
-        sessions: dict[int, _WriteSession] = {}
+        # (chunk_id, part_id) -> session; see _chunk_session
+        sessions: dict[tuple[int, int], _WriteSession] = {}
         admin_state: dict = {}
         # in-flight _finish_write tasks still owe status frames on this
         # writer; native streaming must not interleave with them
@@ -664,11 +676,16 @@ class ChunkServer(Daemon):
                     await self._serve_write_data(
                         writer, msg, sessions, pending_writes
                     )
-                elif isinstance(msg, m.CltocsWriteBulk):
+                elif isinstance(msg, (m.CltocsWriteBulk,
+                                      m.CltocsWriteBulkPart)):
                     await self._serve_write_bulk(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteEnd):
-                    session = sessions.pop(msg.chunk_id, None)
-                    if session is not None:
+                    # one End seals EVERY part session of the chunk on
+                    # this connection (the vectored client sends one
+                    # End per connection), answered by a single status
+                    for key in [k for k in sessions
+                                if k[0] == msg.chunk_id]:
+                        session = sessions.pop(key)
                         if session.downstream is not None:
                             _, dw = session.downstream
                             await framing.send_message(dw, msg)
@@ -992,7 +1009,7 @@ class ChunkServer(Daemon):
             except OSError:
                 code = st.DISCONNECTED
         if code == st.OK:
-            sessions[msg.chunk_id] = session
+            sessions[(msg.chunk_id, msg.part_id)] = session
         else:
             await session.close()
         await framing.send_message(
@@ -1025,7 +1042,7 @@ class ChunkServer(Daemon):
         the upstream ack in a background task — the connection loop keeps
         reading, so blocks pipeline through the chain instead of paying
         one chain round trip each (WRITEFWD pipelining)."""
-        session = sessions.get(msg.chunk_id)
+        session = self._chunk_session(sessions, msg.chunk_id)
         if session is None:
             await framing.send_message(
                 writer,
@@ -1079,11 +1096,18 @@ class ChunkServer(Daemon):
         except (ConnectionError, OSError):
             pass
 
-    async def _serve_write_bulk(self, writer, msg: m.CltocsWriteBulk, sessions):
-        """Asyncio fallback for the bulk write op (serve_native.cpp is
+    async def _serve_write_bulk(self, writer, msg, sessions):
+        """Asyncio fallback for the bulk write ops (serve_native.cpp is
         the fast path): apply the whole block-aligned range, forward the
-        frame down the chain, single combined ack."""
-        session = sessions.get(msg.chunk_id)
+        frame down the chain, single combined ack. Accepts both the
+        chunk-addressed CltocsWriteBulk and the part-addressed
+        CltocsWriteBulkPart (vectored clients multiplex several parts
+        of one chunk over one connection)."""
+        part_id = getattr(msg, "part_id", None)
+        if part_id is not None:
+            session = sessions.get((msg.chunk_id, part_id))
+        else:
+            session = self._chunk_session(sessions, msg.chunk_id)
 
         async def ack(code):
             await framing.send_message(

@@ -80,6 +80,7 @@ class Client:
         wave_timeout: float = 0.3,
         retries: int = 5,
         master_addrs: list[tuple[str, int]] | None = None,
+        metrics=None,
     ):
         # master_addrs: full list of master addresses (active + shadows);
         # the client cycles until the active one accepts its session
@@ -199,6 +200,48 @@ class Client:
         # below this chunk payload size the per-segment handshake
         # overhead outweighs the overlap win — serial path handles it
         self.WRITE_PIPELINE_MIN_BYTES = 8 * 1024 * 1024
+        # client-side metrics registry: the write window registers its
+        # depth/credit/coalesce series here. Embedders that export a
+        # registry pass their own (the NFS gateway shares its
+        # gateway-local registry so the window series surface wherever
+        # it is scraped); library users get a private one, readable as
+        # Client.metrics.to_prometheus().
+        from lizardfs_tpu.runtime.metrics import Metrics
+
+        self.metrics = metrics if metrics is not None else Metrics()
+        # adaptive N-deep write window (spends PR 1's phase telemetry):
+        # up to LZ_WRITE_WINDOW stripe segments ride unacknowledged per
+        # striped chunk write under per-chunkserver credits + a shared
+        # staging-byte budget, with depth adapted from live encode/send
+        # busy fractions; finished chunks coalesce their WriteChunkEnd
+        # commits into one master round trip per window flush.
+        # LZ_WRITE_WINDOW=0 is the kill switch: the PR-1 double-buffered
+        # pipeline (per-segment ack barriers, per-chunk commits) runs
+        # byte- and wire-identically to before.
+        from lizardfs_tpu.client.write_window import WriteWindow
+
+        try:
+            _depth = int(_os.environ.get("LZ_WRITE_WINDOW", "8"))
+        except ValueError:
+            _depth = 8
+        try:
+            _cs_credits = int(_os.environ.get("LZ_WRITE_CS_CREDITS", "0"))
+        except ValueError:
+            _cs_credits = 0
+        try:
+            _budget_mb = int(
+                _os.environ.get("LZ_WRITE_WINDOW_BYTES_MB", "128")
+            )
+        except ValueError:
+            _budget_mb = 128
+        self.write_window = (
+            WriteWindow(
+                _depth, metrics=self.metrics,
+                cs_credits=_cs_credits or None,
+                budget_bytes=max(_budget_mb, 1) * 2**20,
+            )
+            if _depth > 0 else None
+        )
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -943,12 +986,18 @@ class Client:
             # and the master's WriteChunkEnd only ever grows the file,
             # so completion order doesn't matter
             window = asyncio.Semaphore(2)
+            # with the write window active, clean chunk ends coalesce
+            # into one CltomaWriteChunkEndBatch per flush instead of a
+            # commit handshake per chunk (multi-chunk files pay one
+            # master round trip per window drain)
+            defer = self.write_window is not None
 
             async def write_one(ci: int, piece: np.ndarray, end: int) -> None:
                 async with window:
                     async def attempt():
                         await self._write_chunk(
-                            inode, ci, piece, file_length=end
+                            inode, ci, piece, file_length=end,
+                            defer_end=defer,
                         )
 
                     await self._retry_transient(f"write chunk {ci}", attempt)
@@ -963,13 +1012,30 @@ class Client:
                 ))
                 pos = end
                 index += 1
+            ok = False
             try:
                 for t in tasks:
                     await t
+                ok = True
             finally:
                 for t in tasks:
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
+                if ok:
+                    # quota raises here must surface like a per-chunk
+                    # end's would
+                    await self._flush_chunk_ends()
+                else:
+                    # error unwind: chunks that DID land must still
+                    # commit (their bytes are on the chunkservers), but
+                    # a flush failure must not mask the original error
+                    try:
+                        await self._flush_chunk_ends()
+                    except (st.StatusError, ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        log.warning(
+                            "coalesced commit flush failed during unwind"
+                        )
             if old_length > total:
                 await self.truncate(inode, total)
             self.write_phases.add_wall(_time.perf_counter() - wall_t0)
@@ -1175,7 +1241,8 @@ class Client:
         self._phase("send", t0)
 
     async def _write_chunk(
-        self, inode: int, chunk_index: int, chunk_data: np.ndarray, file_length: int
+        self, inode: int, chunk_index: int, chunk_data: np.ndarray,
+        file_length: int, defer_end: bool = False,
     ) -> None:
         t0 = self._t0()
         grant = await self._call(
@@ -1189,19 +1256,64 @@ class Client:
             await self._push_chunk_parts(grant, chunk_data)
             status_code = st.OK
         finally:
-            t0 = self._t0()
-            await self._call(
-                m.CltomaWriteChunkEnd,
-                chunk_id=grant.chunk_id,
-                inode=inode,
-                chunk_index=chunk_index,
-                file_length=file_length,
-                status=status_code,
-            )
-            self._phase("commit", t0)
+            if (defer_end and status_code == st.OK
+                    and self.write_window is not None):
+                # commit coalescing: queue the end record; the window's
+                # owner (write_file) flushes the batch as ONE master
+                # round trip. Only CLEAN ends coalesce — a failed write
+                # must release the master's chunk lock before the retry
+                # takes a fresh grant, so it commits immediately below.
+                if self.write_window.queue_end(
+                    grant.chunk_id, inode, chunk_index, file_length,
+                    st.OK,
+                ):
+                    await self._flush_chunk_ends()
+            else:
+                t0 = self._t0()
+                await self._call(
+                    m.CltomaWriteChunkEnd,
+                    chunk_id=grant.chunk_id,
+                    inode=inode,
+                    chunk_index=chunk_index,
+                    file_length=file_length,
+                    status=status_code,
+                )
+                self._phase("commit", t0)
             # see _write_chunk's twin: locates cached mid-write carry
             # pre-write length/identity and must not outlive the write
             self._drop_locates(inode)
+
+    async def _flush_chunk_ends(self) -> None:
+        """Flush queued end-of-write records as one coalesced
+        CltomaWriteChunkEndBatch (the window pays one commit handshake
+        per flush instead of one per chunk)."""
+        win = self.write_window
+        if win is None or not win.pending_ends:
+            return
+        batch = win.drain_ends()
+        t0 = self._t0()
+        try:
+            await self._call(
+                m.CltomaWriteChunkEndBatch,
+                ends=[m.WriteChunkEndEntry(**e) for e in batch],
+            )
+        except st.StatusError:
+            # a STATUS reply proves the master consumed the batch (it
+            # applies every entry it can and reports the first failure,
+            # e.g. quota): surface the error but do NOT requeue —
+            # re-sending would re-apply applied entries and park a
+            # permanently-failing one in front of every future flush
+            raise
+        except BaseException:
+            # transport failure: the batch may never have arrived, and
+            # it may hold ANOTHER concurrent write's commits — requeue
+            # so a later flush retries instead of silently losing that
+            # write's length/locks to this one's failure
+            win.requeue_ends(batch)
+            raise
+        self._phase("commit", t0)
+        win.note_coalesced(len(batch))
+        self._record("write_commit_batch")
 
     async def _push_chunk_parts(self, grant, chunk_data: np.ndarray) -> None:
         # group locations by part index
@@ -1380,10 +1492,22 @@ class Client:
                 ))
                 throttled = True
                 try:
-                    await self._push_striped_pipelined(
-                        grant, chunk_data, slice_type, by_part, stacked,
-                        part_len, full_chunk, send_cells,
-                    )
+                    if (self.write_window is not None
+                            and native_io.parts_scatterv_available()):
+                        # adaptive window: N unacked segments in flight
+                        # over shared per-chunkserver connections
+                        await self._push_striped_windowed(
+                            grant, chunk_data, slice_type, by_part,
+                            stacked, part_len, full_chunk, send_cells,
+                        )
+                        self._record("write_window")
+                    else:
+                        await self._push_striped_pipelined(
+                            grant, chunk_data, slice_type, by_part, stacked,
+                            part_len, full_chunk, send_cells,
+                        )
+                    # both overlapped paths count as the pipeline for
+                    # observability (the window is its deeper form)
                     self._record("write_pipeline")
                     return
                 except (native_io.NativeIOError, OSError, ConnectionError,
@@ -1481,6 +1605,73 @@ class Client:
             for p in range(slice_type.expected_parts)
         )
 
+    def _stripe_send_plan(
+        self, grant, chunk_data, slice_type, by_part, stacked,
+        part_len: int, send_cells: list[dict], share: bool, nseg_min: int,
+    ):
+        """Shared prologue of the two overlapped stripe senders (the
+        double-buffered pipeline and the adaptive window): part order
+        and per-part lengths, the pooled parity send buffer, the
+        scatter session + abort cell, slot-aligned segment bounds, and
+        the per-segment encode/payload/length closures — a stripe-
+        geometry or encoder-boundary change lands in exactly one place.
+        Returns ``(par_buf, cell, session, bounds, encode_segment,
+        seg_payloads, seg_lengths)``."""
+        from lizardfs_tpu.core import native_io
+
+        d = slice_type.data_parts
+        first = 1 if slice_type.is_xor else 0
+        m_par = 1 if slice_type.is_xor else slice_type.parity_parts
+        order = [first + i for i in range(d)] + (
+            [0] if slice_type.is_xor else [d + j for j in range(m_par)]
+        )
+        plens = {
+            p: striping.part_length(slice_type, p, len(chunk_data))
+            for p in order
+        }
+        par_buf = self._parity_acquire(m_par, part_len)
+        cell: dict = {}
+        send_cells.append(cell)
+        session = native_io.PartsScatterSession(
+            [(by_part[p][0].addr.host, by_part[p][0].addr.port)
+             for p in order],
+            grant.chunk_id, grant.version,
+            [by_part[p][0].part_id for p in order],
+            cell, share_connections=share,
+        )
+        blocks_per_part = part_len // MFSBLOCKSIZE
+        nseg = min(
+            max(self.write_pipeline_segments, nseg_min), blocks_per_part
+        )
+        seg_blocks = -(-blocks_per_part // nseg)
+        bounds = [
+            (a * MFSBLOCKSIZE,
+             min(a + seg_blocks, blocks_per_part) * MFSBLOCKSIZE)
+            for a in range(0, blocks_per_part, seg_blocks)
+        ]
+
+        def encode_segment(a: int, b: int) -> None:
+            data_seg = [stacked[i][a:b] for i in range(d)]
+            if slice_type.is_xor:
+                self.encoder.xor_parity_into(data_seg, par_buf[0][a:b])
+            else:
+                self.encoder.encode_into(
+                    d, m_par, data_seg,
+                    [par_buf[j][a:b] for j in range(m_par)],
+                )
+
+        def seg_payloads(a: int, b: int) -> list:
+            return (
+                [stacked[i][a:b] for i in range(d)]
+                + [par_buf[j][a:b] for j in range(m_par)]
+            )
+
+        def seg_lengths(a: int, b: int) -> list[int]:
+            return [max(min(b, plens[p]) - a, 0) for p in order]
+
+        return (par_buf, cell, session, bounds, encode_segment,
+                seg_payloads, seg_lengths)
+
     async def _push_striped_pipelined(
         self, grant, chunk_data, slice_type, by_part, stacked,
         part_len: int, full_chunk: bool, send_cells: list[dict],
@@ -1501,44 +1692,11 @@ class Client:
         segments. The caller has already charged the QoS throttle."""
         from lizardfs_tpu.core import native_io
 
-        d = slice_type.data_parts
-        first = 1 if slice_type.is_xor else 0
-        m_par = 1 if slice_type.is_xor else slice_type.parity_parts
-        data_idx = [first + i for i in range(d)]
-        par_idx = [0] if slice_type.is_xor else [d + j for j in range(m_par)]
-        order = data_idx + par_idx
-        plens = {
-            p: striping.part_length(slice_type, p, len(chunk_data))
-            for p in order
-        }
-        par_buf = self._parity_acquire(m_par, part_len)
-        cell: dict = {}
-        send_cells.append(cell)
-        session = native_io.PartsScatterSession(
-            [(by_part[p][0].addr.host, by_part[p][0].addr.port)
-             for p in order],
-            grant.chunk_id, grant.version,
-            [by_part[p][0].part_id for p in order],
-            cell,
+        (par_buf, cell, session, bounds, encode_segment, seg_payloads,
+         seg_lengths) = self._stripe_send_plan(
+            grant, chunk_data, slice_type, by_part, stacked, part_len,
+            send_cells, share=False, nseg_min=2,
         )
-        blocks_per_part = part_len // MFSBLOCKSIZE
-        nseg = min(self.write_pipeline_segments, blocks_per_part)
-        seg_blocks = -(-blocks_per_part // nseg)
-        bounds = [
-            (a * MFSBLOCKSIZE,
-             min(a + seg_blocks, blocks_per_part) * MFSBLOCKSIZE)
-            for a in range(0, blocks_per_part, seg_blocks)
-        ]
-
-        def encode_segment(a: int, b: int) -> None:
-            data_seg = [stacked[i][a:b] for i in range(d)]
-            if slice_type.is_xor:
-                self.encoder.xor_parity_into(data_seg, par_buf[0][a:b])
-            else:
-                self.encoder.encode_into(
-                    d, m_par, data_seg,
-                    [par_buf[j][a:b] for j in range(m_par)],
-                )
 
         async def send_segment(a: int, b: int, wid: int, after) -> None:
             # chained on the previous segment's task: the session's
@@ -1546,14 +1704,10 @@ class Client:
             # failure propagates down the chain
             if after is not None:
                 await after
-            payloads = (
-                [stacked[i][a:b] for i in range(d)]
-                + [par_buf[j][a:b] for j in range(m_par)]
-            )
-            lengths = [max(min(b, plens[p]) - a, 0) for p in order]
             t0 = self._t0()
             await native_io.run(
-                session.send_segment, payloads, lengths, a, wid
+                session.send_segment, seg_payloads(a, b),
+                seg_lengths(a, b), a, wid,
             )
             self._phase("send", t0)
 
@@ -1590,6 +1744,125 @@ class Client:
                     cell.get("submitted") and not cell.get("finished")
                 ),
             )
+
+    async def _push_striped_windowed(
+        self, grant, chunk_data, slice_type, by_part, stacked,
+        part_len: int, full_chunk: bool, send_cells: list[dict],
+    ) -> None:
+        """Adaptive N-deep write window over the stripe pipeline: up to
+        ``write_window.depth`` slot-aligned segments ride UNACKNOWLEDGED
+        (part-addressed 1215 frames, vectored header+payload sendmsg,
+        parts sharing a chunkserver multiplexed over one connection),
+        with per-chunkserver credits + a shared staging-byte budget as
+        flow control. Acks are collected oldest-first as the window
+        fills — the per-segment round-trip barrier the PR-1 pipeline
+        paid (its send phase dominated the ec(8,4) telemetry) is gone.
+
+        Byte-identical to the serial path for the same reason the
+        pipelined path is: parity is columnwise, segments stay 64 KiB
+        aligned, and the chunkservers land the same per-block pieces
+        and CRCs — only the framing and ack cadence differ. Raises on
+        any failure; the caller's serial fallback heals torn segments.
+        The caller has already charged the QoS throttle."""
+        from lizardfs_tpu.core import native_io
+
+        win = self.write_window
+        # nseg_min=win.max_depth: enough segments that the window can
+        # actually fill (a 4-deep window over 4 segments would
+        # degenerate to the old barrier)
+        (par_buf, cell, session, bounds, encode_segment, seg_payloads,
+         seg_lengths) = self._stripe_send_plan(
+            grant, chunk_data, slice_type, by_part, stacked, part_len,
+            send_cells, share=True, nseg_min=win.max_depth,
+        )
+
+        from collections import deque
+
+        # (write_id, credited bytes, encode seconds, send seconds so far)
+        outstanding: deque[list] = deque()
+        try:
+            t0 = self._t0()
+            await native_io.run(session.open)
+            self._phase("send", t0)
+            for wid, (a, b) in enumerate(bounds, start=1):
+                t0 = self._t0()
+                await asyncio.to_thread(encode_segment, a, b)
+                enc_dt = _time.perf_counter() - t0[0]
+                self._phase("encode", t0)
+                payloads = seg_payloads(a, b)
+                lengths = seg_lengths(a, b)
+                seg_bytes = sum(lengths)
+                # credits BEFORE the send: per-chunkserver in-flight
+                # frames + the client-wide staging budget (returned as
+                # each segment's commit acks come back). NEVER block on
+                # credits while holding outstanding segments — reap the
+                # oldest instead (two concurrent chunk writes jointly
+                # exhausting a bucket would otherwise deadlock, each
+                # waiting for credits only the other's reap can free);
+                # blocking with nothing outstanding is safe, since any
+                # credit holder then has acks of its own to reap.
+                waited = False
+                while not win.try_acquire(session.unique_addrs, seg_bytes):
+                    waited = True
+                    if outstanding:
+                        await self._window_collect(session, win, outstanding)
+                    else:
+                        await win.acquire(session.unique_addrs, seg_bytes)
+                        break
+                win.note_segment(waited)
+                try:
+                    t0 = self._t0()
+                    await native_io.run(
+                        session.send_segment_window, payloads, lengths,
+                        a, wid,
+                    )
+                    send_dt = _time.perf_counter() - t0[0]
+                    self._phase("send", t0)
+                except BaseException:
+                    win.release(session.unique_addrs, seg_bytes)
+                    raise
+                outstanding.append([wid, seg_bytes, enc_dt, send_dt])
+                # window full: reap the oldest segment's acks (depth is
+                # LIVE — adaptation may have moved it since the last
+                # segment, so reap down to the current depth)
+                while len(outstanding) >= max(win.depth, 1):
+                    await self._window_collect(session, win, outstanding)
+            while outstanding:
+                await self._window_collect(session, win, outstanding)
+            t0 = self._t0()
+            await native_io.run(session.finish)
+            self._phase("send", t0)
+        except BaseException:
+            # the session's executor thread may still be streaming from
+            # stacked/par_buf — kill the exchange before those buffers
+            # can be released
+            native_io.abort_write(cell)
+            raise
+        finally:
+            # failure path: return credits the reap loop never got to
+            for wid, seg_bytes, *_rest in outstanding:
+                win.release(session.unique_addrs, seg_bytes)
+            self._stage_release(
+                par_buf,
+                poolable=full_chunk and not (
+                    cell.get("submitted") and not cell.get("finished")
+                ),
+            )
+
+    async def _window_collect(self, session, win, outstanding) -> None:
+        """Reap the oldest outstanding segment: collect its acks,
+        return its credits, and feed the adaptive depth controller."""
+        from lizardfs_tpu.core import native_io
+
+        wid, seg_bytes, enc_dt, send_dt = outstanding.popleft()
+        try:
+            t0 = self._t0()
+            await native_io.run(session.collect_acks, wid)
+            send_dt += _time.perf_counter() - t0[0]
+            self._phase("send", t0)
+        finally:
+            win.release(session.unique_addrs, seg_bytes)
+        win.observe(enc_dt, send_dt)
 
     async def _write_part(
         self,

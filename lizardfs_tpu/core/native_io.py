@@ -89,6 +89,20 @@ if _lib is not None:
             _lib.lz_trace_set.restype = None
         except AttributeError:
             pass  # stale .so: native requests stay untraced
+        try:
+            _lib.lz_write_parts_scatterv.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ]
+            _lib.lz_write_parts_scatterv.restype = ctypes.c_int
+            _lib.lz_write_collect_acks.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ]
+            _lib.lz_write_collect_acks.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: the windowed/vectored write path stays off
     except AttributeError:
         _lib = None
 
@@ -655,6 +669,20 @@ def parts_scatter_available() -> bool:
     return _lib is not None and hasattr(_lib, "lz_write_parts_scatter")
 
 
+def parts_scatterv_available() -> bool:
+    """Vectored + windowed scatter writes (lz_write_parts_scatterv /
+    lz_write_collect_acks): required by the adaptive write window."""
+    return (
+        _lib is not None
+        and hasattr(_lib, "lz_write_parts_scatterv")
+        and hasattr(_lib, "lz_write_collect_acks")
+    )
+
+
+# lz_write_parts_scatterv flags (keep in sync with io_native.cpp)
+SCATTER_NO_ACK = 1
+
+
 # shared building blocks of the two scatter-write paths (the one-shot
 # write_parts_scatter_blocking and the multi-segment PartsScatterSession):
 # a protocol change lands in exactly one place
@@ -741,6 +769,7 @@ class PartsScatterSession:
         version: int,
         part_ids: list[int],
         cell: dict | None = None,
+        share_connections: bool = False,
     ):
         assert len(addrs) == len(part_ids)
         self.addrs = addrs
@@ -748,13 +777,36 @@ class PartsScatterSession:
         self.version = version
         self.part_ids = part_ids
         self.cell = cell if cell is not None else {}
+        # share_connections: parts that target the same chunkserver
+        # ride ONE connection (the windowed/vectored path demuxes them
+        # with part-addressed 1215 frames server-side). The legacy
+        # barrier path keeps one socket per part — its 1214 frames
+        # carry no part id, so a shared connection cannot demux them.
+        self.share = share_connections
+        if share_connections:
+            self.unique_addrs: list[tuple[str, int]] = []
+            self._conn_of: list[int] = []
+            index: dict[tuple[str, int], int] = {}
+            for addr in addrs:
+                if addr not in index:
+                    index[addr] = len(self.unique_addrs)
+                    self.unique_addrs.append(addr)
+                self._conn_of.append(index[addr])
+        else:
+            self.unique_addrs = list(addrs)
+            self._conn_of = list(range(len(addrs)))
         self._socks: list[socket.socket] = []
+        # write_id -> live part indices of an unacked windowed segment
+        self._pending: dict[int, list[int]] = {}
+
+    def _sock_of(self, part_index: int) -> socket.socket:
+        return self._socks[self._conn_of[part_index]]
 
     def open(self) -> None:
         self.cell["submitted"] = True
         for attempt in (0, 1):
             try:
-                for i, addr in enumerate(self.addrs):
+                for addr in self.unique_addrs:
                     # pooled sockets first (the write hot path dials
                     # d+m connections per chunk — churn that the pool
                     # exists to absorb); a stale pooled connection
@@ -764,13 +816,20 @@ class PartsScatterSession:
                     s = (POOL.acquire(addr) if attempt == 0
                          else _blocking_socket(addr, 60.0))
                     self._socks.append(s)
+                for i in range(len(self.part_ids)):
                     _send_write_init(
-                        s, self.chunk_id, self.version, self.part_ids[i]
+                        self._sock_of(i), self.chunk_id, self.version,
+                        self.part_ids[i],
                     )
                 self.cell["socks"] = list(self._socks)
                 if self.cell.get("aborted"):
                     raise NativeIOError(-1, "scatter session (aborted)")
-                _recv_write_init_acks(self._socks)
+                # one ack per part, read from its connection in init
+                # order (a connection answers its inits FIFO, so the
+                # global part order is safe to follow)
+                _recv_write_init_acks(
+                    [self._sock_of(i) for i in range(len(self.part_ids))]
+                )
                 return
             except (ConnectionError, OSError, st.StatusError):
                 for s in self._socks:
@@ -792,10 +851,11 @@ class PartsScatterSession:
         write_id: int,
     ) -> None:
         """Stream ``payloads[i][:lengths[i]]`` at ``part_offset`` within
-        every live part. A zero length skips that part this segment
-        (tail segments cover fewer parts)."""
+        every live part and wait for every ack (the barrier path). A
+        zero length skips that part this segment (tail segments cover
+        fewer parts)."""
         assert self._socks, "session not open"
-        n = len(self._socks)
+        n = len(self.part_ids)
         assert n == len(payloads) == len(lengths)
         live = [i for i in range(n) if lengths[i] > 0]
         if not live:
@@ -804,16 +864,24 @@ class PartsScatterSession:
             if self.cell.get("aborted"):
                 raise NativeIOError(-1, "scatter session (aborted)")
             reqs, ptrs, lens = _marshal_part_reqs(
-                [self._socks[i].fileno() for i in live],
+                [self._sock_of(i).fileno() for i in live],
                 self.chunk_id, write_id,
                 [self.part_ids[i] for i in live],
                 [payloads[i] for i in live],
                 [lengths[i] for i in live],
             )
-            rc = _lib.lz_write_parts_scatter(
-                ctypes.cast(reqs, ctypes.c_void_p), len(live), ptrs, lens,
-                part_offset, 120_000,
-            )
+            if self.share:
+                # shared connections need part-addressed frames (and a
+                # duplicate-fd-aware send loop): the vectored call
+                rc = _lib.lz_write_parts_scatterv(
+                    ctypes.cast(reqs, ctypes.c_void_p), len(live), ptrs,
+                    lens, part_offset, 120_000, 0,
+                )
+            else:
+                rc = _lib.lz_write_parts_scatter(
+                    ctypes.cast(reqs, ctypes.c_void_p), len(live), ptrs,
+                    lens, part_offset, 120_000,
+                )
             if rc != 0:
                 bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
                 raise NativeIOError(bad, "scatter session segment")
@@ -821,15 +889,91 @@ class PartsScatterSession:
             self.close()
             raise
 
+    def send_segment_window(
+        self,
+        payloads: list[np.ndarray],
+        lengths: list[int],
+        part_offset: int,
+        write_id: int,
+    ) -> None:
+        """Windowed send: stream one segment's part-addressed bulk
+        frames (vectored sendmsg, header+payload in one syscall per
+        socket pass) WITHOUT waiting for acks — collect them later via
+        :meth:`collect_acks`. The caller bounds how many segments ride
+        unacknowledged (the adaptive write window's credits)."""
+        assert self._socks, "session not open"
+        n = len(self.part_ids)
+        assert n == len(payloads) == len(lengths)
+        live = [i for i in range(n) if lengths[i] > 0]
+        if not live:
+            self._pending[write_id] = []
+            return
+        try:
+            if self.cell.get("aborted"):
+                raise NativeIOError(-1, "scatter session (aborted)")
+            reqs, ptrs, lens = _marshal_part_reqs(
+                [self._sock_of(i).fileno() for i in live],
+                self.chunk_id, write_id,
+                [self.part_ids[i] for i in live],
+                [payloads[i] for i in live],
+                [lengths[i] for i in live],
+            )
+            rc = _lib.lz_write_parts_scatterv(
+                ctypes.cast(reqs, ctypes.c_void_p), len(live), ptrs, lens,
+                part_offset, 120_000, SCATTER_NO_ACK,
+            )
+            if rc != 0:
+                bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+                raise NativeIOError(bad, "windowed segment send")
+            self._pending[write_id] = live
+        except BaseException:
+            self.close()
+            raise
+
+    def collect_acks(self, write_id: int) -> None:
+        """Collect one segment's outstanding acks (sent via
+        :meth:`send_segment_window`). Segments must be collected in
+        send order — acks are FIFO per connection."""
+        live = self._pending.pop(write_id, None)
+        if not live:
+            return
+        try:
+            if self.cell.get("aborted"):
+                raise NativeIOError(-1, "scatter session (aborted)")
+            n = len(live)
+            reqs = (_PartReq * n)()
+            for j, i in enumerate(live):
+                reqs[j].fd = self._sock_of(i).fileno()
+                reqs[j].chunk_id = self.chunk_id
+                reqs[j].version = write_id
+                reqs[j].part_id = self.part_ids[i]
+                reqs[j].rc = 0
+            rc = _lib.lz_write_collect_acks(
+                ctypes.cast(reqs, ctypes.c_void_p), n, 120_000
+            )
+            if rc != 0:
+                bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+                raise NativeIOError(bad, "windowed segment ack")
+        except BaseException:
+            self.close()
+            raise
+
     def finish(self) -> None:
         try:
+            # the windowed caller collects every segment before
+            # finishing; a leftover here means an unacked segment and
+            # the End status below would desync — refuse
+            if self._pending:
+                raise NativeIOError(-2, "finish with unacked segments")
+            # one WriteEnd per CONNECTION: the server seals every part
+            # session of the chunk on that connection and answers once
             _write_end_handshake(self._socks, self.chunk_id)
         except BaseException:
             self.close()
             raise
         # clean end: the sockets sit in the same reusable protocol
         # state the one-shot scatter path pools — release, don't close
-        for addr, s in zip(self.addrs, self._socks):
+        for addr, s in zip(self.unique_addrs, self._socks):
             POOL.release(addr, s)
         self._socks.clear()
         self.cell.pop("socks", None)

@@ -167,9 +167,13 @@ class MasterServer(Daemon):
         # locks live in self.meta.locks (changelog-replicated)
         self._pending_locks: dict[int, list[dict]] = {}
         self._session_writers: dict[int, asyncio.StreamWriter] = {}
-        # data-cache invalidation (matoclserv.cc analog): which sessions
-        # located chunks of an inode recently; mutations push
-        # MatoclCacheInvalidate to them. inode -> {sid -> last locate}
+        # cache-invalidation watch set (matoclserv.cc analog): which
+        # sessions recently located chunks OR read attrs/access
+        # decisions of an inode; mutations — data writes, truncates,
+        # and metadata changes (chmod/setattr/seteattr/ACLs) — push
+        # MatoclCacheInvalidate to them, so cross-gateway permission
+        # revocation doesn't wait out META_TTL_S.
+        # inode -> {sid -> last watch refresh}
         self._read_watchers: dict[int, dict[int, float]] = {}
         from lizardfs_tpu.master.exports import Exports, Topology
 
@@ -638,7 +642,8 @@ class MasterServer(Daemon):
                 # master's latency-critical class — a slow one breaches
                 # the "locate" objective and flight-records its trace
                 if isinstance(msg, (m.CltomaReadChunk, m.CltomaWriteChunk,
-                                    m.CltomaWriteChunkEnd)):
+                                    m.CltomaWriteChunkEnd,
+                                    m.CltomaWriteChunkEndBatch)):
                     self.slo.observe(
                         "locate", dt, trace_id=tid,
                         name=type(msg).__name__,
@@ -855,7 +860,8 @@ class MasterServer(Daemon):
         "CltomaMkdir", "CltomaCreate", "CltomaSymlink", "CltomaLink",
         "CltomaUnlink", "CltomaRmdir", "CltomaRename", "CltomaSetGoal",
         "CltomaSetattr", "CltomaTruncate", "CltomaWriteChunk",
-        "CltomaWriteChunkEnd", "CltomaSnapshot", "CltomaSetXattr",
+        "CltomaWriteChunkEnd", "CltomaWriteChunkEndBatch",
+        "CltomaSnapshot", "CltomaSetXattr",
         "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
         "CltomaSetRichAcl", "CltomaSetEattr", "CltomaFileRepair",
         "CltomaAppendChunks",
@@ -946,6 +952,11 @@ class MasterServer(Daemon):
             node = fs.lookup(msg.parent, msg.name)
             return self._attr_reply(msg.req_id, node)
         if isinstance(msg, m.CltomaGetattr):
+            # attr readers join the invalidation-watch set: gateways
+            # cache attr/access decisions off this reply, and a later
+            # chmod/seteattr via ANOTHER session must push them stale
+            # (cross-gateway revocation no longer waits out META_TTL_S)
+            self._note_watcher(msg.inode, session_id)
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaTapeInfo):
             node = fs.node(msg.inode)
@@ -1070,6 +1081,11 @@ class MasterServer(Daemon):
                 "op": "seteattr", "inode": msg.inode, "eattr": msg.eattr,
                 "ts": now,
             })
+            # eattr flags gate client/gateway caching decisions: push
+            # the change so another gateway's cached attr snapshot (and
+            # the decisions derived from it) drops NOW, not at TTL
+            # expiry (ADVICE r05 #4 residual)
+            self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaSetattr):
             node = fs.node(msg.inode)
@@ -1087,6 +1103,11 @@ class MasterServer(Daemon):
                 "atime": msg.atime, "mtime": msg.mtime, "ts": now,
                 "trash_time": msg.trash_time,
             })
+            # metadata mutation push (ADVICE r05 #4 residual): a chmod/
+            # chown through THIS session must revoke other gateways'
+            # cached attr/access decisions immediately — before this,
+            # cross-gateway permission revocation lagged by META_TTL_S
+            self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaTruncate):
             self._check_perm(fs.file_node(msg.inode), msg.uid, list(msg.gids), 2)
@@ -1140,6 +1161,39 @@ class MasterServer(Daemon):
                 msg.inode, msg.chunk_index, exclude_sid=session_id
             )
             return await self._write_chunk_end(msg)
+        if isinstance(msg, m.CltomaWriteChunkEndBatch):
+            # coalesced commit: seal every chunk the client's write
+            # window finished since its last flush — one round trip
+            # instead of one per chunk. Entries apply IN ORDER; the
+            # first failure's status is reported, later VALID entries
+            # still apply (their bytes are already on the chunkservers
+            # and their locks must not outlive the batch). Entries
+            # refused by the subtree check are NOT applied at all —
+            # like the single-RPC path's EACCES, an unauthorized end
+            # must not unlock a chunk some other client may be
+            # writing; its lock expires by timeout.
+            status = st.OK
+            root = session.get("root", fsmod.ROOT_INODE)
+            for e in msg.ends:
+                if root != fsmod.ROOT_INODE and not self._in_subtree(
+                    e.inode, root
+                ):
+                    # nested inodes bypass _apply_session_view's field
+                    # remap — enforce the subtree export here
+                    if status == st.OK:
+                        status = st.EACCES
+                    continue
+                self._invalidate_client_caches(
+                    e.inode, e.chunk_index, exclude_sid=session_id
+                )
+                try:
+                    self._apply_write_chunk_end(
+                        e.chunk_id, e.inode, e.file_length, e.status
+                    )
+                except fsmod.FsError as err:
+                    if status == st.OK:
+                        status = err.code
+            return m.MatoclStatusReply(req_id=msg.req_id, status=status)
         if isinstance(msg, m.CltomaSnapshot):
             # no invalidation needed: a snapshot lands on a NEW inode
             # (apply_snapshot raises EEXIST on an existing name), so no
@@ -1226,6 +1280,8 @@ class MasterServer(Daemon):
                 "access": payload.get("access"),
                 "default": payload.get("default"), "ts": now,
             })
+            # ACL changes revoke permissions like a chmod does: push
+            self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaSetRichAcl):
             from lizardfs_tpu.master.richacl import RichAcl
@@ -1251,6 +1307,7 @@ class MasterServer(Daemon):
                 "acl": racl.to_dict() if racl is not None else None,
                 "ts": now,
             })
+            self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
             if racl is not None:
                 # publish the ACL's per-class grant unions as the mode
                 # (richacl_compute_max_masks analog) so the mode masks
@@ -1283,6 +1340,10 @@ class MasterServer(Daemon):
             from lizardfs_tpu.master import acl as acl_mod
 
             node = fs.node(msg.inode)
+            # access decisions are cached gateway-side (NFS _access):
+            # watch the session so a permission change pushes the
+            # cached verdict stale instead of letting it ride the TTL
+            self._note_watcher(msg.inode, session_id)
             ok = self._access_ok(node, msg.uid, list(msg.gids), msg.mask)
             return m.MatoclStatusReply(
                 req_id=msg.req_id, status=st.OK if ok else st.EACCES
@@ -1613,6 +1674,15 @@ class MasterServer(Daemon):
             if not watchers:
                 del self._read_watchers[inode]
 
+    def _note_watcher(self, inode: int, session_id: int) -> None:
+        """Subscribe a session to ``inode``'s invalidation pushes (it
+        just read something cacheable about the inode: chunk
+        locations, attrs, or an access verdict)."""
+        if session_id:
+            self._read_watchers.setdefault(inode, {})[session_id] = (
+                time.monotonic()
+            )
+
     def _invalidate_client_caches(
         self, inode: int, chunk_index: int = 0xFFFFFFFF,
         exclude_sid: int | None = None,
@@ -1656,10 +1726,7 @@ class MasterServer(Daemon):
     ):
         node = self.meta.fs.file_node(msg.inode)
         self._check_perm(node, msg.uid, list(msg.gids), 4)
-        if session_id:
-            self._read_watchers.setdefault(msg.inode, {})[session_id] = (
-                time.monotonic()
-            )
+        self._note_watcher(msg.inode, session_id)
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
         )
@@ -1930,16 +1997,27 @@ class MasterServer(Daemon):
         )
 
     async def _write_chunk_end(self, msg: m.CltomaWriteChunkEnd):
-        chunk = self.meta.registry.chunks.get(msg.chunk_id)
+        self._apply_write_chunk_end(
+            msg.chunk_id, msg.inode, msg.file_length, msg.status
+        )
+        return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+
+    def _apply_write_chunk_end(
+        self, chunk_id: int, inode: int, file_length: int, status: int
+    ) -> None:
+        """Seal one chunk's write: unlock, re-evaluate redundancy, and
+        (on a clean end) journal the length/mtime. Shared by the
+        per-chunk RPC and the coalesced CltomaWriteChunkEndBatch."""
+        chunk = self.meta.registry.chunks.get(chunk_id)
         if chunk is not None:
             chunk.locked_until = 0.0
             state = self.meta.registry.evaluate(chunk)
             if state.needs_work:
-                self.meta.registry.mark_endangered(msg.chunk_id)
-        if msg.status == st.OK:
-            node = self.meta.fs.file_node(msg.inode)
-            if msg.file_length > node.length:
-                delta = msg.file_length - node.length
+                self.meta.registry.mark_endangered(chunk_id)
+        if status == st.OK:
+            node = self.meta.fs.file_node(inode)
+            if file_length > node.length:
+                delta = file_length - node.length
                 parent = node.parents[0] if node.parents else fsmod.ROOT_INODE
                 self._check_quota(parent, node.uid, node.gid, 0, delta)
             # journal every completed write (the reference logs a
@@ -1949,11 +2027,10 @@ class MasterServer(Daemon):
             # write-path grow: never drop chunks — a concurrent write
             # may have attached a higher chunk index already
             self.commit({
-                "op": "set_length", "inode": msg.inode,
-                "length": max(msg.file_length, node.length),
+                "op": "set_length", "inode": inode,
+                "length": max(file_length, node.length),
                 "ts": int(time.time()), "drop_chunks": False,
             })
-        return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
 
     # --- chunkserver service (matocsserv analog) --------------------------------------
 

@@ -253,7 +253,13 @@ class NfsGateway:
         port: int = 0,
         exports: dict[str, str] | None = None,
     ) -> None:
-        self.client = Client(master_host, master_port)
+        # one gateway-local registry shared with the embedded Client:
+        # the write window's depth/credit/coalesce series land next to
+        # the gateway's SLO gauges, so whatever scrapes this registry
+        # sees the whole write-path story (the client would otherwise
+        # hold them in a private registry nothing exports)
+        self.metrics = Metrics()
+        self.client = Client(master_host, master_port, metrics=self.metrics)
         self.rpc = rpc.RpcServer(host, port)
         self.exports = exports or {"/": "/"}
         self.write_verf = secrets.token_bytes(8)
@@ -296,21 +302,24 @@ class NfsGateway:
         # access/attr decision caches: without them every wire READ or
         # WRITE pays 1-2 master RPCs (access + getattr) — kernel NFS
         # servers/clients cache both far longer than this TTL. Both are
-        # dropped per inode by (a) the data-invalidate listener (local
-        # writes + master pushes) and (b) _meta_dirty() after every
-        # metadata-mutating proc THIS gateway serves; cross-gateway
-        # chmod/utimes staleness is bounded by the TTL alone (the
-        # master pushes invalidations for data mutations only).
+        # dropped per inode by (a) the invalidate listener (local
+        # writes + master pushes — the master pushes on metadata
+        # mutations too: chmod/setattr/seteattr/ACL changes via ANY
+        # session revoke these caches promptly) and (b) _meta_dirty()
+        # after every metadata-mutating proc THIS gateway serves;
+        # the TTL remains the backstop for sessions whose watch
+        # subscription on the inode has expired master-side.
         self._access_cache: dict[int, dict[tuple, tuple[bool, float]]] = {}
         self._access_cache_n = 0
         self._attr_cache: dict[int, tuple[object, float]] = {}
         # META_TTL_S is the operator-tunable consistency knob (ADVICE
-        # r05 item 4): the access/attr caches mean a chmod via ANOTHER
-        # gateway/mount keeps granting cached decisions for up to this
-        # many seconds (master invalidation pushes cover data mutations
-        # only). Registered as a runtime tweak so operators can trade
-        # cross-gateway revocation lag against master RPC load without
-        # a restart; 0 disables the caches. See doc/operations.md.
+        # r05 item 4): the master now pushes invalidations on metadata
+        # mutations too (chmod/setattr/seteattr/ACLs), so cross-gateway
+        # revocation is push-prompt for watched inodes; the TTL bounds
+        # staleness only when the watch subscription expired. Still a
+        # runtime tweak so operators can trade residual lag against
+        # master RPC load without a restart; 0 disables the caches.
+        # See doc/operations.md.
         self.tweaks = Tweaks()
         self._meta_ttl = self.tweaks.register("meta_ttl_s", 1.0)
         self.client.cache.add_invalidate_listener(self._on_invalidate)
@@ -320,9 +329,9 @@ class NfsGateway:
         # plane — the last anonymous entry point closed. The op's
         # boundary span lands in the client's ring under role "nfs".
         # The "nfs" SLO class accounts per-proc latency; the registry
-        # is gateway-local (no admin port on the gateway), the flight
+        # (self.metrics, created up top and shared with the Client) is
+        # gateway-local (no admin port on the gateway), the flight
         # recorder's slowops stay queryable in-process.
-        self.metrics = Metrics()
         self.slo = slomod.SloEngine(
             self.metrics, role="nfs",
             span_source=self.client.trace_ring.dump,

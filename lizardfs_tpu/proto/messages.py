@@ -302,6 +302,34 @@ class CltomaWriteChunkEnd(Message):
     )
 
 
+class WriteChunkEndEntry(Message):
+    """One chunk's end-of-write record inside a coalesced commit."""
+
+    FIELDS = (
+        ("chunk_id", "u64"),
+        ("inode", "u32"),
+        ("chunk_index", "u32"),
+        ("file_length", "u64"),
+        ("status", "u8"),
+    )
+
+
+class CltomaWriteChunkEndBatch(Message):
+    """Coalesced WriteChunkEnd: one master round trip seals every chunk
+    the write window has finished since the last flush, instead of one
+    handshake per chunk. Entries apply in list order (chain-write
+    ordering preserved; the length merge is max() so order cannot
+    shrink a file). Trailing ``trace_id``: see CltomaReadChunk."""
+
+    MSG_TYPE = 1075
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("ends", "list:msg:WriteChunkEndEntry"),
+        ("trace_id", "u64"),
+    )
+
+
 class CltomaTruncate(Message):
     MSG_TYPE = 1026
     FIELDS = (
@@ -1002,6 +1030,24 @@ class CltocsWriteBulk(Message):
         ("req_id", "u32"),
         ("chunk_id", "u64"),
         ("write_id", "u32"),
+        ("part_offset", "u32"),  # must be 64 KiB-aligned
+        ("crcs", "list:u32"),
+        ("data", "bytes"),
+    )
+
+
+class CltocsWriteBulkPart(Message):
+    """Part-addressed bulk write: the 1214 layout plus the target
+    ``part_id``, so several parts of one chunk can multiplex a single
+    connection (the vectored scatter path shares one connection per
+    chunkserver; write sessions demux on (chunk_id, part_id))."""
+
+    MSG_TYPE = 1215
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("write_id", "u32"),
+        ("part_id", "u32"),
         ("part_offset", "u32"),  # must be 64 KiB-aligned
         ("crcs", "list:u32"),
         ("data", "bytes"),

@@ -85,6 +85,7 @@ int64_t steady_ms() {
 }
 
 constexpr uint32_t kTypeWriteBulk = 1214;
+constexpr uint32_t kTypeWriteBulkPart = 1215;
 
 // One bulk-write frame header (type 1214): fixed fields + per-block
 // CRC table + payload length. Shared by the single-part and the
@@ -111,6 +112,36 @@ void build_bulk_write_header(std::vector<uint8_t>& head, uint64_t chunk_id,
         put32(head.data() + 33 + 4 * b, lz_crc32(0, payload + start, piece));
     }
     put32(head.data() + 33 + 4 * ncrcs, static_cast<uint32_t>(len));
+}
+
+// Part-addressed bulk-write frame (type 1215): the 1214 layout with the
+// target part_id inserted after write_id, so several parts of one chunk
+// can multiplex a single connection (the server demuxes write sessions
+// on (chunk_id, part_id) instead of assuming one part per connection).
+void build_bulk_write_part_header(std::vector<uint8_t>& head,
+                                  uint64_t chunk_id, uint32_t write_id,
+                                  uint32_t part_id, uint64_t part_offset,
+                                  const uint8_t* payload, uint64_t len) {
+    const uint32_t ncrcs =
+        static_cast<uint32_t>((len + kBlockSize - 1) / kBlockSize);
+    head.resize(8 + 29 + 4 * ncrcs + 4);
+    const size_t body = head.size() - 8 + len;
+    put32(head.data(), kTypeWriteBulkPart);
+    put32(head.data() + 4, static_cast<uint32_t>(body));
+    head[8] = kProtoVersion;
+    put32(head.data() + 9, write_id);
+    put64(head.data() + 13, chunk_id);
+    put32(head.data() + 21, write_id);
+    put32(head.data() + 25, part_id);
+    put32(head.data() + 29, static_cast<uint32_t>(part_offset));
+    put32(head.data() + 33, ncrcs);
+    for (uint32_t b = 0; b < ncrcs; ++b) {
+        const uint64_t start = uint64_t(b) * kBlockSize;
+        const uint32_t piece = static_cast<uint32_t>(
+            std::min<uint64_t>(kBlockSize, len - start));
+        put32(head.data() + 37 + 4 * b, lz_crc32(0, payload + start, piece));
+    }
+    put32(head.data() + 37 + 4 * ncrcs, static_cast<uint32_t>(len));
 }
 
 // Validate a CstoclWriteStatus ack payload for a bulk write: returns
@@ -763,6 +794,304 @@ int lz_write_parts_scatter(lz_part_req* parts, uint32_t n,
         if (parts[i].rc != 0) ret = -1;
     }
     return ret;
+}
+
+// --- windowed / vectored scatter writes ------------------------------------
+//
+// lz_write_parts_scatterv is the vectored successor of
+// lz_write_parts_scatter: frames are part-addressed (type 1215), so
+// several parts of one chunk can multiplex ONE connection to their
+// shared chunkserver; header + payload leave through a single
+// scatter-gather sendmsg per socket pass (no separate header syscall,
+// no payload staging copy); and with kScatterNoAck the call returns as
+// soon as every byte is handed to the kernel — the acks are collected
+// later by lz_write_collect_acks, so the caller can keep an N-deep
+// window of unacknowledged segments in flight instead of paying one
+// ack round trip per segment (the stripe-serial round trips PR 1's
+// phase telemetry blamed the send phase for).
+//
+// parts[i].version carries the bulk write_id (as on the 1214 path);
+// parts[i].part_id addresses the part inside the frame. Entries MAY
+// share fds; per fd they are sent — and acknowledged — in entry order.
+
+constexpr uint32_t kScatterNoAck = 1;
+
+namespace {
+
+// Collect one CstoclWriteStatus per entry, entries on the same fd in
+// order. parts[i].version = the expected write_id. Fills parts[i].rc;
+// returns 0 iff every entry acked OK.
+int collect_acks_inner(lz_part_req* parts, uint32_t n, int64_t deadline) {
+    struct AckQ {
+        int fd;
+        std::vector<uint32_t> entries;
+        size_t cur = 0;
+        int phase = 0;  // 0: frame header, 1: ack payload
+        uint32_t got = 0;
+        uint32_t ack_len = 0;
+        uint8_t small[32];
+    };
+    std::vector<AckQ> qs;
+    for (uint32_t i = 0; i < n; ++i) {
+        parts[i].rc = 1 << 30;
+        AckQ* q = nullptr;
+        for (auto& cand : qs)
+            if (cand.fd == parts[i].fd) { q = &cand; break; }
+        if (q == nullptr) {
+            qs.emplace_back();
+            q = &qs.back();
+            q->fd = parts[i].fd;
+        }
+        q->entries.push_back(i);
+    }
+    uint32_t live = n;
+    bool failed = false;
+    std::vector<pollfd> pfds(qs.size());
+    while (live && !failed) {
+        const int64_t now = steady_ms();
+        if (now >= deadline) break;
+        int nfds = 0;
+        for (auto& q : qs) {
+            if (q.cur >= q.entries.size()) continue;
+            pfds[nfds].fd = q.fd;
+            pfds[nfds].events = POLLIN;
+            pfds[nfds].revents = 0;
+            ++nfds;
+        }
+        int pr = ::poll(pfds.data(), nfds,
+                        static_cast<int>(std::min<int64_t>(deadline - now,
+                                                           30000)));
+        if (pr < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int pi = 0; pi < nfds; ++pi) {
+            if (!(pfds[pi].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+            AckQ* q = nullptr;
+            for (auto& cand : qs)
+                if (cand.fd == pfds[pi].fd && cand.cur < cand.entries.size()) {
+                    q = &cand;
+                    break;
+                }
+            if (q == nullptr) continue;
+            bool progress = true;
+            while (progress && q->cur < q->entries.size()) {
+                progress = false;
+                const uint32_t idx = q->entries[q->cur];
+                const uint32_t want = q->phase == 0 ? 8 : q->ack_len;
+                ssize_t r = ::recv(q->fd, q->small + q->got, want - q->got,
+                                   MSG_DONTWAIT);
+                if (r == 0) {
+                    parts[idx].rc = -1; --live; failed = true; break;
+                }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                    if (errno == EINTR) { progress = true; continue; }
+                    parts[idx].rc = -1; --live; failed = true; break;
+                }
+                q->got += static_cast<uint32_t>(r);
+                if (q->got < want) { progress = true; continue; }
+                q->got = 0;
+                if (q->phase == 0) {
+                    const uint32_t type = get32(q->small);
+                    q->ack_len = get32(q->small + 4);
+                    if (type != kTypeWriteStatus || q->ack_len < 18 ||
+                        q->ack_len > sizeof(q->small)) {
+                        parts[idx].rc = -2; --live; failed = true; break;
+                    }
+                    q->phase = 1;
+                    progress = true;
+                } else {
+                    const int rc = parse_bulk_write_ack(
+                        q->small, q->ack_len, parts[idx].version);
+                    parts[idx].rc = rc;
+                    --live;
+                    if (rc != 0) { failed = true; break; }
+                    q->phase = 0;
+                    ++q->cur;
+                    progress = true;
+                }
+            }
+        }
+    }
+    int ret = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        if (parts[i].rc != 0) ret = -1;
+    }
+    return ret;
+}
+
+}  // namespace
+
+// Vectored multi-part bulk write. flags: kScatterNoAck skips the ack
+// phase (collect later with lz_write_collect_acks). Returns 0 iff
+// every entry succeeded; per-entry codes land in parts[i].rc.
+int lz_write_parts_scatterv(lz_part_req* parts, uint32_t n,
+                            const uint8_t* const* payloads,
+                            const uint64_t* lens, uint64_t part_offset,
+                            uint32_t max_ms, uint32_t flags) {
+    if (n == 0 || part_offset % kBlockSize != 0) return -1;
+    std::vector<std::vector<uint8_t>> heads(n);
+    bool bad = false;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (lens[i] > (64u << 20)) {
+            parts[i].rc = -2;
+            bad = true;
+            continue;
+        }
+        build_bulk_write_part_header(heads[i], parts[i].chunk_id,
+                                     parts[i].version, parts[i].part_id,
+                                     part_offset, payloads[i], lens[i]);
+        parts[i].rc = 1 << 30;
+    }
+    if (bad) {
+        for (uint32_t i = 0; i < n; ++i)
+            if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        return -1;
+    }
+    // per-fd send queues: entries sharing a connection go out strictly
+    // in entry order, each as [header | payload] iovec pairs
+    struct SendQ {
+        int fd;
+        std::vector<uint32_t> entries;
+        size_t cur = 0;      // entry being sent
+        uint64_t done = 0;   // bytes of the current entry already sent
+        bool dead = false;
+    };
+    std::vector<SendQ> qs;
+    for (uint32_t i = 0; i < n; ++i) {
+        SendQ* q = nullptr;
+        for (auto& cand : qs)
+            if (cand.fd == parts[i].fd) { q = &cand; break; }
+        if (q == nullptr) {
+            qs.emplace_back();
+            q = &qs.back();
+            q->fd = parts[i].fd;
+        }
+        q->entries.push_back(i);
+    }
+    const int64_t deadline = steady_ms() + max_ms;
+    bool failed = false;
+    std::vector<pollfd> pfds(qs.size());
+    auto queue_unfinished = [&](const SendQ& q) {
+        return !q.dead && q.cur < q.entries.size();
+    };
+    for (;;) {
+        int pending = 0;
+        for (auto& q : qs)
+            if (queue_unfinished(q)) ++pending;
+        if (pending == 0 || failed) break;
+        const int64_t now = steady_ms();
+        if (now >= deadline) {
+            failed = true;
+            break;
+        }
+        int nfds = 0;
+        for (auto& q : qs) {
+            if (!queue_unfinished(q)) continue;
+            pfds[nfds].fd = q.fd;
+            pfds[nfds].events = POLLOUT;
+            pfds[nfds].revents = 0;
+            ++nfds;
+        }
+        int pr = ::poll(pfds.data(), nfds,
+                        static_cast<int>(std::min<int64_t>(deadline - now,
+                                                           30000)));
+        if (pr < 0) {
+            if (errno == EINTR) continue;
+            failed = true;
+            break;
+        }
+        for (int pi = 0; pi < nfds; ++pi) {
+            if (!(pfds[pi].revents & (POLLOUT | POLLERR | POLLHUP))) continue;
+            SendQ* q = nullptr;
+            for (auto& cand : qs)
+                if (cand.fd == pfds[pi].fd && queue_unfinished(cand)) {
+                    q = &cand;
+                    break;
+                }
+            if (q == nullptr) continue;
+            bool progress = true;
+            while (progress && queue_unfinished(*q)) {
+                progress = false;
+                // gather up to 16 iovecs starting at (cur, done):
+                // remaining header slice + payload slice of the current
+                // entry, then whole header/payload pairs of successors
+                struct iovec iov[16];
+                int niov = 0;
+                uint64_t pos = q->done;
+                for (size_t e = q->cur;
+                     e < q->entries.size() && niov < 15; ++e) {
+                    const uint32_t idx = q->entries[e];
+                    const uint64_t hlen = heads[idx].size();
+                    if (pos < hlen) {
+                        iov[niov].iov_base = heads[idx].data() + pos;
+                        iov[niov].iov_len = static_cast<size_t>(hlen - pos);
+                        ++niov;
+                        if (lens[idx] > 0) {
+                            iov[niov].iov_base = const_cast<uint8_t*>(
+                                payloads[idx]);
+                            iov[niov].iov_len =
+                                static_cast<size_t>(lens[idx]);
+                            ++niov;
+                        }
+                    } else if (pos < hlen + lens[idx]) {
+                        iov[niov].iov_base = const_cast<uint8_t*>(
+                            payloads[idx] + (pos - hlen));
+                        iov[niov].iov_len =
+                            static_cast<size_t>(hlen + lens[idx] - pos);
+                        ++niov;
+                    }
+                    pos = 0;
+                }
+                struct msghdr mh {};
+                mh.msg_iov = iov;
+                mh.msg_iovlen = static_cast<size_t>(niov);
+                ssize_t w = ::sendmsg(q->fd, &mh,
+                                      MSG_DONTWAIT | MSG_NOSIGNAL);
+                if (w < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                    if (errno == EINTR) { progress = true; continue; }
+                    for (size_t e = q->cur; e < q->entries.size(); ++e)
+                        parts[q->entries[e]].rc = -1;
+                    q->dead = true;
+                    failed = true;
+                    break;
+                }
+                uint64_t sent = static_cast<uint64_t>(w);
+                q->done += sent;
+                while (q->cur < q->entries.size()) {
+                    const uint32_t idx = q->entries[q->cur];
+                    const uint64_t total = heads[idx].size() + lens[idx];
+                    if (q->done < total) break;
+                    q->done -= total;
+                    if (flags & kScatterNoAck) parts[idx].rc = 0;
+                    ++q->cur;
+                }
+                progress = sent > 0;
+            }
+        }
+    }
+    if (failed) {
+        for (uint32_t i = 0; i < n; ++i)
+            if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        return -1;
+    }
+    if (flags & kScatterNoAck) {
+        for (uint32_t i = 0; i < n; ++i)
+            if (parts[i].rc == (1 << 30)) parts[i].rc = 0;
+        return 0;
+    }
+    return collect_acks_inner(parts, n, deadline);
+}
+
+// Collect the acks of previously sent (kScatterNoAck) bulk frames:
+// parts[i].fd + parts[i].version (= expected write_id), entries on the
+// same fd acknowledged in entry order. Returns 0 iff all acked OK.
+int lz_write_collect_acks(lz_part_req* parts, uint32_t n, uint32_t max_ms) {
+    if (n == 0) return 0;
+    return collect_acks_inner(parts, n, steady_ms() + max_ms);
 }
 
 }  // extern "C"

@@ -82,6 +82,7 @@ constexpr uint32_t kTypeWriteData = 1211;
 constexpr uint32_t kTypeWriteStatus = 1212;
 constexpr uint32_t kTypeWriteEnd = 1213;
 constexpr uint32_t kTypeWriteBulk = 1214;
+constexpr uint32_t kTypeWriteBulkPart = 1215;
 constexpr uint8_t kProtoVersion = 1;
 
 constexpr uint32_t kBlockSize = 64 * 1024;
@@ -312,6 +313,21 @@ constexpr uint64_t kTraceRead = 1;
 constexpr uint64_t kTraceReadBulk = 2;
 constexpr uint64_t kTraceWriteBulk = 4;
 constexpr size_t kTraceRingCap = 1024;
+
+// Write sessions are demuxed on (chunk_id, part_id): the vectored
+// client path (io_native lz_write_parts_scatterv) multiplexes several
+// parts of one chunk over a single connection, each with its own
+// WriteInit. Frames that predate part addressing (1211/1214) resolve
+// to the connection's sole session for that chunk (ordered map:
+// lower_bound finds it without a scan).
+using SessionKey = std::pair<uint64_t, uint32_t>;
+using SessionMap = std::map<SessionKey, WriteSession*>;
+
+WriteSession* find_chunk_session(SessionMap* sessions, uint64_t chunk_id) {
+    auto it = sessions->lower_bound(SessionKey(chunk_id, 0));
+    if (it == sessions->end() || it->first.first != chunk_id) return nullptr;
+    return it->second;
+}
 
 struct Server {
     std::vector<std::string> folders;
@@ -886,7 +902,7 @@ void teardown_session(WriteSession* s) {
 
 void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
                       const uint8_t* body, uint32_t blen,
-                      std::unordered_map<uint64_t, WriteSession*>* sessions) {
+                      SessionMap* sessions) {
     // parse
     if (blen < 4 + 8 + 4 + 4 + 4 + 1) return;
     uint32_t req_id = get32(body);
@@ -1031,16 +1047,16 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
         if (raw->down_fd >= 0) {
             raw->relay = std::thread(relay_down, raw, cfd, send_mu);
         }
-        auto it = sessions->find(chunk_id);
+        auto it = sessions->find(SessionKey(chunk_id, part_id));
         if (it != sessions->end()) teardown_session(it->second);
-        (*sessions)[chunk_id] = raw;
+        (*sessions)[SessionKey(chunk_id, part_id)] = raw;
     }
     send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0, code);
 }
 
 void serve_write_data(Server& srv, int cfd, std::mutex* send_mu,
                       const uint8_t* frame, uint32_t flen,
-                      std::unordered_map<uint64_t, WriteSession*>* sessions) {
+                      SessionMap* sessions) {
     // frame = full raw frame (header + payload) so chain forward can
     // resend verbatim; body starts at frame+9 (after header + version)
     const uint8_t* body = frame + 9;
@@ -1053,13 +1069,12 @@ void serve_write_data(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t crc = get32(body + 24);
     uint32_t dlen = get32(body + 28);
     if (32 + dlen != blen) return;
-    auto it = sessions->find(chunk_id);
-    if (it == sessions->end()) {
+    WriteSession* s = find_chunk_session(sessions, chunk_id);
+    if (s == nullptr) {
         send_status(cfd, send_mu, kTypeWriteStatus, write_id, chunk_id,
                     write_id, stEINVAL);
         return;
     }
-    WriteSession* s = it->second;
     bool chained = s->down_fd >= 0;
     if (chained) {
         if (!send_all(s->down_fd, frame, flen)) {
@@ -1104,33 +1119,41 @@ void serve_write_data(Server& srv, int cfd, std::mutex* send_mu,
 // through the same relay bookkeeping as per-piece writes).
 void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
                       const uint8_t* header8, uint32_t length,
-                      std::unordered_map<uint64_t, WriteSession*>* sessions,
-                      bool* conn_ok) {
+                      SessionMap* sessions, bool* conn_ok, bool has_part) {
     *conn_ok = false;  // until the full frame is consumed
     uint64_t t_start = lzwire::now_us();
     uint64_t recv_us = 0, disk_us = 0;
-    // fixed: ver(1) req(4) chunk(8) write_id(4) part_offset(4) ncrcs(4)
-    uint8_t fixed[25];
-    if (length < sizeof(fixed) + 4 || !recv_all(cfd, fixed, sizeof(fixed)))
+    // 1214 fixed: ver(1) req(4) chunk(8) write_id(4) part_offset(4)
+    // ncrcs(4); the part-addressed 1215 inserts part_id(4) after
+    // write_id so parts multiplexing one connection demux correctly
+    uint8_t fixed[29];
+    const size_t fixed_len = has_part ? 29 : 25;
+    if (length < fixed_len + 4 || !recv_all(cfd, fixed, fixed_len))
         return;
     if (fixed[0] != kProtoVersion) return;
     uint32_t req_id = get32(fixed + 1);
     uint64_t chunk_id = get64(fixed + 5);
     uint32_t write_id = get32(fixed + 13);
-    uint32_t part_offset = get32(fixed + 17);
-    uint32_t ncrcs = get32(fixed + 21);
+    uint32_t part_id = has_part ? get32(fixed + 17) : 0;
+    uint32_t part_offset = get32(fixed + (has_part ? 21 : 17));
+    uint32_t ncrcs = get32(fixed + (has_part ? 25 : 21));
     if (ncrcs > kBlocksInChunk ||
-        length < sizeof(fixed) + 4ull * ncrcs + 4)
+        length < fixed_len + 4ull * ncrcs + 4)
         return;
     std::vector<uint8_t> crcs(4 * ncrcs);
     uint8_t dlen_raw[4];
     if (!recv_all(cfd, crcs.data(), crcs.size())) return;
     if (!recv_all(cfd, dlen_raw, 4)) return;
     uint32_t dlen = get32(dlen_raw);
-    if (length != sizeof(fixed) + 4 * ncrcs + 4 + dlen) return;
+    if (length != fixed_len + 4 * ncrcs + 4 + dlen) return;
 
-    auto it = sessions->find(chunk_id);
-    WriteSession* s = it == sessions->end() ? nullptr : it->second;
+    WriteSession* s;
+    if (has_part) {
+        auto it = sessions->find(SessionKey(chunk_id, part_id));
+        s = it == sessions->end() ? nullptr : it->second;
+    } else {
+        s = find_chunk_session(sessions, chunk_id);
+    }
     uint8_t code = stOK;
     if (s == nullptr) {
         code = stEINVAL;
@@ -1146,7 +1169,7 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
         uint8_t hdr[8];
         std::memcpy(hdr, header8, 8);
         bool fwd = send_all(s->down_fd, hdr, 8) &&
-                   send_all(s->down_fd, fixed, sizeof(fixed)) &&
+                   send_all(s->down_fd, fixed, fixed_len) &&
                    send_all(s->down_fd, crcs.data(), crcs.size()) &&
                    send_all(s->down_fd, dlen_raw, 4);
         if (!fwd) {
@@ -1290,7 +1313,7 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
 
 void connection_loop(Server& srv, int cfd) {
     set_bulk_sockopts(cfd);
-    std::unordered_map<uint64_t, WriteSession*> sessions;
+    SessionMap sessions;
     std::mutex send_mu;
     std::vector<uint8_t> frame;
     for (;;) {
@@ -1298,13 +1321,13 @@ void connection_loop(Server& srv, int cfd) {
         if (!recv_all(cfd, header, 8)) break;
         uint32_t type = get32(header);
         uint32_t length = get32(header + 4);
-        if (type == kTypeWriteBulk) {
+        if (type == kTypeWriteBulk || type == kTypeWriteBulkPart) {
             // streamed: the frame may be tens of MiB and never lands in
             // one buffer
             if (length < 1 || length > (96u << 20)) break;
             bool conn_ok = false;
             serve_write_bulk(srv, cfd, &send_mu, header, length, &sessions,
-                             &conn_ok);
+                             &conn_ok, type == kTypeWriteBulkPart);
             if (!conn_ok) break;
             continue;
         }
@@ -1327,13 +1350,16 @@ void connection_loop(Server& srv, int cfd) {
         } else if (type == kTypeWriteEnd && blen >= 12) {
             uint32_t req_id = get32(body);
             uint64_t chunk_id = get64(body + 4);
-            auto it = sessions.find(chunk_id);
-            if (it != sessions.end()) {
+            // one WriteEnd seals EVERY part session of the chunk on
+            // this connection (the vectored client sends one End per
+            // connection, not per part), answered by a single status
+            auto it = sessions.lower_bound(SessionKey(chunk_id, 0));
+            while (it != sessions.end() && it->first.first == chunk_id) {
                 WriteSession* s = it->second;
                 if (s->down_fd >= 0) {
                     send_all(s->down_fd, frame.data(), frame.size());
                 }
-                sessions.erase(it);
+                it = sessions.erase(it);
                 teardown_session(s);
             }
             send_status(cfd, &send_mu, kTypeWriteStatus, req_id, chunk_id, 0,

@@ -54,6 +54,12 @@ def _fat_row() -> dict:
             "encode_ms": 1234.56, "stage_ms": 345.67, "send_ms": 4567.89,
             "commit_ms": 123.45, "wall_ms": 5678.9, "reps": 5,
         }
+        # adaptive write-window fiducials (round 6: depth settled +
+        # segment/credit/coalesce deltas per striped row)
+        row[f"cluster_{g}_write_window"] = {
+            "depth": 8, "max_depth": 8, "segments": 1234,
+            "credit_waits": 56, "commits_coalesced": 12,
+        }
     row["cluster_ec8_4_write_trace"] = {
         "rep_MBps": 431.2, "wall_ms": 297.123, "coverage_pct": 94.7,
         "by_role_ms": {"client": 401.2, "chunkserver": 233.4,
@@ -90,7 +96,21 @@ def test_summary_line_fits_driver_tail():
     # the verdict-bearing fields survived the compaction
     assert parsed["cluster_ec8_4_write_target_met"] is False
     assert "cluster_ec8_4_write_phases" in parsed
-    assert parsed["cluster_ec8_4_write_trace"]["coverage_pct"] == 94.7
+    # instruments on the drop ladder may be cut on a worst-case round,
+    # but then the cut is RECORDED — never silent, never mid-JSON
+    assert (
+        parsed.get("cluster_ec8_4_write_trace", {}).get("coverage_pct")
+        == 94.7
+        or "cluster_ec8_4_write_trace" in parsed.get("dropped", [])
+    )
+    # write-window fiducials ride the tail for the target row only
+    # (xor3/ec3_2 window dicts stay in BENCH_FULL.json); under budget
+    # pressure the dict may drop, but then the drop is RECORDED
+    assert (
+        parsed.get("cluster_ec8_4_write_window", {}).get("depth") == 8
+        or "cluster_ec8_4_write_window" in parsed.get("dropped", [])
+    )
+    assert not any("xor3_write_window" in k for k in parsed)
     # slo fiducials ride the tail: noise attribution from the artifact
     assert parsed["cluster_health_status"] == "degraded"
     assert parsed["cluster_slo_breaches"] == 1234

@@ -435,9 +435,11 @@ async def test_cancelled_striped_write_does_not_pool_staging(
         assert any(cl and cl.get("aborted") for cl in seen_cells), \
             "cancelled write did not abort its in-flight sender"
 
-        # 3) same invariant for the PIPELINED sender: a cancelled
-        # session segment must abort its cell and keep both the stage
-        # and the parity send buffer out of the pool
+        # 3) same invariant for the PIPELINED/WINDOWED sender: a
+        # cancelled session segment must abort its cell and keep both
+        # the stage and the parity send buffer out of the pool (the
+        # windowed default sends via send_segment_window, the kill-
+        # switch path via send_segment — hang whichever engages)
         monkeypatch.undo()
         c.write_pipeline = True
         started3 = threading.Event()
@@ -456,6 +458,10 @@ async def test_cancelled_striped_write_does_not_pool_staging(
 
         monkeypatch.setattr(
             native_io.PartsScatterSession, "send_segment", hang_segment
+        )
+        monkeypatch.setattr(
+            native_io.PartsScatterSession, "send_segment_window",
+            hang_segment,
         )
         h = await c.create(1, "pool3.bin")
         await c.setgoal(h.inode, EC_GOAL)

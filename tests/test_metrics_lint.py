@@ -157,6 +157,12 @@ async def test_lint_live_daemon_registries(tmp_path):
         await cluster.master._health_tick()
         for daemon in [cluster.master, *cluster.chunkservers]:
             lint_prometheus(daemon.metrics.to_prometheus())
+        # the client-side registry (write-window depth/credit/coalesce
+        # series ride whatever exporter embeds the client) lints too
+        typed_client = lint_prometheus(c.metrics.to_prometheus())
+        assert "lizardfs_write_window_depth" in typed_client
+        assert "lizardfs_write_window_credit_waits_total" in typed_client
+        assert "lizardfs_write_commits_coalesced_total" in typed_client
         # over the wire (metrics-prom relays the same render)
         r, w = await asyncio.open_connection(
             "127.0.0.1", cluster.master.port

@@ -532,6 +532,68 @@ async def test_nfs_chmod_drops_cached_access_immediately(tmp_path):
         await cluster.stop()
 
 
+async def test_nfs_cross_gateway_chmod_revokes_cached_access(tmp_path):
+    """ADVICE r05 #4 residual: a chmod through gateway A must revoke
+    gateway B's cached access decisions via a master invalidation push
+    — NOT after META_TTL_S. With the TTL cranked far above the test's
+    lifetime, only the push can make B refuse."""
+    import asyncio as aio
+
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    gw_a = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    gw_b = nfs.NfsGateway("127.0.0.1", cluster.master.port)
+    await gw_a.start()
+    await gw_b.start()
+    # the TTL alone may NOT rescue revocation in this test
+    gw_a.META_TTL_S = 300.0
+    gw_b.META_TTL_S = 300.0
+    try:
+        async with Nfs3Client("127.0.0.1", gw_a.port) as r, \
+                Nfs3Client("127.0.0.1", gw_a.port, uid=1000, gid=1000) as a, \
+                Nfs3Client("127.0.0.1", gw_b.port, uid=1000, gid=1000) as b:
+            pub = await r.mkdir(await r.mnt("/"), "pub", mode=0o777)
+            root_a = await a.mnt("/")
+            code, dir_a, _ = await a.lookup(root_a, "pub")
+            assert code == nfs.NFS3_OK
+            code, fh = await a.create(dir_a, "locked.bin", mode=0o644)
+            assert code == nfs.NFS3_OK, code
+            await a.write(fh, 0, b"secret-bytes!")
+            # warm gateway B's attr + access caches for the inode
+            root_b = await b.mnt("/")
+            code, dir_b, _ = await b.lookup(root_b, "pub")
+            assert code == nfs.NFS3_OK
+            code, fh_b, _ = await b.lookup(dir_b, "locked.bin")
+            assert code == nfs.NFS3_OK
+            piece, _ = await b.read(fh_b, 0, 13)
+            assert piece == b"secret-bytes!"
+            # revoke through gateway A
+            assert await a.setattr(fh, mode=0) == nfs.NFS3_OK
+            # the push rides master -> B's client session -> the
+            # gateway's invalidate listener; poll briefly (it is one
+            # in-process hop, nowhere near the 300 s TTL)
+            from lizardfs_tpu.nfs.xdr import Packer
+
+            deadline = aio.get_event_loop().time() + 5.0
+            refused = False
+            while aio.get_event_loop().time() < deadline:
+                u = await b.call(
+                    6, Packer().opaque(fh_b).u64(0).u32(13).bytes()
+                )
+                if u.u32() == nfs.NFS3ERR_ACCES:
+                    refused = True
+                    break
+                await aio.sleep(0.05)
+            assert refused, (
+                "cross-gateway chmod never revoked B's cached access "
+                "inside the TTL (invalidation push missing)"
+            )
+    finally:
+        await gw_a.stop()
+        await gw_b.stop()
+        await cluster.stop()
+
+
 async def test_nfs_trace_propagation_to_chunkserver(tmp_path):
     """NFS joins the trace domain (PR 3): a wire READ starts a trace at
     the gateway's dispatch boundary and the id propagates through the
