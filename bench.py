@@ -413,6 +413,16 @@ def cluster_throughput() -> dict:
                     "by_role_ms": r.get("by_role_ms", {}),
                     "spans": r.get("spans", 0),
                 }
+            elif "shm_on_MBps" in r:
+                # shm-ring A/B: the same-host shared-memory data plane
+                # vs the LZ_SHM_RING=0 scatterv path, interleaved reps
+                out["cluster_ec8_4_write_shm"] = {
+                    "on_MBps": r["shm_on_MBps"],
+                    "off_MBps": r["shm_off_MBps"],
+                    "delta_pct": r["shm_delta_pct"],
+                    "desc_parts": r.get("shm_desc_parts", 0),
+                    "engaged": r.get("shm_engaged", False),
+                }
             elif "health_status" in r:
                 # SLO/flight-recorder fiducials (the "slo health" row):
                 # breach counts make a co-located-load rep attributable
@@ -714,11 +724,19 @@ def _summary_row(row: dict) -> dict:
             "_ec8_4_" in key or "_ec3_2_" in key
         ):
             # the phase instrument the ec(8,4) target miss exists for
-            # (+ ec(3,2) as its cross-check), integer ms to stay lean
+            # (+ ec(3,2) as its cross-check), integer ms to stay lean —
+            # except the send/encode ratio, whose verdict lives in its
+            # decimals (<= 1.0 is the ISSUE 6 target)
             s[key] = {
-                k: (int(round(v)) if isinstance(v, float) else v)
+                k: (int(round(v))
+                    if isinstance(v, float) and k != "send_over_encode"
+                    else v)
                 for k, v in value.items()
             }
+        elif key == "cluster_ec8_4_write_shm" and isinstance(value, dict):
+            # the shm on/off A/B delta: THE instrument of this round's
+            # send-phase attack
+            s[key] = value
         elif key.endswith("_write_window") and "_ec8_4_" in key:
             # window fiducials for the target row: did the adaptive
             # depth actually deepen, and did credits ever stall it
@@ -749,7 +767,7 @@ _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "kernel_ladder",
     "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
-    "cluster_ec8_4_write_phases",
+    "cluster_ec8_4_write_shm", "cluster_ec8_4_write_phases",
 )
 
 

@@ -164,7 +164,13 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 name: client.metrics.series[name].total
                 for name in ("write_window_segments",
                              "write_window_credit_waits",
-                             "write_commits_coalesced")
+                             "write_commits_coalesced",
+                             # shm ring engagement per striped row: how
+                             # many part writes moved as descriptors vs
+                             # fell back to the socket copy
+                             "shm_ring_desc_parts",
+                             "shm_ring_full_waits",
+                             "shm_ring_fallbacks")
                 if name in client.metrics.series
             }
             for rep in range(GOAL_REPS):
@@ -208,9 +214,24 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 # Phases overlap in the pipelined path, so their sum can
                 # exceed wall — the gap is the overlap win; a phase that
                 # dominates names where the next MB/s must come from.
-                row["write_phases_ms"] = phase_delta(
+                phases = phase_delta(
                     client.write_phases.snapshot(), phases_before
                 )
+                # send/encode busy-fraction ratio: the roofline verdict
+                # in one number (ISSUE 6 target: <= 1.0 with the shm
+                # ring active; the r05 capture sat at ~2.4)
+                if phases.get("encode_ms"):
+                    phases["send_over_encode"] = round(
+                        phases.get("send_ms", 0.0) / phases["encode_ms"], 2
+                    )
+                # name the dominant phase outright: with the shm ring
+                # active the acceptance question is "if not send, what
+                # is the roofline now" — answer it from the row alone
+                busy = {p: phases.get(f"{p}_ms", 0.0)
+                        for p in ("encode", "stage", "send", "ack",
+                                  "commit")}
+                phases["dominant"] = max(busy, key=busy.get)
+                row["write_phases_ms"] = phases
                 if client.write_window is not None:
                     # write-window fiducials: the depth the controller
                     # settled on plus this row's segment/credit-wait/
@@ -227,8 +248,21 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                                 - window_before.get(name, 0.0)
                             )
                             for name in window_before
+                            if not name.startswith("shm_ring_")
                         },
                     }
+                    shm_delta = {
+                        name.replace("shm_ring_", ""): round(
+                            client.metrics.series[name].total
+                            - window_before.get(name, 0.0)
+                        )
+                        for name in window_before
+                        if name.startswith("shm_ring_")
+                    }
+                    if any(shm_delta.values()):
+                        # ring engagement per striped row (full JSON
+                        # only; the tail carries the dedicated A/B row)
+                        row["write_shm_ring"] = shm_delta
             rows.append(_attach_targets(row))
 
         # one TRACED ec(8,4) write rep: cross-role request tracing
@@ -269,6 +303,80 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 import logging
 
                 logging.getLogger("bench").exception("trace row failed")
+
+        # shm-ring A/B fiducial: the same ec(8,4) write with the same-
+        # host shared-memory data plane active vs LZ_SHM_RING=0 (the
+        # PR-5 vectored scatterv path), interleaved so drifting box
+        # load hits both arms. The delta is the direct measurement of
+        # what killing the send-phase socket copy buys on this box.
+        try:
+            from lizardfs_tpu.core import native_io as _nio
+
+            # honor the operator's kill switch: with LZ_SHM_RING=0 set
+            # the "on" arm must not force-enable the very path the
+            # switch exists to avoid — skip the row entirely
+            if _nio.parts_shm_available() and _nio.shm_ring_enabled():
+                import os as _os
+
+                async def _shm_rep(name: str) -> float:
+                    f = await client.create(1, name)
+                    await client.setgoal(f.inode, 12)  # ec(8,4)
+                    t0 = time.perf_counter()
+                    await client.write_file(f.inode, payload)
+                    return size_mb / (time.perf_counter() - t0)
+
+                def _ring_total(series: str) -> float:
+                    s = client.metrics.series.get(series)
+                    return s.total if s is not None else 0.0
+
+                on, off, names = [], [], []
+                had_env = _os.environ.get("LZ_SHM_RING")
+                try:
+                    # one discarded warm-up rep per arm: the goal reps
+                    # above left only ring connections pooled, so the
+                    # first off rep would otherwise pay d+m fresh UDS
+                    # dials and inflate shm_delta_pct — the very number
+                    # this row exists to report
+                    for suffix, env in (("on", None), ("off", "0")):
+                        if env is None:
+                            _os.environ.pop("LZ_SHM_RING", None)
+                        else:
+                            _os.environ["LZ_SHM_RING"] = env
+                        names.append(f"shm_warm_{suffix}.bin")
+                        await _shm_rep(names[-1])
+                    desc_before = _ring_total("shm_ring_desc_parts")
+                    for rep in range(2):
+                        _os.environ.pop("LZ_SHM_RING", None)
+                        names.append(f"shm_on_{rep}.bin")
+                        on.append(await _shm_rep(names[-1]))
+                        _os.environ["LZ_SHM_RING"] = "0"
+                        names.append(f"shm_off_{rep}.bin")
+                        off.append(await _shm_rep(names[-1]))
+                finally:
+                    if had_env is None:
+                        _os.environ.pop("LZ_SHM_RING", None)
+                    else:
+                        _os.environ["LZ_SHM_RING"] = had_env
+                on_med, _ = _median_spread([round(v, 1) for v in on])
+                off_med, _ = _median_spread([round(v, 1) for v in off])
+                desc_parts = round(
+                    _ring_total("shm_ring_desc_parts") - desc_before
+                )
+                rows.append({
+                    "goal": "ec(8,4) write shm",
+                    "shm_on_MBps": on_med,
+                    "shm_off_MBps": off_med,
+                    "shm_delta_pct": round(
+                        (on_med - off_med) / off_med * 100.0, 1
+                    ) if off_med else 0.0,
+                    "shm_desc_parts": desc_parts,
+                    "shm_engaged": desc_parts > 0,
+                })
+                await drop_bench_files(names)
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("shm A/B row failed")
 
         # SLO / flight-recorder fiducials: with objectives watching the
         # hot paths, a driver-box stall during a rep is attributable
@@ -639,6 +747,10 @@ def main(argv=None) -> int:
             )
             print(f"{r['goal']:>18s}:  wall {r['wall_ms']:8.1f} ms"
                   f"   coverage {r['coverage_pct']:5.1f}%   [{by_role}]")
+        elif "shm_on_MBps" in r:
+            print(f"{r['goal']:>18s}:  on {r['shm_on_MBps']:8.1f} MB/s"
+                  f"   off {r['shm_off_MBps']:8.1f} MB/s"
+                  f"   delta {r['shm_delta_pct']:+.1f}%")
         elif "health_status" in r:
             print(f"{r['goal']:>18s}:  {r['health_status']}"
                   f"   breaches {r['slo_breaches']}"

@@ -49,6 +49,13 @@ if _lib is not None:
             _lib.lz_serve_trace.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: per-op timing/trace channel stays off
+        try:
+            _lib.lz_serve_shm_stats.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
+            ]
+            _lib.lz_serve_shm_stats.restype = None
+        except AttributeError:
+            pass  # stale .so: shm ring counters stay off
     except AttributeError:
         _lib = None
 
@@ -56,7 +63,8 @@ if _lib is not None:
 # lz_serve_trace flattens one op to 8 u64 slots — keep in sync with
 # serve_native.cpp TraceOp
 TRACE_OP_SLOTS = 8
-_TRACE_KINDS = {1: "cs_read", 2: "cs_read_bulk", 4: "cs_write_bulk"}
+_TRACE_KINDS = {1: "cs_read", 2: "cs_read_bulk", 4: "cs_write_bulk",
+                5: "cs_write_shm"}
 
 
 def available() -> bool:
@@ -100,6 +108,22 @@ class DataPlaneServer:
             "bytes_written": out[1],
             "read_ops": out[2],
             "write_ops": out[3],
+        }
+
+    def shm_stats(self) -> dict[str, int]:
+        """Shared-memory ring plane counters (shm_ring.h proactor):
+        segments mapped, descriptor ops landed, payload bytes moved via
+        ring, and currently active mappings. Zeros on a stale .so."""
+        if not hasattr(_lib, "lz_serve_shm_stats") or self._handle < 0:
+            return {"segments_mapped": 0, "desc_ops": 0, "bytes": 0,
+                    "active_segments": 0}
+        out = (ctypes.c_uint64 * 4)()
+        _lib.lz_serve_shm_stats(self._handle, out)
+        return {
+            "segments_mapped": out[0],
+            "desc_ops": out[1],
+            "bytes": out[2],
+            "active_segments": out[3],
         }
 
     def trace_ops(self, max_ops: int = 1024) -> list[dict]:

@@ -292,6 +292,23 @@ class ChunkServer(Daemon):
             ):
                 if key in s:
                     self.metrics.gauge(f"native_{key}").set(float(s[key]))
+            # shm ring plane (native/shm_ring.h proactor): how many
+            # same-host segments are mapped and how many bytes skipped
+            # the socket copy — the same view Prometheus scrapes
+            shm = self.data_server.shm_stats()
+            for key, help_txt in (
+                ("segments_mapped", "shm ring segments negotiated on "
+                 "the native data plane (memfd mappings created)"),
+                ("desc_ops", "part writes landed from shm ring "
+                 "descriptors on the native data plane"),
+                ("bytes", "payload bytes landed via shm ring segments "
+                 "(no socket copy)"),
+                ("active_segments", "shm ring segments currently "
+                 "mapped (released on peer disconnect)"),
+            ):
+                self.metrics.gauge(
+                    f"native_shm_{key}", help=help_txt
+                ).set(float(shm[key]))
             self._fold_native_trace()
         try:
             import json as _json
@@ -619,6 +636,10 @@ class ChunkServer(Daemon):
         # (chunk_id, part_id) -> session; see _chunk_session
         sessions: dict[tuple[int, int], _WriteSession] = {}
         admin_state: dict = {}
+        # shared-memory part ring negotiated on this connection (pure-
+        # Python demux of the same descriptor frames serve_native.cpp's
+        # proactor drains; the mapping is released on disconnect)
+        shm_state: dict = {}
         # in-flight _finish_write tasks still owe status frames on this
         # writer; native streaming must not interleave with them
         pending_writes: set[asyncio.Task] = set()
@@ -679,6 +700,12 @@ class ChunkServer(Daemon):
                 elif isinstance(msg, (m.CltocsWriteBulk,
                                       m.CltocsWriteBulkPart)):
                     await self._serve_write_bulk(writer, msg, sessions)
+                elif isinstance(msg, m.CltocsShmInit):
+                    await self._serve_shm_init(writer, msg, shm_state)
+                elif isinstance(msg, m.CltocsShmWritePart):
+                    await self._serve_shm_write(
+                        writer, msg, sessions, shm_state
+                    )
                 elif isinstance(msg, m.CltocsWriteEnd):
                     # one End seals EVERY part session of the chunk on
                     # this connection (the vectored client sends one
@@ -705,6 +732,147 @@ class ChunkServer(Daemon):
         finally:
             for session in sessions.values():
                 await session.close()
+            mm = shm_state.pop("mm", None)
+            if mm is not None:
+                # peer gone (incl. SIGKILL): release the mapping now —
+                # segments are owned by the connection, never leaked
+                # across reconnects
+                mm.close()
+
+    async def _serve_shm_init(self, writer, msg: m.CltocsShmInit,
+                              shm_state: dict) -> None:
+        """Map the client's memfd ring segment (native/shm_ring.h).
+
+        The asyncio plane reads frames through a StreamReader, which
+        drops SCM_RIGHTS ancillary data, so the segment is opened via
+        ``/proc/<pid>/fd/<n>`` instead — same-host only, and the kernel
+        enforces the same same-uid gate the UDS SO_PEERCRED check does.
+        Acked with a CstoclWriteStatus; any refusal leaves the
+        connection on the socket-copy path."""
+        import mmap as mmap_mod
+        import socket as socket_mod
+
+        # same-host contract: a remote peer must not be able to drive
+        # the /proc fd mapping (or pin server-side segments).  Unix
+        # sockets qualify outright; TCP only from a loopback peer —
+        # pure-Python runs have no UDS data listener, so the demux's
+        # legitimate callers arrive over 127.0.0.1 (the /proc open
+        # still enforces the same-uid gate either way).
+        sock = writer.get_extra_info("socket")
+        peer = writer.get_extra_info("peername")
+        if sock is not None and sock.family == socket_mod.AF_UNIX:
+            same_host = True
+        else:
+            host = peer[0] if isinstance(peer, tuple) and peer else None
+            same_host = host in ("127.0.0.1", "::1")
+        code = st.OK
+        if (
+            not same_host
+            or not native_io.shm_ring_enabled()
+            or msg.seg_size <= 0
+            or msg.seg_size > (1 << 30)
+        ):
+            code = st.EINVAL
+        else:
+            try:
+                fd = os.open(
+                    f"/proc/{msg.pid}/fd/{msg.mem_fd}", os.O_RDONLY
+                )
+                try:
+                    if os.fstat(fd).st_size < msg.seg_size:
+                        raise OSError("segment smaller than advertised")
+                    mm = mmap_mod.mmap(
+                        fd, msg.seg_size, prot=mmap_mod.PROT_READ
+                    )
+                finally:
+                    os.close(fd)
+                old = shm_state.pop("mm", None)
+                if old is not None:
+                    old.close()  # renegotiation replaces the mapping
+                shm_state["mm"] = mm
+                shm_state["size"] = msg.seg_size
+                self.metrics.counter(
+                    "shm_segments_mapped",
+                    help="shm ring segments mapped from same-host "
+                         "clients (asyncio data plane)",
+                ).inc()
+            except OSError:
+                code = st.EINVAL
+        await framing.send_message(
+            writer,
+            m.CstoclWriteStatus(
+                req_id=msg.req_id, chunk_id=0, write_id=0, status=code
+            ),
+        )
+
+    async def _serve_shm_write(self, writer, msg: m.CltocsShmWritePart,
+                               sessions, shm_state: dict) -> None:
+        """Land one ring descriptor: the payload is read straight out
+        of the mapped segment; the wire carried only addressing + CRCs.
+        Acked exactly like a CltocsWriteBulkPart (FIFO per connection),
+        so the windowed client's ack collector is path-agnostic."""
+        session = sessions.get((msg.chunk_id, msg.part_id))
+        mm = shm_state.get("mm")
+
+        async def ack(code):
+            await framing.send_message(
+                writer,
+                m.CstoclWriteStatus(
+                    req_id=msg.req_id, chunk_id=msg.chunk_id,
+                    write_id=msg.write_id, status=code,
+                ),
+            )
+
+        nblocks = -(-msg.length // MFSBLOCKSIZE)
+        if (
+            session is None
+            or mm is None
+            or msg.length == 0
+            or msg.part_offset % MFSBLOCKSIZE != 0
+            or msg.ring_off + msg.length > shm_state.get("size", 0)
+            or len(msg.crcs) != nblocks
+        ):
+            await ack(st.EINVAL)
+            return
+        tw0 = time.time()
+        t0 = time.perf_counter()
+        data = bytes(mm[msg.ring_off : msg.ring_off + msg.length])
+
+        def apply_all():
+            pos = 0
+            for crc in msg.crcs:
+                piece = data[pos : pos + MFSBLOCKSIZE]
+                # store.write verifies the piece against its wire CRC
+                self.store.write(
+                    msg.chunk_id, session.version, session.part_id,
+                    (msg.part_offset + pos) // MFSBLOCKSIZE, 0,
+                    piece, int(crc),
+                )
+                pos += len(piece)
+
+        code = st.OK
+        try:
+            await asyncio.to_thread(apply_all)
+        except ChunkStoreError as e:
+            code = e.code
+        except Exception:
+            self.log.exception("shm write failed")
+            code = st.EIO
+        self.metrics.counter("bytes_written").inc(float(msg.length))
+        self.metrics.counter(
+            "shm_desc_writes",
+            help="part writes landed from shm ring descriptors "
+                 "(asyncio data plane)",
+        ).inc()
+        self.trace_ring.record(
+            session.trace_id, "cs_write_shm", tw0, time.time(),
+            role="chunkserver", bytes=msg.length,
+        )
+        self.slo.observe(
+            "write", time.perf_counter() - t0, trace_id=session.trace_id,
+            name="cs_write_shm",
+        )
+        await ack(code)
 
     async def _debug_read_delay(self) -> None:
         """Fault injection (tweak ``debug_read_delay_ms``): stall the

@@ -174,10 +174,15 @@ class Client:
         self.locate_cache_ttl = 3.0
         self.cache.add_invalidate_listener(self._drop_locates)
         # per-phase busy-time accounting for the write data path
-        # (encode/stage/send/commit); pipelined phases overlap, so the
-        # phase sum may exceed wall time — see runtime.metrics
+        # (encode/stage/send/ack/commit); pipelined phases overlap, so
+        # the phase sum may exceed wall time — see runtime.metrics.
+        # "send" is the push cost (socket copy, or descriptor writes on
+        # the shm-ring plane); "ack" is the windowed path's completion
+        # wait (downstream backpressure). Through r06 ack waits were
+        # folded into send_ms — compare r07+ send_ms to older rounds as
+        # send_ms + ack_ms.
         self.write_phases = PhaseBreakdown(
-            "client_write", ("encode", "stage", "send", "commit")
+            "client_write", ("encode", "stage", "send", "ack", "commit")
         )
         # request-scoped span ring (runtime/tracing.py): phase charges
         # double as client-role spans when the op runs under a trace;
@@ -1650,8 +1655,23 @@ class Client:
             for a in range(0, blocks_per_part, seg_blocks)
         ]
 
-        def encode_segment(a: int, b: int) -> None:
+        def encode_segment(a: int, b: int, views=None) -> None:
             data_seg = [stacked[i][a:b] for i in range(d)]
+            if views is not None:
+                # shm-ring staging: parity is encoded STRAIGHT into the
+                # chunkserver-mapped arena (zero copies end to end);
+                # data rows stay in the stage buffer — their single
+                # GIL-free memcpy into the arena happens inside the
+                # native descriptor send (native/shm_ring.h). The
+                # later "send" phase moves descriptors, not megabytes.
+                par_out = [views[d + j] for j in range(m_par)]
+                if par_out[0] is None:
+                    return  # segment past every part's live length
+                if slice_type.is_xor:
+                    self.encoder.xor_parity_into(data_seg, par_out[0])
+                else:
+                    self.encoder.encode_into(d, m_par, data_seg, par_out)
+                return
             if slice_type.is_xor:
                 self.encoder.xor_parity_into(data_seg, par_buf[0][a:b])
             else:
@@ -1767,6 +1787,7 @@ class Client:
         from lizardfs_tpu.core import native_io
 
         win = self.write_window
+        d = slice_type.data_parts  # ring widths: data rows vs parity
         # nseg_min=win.max_depth: enough segments that the window can
         # actually fill (a 4-deep window over 4 segments would
         # degenerate to the old barrier)
@@ -1785,12 +1806,39 @@ class Client:
             await native_io.run(session.open)
             self._phase("send", t0)
             for wid, (a, b) in enumerate(bounds, start=1):
+                lengths = seg_lengths(a, b)
+                # shm-ring staging: reserve this segment's arena regions
+                # BEFORE encoding so parity lands straight in mapped
+                # memory. A full ring reaps the oldest segment's acks
+                # (freeing its regions) and retries; with nothing left
+                # to reap, this segment takes the socket-copy send.
+                views = None
+                if session.ring_ready():
+                    # parity regions are allocated at the full padded
+                    # segment width (the encoder writes the whole
+                    # column range); only the live bytes go on the wire
+                    widths = lengths[:d] + [b - a] * (len(lengths) - d)
+                    views = session.ring_stage(wid, lengths, widths)
+                    while views is None and outstanding:
+                        await self._window_collect(session, win, outstanding)
+                        views = session.ring_stage(wid, lengths, widths)
                 t0 = self._t0()
-                await asyncio.to_thread(encode_segment, a, b)
+                try:
+                    await asyncio.to_thread(encode_segment, a, b, views)
+                except BaseException:
+                    session.ring_unstage(wid)
+                    raise
                 enc_dt = _time.perf_counter() - t0[0]
                 self._phase("encode", t0)
                 payloads = seg_payloads(a, b)
-                lengths = seg_lengths(a, b)
+                if views is not None:
+                    # parity already lives in its staged arena view —
+                    # hand THAT as the payload so the native send sees
+                    # src == dst and moves zero parity bytes; data rows
+                    # keep their stage-buffer source for the C memcpy
+                    for idx in range(d, len(views)):
+                        if views[idx] is not None:
+                            payloads[idx] = views[idx]
                 seg_bytes = sum(lengths)
                 # credits BEFORE the send: per-chunkserver in-flight
                 # frames + the client-wide staging budget (returned as
@@ -1839,6 +1887,7 @@ class Client:
             native_io.abort_write(cell)
             raise
         finally:
+            self._fold_ring_stats(session)
             # failure path: return credits the reap loop never got to
             for wid, seg_bytes, *_rest in outstanding:
                 win.release(session.unique_addrs, seg_bytes)
@@ -1849,6 +1898,34 @@ class Client:
                 ),
             )
 
+    _SHM_RING_HELP = {
+        "segments_mapped": "shm ring segments negotiated with same-host "
+                           "chunkservers (memfd mappings created)",
+        "desc_parts": "part writes handed off as shm-ring descriptors "
+                      "(payload moved via shared memory, not the socket)",
+        "full_waits": "segment stagings that found a ring full and had "
+                      "to reap acks first (ring backpressure events)",
+        "fallbacks": "windowed segments sent via socket copy while rings "
+                     "were active (ring-full or unstaged fallbacks)",
+    }
+
+    def _fold_ring_stats(self, session) -> None:
+        """Fold one scatter session's shm-ring counters into the client
+        registry (Prometheus-exported wherever the owner exposes it)."""
+        stats = getattr(session, "ring_stats", None)
+        if not stats:
+            return
+        for key, val in stats.items():
+            if val:
+                self.metrics.counter(
+                    f"shm_ring_{key}", help=self._SHM_RING_HELP[key]
+                ).inc(float(val))
+        if stats.get("desc_parts"):
+            # visible alongside write_pipeline/write_window counters:
+            # this chunk moved (at least partly) over the ring plane
+            self._record("write_shm")
+        session.ring_stats = {k: 0 for k in stats}
+
     async def _window_collect(self, session, win, outstanding) -> None:
         """Reap the oldest outstanding segment: collect its acks,
         return its credits, and feed the adaptive depth controller."""
@@ -1858,8 +1935,13 @@ class Client:
         try:
             t0 = self._t0()
             await native_io.run(session.collect_acks, wid)
+            # ack-reaping is backpressure (downstream disk/CPU), not
+            # push cost — charge it to its own phase so send_ms keeps
+            # measuring the copy the shm ring exists to eliminate; the
+            # depth controller still sees the combined time (ack wait
+            # is exactly the send-bound signal that should deepen it)
             send_dt += _time.perf_counter() - t0[0]
-            self._phase("send", t0)
+            self._phase("ack", t0)
         finally:
             win.release(session.unique_addrs, seg_bytes)
         win.observe(enc_dt, send_dt)

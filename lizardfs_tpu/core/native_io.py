@@ -18,6 +18,7 @@ import os
 import socket
 import struct
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -103,6 +104,18 @@ if _lib is not None:
             _lib.lz_write_collect_acks.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: the windowed/vectored write path stays off
+        try:
+            _lib.lz_shm_write_descs.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ]
+            _lib.lz_shm_write_descs.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: the shm-ring send path stays off
     except AttributeError:
         _lib = None
 
@@ -134,19 +147,257 @@ class _SocketPool:
                 return bucket.pop()
         return _blocking_socket(addr, 30.0)
 
+    def try_acquire(self, addr: tuple[str, int]):
+        """Pop an idle socket or return None — never dials."""
+        with self._lock:
+            bucket = self._idle.get(addr)
+            if bucket:
+                return bucket.pop()
+        return None
+
     def release(self, addr: tuple[str, int], sock: socket.socket) -> None:
         with self._lock:
             bucket = self._idle.setdefault(addr, [])
             if len(bucket) < self.max_idle:
                 bucket.append(sock)
                 return
+        shm_ring_drop(sock)
         sock.close()
 
     def discard(self, sock: socket.socket) -> None:
+        shm_ring_drop(sock)
         sock.close()
 
 
+# --- same-host shared-memory part rings (native/shm_ring.h) ----------------
+#
+# One memfd payload segment per data-plane connection, negotiated over
+# the abstract-UDS fast path via a CltocsShmInit frame carrying the fd
+# as SCM_RIGHTS (the SO_PEERCRED gate already vetted the peer).  After
+# the handshake, encoded parts land straight in the mapped arena and
+# "sending" a part is one tiny CltocsShmWritePart descriptor frame —
+# the per-byte socket copy is gone.  The CLIENT owns allocation: a
+# classic FIFO ring (regions freed in ack-collection order), so the
+# server only ever reads ranges named by descriptors.
+#
+# Rings ride the pooled socket they were negotiated on (keyed weakly by
+# the socket object), so back-to-back chunk writes reuse one segment
+# instead of re-negotiating per chunk.  LZ_SHM_RING=0 kills the whole
+# path; LZ_SHM_RING_MB sizes segments (default 16).
+
+SHM_MEMFD_NAME = "lzshm"  # grep-able in /proc/<pid>/maps (leak tests)
+
+
+def shm_ring_enabled() -> bool:
+    return os.environ.get("LZ_SHM_RING", "1").lower() not in (
+        "0", "off", "false", "no"
+    )
+
+
+def shm_seg_bytes() -> int:
+    from lizardfs_tpu.constants import MFSBLOCKSIZE
+
+    try:
+        mb = float(os.environ.get("LZ_SHM_RING_MB", "16"))
+    except ValueError:
+        mb = 16.0
+    nbytes = int(mb * 2**20)
+    nbytes = max(MFSBLOCKSIZE, min(nbytes, 1 << 30))
+    return (nbytes // MFSBLOCKSIZE) * MFSBLOCKSIZE
+
+
+def parts_shm_available() -> bool:
+    """Shm-ring descriptor sends: the windowed path's copy-free rung."""
+    return (
+        _lib is not None
+        and hasattr(_lib, "lz_shm_write_descs")
+        and hasattr(_lib, "lz_write_collect_acks")
+        and hasattr(os, "memfd_create")
+    )
+
+
+class ShmRing:
+    """Client side of one connection's memfd payload ring.
+
+    A FIFO bump allocator over a raw arena: :meth:`alloc` hands out
+    contiguous regions (wrapping past the end wastes the tail, charged
+    to the allocation that wrapped), :meth:`free` returns the oldest
+    allocation's cost.  Correct because frees happen strictly in alloc
+    order — acks are FIFO per connection and the windowed client
+    collects segments oldest-first."""
+
+    def __init__(self, size: int):
+        import mmap as _mmap
+
+        self.size = size
+        self.memfd = os.memfd_create(SHM_MEMFD_NAME, 0)
+        try:
+            os.ftruncate(self.memfd, size)
+            self.mm = _mmap.mmap(self.memfd, size)
+        except BaseException:
+            os.close(self.memfd)
+            raise
+        try:
+            # forked children (the master's image-dump fork being the
+            # in-process-cluster case) have no use for the arena, and
+            # copying PTEs for every touched ring page would tax every
+            # fork the process makes — exclude the mapping outright
+            self.mm.madvise(_mmap.MADV_DONTFORK)
+        except (AttributeError, OSError):
+            pass  # pre-3.8 mmap or exotic kernel: fork just pays PTEs
+        self.arr = np.frombuffer(self.mm, dtype=np.uint8)
+        self._head = 0
+        self._used = 0
+        self._closed = False
+
+    def alloc(self, nbytes: int):
+        """-> (offset, cost) or None when the ring cannot fit it."""
+        if nbytes <= 0 or nbytes > self.size:
+            return None
+        pad = 0
+        if self._head + nbytes > self.size:
+            pad = self.size - self._head  # wasted tail, freed with us
+        if self._used + pad + nbytes > self.size:
+            return None
+        off = 0 if pad else self._head
+        self._head = (off + nbytes) % self.size
+        self._used += pad + nbytes
+        return off, pad + nbytes
+
+    def free(self, cost: int) -> None:
+        self._used -= cost
+
+    def unalloc(self, off: int, cost: int, nbytes: int) -> None:
+        """LIFO undo of the NEWEST allocation (staging rollback).
+
+        ``free`` retires the OLDEST allocation — using it to roll back
+        the newest would advance the implied tail instead of retracting
+        the head, leaving a hole the accounting no longer covers, and a
+        later alloc could hand out a region overlapping a sent-but-
+        unacked segment's live bytes.  Undo restores the exact
+        pre-alloc head: ``cost - nbytes`` is the wrap pad the
+        allocation charged, so the head it advanced from is
+        ``off - pad`` (mod size)."""
+        self._head = (off - (cost - nbytes)) % self.size
+        self._used -= cost
+
+    def view(self, off: int, nbytes: int) -> np.ndarray:
+        return self.arr[off : off + nbytes]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.arr = None
+        try:
+            self.mm.close()
+        except BufferError:
+            # a caller still holds an arena view; the mapping is freed
+            # when the last view dies (the memfd below is closed now, so
+            # nothing else can map it)
+            pass
+        try:
+            os.close(self.memfd)
+        except OSError:
+            pass
+
+    def __del__(self):  # noqa: D105 — last-resort fd hygiene
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+# ring negotiated on a socket, surviving pool round trips (a pooled
+# connection keeps its server-side mapping, so the next session skips
+# the handshake); entries die with the socket object
+_SOCK_RINGS: "weakref.WeakKeyDictionary[socket.socket, ShmRing]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def shm_ring_of(sock: socket.socket) -> "ShmRing | None":
+    return _SOCK_RINGS.get(sock)
+
+
+def shm_ring_drop(sock) -> None:
+    """Release a socket's ring (called wherever the socket leaves the
+    reuse cycle — pool discard/overflow, session close)."""
+    ring = _SOCK_RINGS.pop(sock, None)
+    if ring is not None:
+        ring.close()
+
+
+def shm_ring_capable(sock: socket.socket) -> bool:
+    """Is this a same-host data connection a ring may ride?  Abstract-
+    UDS connections qualify outright (SO_PEERCRED gate).  Loopback TCP
+    also qualifies: pure-Python chunkservers have no UDS listener, so
+    their demux's only reachable transport is 127.0.0.1 — the server
+    still enforces the same-uid gate through its /proc/<pid>/fd open,
+    and a native server just refuses ShmInit on TCP (the connection
+    stays on the socket-copy path)."""
+    if sock.family == socket.AF_UNIX:
+        return True
+    try:
+        peer = sock.getpeername()
+    except OSError:
+        return False
+    return (
+        isinstance(peer, tuple)
+        and bool(peer)
+        and peer[0] in ("127.0.0.1", "::1")
+    )
+
+
+def shm_ring_handshake(sock: socket.socket) -> "ShmRing | None":
+    """Negotiate (or reuse) a ring on a same-host data connection.
+
+    On a unix socket the memfd rides the CltocsShmInit frame as
+    SCM_RIGHTS ancillary data; on loopback TCP (asyncio chunkserver)
+    the frame goes bare and the server maps /proc/<pid>/fd/<n>
+    instead.  Any refusal leaves the connection on the socket-copy
+    path. Raises on socket errors (a server that predates the frame
+    closes the connection — the caller treats that like any other
+    failed exchange)."""
+    ring = _SOCK_RINGS.get(sock)
+    if ring is not None:
+        return ring
+    size = shm_seg_bytes()
+    ring = ShmRing(size)
+    try:
+        frame = framing.encode(m.CltocsShmInit(
+            req_id=1, pid=os.getpid(), mem_fd=ring.memfd, seg_size=size,
+        ))
+        if sock.family == socket.AF_UNIX:
+            sock.sendmsg(
+                [frame],
+                [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                  struct.pack("i", ring.memfd))],
+            )
+        else:
+            sock.sendall(frame)
+        reply = _recv_message(sock)
+    except BaseException:
+        ring.close()
+        raise
+    if (
+        not isinstance(reply, m.CstoclWriteStatus)
+        or reply.status != st.OK
+    ):
+        ring.close()
+        return None
+    _SOCK_RINGS[sock] = ring
+    return ring
+
+
 POOL = _SocketPool()
+
+# Connections that negotiated a shm ring are pooled SEPARATELY: their
+# server side lives on the epoll proactor, which serves the write-
+# session protocol (descriptors + bulk frames + init/end) but not the
+# read plane — reads and legacy per-part writes must keep drawing from
+# the plain POOL so they never land on a proactor-owned connection.
+RING_POOL = _SocketPool()
 
 # observability + contract pin: how many data-plane connections took the
 # same-host unix-socket fast path (tests assert this moves, so a silent
@@ -798,23 +1049,44 @@ class PartsScatterSession:
         self._socks: list[socket.socket] = []
         # write_id -> live part indices of an unacked windowed segment
         self._pending: dict[int, list[int]] = {}
+        # shm rings per connection (None = socket-copy path for that
+        # conn) + staged ring regions per in-flight write_id:
+        # write_id -> list of (part_index, conn_index, off, cost, view)
+        self._rings: list[ShmRing | None] = []
+        self._ring_staged: dict[int, list[tuple]] = {}
+        # folded into Client.metrics by the owner after the chunk write
+        self.ring_stats = {
+            "segments_mapped": 0, "desc_parts": 0, "full_waits": 0,
+            "fallbacks": 0,
+        }
 
     def _sock_of(self, part_index: int) -> socket.socket:
         return self._socks[self._conn_of[part_index]]
 
+    def _ring_eligible(self) -> bool:
+        return self.share and shm_ring_enabled() and parts_shm_available()
+
     def open(self) -> None:
         self.cell["submitted"] = True
+        ring_mode = self._ring_eligible()
         for attempt in (0, 1):
             try:
                 for addr in self.unique_addrs:
                     # pooled sockets first (the write hot path dials
                     # d+m connections per chunk — churn that the pool
-                    # exists to absorb); a stale pooled connection
-                    # (server restart) fails the init handshake and
-                    # retries once with fresh dials, mirroring
+                    # exists to absorb); ring-negotiated connections
+                    # live in their own pool (their server side is the
+                    # proactor) and are only reused by ring-eligible
+                    # sessions. A stale pooled connection (server
+                    # restart) fails the init handshake and retries
+                    # once with fresh dials, mirroring
                     # _write_parts_scatter
-                    s = (POOL.acquire(addr) if attempt == 0
-                         else _blocking_socket(addr, 60.0))
+                    s = None
+                    if attempt == 0 and ring_mode:
+                        s = RING_POOL.try_acquire(addr)
+                    if s is None:
+                        s = (POOL.acquire(addr) if attempt == 0
+                             else _blocking_socket(addr, 60.0))
                     self._socks.append(s)
                 for i in range(len(self.part_ids)):
                     _send_write_init(
@@ -830,6 +1102,7 @@ class PartsScatterSession:
                 _recv_write_init_acks(
                     [self._sock_of(i) for i in range(len(self.part_ids))]
                 )
+                self._setup_rings()
                 return
             except (ConnectionError, OSError, st.StatusError):
                 for s in self._socks:
@@ -842,6 +1115,120 @@ class PartsScatterSession:
             except BaseException:
                 self.close()
                 raise
+
+    # --- shm-ring staging (native/shm_ring.h) -------------------------
+
+    def _setup_rings(self) -> None:
+        """Negotiate a memfd ring per shared connection where the
+        same-host fast path applies. Only the windowed/shared mode uses
+        rings (the legacy per-part barrier path keeps its wire shape);
+        any per-connection failure just leaves that connection on the
+        socket-copy path — never fails the session."""
+        self._rings = [None] * len(self._socks)
+        if not self._ring_eligible():
+            return
+        for ci, sock in enumerate(self._socks):
+            if not shm_ring_capable(sock):
+                continue  # same-host connections only
+            try:
+                had = shm_ring_of(sock) is not None
+                ring = shm_ring_handshake(sock)
+            except (ConnectionError, OSError):
+                # a peer predating the frame kills the connection; the
+                # session keeps running and the next exchange on the
+                # dead socket fails into the ordinary fallback chain
+                continue
+            self._rings[ci] = ring
+            if ring is not None and not had:
+                self.ring_stats["segments_mapped"] += 1
+
+    def ring_ready(self) -> bool:
+        """True when EVERY connection negotiated a ring — segment
+        staging is all-or-nothing so one encode pass targets one kind
+        of memory (mixed ring/socket conns take the scatterv path)."""
+        return bool(self._rings) and all(
+            r is not None for r in self._rings
+        )
+
+    def ring_stage(self, write_id: int, lengths: list[int],
+                   widths: list[int] | None = None):
+        """Allocate this segment's per-part regions in the rings and
+        return arena views to encode/copy into (None entries for parts
+        skipped this segment), or None when any ring is full — the
+        caller reaps acks (freeing regions) and retries, or falls back
+        to the socket-copy send for this segment.
+
+        ``widths[i]`` (>= ``lengths[i]``, default equal) sizes the
+        allocation and the returned view: an encoder that produces the
+        full padded segment width needs the whole region writable even
+        when only the part's live ``lengths[i]`` bytes go on the wire
+        (ragged tail segments)."""
+        if not self.ring_ready():
+            return None
+        staged: list[tuple] = []
+        views: list = [None] * len(self.part_ids)
+        for i, length in enumerate(lengths):
+            if length <= 0:
+                continue
+            width = max(length, widths[i]) if widths is not None else length
+            ci = self._conn_of[i]
+            ring = self._rings[ci]
+            got = ring.alloc(width)
+            if got is None:
+                for _i, _ci, _off, cost, _v in reversed(staged):
+                    self._rings[_ci].unalloc(_off, cost, _v.nbytes)
+                self.ring_stats["full_waits"] += 1
+                return None
+            off, cost = got
+            view = ring.view(off, width)
+            staged.append((i, ci, off, cost, view))
+            views[i] = view
+        self._ring_staged[write_id] = staged
+        return views
+
+    def ring_unstage(self, write_id: int) -> None:
+        """Roll back a staged-but-never-sent segment (encode failure).
+
+        Valid because staging/sending are serialized per session, so a
+        just-staged segment's regions are strictly the ring's newest —
+        the LIFO precondition of :meth:`ShmRing.unalloc`."""
+        for _i, ci, _off, cost, _v in reversed(
+            self._ring_staged.pop(write_id, ())
+        ):
+            self._rings[ci].unalloc(_off, cost, _v.nbytes)
+
+    def _ring_send_descs(self, staged, payloads, lengths, part_offset,
+                         write_id):
+        """Move + describe one staged segment: entries whose payload
+        still lives outside the arena (data rows) get their one GIL-free
+        memcpy in C; entries encoded straight into the arena (parity —
+        payload IS the staged view) move zero bytes."""
+        n = len(staged)
+        reqs = (_PartReq * n)()
+        srcs = (ctypes.c_void_p * n)()
+        dsts = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        offs = (ctypes.c_uint64 * n)()
+        for j, (i, _ci, off, _cost, view) in enumerate(staged):
+            src = payloads[i]
+            assert src.flags.c_contiguous and src.nbytes >= lengths[i]
+            reqs[j].fd = self._sock_of(i).fileno()
+            reqs[j].chunk_id = self.chunk_id
+            reqs[j].version = write_id
+            reqs[j].part_id = self.part_ids[i]
+            reqs[j].rc = 0
+            srcs[j] = src.ctypes.data_as(ctypes.c_void_p).value
+            dsts[j] = view.ctypes.data_as(ctypes.c_void_p).value
+            lens[j] = lengths[i]
+            offs[j] = off
+        rc = _lib.lz_shm_write_descs(
+            ctypes.cast(reqs, ctypes.c_void_p), n, srcs, dsts, lens,
+            offs, part_offset, 120_000, SCATTER_NO_ACK,
+        )
+        if rc != 0:
+            bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
+            raise NativeIOError(bad, "shm descriptor send")
+        self.ring_stats["desc_parts"] += n
 
     def send_segment(
         self,
@@ -904,10 +1291,34 @@ class PartsScatterSession:
         assert self._socks, "session not open"
         n = len(self.part_ids)
         assert n == len(payloads) == len(lengths)
+        staged = self._ring_staged.get(write_id)
+        if staged is not None:
+            if not staged:  # fully dead segment (ragged tail)
+                self._ring_staged.pop(write_id, None)
+                self._pending[write_id] = []
+                return
+            # staged segment: payloads move into the arena with at most
+            # one GIL-free memcpy each (zero for parity, which the
+            # caller encoded straight into its staged view), then tiny
+            # descriptors ship instead of megabytes
+            try:
+                if self.cell.get("aborted"):
+                    raise NativeIOError(-1, "scatter session (aborted)")
+                self._ring_send_descs(staged, payloads, lengths,
+                                      part_offset, write_id)
+                self._pending[write_id] = [e[0] for e in staged]
+            except BaseException:
+                self.close()
+                raise
+            return
         live = [i for i in range(n) if lengths[i] > 0]
         if not live:
             self._pending[write_id] = []
             return
+        if self.ring_ready():
+            # rings are up but this segment didn't fit (or wasn't
+            # staged): socket-copy send, counted as a fallback
+            self.ring_stats["fallbacks"] += 1
         try:
             if self.cell.get("aborted"):
                 raise NativeIOError(-1, "scatter session (aborted)")
@@ -933,8 +1344,10 @@ class PartsScatterSession:
     def collect_acks(self, write_id: int) -> None:
         """Collect one segment's outstanding acks (sent via
         :meth:`send_segment_window`). Segments must be collected in
-        send order — acks are FIFO per connection."""
+        send order — acks are FIFO per connection (and so are ring
+        region frees, which keeps the FIFO arena allocator exact)."""
         live = self._pending.pop(write_id, None)
+        staged = self._ring_staged.pop(write_id, None)
         if not live:
             return
         try:
@@ -954,6 +1367,10 @@ class PartsScatterSession:
             if rc != 0:
                 bad = next((int(r.rc) for r in reqs if r.rc != 0), -1)
                 raise NativeIOError(bad, "windowed segment ack")
+            if staged:
+                # the server acked: it is done reading these regions
+                for _i, ci, _off, cost, _v in staged:
+                    self._rings[ci].free(cost)
         except BaseException:
             self.close()
             raise
@@ -972,20 +1389,26 @@ class PartsScatterSession:
             self.close()
             raise
         # clean end: the sockets sit in the same reusable protocol
-        # state the one-shot scatter path pools — release, don't close
+        # state the one-shot scatter path pools — release, don't close.
+        # Ring-negotiated connections go to THEIR pool (the server side
+        # is the proactor; only ring-eligible sessions may reuse them)
         for addr, s in zip(self.unique_addrs, self._socks):
-            POOL.release(addr, s)
+            pool = RING_POOL if shm_ring_of(s) is not None else POOL
+            pool.release(addr, s)
         self._socks.clear()
         self.cell.pop("socks", None)
         self.cell["finished"] = True
 
     def close(self) -> None:
         for s in self._socks:
+            shm_ring_drop(s)  # dead socket: its segment dies with it
             try:
                 s.close()
             except OSError:
                 pass
         self._socks.clear()
+        self._rings = []
+        self._ring_staged.clear()
         self.cell.pop("socks", None)
         self.cell["finished"] = True
 

@@ -1054,6 +1054,50 @@ class CltocsWriteBulkPart(Message):
     )
 
 
+class CltocsShmInit(Message):
+    """Negotiate a same-host shared-memory part ring on this data-plane
+    connection: the client created a memfd segment of ``seg_size`` bytes
+    and attaches its fd as SCM_RIGHTS ancillary data on the sendmsg that
+    carries this frame (abstract-UDS connections only, riding the
+    SO_PEERCRED gate in native/wire.h). ``pid``/``mem_fd`` name the same
+    segment as ``/proc/<pid>/fd/<mem_fd>`` so a receiver that cannot
+    take the ancillary fd (the asyncio fallback chunkserver reads
+    through StreamReader, which drops cmsgs) can still map it — the
+    /proc open enforces the same same-uid gate. Acked with a
+    CstoclWriteStatus (chunk_id/write_id 0); any non-OK status leaves
+    the connection on the socket-copy path."""
+
+    MSG_TYPE = 1216
+    FIELDS = (
+        ("req_id", "u32"),
+        ("pid", "u32"),
+        ("mem_fd", "u32"),
+        ("seg_size", "u64"),
+    )
+
+
+class CltocsShmWritePart(Message):
+    """Shared-memory part descriptor: the payload already sits in the
+    connection's negotiated ring segment at ``ring_off`` — this frame
+    carries only addressing + per-64KiB-piece CRCs, so the send phase
+    moves tens of bytes instead of megabytes. Demuxed on
+    (chunk_id, part_id) like CltocsWriteBulkPart and acked by the same
+    CstoclWriteStatus, FIFO per connection (the windowed client's ack
+    collector handles both frame kinds identically)."""
+
+    MSG_TYPE = 1217
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("write_id", "u32"),
+        ("part_id", "u32"),
+        ("part_offset", "u32"),  # must be 64 KiB-aligned
+        ("ring_off", "u64"),  # payload offset inside the ring segment
+        ("length", "u32"),
+        ("crcs", "list:u32"),
+    )
+
+
 class CstoclWriteStatus(Message):
     """Per-write ack, flows back up the chain."""
 

@@ -37,6 +37,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include "shm_ring.h"
+
 extern "C" uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len);
 
 namespace {
@@ -1092,6 +1094,107 @@ int lz_write_parts_scatterv(lz_part_req* parts, uint32_t n,
 int lz_write_collect_acks(lz_part_req* parts, uint32_t n, uint32_t max_ms) {
     if (n == 0) return 0;
     return collect_acks_inner(parts, n, steady_ms() + max_ms);
+}
+
+// --- shared-memory ring sends ----------------------------------------------
+//
+// The destination regions sit in the connection's negotiated memfd
+// ring segment (shm_ring.h): `dsts[i]` points at the CLIENT's mapping
+// of entry i's staged region, `ring_offs[i]` is the same region's
+// offset inside the segment (what the server's mapping indexes).
+// `srcs[i]` is where the payload bytes currently live: when it differs
+// from `dsts[i]` (data rows staged outside the ring) this call moves
+// them with ONE GIL-free memcpy — the only copy left on the path;
+// parity rows are encoded straight into the arena, so src == dst and
+// no byte moves at all.  Then the per-64KiB piece CRC pass runs over
+// the mapped memory and one tiny CltocsShmWritePart descriptor frame
+// per entry ships, all of one fd's frames concatenated into a single
+// send.  Acks are ordinary CstoclWriteStatus frames: with kScatterNoAck
+// they are collected later by lz_write_collect_acks, exactly like the
+// 1215 scatterv path, so ring and socket-copy segments can interleave
+// on one connection.
+//
+// parts[i].version carries the bulk write_id; parts[i].part_id the
+// target part.  Returns 0 iff every entry was handed off (and, without
+// kScatterNoAck, acked OK); per-entry codes land in parts[i].rc.
+int lz_shm_write_descs(lz_part_req* parts, uint32_t n,
+                       const uint8_t* const* srcs,
+                       const uint8_t* const* dsts,
+                       const uint64_t* lens, const uint64_t* ring_offs,
+                       uint64_t part_offset, uint32_t max_ms,
+                       uint32_t flags) {
+    if (n == 0 || part_offset % kBlockSize != 0) return -1;
+    const int64_t deadline = steady_ms() + max_ms;
+    // per-fd send buffers, entries in order (ack order == entry order)
+    struct SendBuf {
+        int fd;
+        std::vector<uint8_t> bytes;
+    };
+    std::vector<SendBuf> bufs;
+    std::vector<uint32_t> crcs;
+    std::vector<uint8_t> frame;
+    bool bad = false;
+    for (uint32_t i = 0; i < n; ++i) {
+        if (lens[i] == 0 || lens[i] > (64u << 20)) {
+            parts[i].rc = -2;
+            bad = true;
+            continue;
+        }
+        if (srcs[i] != dsts[i])
+            std::memcpy(const_cast<uint8_t*>(dsts[i]), srcs[i],
+                        static_cast<size_t>(lens[i]));
+        const uint32_t ncrcs =
+            static_cast<uint32_t>((lens[i] + kBlockSize - 1) / kBlockSize);
+        crcs.resize(ncrcs);
+        for (uint32_t b = 0; b < ncrcs; ++b) {
+            const uint64_t start = uint64_t(b) * kBlockSize;
+            const uint32_t piece = static_cast<uint32_t>(
+                std::min<uint64_t>(kBlockSize, lens[i] - start));
+            crcs[b] = lz_crc32(0, dsts[i] + start, piece);
+        }
+        lzshm::build_shm_desc_frame(
+            frame, parts[i].chunk_id, parts[i].version, parts[i].part_id,
+            part_offset, ring_offs[i], static_cast<uint32_t>(lens[i]),
+            crcs.data(), ncrcs);
+        SendBuf* sb = nullptr;
+        for (auto& cand : bufs)
+            if (cand.fd == parts[i].fd) { sb = &cand; break; }
+        if (sb == nullptr) {
+            bufs.emplace_back();
+            sb = &bufs.back();
+            sb->fd = parts[i].fd;
+        }
+        sb->bytes.insert(sb->bytes.end(), frame.begin(), frame.end());
+        parts[i].rc = 1 << 30;
+    }
+    if (bad) {
+        for (uint32_t i = 0; i < n; ++i)
+            if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        return -1;
+    }
+    // descriptors are tens of bytes each: one blocking send per fd
+    // (client sockets carry SO_SNDTIMEO; a full buffer means the peer
+    // is wedged and the timeout converts it to a socket error)
+    for (auto& sb : bufs) {
+        if (!send_all(sb.fd, sb.bytes.data(), sb.bytes.size())) {
+            for (uint32_t i = 0; i < n; ++i)
+                if (parts[i].fd == sb.fd && parts[i].rc == (1 << 30))
+                    parts[i].rc = -1;
+        }
+    }
+    bool failed = false;
+    for (uint32_t i = 0; i < n; ++i)
+        if (parts[i].rc != (1 << 30)) failed = true;
+    if (failed) {
+        for (uint32_t i = 0; i < n; ++i)
+            if (parts[i].rc == (1 << 30)) parts[i].rc = -1;
+        return -1;
+    }
+    if (flags & kScatterNoAck) {
+        for (uint32_t i = 0; i < n; ++i) parts[i].rc = 0;
+        return 0;
+    }
+    return collect_acks_inner(parts, n, deadline);
 }
 
 }  // extern "C"

@@ -39,6 +39,8 @@
 #include <atomic>
 #include <cerrno>
 #include <chrono>
+#include <sys/epoll.h>
+#include <sys/mman.h>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -65,6 +67,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "shm_ring.h"
 #include "wire.h"
 
 extern "C" uint32_t lz_crc32(uint32_t crc, const uint8_t* data, size_t len);
@@ -312,6 +315,7 @@ struct TraceOp {
 constexpr uint64_t kTraceRead = 1;
 constexpr uint64_t kTraceReadBulk = 2;
 constexpr uint64_t kTraceWriteBulk = 4;
+constexpr uint64_t kTraceWriteShm = 5;  // ring descriptor write (copy-free)
 constexpr size_t kTraceRingCap = 1024;
 
 // Write sessions are demuxed on (chunk_id, part_id): the vectored
@@ -328,6 +332,8 @@ WriteSession* find_chunk_session(SessionMap* sessions, uint64_t chunk_id) {
     if (it == sessions->end() || it->first.first != chunk_id) return nullptr;
     return it->second;
 }
+
+struct Proactor;  // epoll loop serving shm-ring connections (below)
 
 struct Server {
     std::vector<std::string> folders;
@@ -346,11 +352,21 @@ struct Server {
     std::thread uds_thread;
     // live connections: fds are pruned as connections close (a stale
     // entry could alias a recycled descriptor); threads run detached
-    // and are awaited at stop via the counter + condvar
-    std::mutex conn_mu;
-    std::condition_variable conn_cv;
-    std::vector<int> conn_fds;
-    size_t active_conns = 0;
+    // and are awaited at stop via the counter + condvar. The sync
+    // state lives behind a shared_ptr each connection thread copies:
+    // a detached thread's FINAL mutex/condvar touches (the decrement,
+    // the notify, even the pthread unlock tail) may overlap the stop
+    // path observing active == 0 and deleting the Server — primitives
+    // owned by the Server would be destroyed under that live thread
+    // (TSAN: cond_destroy/delete vs notify/unlock, r07). Shared
+    // ownership keeps them alive until the last toucher drops out.
+    struct ConnSync {
+        std::mutex mu;
+        std::condition_variable cv;
+        std::vector<int> fds;
+        size_t active = 0;
+    };
+    std::shared_ptr<ConnSync> conns = std::make_shared<ConnSync>();
     std::atomic<uint64_t> bytes_read{0}, bytes_written{0};
     std::atomic<uint64_t> read_ops{0}, write_ops{0};
     // per-op accumulated microseconds (stats v2): where data-plane wall
@@ -363,12 +379,21 @@ struct Server {
     // clock reads + atomic adds per op here
     std::mutex trace_mu;
     std::vector<TraceOp> trace_ring;
+    // shared-memory ring plane (shm_ring.h): connections that negotiate
+    // a segment are handed from their accept thread to ONE epoll
+    // proactor, started lazily on the first successful ShmInit
+    std::mutex proactor_mu;
+    Proactor* proactor = nullptr;
+    std::atomic<uint64_t> shm_segments_mapped{0};  // ShmInit accepts
+    std::atomic<uint64_t> shm_desc_ops{0};         // descriptors landed
+    std::atomic<uint64_t> shm_bytes{0};            // payload bytes via ring
+    std::atomic<int64_t> shm_active_segments{0};   // currently mapped
 };
 
 void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
               uint64_t chunk_id, uint64_t bytes, uint64_t t_start_us,
               uint64_t t_end_us, uint64_t disk_us, uint64_t net_us) {
-    if (kind == kTraceWriteBulk) {
+    if (kind == kTraceWriteBulk || kind == kTraceWriteShm) {
         srv.write_disk_us.fetch_add(disk_us, std::memory_order_relaxed);
         srv.write_net_us.fetch_add(net_us, std::memory_order_relaxed);
     } else {
@@ -900,6 +925,64 @@ void teardown_session(WriteSession* s) {
     delete s;
 }
 
+// Resolve-or-create the part file and open a write session bound to it
+// (no chain wiring): the shared prologue of the threaded WriteInit path
+// and the proactor's chainless one. Returns nullptr with *code set on
+// failure.
+WriteSession* make_local_session(Server& srv, uint64_t chunk_id,
+                                 uint32_t version, uint32_t part_id,
+                                 bool create, uint64_t trace_id,
+                                 uint8_t* code) {
+    *code = stOK;
+    std::string path;
+    *code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
+    if (*code == stNO_CHUNK && create) {
+        // place on the emptiest folder (MultiStore._emptiest analog)
+        const std::string* best = nullptr;
+        uint64_t best_free = 0;
+        for (const auto& folder : srv.folders) {
+            struct statvfs sv;
+            uint64_t free = 0;
+            if (::statvfs(folder.c_str(), &sv) == 0)
+                free = static_cast<uint64_t>(sv.f_bavail) * sv.f_frsize;
+            if (best == nullptr || free > best_free) {
+                best = &folder;
+                best_free = free;
+            }
+        }
+        *code = best != nullptr
+                    ? create_chunk_file(*best, chunk_id, version, part_id,
+                                        &path)
+                    : stEIO;
+        if (*code == stOK && path.empty()) {
+            // EEXIST race: someone else created it; resolve again
+            *code = resolve_chunk(srv.folders, chunk_id, part_id, version,
+                                  &path);
+        }
+    }
+    if (*code != stOK) return nullptr;
+    std::unique_ptr<WriteSession> s(new WriteSession);
+    Sig sig{};
+    s->fd = open_chunk(path, /*rw=*/true, &sig);
+    if (s->fd >= 0 && (sig.chunk_id != chunk_id || sig.version != version ||
+                       sig.part_id != part_id)) {
+        ::close(s->fd);
+        s->fd = -1;
+        *code = stNO_CHUNK;
+        return nullptr;
+    }
+    if (s->fd < 0) {
+        *code = stEIO;
+        return nullptr;
+    }
+    s->chunk_id = chunk_id;
+    s->version = version;
+    s->part_id = part_id;
+    s->trace_id = trace_id;
+    s->max_blocks = blocks_in_part(part_id);
+    return s.release();
+}
+
 void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
                       const uint8_t* body, uint32_t blen,
                       SessionMap* sessions) {
@@ -943,45 +1026,14 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
     uint64_t trace_id = pos + 1 + 8 <= blen ? get64(body + pos + 1) : 0;
 
     uint8_t code = stOK;
-    std::string path;
-    code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
-    if (code == stNO_CHUNK && create) {
-        // place on the emptiest folder (MultiStore._emptiest analog)
-        const std::string* best = nullptr;
-        uint64_t best_free = 0;
-        for (const auto& folder : srv.folders) {
-            struct statvfs sv;
-            uint64_t free = 0;
-            if (::statvfs(folder.c_str(), &sv) == 0)
-                free = static_cast<uint64_t>(sv.f_bavail) * sv.f_frsize;
-            if (best == nullptr || free > best_free) {
-                best = &folder;
-                best_free = free;
-            }
-        }
-        code = best != nullptr
-                   ? create_chunk_file(*best, chunk_id, version, part_id, &path)
-                   : stEIO;
-        if (code == stOK && path.empty()) {
-            // EEXIST race: someone else created it; resolve again
-            code = resolve_chunk(srv.folders, chunk_id, part_id, version, &path);
-        }
+    std::unique_ptr<WriteSession> s(make_local_session(
+        srv, chunk_id, version, part_id, create, trace_id, &code));
+    if (s == nullptr) {
+        send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0,
+                    code);
+        return;
     }
-    std::unique_ptr<WriteSession> s(new WriteSession);
-    if (code == stOK) {
-        Sig sig{};
-        s->fd = open_chunk(path, /*rw=*/true, &sig);
-        if (s->fd >= 0 && (sig.chunk_id != chunk_id ||
-                           sig.version != version ||
-                           sig.part_id != part_id)) {
-            ::close(s->fd);
-            s->fd = -1;
-            code = stNO_CHUNK;
-        } else if (s->fd < 0) {
-            code = stEIO;
-        }
-    }
-    if (code == stOK && !chain.empty()) {
+    if (!chain.empty()) {
         s->down_fd = connect_addr(chain[0].host, chain[0].port);
         if (s->down_fd < 0) {
             code = stDISCONNECTED;
@@ -1038,11 +1090,6 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
         }
     }
     if (code == stOK) {
-        s->chunk_id = chunk_id;
-        s->version = version;
-        s->part_id = part_id;
-        s->trace_id = trace_id;
-        s->max_blocks = blocks_in_part(part_id);
         WriteSession* raw = s.release();
         if (raw->down_fd >= 0) {
             raw->relay = std::thread(relay_down, raw, cfd, send_mu);
@@ -1050,6 +1097,9 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
         auto it = sessions->find(SessionKey(chunk_id, part_id));
         if (it != sessions->end()) teardown_session(it->second);
         (*sessions)[SessionKey(chunk_id, part_id)] = raw;
+    } else if (s != nullptr) {
+        WriteSession* raw = s.release();
+        teardown_session(raw);
     }
     send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0, code);
 }
@@ -1309,18 +1359,642 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
     }
 }
 
+// --- shared-memory ring serving (epoll proactor) ---------------------------
+//
+// Connections that negotiate a memfd segment (shm_ring.h) leave their
+// thread-per-connection loop and join ONE epoll-driven proactor: after
+// the handoff every frame on the connection is small (WriteInit /
+// ShmWritePart descriptors / WriteEnd), so a single thread drains them
+// in batches — one recvmsg can return many descriptor frames, every
+// descriptor's payload is read straight out of the shared mapping, and
+// the acks of a batch leave through one send.  No per-frame syscall,
+// no per-byte socket copy.
+
+// Verify + land one descriptor's payload range from the shared mapping:
+// the ring analog of serve_write_bulk's batch landing (whole range in
+// hand, so: one flock, one data pwrite, one CRC-table pwrite; a partial
+// tail block is read-modify-written with its stored CRC covering the
+// full zero-padded block).
+uint8_t shm_land(WriteSession& s, const uint8_t* data,
+                 uint32_t len, uint32_t part_offset,
+                 const uint8_t* crcs_be, uint32_t ncrcs,
+                 uint64_t* disk_us) {
+    if (part_offset % kBlockSize != 0 || len == 0 ||
+        ncrcs != (len + kBlockSize - 1) / kBlockSize ||
+        part_offset + static_cast<uint64_t>(len) >
+            static_cast<uint64_t>(s.max_blocks) * kBlockSize)
+        return stEINVAL;
+    static thread_local std::vector<uint8_t> slot_be;
+    slot_be.resize(4 * ncrcs);
+    for (uint32_t b = 0; b < ncrcs; ++b) {
+        const uint32_t piece =
+            std::min(kBlockSize, len - b * kBlockSize);
+        const uint32_t wire_crc = get32(crcs_be + 4 * b);
+        if (lz_crc32(0, data + uint64_t(b) * kBlockSize, piece) != wire_crc)
+            return stCRC_ERROR;
+        put32(slot_be.data() + 4 * b, wire_crc);
+    }
+    const uint32_t first_block = part_offset / kBlockSize;
+    const uint64_t pos =
+        kHeaderSize + static_cast<uint64_t>(first_block) * kBlockSize;
+    uint8_t code = stOK;
+    const uint64_t disk0 = lzwire::now_us();
+    ::flock(s.fd, LOCK_EX);
+    const uint32_t tail = len % kBlockSize;
+    if (tail != 0) {
+        static thread_local std::vector<uint8_t> blockbuf;
+        blockbuf.assign(kBlockSize, 0);
+        const uint64_t tpos = pos + (ncrcs - 1ull) * kBlockSize;
+        ssize_t n = ::pread(s.fd, blockbuf.data(), kBlockSize, tpos);
+        if (n < 0) n = 0;
+        if (static_cast<size_t>(n) < kBlockSize)
+            std::memset(blockbuf.data() + n, 0, kBlockSize - n);
+        std::memcpy(blockbuf.data(), data + (ncrcs - 1ull) * kBlockSize,
+                    tail);
+        put32(slot_be.data() + 4 * (ncrcs - 1),
+              lz_crc32(0, blockbuf.data(), kBlockSize));
+        if (::pwrite(s.fd, blockbuf.data(), kBlockSize, tpos) !=
+            static_cast<ssize_t>(kBlockSize))
+            code = stEIO;
+        if (ncrcs > 1 &&
+            ::pwrite(s.fd, data, (ncrcs - 1ull) * kBlockSize, pos) !=
+                static_cast<ssize_t>((ncrcs - 1ull) * kBlockSize))
+            code = stEIO;
+    } else if (::pwrite(s.fd, data, len, pos) !=
+               static_cast<ssize_t>(len)) {
+        code = stEIO;
+    }
+    if (code == stOK &&
+        ::pwrite(s.fd, slot_be.data(), slot_be.size(),
+                 kSignatureSize + 4ull * first_block) !=
+            static_cast<ssize_t>(slot_be.size()))
+        code = stEIO;
+    ::flock(s.fd, LOCK_UN);
+    *disk_us += lzwire::now_us() - disk0;
+    return code;
+}
+
+struct ShmConn {
+    int fd = -1;
+    uint8_t* map = nullptr;
+    size_t map_len = 0;
+    SessionMap sessions;
+    std::vector<uint8_t> in;   // recv scratch (grown once, kept)
+    size_t in_len = 0;         // valid bytes in `in`
+    std::vector<uint8_t> out;  // queued unsent ack bytes
+    size_t out_sent = 0;
+    bool want_out = false;     // EPOLLOUT currently armed
+    int pending_fd = -1;       // SCM_RIGHTS fd awaiting its ShmInit frame
+    bool dead = false;
+};
+
+struct Proactor {
+    Server* srv = nullptr;
+    int epfd = -1;
+    int wake_r = -1, wake_w = -1;  // self-pipe: stop/adopt wakeups
+    std::thread th;
+    std::atomic<bool> stopping{false};
+    // all live conns; inserted by adopting accept threads, removed only
+    // by the loop thread (epoll event payloads carry the raw pointer)
+    std::mutex mu;
+    std::vector<ShmConn*> conns;
+};
+
+void shm_conn_destroy(Server& srv, ShmConn* c) {
+    for (auto& kv : c->sessions) teardown_session(kv.second);
+    c->sessions.clear();
+    if (c->map != nullptr) {
+        ::munmap(c->map, c->map_len);
+        c->map = nullptr;
+        srv.shm_active_segments.fetch_add(-1, std::memory_order_relaxed);
+    }
+    if (c->pending_fd >= 0) ::close(c->pending_fd);
+    if (c->fd >= 0) ::close(c->fd);
+    delete c;
+}
+
+// Accept one ShmInit: prefer the SCM_RIGHTS fd; an fd-less frame (the
+// asyncio→native forwarding case, or a cmsg dropped en route) falls
+// back to /proc/<pid>/fd/<n>, which enforces the same same-uid gate.
+uint8_t shm_map_segment(Server& srv, int scm_fd, uint32_t pid,
+                        uint32_t mem_fd, uint64_t seg_size, uint8_t** map,
+                        size_t* map_len) {
+    if (lzshm::ring_disabled() || seg_size == 0 ||
+        seg_size > lzshm::kMaxSegBytes) {
+        if (scm_fd >= 0) ::close(scm_fd);
+        return stEINVAL;
+    }
+    int fd = scm_fd;
+    if (fd < 0) {
+        char path[64];
+        std::snprintf(path, sizeof(path), "/proc/%u/fd/%u", pid, mem_fd);
+        fd = ::open(path, O_RDONLY);
+        if (fd < 0) return stEINVAL;
+    }
+    struct stat stbuf;
+    if (::fstat(fd, &stbuf) != 0 ||
+        static_cast<uint64_t>(stbuf.st_size) < seg_size) {
+        ::close(fd);
+        return stEINVAL;
+    }
+    void* m = ::mmap(nullptr, seg_size, PROT_READ, MAP_SHARED, fd, 0);
+    ::close(fd);  // the mapping pins the segment; the fd is not needed
+    if (m == MAP_FAILED) return stEIO;
+    *map = static_cast<uint8_t*>(m);
+    *map_len = seg_size;
+    srv.shm_segments_mapped.fetch_add(1, std::memory_order_relaxed);
+    srv.shm_active_segments.fetch_add(1, std::memory_order_relaxed);
+    return stOK;
+}
+
+// queue one WriteStatus ack on the connection's out buffer (flushed in
+// one send per batch by the caller)
+void shm_queue_status(ShmConn* c, uint32_t type, uint32_t req_id,
+                      uint64_t chunk_id, uint32_t write_id,
+                      uint8_t status) {
+    uint8_t f[8 + 18];
+    size_t body = (type == kTypeWriteStatus) ? 18 : 14;
+    put32(f, type);
+    put32(f + 4, static_cast<uint32_t>(body));
+    f[8] = kProtoVersion;
+    put32(f + 9, req_id);
+    put64(f + 13, chunk_id);
+    if (type == kTypeWriteStatus) {
+        put32(f + 21, write_id);
+        f[25] = status;
+    } else {
+        f[21] = status;
+    }
+    c->out.insert(c->out.end(), f, f + 8 + body);
+}
+
+// Handle one complete frame on a proactor connection. Returns false on
+// a protocol violation (the connection is torn down).
+bool shm_handle_frame(Server& srv, ShmConn* c, uint32_t type,
+                      const uint8_t* payload, uint32_t length) {
+    if (length < 1 || payload[0] != kProtoVersion) return false;
+    const uint8_t* body = payload + 1;
+    const uint32_t blen = length - 1;
+    if (type == lzshm::kTypeShmWritePart) {
+        if (blen + 1 < lzshm::kShmDescFixed) return false;
+        const uint64_t t_start = lzwire::now_us();
+        uint64_t disk_us = 0;
+        const uint32_t req_id = get32(body);
+        const uint64_t chunk_id = get64(body + 4);
+        const uint32_t write_id = get32(body + 12);
+        const uint32_t part_id = get32(body + 16);
+        const uint32_t part_offset = get32(body + 20);
+        const uint64_t ring_off = get64(body + 24);
+        const uint32_t len = get32(body + 32);
+        const uint32_t ncrcs = get32(body + 36);
+        if (blen < 40 + 4ull * ncrcs || ncrcs > kBlocksInChunk)
+            return false;
+        uint8_t code;
+        auto it = c->sessions.find(SessionKey(chunk_id, part_id));
+        WriteSession* s = it == c->sessions.end() ? nullptr : it->second;
+        if (s == nullptr || c->map == nullptr) {
+            code = stEINVAL;
+        } else if (ring_off > c->map_len ||
+                   static_cast<uint64_t>(len) > c->map_len - ring_off) {
+            code = stEINVAL;
+        } else {
+            code = shm_land(*s, c->map + ring_off, len, part_offset,
+                            body + 40, ncrcs, &disk_us);
+        }
+        if (code == stOK) {
+            srv.bytes_written.fetch_add(len, std::memory_order_relaxed);
+            srv.write_ops.fetch_add(1, std::memory_order_relaxed);
+            srv.shm_bytes.fetch_add(len, std::memory_order_relaxed);
+        }
+        srv.shm_desc_ops.fetch_add(1, std::memory_order_relaxed);
+        trace_op(srv, kTraceWriteShm, s != nullptr ? s->trace_id : 0,
+                 chunk_id, len, t_start, lzwire::now_us(), disk_us, 0);
+        shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, write_id,
+                         code);
+        return true;
+    }
+    if (type == kTypeWriteBulk || type == kTypeWriteBulkPart) {
+        // socket-copy bulk frames on a ring connection: the windowed
+        // client legally interleaves them with descriptors (a segment
+        // that found the ring full falls back to scatterv on the SAME
+        // connection, acks staying FIFO), so the proactor demuxes them
+        // too — the payload is already buffered whole, which is the
+        // shm_land shape
+        const bool has_part = type == kTypeWriteBulkPart;
+        const size_t fixed = has_part ? 28u : 24u;  // past version byte
+        if (blen < fixed + 4) return false;
+        const uint64_t t_start = lzwire::now_us();
+        uint64_t disk_us = 0;
+        const uint32_t req_id = get32(body);
+        const uint64_t chunk_id = get64(body + 4);
+        const uint32_t write_id = get32(body + 12);
+        const uint32_t part_id = has_part ? get32(body + 16) : 0;
+        const uint32_t part_offset = get32(body + (has_part ? 20 : 16));
+        const uint32_t ncrcs = get32(body + (has_part ? 24 : 20));
+        if (ncrcs > kBlocksInChunk || blen < fixed + 4ull * ncrcs + 4)
+            return false;
+        // layout past the fixed fields (which end with ncrcs): the CRC
+        // list, then dlen, then the payload — matches the threaded
+        // serve_write_bulk parse and build_bulk_write[_part]_header
+        const uint8_t* crcs_be = body + fixed;
+        const uint32_t dlen = get32(body + fixed + 4ull * ncrcs);
+        if (blen != fixed + 4ull * ncrcs + 4 + dlen) return false;
+        WriteSession* s;
+        if (has_part) {
+            auto it = c->sessions.find(SessionKey(chunk_id, part_id));
+            s = it == c->sessions.end() ? nullptr : it->second;
+        } else {
+            s = find_chunk_session(&c->sessions, chunk_id);
+        }
+        uint8_t code;
+        if (s == nullptr || dlen == 0) {
+            code = stEINVAL;
+        } else {
+            code = shm_land(*s, body + fixed + 4ull * ncrcs + 4,
+                            dlen, part_offset, crcs_be, ncrcs, &disk_us);
+        }
+        if (code == stOK) {
+            srv.bytes_written.fetch_add(dlen, std::memory_order_relaxed);
+            srv.write_ops.fetch_add(1, std::memory_order_relaxed);
+        }
+        trace_op(srv, kTraceWriteBulk, s != nullptr ? s->trace_id : 0,
+                 chunk_id, dlen, t_start, lzwire::now_us(), disk_us, 0);
+        shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, write_id,
+                         code);
+        return true;
+    }
+    if (type == kTypeWriteInit) {
+        // chainless only: a ring connection's writes have no relay
+        // downstream (the windowed client never opens chained sessions)
+        if (blen < 4 + 8 + 4 + 4 + 4 + 1) return false;
+        const uint32_t req_id = get32(body);
+        const uint64_t chunk_id = get64(body + 4);
+        const uint32_t version = get32(body + 12);
+        const uint32_t part_id = get32(body + 16);
+        const uint32_t nchain = get32(body + 20);
+        uint8_t code = stOK;
+        if (nchain != 0) {
+            code = stEINVAL;
+        } else {
+            const size_t pos = 24;  // empty chain: create flag is next
+            if (pos + 1 > blen) return false;
+            const bool create = body[pos] != 0;
+            const uint64_t trace_id =
+                pos + 1 + 8 <= blen ? get64(body + pos + 1) : 0;
+            WriteSession* s = make_local_session(
+                srv, chunk_id, version, part_id, create, trace_id, &code);
+            if (s != nullptr) {
+                auto it = c->sessions.find(SessionKey(chunk_id, part_id));
+                if (it != c->sessions.end()) teardown_session(it->second);
+                c->sessions[SessionKey(chunk_id, part_id)] = s;
+            }
+        }
+        shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, 0, code);
+        return true;
+    }
+    if (type == kTypeWriteEnd) {
+        if (blen < 12) return false;
+        const uint32_t req_id = get32(body);
+        const uint64_t chunk_id = get64(body + 4);
+        auto it = c->sessions.lower_bound(SessionKey(chunk_id, 0));
+        while (it != c->sessions.end() && it->first.first == chunk_id) {
+            WriteSession* s = it->second;
+            it = c->sessions.erase(it);
+            teardown_session(s);
+        }
+        shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, 0, stOK);
+        return true;
+    }
+    if (type == lzshm::kTypeShmInit) {
+        // segment renegotiation on a pooled connection: replace the
+        // mapping (the old segment's owner dropped it client-side)
+        if (blen + 1 < lzshm::kShmInitBody) return false;
+        const uint32_t req_id = get32(body);
+        const uint32_t pid = get32(body + 4);
+        const uint32_t mem_fd = get32(body + 8);
+        const uint64_t seg_size = get64(body + 12);
+        uint8_t* map = nullptr;
+        size_t map_len = 0;
+        const int scm = c->pending_fd;
+        c->pending_fd = -1;
+        const uint8_t code =
+            shm_map_segment(srv, scm, pid, mem_fd, seg_size, &map, &map_len);
+        if (code == stOK) {
+            if (c->map != nullptr) {
+                ::munmap(c->map, c->map_len);
+                srv.shm_active_segments.fetch_add(
+                    -1, std::memory_order_relaxed);
+            }
+            c->map = map;
+            c->map_len = map_len;
+        }
+        shm_queue_status(c, kTypeWriteStatus, req_id, 0, 0, code);
+        return true;
+    }
+    if (type == kTypePrefetch) return true;  // fire-and-forget hint
+    return false;  // anything else is off-protocol for a ring connection
+}
+
+void shm_flush_out(Proactor* p, ShmConn* c) {
+    while (c->out_sent < c->out.size()) {
+        ssize_t n = ::send(c->fd, c->out.data() + c->out_sent,
+                           c->out.size() - c->out_sent,
+                           MSG_DONTWAIT | MSG_NOSIGNAL);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            c->dead = true;
+            return;
+        }
+        c->out_sent += static_cast<size_t>(n);
+    }
+    if (c->out_sent >= c->out.size()) {
+        c->out.clear();
+        c->out_sent = 0;
+    }
+    const bool need_out = !c->out.empty();
+    if (need_out != c->want_out) {
+        struct epoll_event ev {};
+        ev.events = EPOLLIN | (need_out ? uint32_t(EPOLLOUT) : 0u);
+        ev.data.ptr = c;
+        ::epoll_ctl(p->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+        c->want_out = need_out;
+    }
+}
+
+void shm_handle_in(Server& srv, Proactor* p, ShmConn* c) {
+    // drain the socket, then parse every complete frame in the buffer:
+    // many descriptor frames ride one recvmsg under load (the batch
+    // that kills the per-frame syscall). `in` is a kept scratch with an
+    // explicit length — a value-initializing resize per recv would
+    // memset 256 KiB for every few-dozen-byte descriptor batch.
+    for (;;) {
+        if (c->in.size() < c->in_len + (256u << 10))
+            c->in.resize(c->in_len + (256u << 10));  // grows rarely
+        struct iovec iov;
+        iov.iov_base = c->in.data() + c->in_len;
+        iov.iov_len = c->in.size() - c->in_len;
+        alignas(struct cmsghdr) char ctrl[CMSG_SPACE(4 * sizeof(int))];
+        struct msghdr mh {};
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        mh.msg_control = ctrl;
+        mh.msg_controllen = sizeof(ctrl);
+        ssize_t n = ::recvmsg(c->fd, &mh, MSG_DONTWAIT);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            c->dead = true;
+            return;
+        }
+        if (n == 0) {
+            c->dead = true;  // peer closed (incl. SIGKILL): release all
+            return;
+        }
+        c->in_len += static_cast<size_t>(n);
+        for (struct cmsghdr* cm = CMSG_FIRSTHDR(&mh); cm != nullptr;
+             cm = CMSG_NXTHDR(&mh, cm)) {
+            if (cm->cmsg_level != SOL_SOCKET ||
+                cm->cmsg_type != SCM_RIGHTS)
+                continue;
+            size_t nfds = (cm->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+            int fds[4];
+            std::memcpy(fds, CMSG_DATA(cm),
+                        std::min(nfds, size_t(4)) * sizeof(int));
+            for (size_t i = 0; i < nfds && i < 4; ++i) {
+                if (c->pending_fd < 0) c->pending_fd = fds[i];
+                else ::close(fds[i]);
+            }
+        }
+        if (static_cast<size_t>(n) < iov.iov_len) break;  // drained
+    }
+    size_t pos = 0;
+    while (c->in_len - pos >= 8) {
+        const uint32_t type = get32(c->in.data() + pos);
+        const uint32_t length = get32(c->in.data() + pos + 4);
+        // descriptor/handshake frames are tiny; interleaved socket-copy
+        // bulk frames (ring-full fallback segments) may carry payload
+        const uint32_t cap =
+            (type == kTypeWriteBulk || type == kTypeWriteBulkPart)
+                ? (96u << 20) : (1u << 20);
+        if (length < 1 || length > cap) {
+            c->dead = true;
+            break;
+        }
+        if (c->in_len - pos < 8 + length) break;
+        if (!shm_handle_frame(srv, c, type, c->in.data() + pos + 8,
+                              length)) {
+            c->dead = true;
+            break;
+        }
+        pos += 8 + length;
+    }
+    if (pos > 0) {
+        std::memmove(c->in.data(), c->in.data() + pos, c->in_len - pos);
+        c->in_len -= pos;
+    }
+    if (c->in.size() > (1u << 20) && c->in_len < (256u << 10)) {
+        // an interleaved socket-copy bulk frame (ring-full fallback)
+        // grew the kept scratch to payload size; once it drains, give
+        // the capacity back — pooled ring connections are long-lived
+        // and descriptor traffic needs a few hundred bytes, not MiBs
+        std::vector<uint8_t> shrunk(c->in.begin(),
+                                    c->in.begin() + c->in_len);
+        c->in.swap(shrunk);
+    }
+    if (!c->dead) shm_flush_out(p, c);
+}
+
+void proactor_remove(Proactor* p, ShmConn* c) {
+    ::epoll_ctl(p->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        auto it = std::find(p->conns.begin(), p->conns.end(), c);
+        if (it != p->conns.end()) p->conns.erase(it);
+    }
+    shm_conn_destroy(*p->srv, c);
+}
+
+void proactor_loop(Proactor* p) {
+    struct epoll_event events[64];
+    while (!p->stopping.load(std::memory_order_acquire)) {
+        int n = ::epoll_wait(p->epfd, events, 64, 1000);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int i = 0; i < n; ++i) {
+            if (events[i].data.ptr == nullptr) {  // wake pipe
+                uint8_t sink[64];
+                while (::read(p->wake_r, sink, sizeof(sink)) > 0) {
+                }
+                continue;
+            }
+            ShmConn* c = static_cast<ShmConn*>(events[i].data.ptr);
+            if (events[i].events & (EPOLLERR | EPOLLHUP)) c->dead = true;
+            if (!c->dead && (events[i].events & EPOLLOUT))
+                shm_flush_out(p, c);
+            if (!c->dead && (events[i].events & EPOLLIN))
+                shm_handle_in(*p->srv, p, c);
+            if (c->dead) proactor_remove(p, c);
+        }
+    }
+}
+
+// Lazily start the server's proactor and hand it a freshly negotiated
+// connection. Returns false when the server is stopping (the caller
+// closes the connection instead).
+bool proactor_adopt(Server& srv, int cfd, uint8_t* map, size_t map_len,
+                    SessionMap&& sessions) {
+    Proactor* p;
+    {
+        std::lock_guard<std::mutex> g(srv.proactor_mu);
+        if (srv.stopping.load()) return false;
+        if (srv.proactor == nullptr) {
+            auto up = std::make_unique<Proactor>();
+            up->srv = &srv;
+            up->epfd = ::epoll_create1(0);
+            int pipefd[2];
+            if (up->epfd < 0 || ::pipe(pipefd) != 0) {
+                if (up->epfd >= 0) ::close(up->epfd);
+                return false;
+            }
+            up->wake_r = pipefd[0];
+            up->wake_w = pipefd[1];
+            ::fcntl(up->wake_r, F_SETFL, O_NONBLOCK);
+            struct epoll_event ev {};
+            ev.events = EPOLLIN;
+            ev.data.ptr = nullptr;
+            ::epoll_ctl(up->epfd, EPOLL_CTL_ADD, up->wake_r, &ev);
+            up->th = std::thread(proactor_loop, up.get());
+            srv.proactor = up.release();
+        }
+        p = srv.proactor;
+    }
+    ::fcntl(cfd, F_SETFL, ::fcntl(cfd, F_GETFL, 0) | O_NONBLOCK);
+    auto* c = new ShmConn;
+    c->fd = cfd;
+    c->map = map;
+    c->map_len = map_len;
+    c->sessions = std::move(sessions);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        p->conns.push_back(c);
+    }
+    struct epoll_event ev {};
+    ev.events = EPOLLIN;
+    ev.data.ptr = c;
+    if (::epoll_ctl(p->epfd, EPOLL_CTL_ADD, cfd, &ev) != 0) {
+        {
+            std::lock_guard<std::mutex> g(p->mu);
+            auto it = std::find(p->conns.begin(), p->conns.end(), c);
+            if (it != p->conns.end()) p->conns.erase(it);
+        }
+        // on failure the CALLER keeps ownership of cfd, the mapping,
+        // and the sessions — hand the latter back before deleting
+        sessions = std::move(c->sessions);
+        delete c;
+        return false;
+    }
+    return true;
+}
+
+void proactor_stop(Server& srv) {
+    Proactor* p;
+    {
+        std::lock_guard<std::mutex> g(srv.proactor_mu);
+        p = srv.proactor;
+        srv.proactor = nullptr;
+    }
+    if (p == nullptr) return;
+    p->stopping.store(true, std::memory_order_release);
+    uint8_t one = 1;
+    ssize_t ignored = ::write(p->wake_w, &one, 1);
+    (void)ignored;
+    if (p->th.joinable()) p->th.join();
+    for (ShmConn* c : p->conns) shm_conn_destroy(srv, c);
+    p->conns.clear();
+    ::close(p->epfd);
+    ::close(p->wake_r);
+    ::close(p->wake_w);
+    delete p;
+}
+
 // --- connection / accept loops --------------------------------------------
 
-void connection_loop(Server& srv, int cfd) {
+void connection_loop(Server& srv, std::shared_ptr<Server::ConnSync> sync,
+                     int cfd) {
     set_bulk_sockopts(cfd);
     SessionMap sessions;
     std::mutex send_mu;
     std::vector<uint8_t> frame;
+    bool adopted = false;
+    // one SCM_RIGHTS fd may ride the header bytes of a ShmInit frame
+    // (shm_ring.h handshake); fds attached to anything else are closed
+    int pending_fd = -1;
     for (;;) {
         uint8_t header[8];
-        if (!recv_all(cfd, header, 8)) break;
+        if (!lzshm::recv_all_with_fd(cfd, header, 8, &pending_fd)) break;
         uint32_t type = get32(header);
         uint32_t length = get32(header + 4);
+        if (type != lzshm::kTypeShmInit && pending_fd >= 0) {
+            ::close(pending_fd);
+            pending_fd = -1;
+        }
+        if (type == lzshm::kTypeShmInit) {
+            if (length < lzshm::kShmInitBody || length > 64) break;
+            frame.resize(length);
+            if (!lzshm::recv_all_with_fd(cfd, frame.data(), length,
+                                         &pending_fd))
+                break;
+            if (frame[0] != kProtoVersion) break;
+            const uint32_t req_id = get32(frame.data() + 1);
+            const uint32_t pid = get32(frame.data() + 5);
+            const uint32_t mem_fd = get32(frame.data() + 9);
+            const uint64_t seg_size = get64(frame.data() + 13);
+            uint8_t* map = nullptr;
+            size_t map_len = 0;
+            const int scm = pending_fd;
+            pending_fd = -1;
+            // chained sessions pin relay threads that lock this
+            // loop's stack-local send_mu and write to cfd directly —
+            // adopting them onto the proactor would destroy the mutex
+            // under them.  In-tree clients negotiate on a fresh
+            // connection before any WriteInit, so refusing here only
+            // stops a misbehaving peer.
+            bool chained = false;
+            for (auto& kv : sessions)
+                if (kv.second->down_fd >= 0) { chained = true; break; }
+            uint8_t code = stEINVAL;
+            if (!chained && lzshm::sock_is_unix(cfd)) {
+                code = shm_map_segment(srv, scm, pid, mem_fd, seg_size,
+                                       &map, &map_len);
+            } else if (scm >= 0) {
+                // same-host contract: a TCP peer never negotiates a
+                // ring (and never drives the /proc fd fallback)
+                ::close(scm);
+            }
+            send_status(cfd, &send_mu, kTypeWriteStatus, req_id, 0, 0,
+                        code);
+            if (code != stOK) continue;  // stays on the socket-copy path
+            {
+                // the proactor owns the fd from here; drop it from the
+                // threaded plane's shutdown list first
+                std::lock_guard<std::mutex> g(sync->mu);
+                auto it =
+                    std::find(sync->fds.begin(), sync->fds.end(), cfd);
+                if (it != sync->fds.end()) sync->fds.erase(it);
+            }
+            if (!proactor_adopt(srv, cfd, map, map_len,
+                                std::move(sessions))) {
+                ::munmap(map, map_len);
+                srv.shm_active_segments.fetch_add(
+                    -1, std::memory_order_relaxed);
+                break;  // server stopping: close the connection
+            }
+            adopted = true;
+            break;
+        }
         if (type == kTypeWriteBulk || type == kTypeWriteBulkPart) {
             // streamed: the frame may be tens of MiB and never lands in
             // one buffer
@@ -1385,22 +2059,23 @@ void connection_loop(Server& srv, int cfd) {
             break;  // not a data-plane frame: this port serves data only
         }
     }
+    if (pending_fd >= 0) ::close(pending_fd);
     for (auto& kv : sessions) teardown_session(kv.second);
     {
-        std::lock_guard<std::mutex> g(srv.conn_mu);
-        auto it = std::find(srv.conn_fds.begin(), srv.conn_fds.end(), cfd);
-        if (it != srv.conn_fds.end()) srv.conn_fds.erase(it);
+        std::lock_guard<std::mutex> g(sync->mu);
+        auto it = std::find(sync->fds.begin(), sync->fds.end(), cfd);
+        if (it != sync->fds.end()) sync->fds.erase(it);
     }
-    ::close(cfd);
+    if (!adopted) ::close(cfd);
     {
-        // notify UNDER the mutex: the stop path deletes the Server
-        // (and this condvar) as soon as it observes active_conns == 0,
-        // and it can only observe that after we release conn_mu — a
-        // notify after the unlock would race pthread_cond_destroy
-        // (found by TSAN, r05)
-        std::lock_guard<std::mutex> g(srv.conn_mu);
-        --srv.active_conns;
-        srv.conn_cv.notify_all();
+        // notify UNDER the mutex (TSAN, r05) — and everything in this
+        // epilogue goes through the shared `sync`, never `srv`: the
+        // stop path deletes the Server as soon as it observes
+        // active == 0, and only the shared_ptr keeps these primitives
+        // alive through this thread's final unlock
+        std::lock_guard<std::mutex> g(sync->mu);
+        --sync->active;
+        sync->cv.notify_all();
     }
 }
 
@@ -1415,12 +2090,15 @@ void accept_loop(Server* srv, int lfd) {
             ::close(cfd);
             break;
         }
+        std::shared_ptr<Server::ConnSync> sync = srv->conns;
         {
-            std::lock_guard<std::mutex> g(srv->conn_mu);
-            srv->conn_fds.push_back(cfd);
-            ++srv->active_conns;
+            std::lock_guard<std::mutex> g(sync->mu);
+            sync->fds.push_back(cfd);
+            ++sync->active;
         }
-        std::thread([srv, cfd] { connection_loop(*srv, cfd); }).detach();
+        std::thread([srv, sync, cfd] {
+            connection_loop(*srv, sync, cfd);
+        }).detach();
     }
 }
 
@@ -1535,16 +2213,30 @@ void lz_serve_stop(int handle) {
     if (srv->accept_thread.joinable()) srv->accept_thread.join();
     if (srv->uds_thread.joinable()) srv->uds_thread.join();
     bool drained;
+    // hold our own reference to the sync block: a straggler thread's
+    // final notify/unlock may still be in flight after we observe
+    // active == 0, and `delete srv` below must not destroy the
+    // primitives under it — the last shared_ptr holder frees them
+    std::shared_ptr<Server::ConnSync> sync = srv->conns;
     {
-        std::unique_lock<std::mutex> g(srv->conn_mu);
-        for (int cfd : srv->conn_fds) ::shutdown(cfd, SHUT_RDWR);
-        drained = srv->conn_cv.wait_for(
+        std::unique_lock<std::mutex> g(sync->mu);
+        for (int cfd : sync->fds) ::shutdown(cfd, SHUT_RDWR);
+        drained = sync->cv.wait_for(
             g, std::chrono::seconds(10),
-            [srv] { return srv->active_conns == 0; });
+            [&sync] { return sync->active == 0; });
     }
     // a straggler thread past the timeout still references srv: leak it
-    // rather than free memory under a live thread
-    if (drained) delete srv;
+    // rather than free memory under a live thread. The proactor stops
+    // only AFTER the drain — a connection thread may be mid-adopt
+    // (holding a captured Proactor* outside proactor_mu), and stopping
+    // it earlier would delete that pointer under the live thread; once
+    // drained, nobody can be inside proactor_adopt (its lazy start is
+    // already fenced by `stopping` for any straggler).
+    if (drained) {
+        // closes the ring-plane connections and unmaps every segment
+        proactor_stop(*srv);
+        delete srv;
+    }
 }
 
 void lz_serve_stats(int handle, uint64_t* out) {
@@ -1579,6 +2271,24 @@ void lz_serve_stats2(int handle, uint64_t* out) {
     out[5] = srv->read_net_us.load();
     out[6] = srv->write_disk_us.load();
     out[7] = srv->write_net_us.load();
+}
+
+// Shared-memory ring plane counters, 4 slots: segments mapped (total),
+// descriptor ops landed, payload bytes landed via ring, currently
+// mapped segments. Folded into the chunkserver's Metrics registry by
+// the heartbeat alongside stats v2.
+void lz_serve_shm_stats(int handle, uint64_t* out) {
+    for (int i = 0; i < 4; ++i) out[i] = 0;
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+        g_servers[handle] == nullptr)
+        return;
+    Server* srv = g_servers[handle];
+    out[0] = srv->shm_segments_mapped.load();
+    out[1] = srv->shm_desc_ops.load();
+    out[2] = srv->shm_bytes.load();
+    int64_t active = srv->shm_active_segments.load();
+    out[3] = active > 0 ? static_cast<uint64_t>(active) : 0;
 }
 
 // Drain up to max_ops finished traced ops, oldest first, 8 u64 slots

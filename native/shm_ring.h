@@ -1,0 +1,169 @@
+// Same-host shared-memory part ring: the wire + segment contract.
+//
+// One memfd-backed segment per (client connection, chunkserver) pair,
+// negotiated over the existing abstract-UDS data connection (riding the
+// SO_PEERCRED gate in wire.h) via a CltocsShmInit frame whose sendmsg
+// carries the memfd as SCM_RIGHTS ancillary data.  After that, encoded
+// parts land straight in the mapped segment and the "send" phase is a
+// tiny CltocsShmWritePart descriptor frame (chunk/part/write ids, ring
+// offset, length, per-64KiB-piece CRCs) instead of megabytes through
+// sendmsg.  Acks stay ordinary CstoclWriteStatus frames, FIFO per
+// connection, so the windowed client's ack collector serves both the
+// socket-copy (1215) and the ring (1217) paths unchanged.
+//
+// Segment layout: a raw payload arena — no header, no in-segment
+// indices.  The CLIENT owns allocation (a classic FIFO ring bump
+// allocator: regions are freed in ack order), the server only ever
+// reads [ring_off, ring_off+length) ranges named by descriptors it has
+// received, so no cross-process synchronization beyond the descriptor/
+// ack exchange itself is needed.  The memfd is created under the name
+// "lzshm" so leaked mappings are grep-able in /proc/<pid>/maps
+// (pinned by tests/test_process_cluster.py).
+//
+// Wire frames (keep in sync with lizardfs_tpu/proto/messages.py):
+//   CltocsShmInit     (1216): req_id:u32 pid:u32 mem_fd:u32 seg_size:u64
+//                             [+ SCM_RIGHTS memfd on the carrying
+//                             sendmsg; receivers that lose the cmsg —
+//                             the asyncio fallback — map
+//                             /proc/<pid>/fd/<mem_fd> instead, which
+//                             enforces the same same-uid gate]
+//   CltocsShmWritePart(1217): req_id:u32 chunk_id:u64 write_id:u32
+//                             part_id:u32 part_offset:u32 ring_off:u64
+//                             length:u32 crcs(u32 count + u32 each)
+//   ack = CstoclWriteStatus  (1212), exactly as for 1214/1215 frames.
+//
+// Kill switch: LZ_SHM_RING=0 disables both the client attempt and the
+// server accept, restoring the vectored scatterv path byte-for-byte.
+
+#pragma once
+
+#include <algorithm>
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace lzshm {
+
+constexpr uint32_t kTypeShmInit = 1216;
+constexpr uint32_t kTypeShmWritePart = 1217;
+
+// segment size sanity bound: a descriptor naming bytes past this is a
+// protocol violation regardless of what the fd's size claims
+constexpr uint64_t kMaxSegBytes = 1ull << 30;
+
+// ShmInit body: ver(1) req(4) pid(4) mem_fd(4) seg_size(8)
+constexpr size_t kShmInitBody = 1 + 4 + 4 + 4 + 8;
+
+// ShmWritePart fixed body before the CRC list:
+// ver(1) req(4) chunk(8) write_id(4) part_id(4) part_offset(4)
+// ring_off(8) length(4) ncrcs(4)
+constexpr size_t kShmDescFixed = 1 + 4 + 8 + 4 + 4 + 4 + 8 + 4 + 4;
+
+inline bool ring_disabled() {
+    // read per call, not cached: tests flip LZ_SHM_RING mid-process.
+    // Accepted spellings mirror native_io.shm_ring_enabled exactly —
+    // an operator's LZ_SHM_RING=off must kill the native server's ring
+    // acceptance too, not just the Python side's.
+    const char* v = ::getenv("LZ_SHM_RING");
+    if (v == nullptr) return false;
+    char low[8] = {};
+    for (size_t i = 0; i < sizeof(low) - 1 && v[i] != '\0'; ++i)
+        low[i] = static_cast<char>(
+            std::tolower(static_cast<unsigned char>(v[i])));
+    return std::strcmp(low, "0") == 0 || std::strcmp(low, "off") == 0 ||
+           std::strcmp(low, "false") == 0 || std::strcmp(low, "no") == 0;
+}
+
+// The shm contract is same-host only: the handshake must arrive on the
+// abstract-UDS connection (behind wire.h's SO_PEERCRED gate), never on
+// a TCP data port — a remote peer must not be able to drive the
+// /proc/<pid>/fd mapping fallback or pin server-side mappings.
+inline bool sock_is_unix(int fd) {
+    sockaddr_storage ss {};
+    socklen_t slen = sizeof(ss);
+    return ::getsockname(fd, reinterpret_cast<sockaddr*>(&ss), &slen) ==
+               0 &&
+           ss.ss_family == AF_UNIX;
+}
+
+// recv exactly `len` bytes, capturing at most one SCM_RIGHTS fd that
+// arrives attached to this segment of the stream.  Extra fds in one
+// cmsg are closed (never leaked).  *out_fd is left untouched unless an
+// fd arrives, so callers initialize it to -1.  Returns false on EOF or
+// a socket error.
+inline bool recv_all_with_fd(int sock, uint8_t* buf, size_t len,
+                             int* out_fd) {
+    while (len) {
+        struct iovec iov;
+        iov.iov_base = buf;
+        iov.iov_len = len;
+        // room for a few fds: a well-formed peer sends exactly one
+        alignas(struct cmsghdr) char ctrl[CMSG_SPACE(4 * sizeof(int))];
+        struct msghdr mh {};
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        mh.msg_control = ctrl;
+        mh.msg_controllen = sizeof(ctrl);
+        ssize_t n = ::recvmsg(sock, &mh, 0);
+        if (n <= 0) {
+            if (n < 0 && errno == EINTR) continue;
+            return false;
+        }
+        for (struct cmsghdr* c = CMSG_FIRSTHDR(&mh); c != nullptr;
+             c = CMSG_NXTHDR(&mh, c)) {
+            if (c->cmsg_level != SOL_SOCKET || c->cmsg_type != SCM_RIGHTS)
+                continue;
+            size_t nfds = (c->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+            int fds[4];
+            std::memcpy(fds, CMSG_DATA(c),
+                        std::min(nfds, size_t(4)) * sizeof(int));
+            for (size_t i = 0; i < nfds && i < 4; ++i) {
+                if (out_fd != nullptr && *out_fd < 0 && i == 0) {
+                    *out_fd = fds[i];
+                } else {
+                    ::close(fds[i]);
+                }
+            }
+        }
+        buf += n;
+        len -= static_cast<size_t>(n);
+    }
+    return true;
+}
+
+// Build one CltocsShmWritePart frame (header + body) into out.  The
+// CRC list covers ceil(len / 64Ki) pieces computed by the caller.
+inline void build_shm_desc_frame(std::vector<uint8_t>& out,
+                                 uint64_t chunk_id, uint32_t write_id,
+                                 uint32_t part_id, uint64_t part_offset,
+                                 uint64_t ring_off, uint32_t len,
+                                 const uint32_t* crcs, uint32_t ncrcs) {
+    auto put32 = [](uint8_t* p, uint32_t v) {
+        p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+    };
+    auto put64 = [&put32](uint8_t* p, uint64_t v) {
+        put32(p, static_cast<uint32_t>(v >> 32));
+        put32(p + 4, static_cast<uint32_t>(v));
+    };
+    out.resize(8 + kShmDescFixed + 4ull * ncrcs);
+    put32(out.data(), kTypeShmWritePart);
+    put32(out.data() + 4, static_cast<uint32_t>(out.size() - 8));
+    out[8] = 1;  // kProtoVersion
+    put32(out.data() + 9, write_id);   // req_id
+    put64(out.data() + 13, chunk_id);
+    put32(out.data() + 21, write_id);
+    put32(out.data() + 25, part_id);
+    put32(out.data() + 29, static_cast<uint32_t>(part_offset));
+    put64(out.data() + 33, ring_off);
+    put32(out.data() + 41, len);
+    put32(out.data() + 45, ncrcs);
+    for (uint32_t i = 0; i < ncrcs; ++i)
+        put32(out.data() + 49 + 4ull * i, crcs[i]);
+}
+
+}  // namespace lzshm

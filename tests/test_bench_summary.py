@@ -52,7 +52,13 @@ def _fat_row() -> dict:
     for g in ("xor3", "ec3_2", "ec8_4"):
         row[f"cluster_{g}_write_phases"] = {
             "encode_ms": 1234.56, "stage_ms": 345.67, "send_ms": 4567.89,
-            "commit_ms": 123.45, "wall_ms": 5678.9, "reps": 5,
+            "ack_ms": 2345.67, "commit_ms": 123.45, "wall_ms": 5678.9,
+            "reps": 5,
+            # round 7: the send/encode busy-fraction ratio (<= 1.0 is
+            # the shm-ring target; its verdict lives in the decimals)
+            # plus the named dominant phase (the acceptance question
+            # "if not send, what bounds the row now" answered in-row)
+            "send_over_encode": 0.87, "dominant": "encode",
         }
         # adaptive write-window fiducials (round 6: depth settled +
         # segment/credit/coalesce deltas per striped row)
@@ -65,6 +71,12 @@ def _fat_row() -> dict:
         "by_role_ms": {"client": 401.2, "chunkserver": 233.4,
                        "master": 12.9},
         "spans": 64,
+    }
+    # shm-ring A/B fiducial (round 7: same-host shared-memory data
+    # plane on vs LZ_SHM_RING=0 scatterv)
+    row["cluster_ec8_4_write_shm"] = {
+        "on_MBps": 512.3, "off_MBps": 431.2, "delta_pct": 18.8,
+        "desc_parts": 1536, "engaged": True,
     }
     row["cluster_dbench8_MBps"] = 330.3
     row["cluster_dbench8_ops_per_s"] = 990.9
@@ -111,6 +123,16 @@ def test_summary_line_fits_driver_tail():
         or "cluster_ec8_4_write_window" in parsed.get("dropped", [])
     )
     assert not any("xor3_write_window" in k for k in parsed)
+    # the shm on/off A/B delta rides the tail (or its drop is recorded),
+    # and the send/encode ratio survives int compaction with decimals
+    assert (
+        parsed.get("cluster_ec8_4_write_shm", {}).get("delta_pct") == 18.8
+        or "cluster_ec8_4_write_shm" in parsed.get("dropped", [])
+    )
+    if "cluster_ec8_4_write_phases" in parsed:
+        assert parsed["cluster_ec8_4_write_phases"][
+            "send_over_encode"] == 0.87
+        assert parsed["cluster_ec8_4_write_phases"]["dominant"] == "encode"
     # slo fiducials ride the tail: noise attribution from the artifact
     assert parsed["cluster_health_status"] == "degraded"
     assert parsed["cluster_slo_breaches"] == 1234

@@ -508,3 +508,385 @@ async def test_uds_fast_path_engages(tmp_path):
             "no data-plane connection took the unix-socket fast path"
     finally:
         await cluster.stop()
+
+
+# --- same-host shared-memory part rings (native/shm_ring.h) -----------------
+
+async def _striped_roundtrip(cluster, c, name, nbytes, goal=None):
+    f = await c.create(1, name)
+    await c.setgoal(f.inode, goal if goal is not None else EC_GOAL)
+    payload = data_generator.generate(29, nbytes).tobytes()
+    await c.write_file(f.inode, payload)
+    c.cache.invalidate(f.inode)
+    back = await c.read_file(f.inode, 0, nbytes)
+    assert bytes(back) == payload, "roundtrip corruption"
+    return f
+
+
+def test_shm_ring_unalloc_rollback_does_not_overlap_live_regions():
+    """Rolling back a staged-but-failed allocation must retract the
+    ring head, not advance the implied tail: a free()-based rollback
+    leaves a hole the accounting stops covering, and a later alloc can
+    hand out a region overlapping a sent-but-unacked segment's bytes
+    (the server would then CRC-fail the descriptor it reads later)."""
+    if not hasattr(native_io, "ShmRing"):
+        pytest.skip("native shm ring not built")
+    ring = native_io.ShmRing(native_io.shm_seg_bytes())
+    try:
+        ring.size = 100  # drive the allocator, not the mapping
+        live = []
+        for _ in range(2):  # seg1 [0,30), seg2 [30,60): sent, unacked
+            off, cost = ring.alloc(30)
+            live.append((off, off + 30))
+        off3, cost3 = ring.alloc(20)  # seg3 staged [60,80)...
+        ring.unalloc(off3, cost3, 20)  # ...then encode fails: roll back
+        ring.free(30)  # seg1 acked (FIFO)
+        live.pop(0)
+        for nbytes in (20, 30, 20):
+            got = ring.alloc(nbytes)
+            if got is None:
+                continue
+            off, _cost = got
+            for lo, hi in live:
+                assert not (off < hi and off + nbytes > lo), (
+                    f"alloc [{off},{off + nbytes}) overlaps "
+                    f"live [{lo},{hi})"
+                )
+    finally:
+        ring.close()
+
+
+async def test_shm_ring_engages(tmp_path):
+    """A same-host windowed striped write must negotiate memfd rings
+    and move its parts as descriptor frames: client counters, the
+    chunkserver's native shm stats, and the copy-free trace kind all
+    prove the handoff — a silent precondition miss would quietly fall
+    back to the socket-copy path and forfeit the send-phase win."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        assert c.write_window is not None
+        await _striped_roundtrip(cluster, c, "ring.bin", 8 * 2**20)
+        assert c.op_counters.get("write_shm", 0) >= 1, \
+            "shm ring path did not engage"
+        assert c.metrics.series["shm_ring_segments_mapped"].total >= 1
+        assert c.metrics.series["shm_ring_desc_parts"].total >= 1
+        server_desc_ops = sum(
+            cs.data_server.shm_stats()["desc_ops"]
+            for cs in cluster.chunkservers
+            if cs.data_server is not None
+        )
+        assert server_desc_ops >= 1, \
+            "no chunkserver landed a ring descriptor"
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_engages_on_asyncio_chunkserver(tmp_path):
+    """Pure-Python chunkservers have no UDS listener, so their demux's
+    only reachable transport is loopback TCP: a ring-capable client
+    writing to an asyncio chunkserver over 127.0.0.1 must still
+    negotiate segments and ship descriptors (the fd travels as a
+    /proc/<pid>/fd name instead of SCM_RIGHTS) — otherwise the
+    pure-Python fallback demux is dead code."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    cluster = Cluster(tmp_path, n_cs=6, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        assert c.write_window is not None
+        await _striped_roundtrip(cluster, c, "pyring2.bin", 8 * 2**20)
+        assert c.op_counters.get("write_shm", 0) >= 1, \
+            "shm ring path did not engage against the asyncio plane"
+        mapped = sum(
+            cs.metrics.series["shm_segments_mapped"].total
+            for cs in cluster.chunkservers
+            if "shm_segments_mapped" in cs.metrics.series
+        )
+        assert mapped >= 1, "no asyncio chunkserver mapped a segment"
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_segments_released_on_session_teardown(tmp_path):
+    """After writes finish and pooled connections are discarded, every
+    chunkserver's active-segment gauge returns to zero (segments are
+    owned by the connection, never leaked across sessions)."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        for rep in range(3):
+            await _striped_roundtrip(
+                cluster, c, f"seg{rep}.bin", 4 * 2**20
+            )
+        mapped = sum(
+            cs.data_server.shm_stats()["segments_mapped"]
+            for cs in cluster.chunkservers
+            if cs.data_server is not None
+        )
+        assert mapped >= 1
+        # pooled connections keep their segment mapped (that's the
+        # point: no per-chunk renegotiation) — drop the pools and the
+        # mappings must go with them (ring conns pool in RING_POOL)
+        idle = []
+        for pool in (native_io.POOL, native_io.RING_POOL):
+            with pool._lock:
+                idle += [
+                    s for bucket in pool._idle.values() for s in bucket
+                ]
+                pool._idle.clear()
+        for s in idle:
+            native_io.shm_ring_drop(s)
+            s.close()
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            active = sum(
+                cs.data_server.shm_stats()["active_segments"]
+                for cs in cluster.chunkservers
+                if cs.data_server is not None
+            )
+            if active == 0:
+                break
+            await asyncio.sleep(0.1)
+        assert active == 0, f"{active} shm segments leaked past teardown"
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_full_falls_back_to_scatterv(tmp_path, monkeypatch):
+    """A ring too small for a segment must fall back to the vectored
+    socket-copy send mid-stripe — same bytes, fallback counted."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    # 64 KiB segments: smaller than any padded parity region of the
+    # striped segments below, so every staging attempt fails ring-full
+    monkeypatch.setenv("LZ_SHM_RING_MB", "0.0625")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        await _striped_roundtrip(cluster, c, "tiny_ring.bin", 8 * 2**20)
+        fallbacks = c.metrics.series.get("shm_ring_fallbacks")
+        assert fallbacks is not None and fallbacks.total >= 1, \
+            "ring-full segments did not fall back to scatterv"
+        # the socket-copy frames ride the SAME proactor-owned
+        # connections the ring negotiated on — the windowed write must
+        # survive the interleave, not degrade to the serial rewrite
+        assert not c.op_counters.get("write_pipeline_fallback"), \
+            "proactor rejected interleaved scatterv frames"
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_kill_switch_stays_on_socket_path(tmp_path,
+                                                         monkeypatch):
+    """LZ_SHM_RING=0 must keep the windowed write on the PR-5 scatterv
+    path: no handshake, no descriptors, no client-side ring series."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    monkeypatch.setenv("LZ_SHM_RING", "0")
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        await _striped_roundtrip(cluster, c, "killed.bin", 8 * 2**20)
+        assert c.op_counters.get("write_window", 0) >= 1
+        assert not c.op_counters.get("write_shm"), \
+            "kill switch did not disable the ring path"
+        assert "shm_ring_desc_parts" not in c.metrics.series
+        assert all(
+            cs.data_server.shm_stats()["segments_mapped"] == 0
+            for cs in cluster.chunkservers
+            if cs.data_server is not None
+        )
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_kill_switch_off_spelling_disables_server(
+        tmp_path, monkeypatch):
+    """LZ_SHM_RING=off must kill the native server's ring acceptance
+    too — spelling parity between lzshm::ring_disabled and
+    native_io.shm_ring_enabled.  The client side is forced eligible so
+    only the server's C-side env parse is under test: the handshake
+    must be refused and the write must fall back to scatterv."""
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    monkeypatch.setenv("LZ_SHM_RING", "off")
+    monkeypatch.setattr(native_io, "shm_ring_enabled", lambda: True)
+    cluster = Cluster(tmp_path)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        c.WRITE_PIPELINE_MIN_BYTES = 1
+        await _striped_roundtrip(cluster, c, "killed_off.bin", 8 * 2**20)
+        assert not c.op_counters.get("write_shm"), \
+            "server accepted a ring despite LZ_SHM_RING=off"
+        assert all(
+            cs.data_server.shm_stats()["segments_mapped"] == 0
+            for cs in cluster.chunkservers
+            if cs.data_server is not None
+        )
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_ring_asyncio_fallback_demux(tmp_path):
+    """The pure-Python chunkserver demuxes the same descriptor frames:
+    ShmInit maps the client's memfd via /proc (StreamReader drops the
+    SCM_RIGHTS cmsg), ShmWritePart lands bytes read straight from the
+    mapping, and the mapping is released when the connection closes."""
+    import os
+
+    from lizardfs_tpu.ops import crc32 as crc_mod
+    from lizardfs_tpu.proto import framing
+    from lizardfs_tpu.proto import messages as m
+    from lizardfs_tpu.proto import status as st
+
+    if not hasattr(os, "memfd_create"):
+        pytest.skip("no memfd_create")
+    cluster = Cluster(tmp_path, n_cs=3, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "pyring.bin")
+        # goal 1 plain copy: one part, part_id 0, easy to address
+        payload = data_generator.generate(31, 2 * MFSBLOCKSIZE).tobytes()
+        await c.write_file(f.inode, payload)  # creates the chunk
+        loc = await c.chunk_info(f.inode, 0)
+        part = loc.locations[0]
+
+        ring = native_io.ShmRing(1 << 20)
+        try:
+            fresh = data_generator.generate(37, 2 * MFSBLOCKSIZE).tobytes()
+            ring.arr[: len(fresh)] = np.frombuffer(fresh, dtype=np.uint8)
+            reader, writer = await asyncio.open_connection(
+                part.addr.host, part.addr.port
+            )
+            try:
+                await framing.send_message(writer, m.CltocsShmInit(
+                    req_id=1, pid=os.getpid(), mem_fd=ring.memfd,
+                    seg_size=ring.size,
+                ))
+                ack = await framing.read_message(reader)
+                assert isinstance(ack, m.CstoclWriteStatus)
+                assert ack.status == st.OK, "asyncio ShmInit refused"
+                await framing.send_message(writer, m.CltocsWriteInit(
+                    req_id=2, chunk_id=loc.chunk_id, version=loc.version,
+                    part_id=part.part_id, chain=[], create=False,
+                ))
+                ack = await framing.read_message(reader)
+                assert ack.status == st.OK
+                crcs = [
+                    crc_mod.crc32(
+                        fresh[i * MFSBLOCKSIZE:(i + 1) * MFSBLOCKSIZE]
+                    )
+                    for i in range(2)
+                ]
+                await framing.send_message(writer, m.CltocsShmWritePart(
+                    req_id=3, chunk_id=loc.chunk_id, write_id=3,
+                    part_id=part.part_id, part_offset=0, ring_off=0,
+                    length=len(fresh), crcs=crcs,
+                ))
+                ack = await framing.read_message(reader)
+                assert ack.status == st.OK, "descriptor write refused"
+                await framing.send_message(writer, m.CltocsWriteEnd(
+                    req_id=4, chunk_id=loc.chunk_id,
+                ))
+                ack = await framing.read_message(reader)
+                assert ack.status == st.OK
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+        finally:
+            ring.close()
+        c.cache.invalidate(f.inode)
+        back = await c.read_file(f.inode, 0, len(fresh))
+        assert bytes(back) == fresh, "ring bytes did not land"
+    finally:
+        await cluster.stop()
+
+
+async def test_shm_init_refused_for_remote_peers(tmp_path):
+    """Server-side enforcement of the same-host contract: a ShmInit
+    arriving over TCP from a non-loopback peer is refused outright —
+    remote peers must not drive the /proc fd mapping or pin 1 GiB
+    server-side segments (the client's own AF_UNIX gate only protects
+    well-behaved clients, not the server)."""
+    import os
+
+    from lizardfs_tpu.proto import framing
+    from lizardfs_tpu.proto import messages as m
+    from lizardfs_tpu.proto import status as st
+
+    cluster = Cluster(tmp_path, n_cs=1, native_data_plane=False)
+    await cluster.start()
+    try:
+        cs = cluster.chunkservers[0]
+
+        class _RemoteWriter:
+            """Quacks like a StreamWriter on a non-loopback TCP conn."""
+
+            def __init__(self):
+                self.buf = bytearray()
+                self.sock = socket_mod.socket(
+                    socket_mod.AF_INET, socket_mod.SOCK_STREAM
+                )
+
+            def get_extra_info(self, key):
+                if key == "socket":
+                    return self.sock
+                if key == "peername":
+                    return ("203.0.113.9", 54321)
+                return None
+
+            def write(self, data):
+                self.buf += data
+
+            async def drain(self):
+                pass
+
+        if not hasattr(os, "memfd_create"):
+            pytest.skip("no memfd_create")
+        # a real, mappable segment: the refusal must come from the
+        # same-host gate, not from a failed /proc open
+        memfd = os.memfd_create("lzshm-test")
+        os.ftruncate(memfd, 1 << 20)
+        w = _RemoteWriter()
+        try:
+            shm_state: dict = {}
+            await cs._serve_shm_init(
+                w,
+                m.CltocsShmInit(
+                    req_id=1, pid=os.getpid(), mem_fd=memfd,
+                    seg_size=1 << 20,
+                ),
+                shm_state,
+            )
+        finally:
+            w.sock.close()
+            os.close(memfd)
+        reader = asyncio.StreamReader()
+        reader.feed_data(bytes(w.buf))
+        reader.feed_eof()
+        ack = await framing.read_message(reader)
+        assert isinstance(ack, m.CstoclWriteStatus)
+        assert ack.status == st.EINVAL, "remote ShmInit must be refused"
+        assert "mm" not in shm_state, "remote peer mapped a segment"
+    finally:
+        await cluster.stop()

@@ -443,3 +443,91 @@ async def test_sigkill_rebuild_engine_status_and_trace(tmp_path):
         await c.close()
     finally:
         cluster.stop()
+
+
+def _lzshm_mappings(pid: int) -> int:
+    """Count memfd ring segments currently mapped by a process (the
+    memfd is created under the name "lzshm" — native/shm_ring.h)."""
+    try:
+        with open(f"/proc/{pid}/maps") as f:
+            return sum(1 for line in f if "lzshm" in line)
+    except OSError:
+        return 0
+
+
+def _data_uds_ports(before: set[str] | None = None) -> set[str]:
+    """Abstract data-plane listener ports visible on this host
+    (serve_native.cpp binds @lzfs-data-<host>-<port>)."""
+    out = set()
+    try:
+        with open("/proc/net/unix") as f:
+            for line in f:
+                marker = "@lzfs-data-127.0.0.1-"
+                idx = line.find(marker)
+                if idx >= 0:
+                    out.add(line[idx + len(marker):].strip())
+    except OSError:
+        pass
+    return out - (before or set())
+
+
+async def test_shm_segment_lifecycle_survives_peer_sigkill(tmp_path):
+    """Ring segments are owned by the connection: a client that mapped
+    a segment and got SIGKILLed (no goodbye) must leave the chunkserver
+    with ZERO lingering memfd mappings once the kernel closes the
+    socket — and repeated map/kill cycles must not accumulate any."""
+    from lizardfs_tpu.core import native_io
+
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    ports_before = _data_uds_ports()
+    cluster = ProcCluster(tmp_path, n_cs=1)
+    try:
+        await cluster.start()
+        ports = _data_uds_ports(ports_before)
+        assert ports, "chunkserver bound no abstract data listener"
+        port = sorted(ports)[0]
+        cs_pid = cluster.procs["cs0"].pid
+        assert _lzshm_mappings(cs_pid) == 0
+
+        helper_src = (
+            "import sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from lizardfs_tpu.core import native_io\n"
+            f"sock = native_io._blocking_socket(('127.0.0.1', {port}), 30.0)\n"
+            "ring = native_io.shm_ring_handshake(sock)\n"
+            "assert ring is not None, 'handshake refused'\n"
+            "print('MAPPED', flush=True)\n"
+            "time.sleep(60)\n"
+        )
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        for cycle in range(2):
+            helper = subprocess.Popen(
+                [sys.executable, "-c", helper_src],
+                stdout=subprocess.PIPE, env=env,
+            )
+            try:
+                line = await asyncio.wait_for(
+                    asyncio.to_thread(helper.stdout.readline), 30.0
+                )
+                assert b"MAPPED" in line, "helper never mapped a ring"
+                # the segment is live in the SERVER's address space now
+                for _ in range(100):
+                    if _lzshm_mappings(cs_pid) > 0:
+                        break
+                    await asyncio.sleep(0.1)
+                assert _lzshm_mappings(cs_pid) > 0, \
+                    f"cycle {cycle}: server never mapped the segment"
+            finally:
+                helper.send_signal(signal.SIGKILL)
+                helper.wait(timeout=10)
+            for _ in range(100):
+                if _lzshm_mappings(cs_pid) == 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert _lzshm_mappings(cs_pid) == 0, (
+                f"cycle {cycle}: segment leaked past peer SIGKILL "
+                "(proactor did not unmap on disconnect)"
+            )
+    finally:
+        cluster.stop()

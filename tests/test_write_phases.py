@@ -146,8 +146,12 @@ async def test_phase_breakdown_sums_to_wall_clock(tmp_path):
             assert d["reps"] == 1
             for phase in ("encode", "stage", "send", "commit"):
                 assert d[f"{phase}_ms"] > 0.0, f"{phase} not recorded"
+            # "ack" only accrues when the window runs deep enough to
+            # reap — present in the snapshot, but may be ~0 here
+            assert "ack_ms" in d
             total = sum(
-                d[f"{p}_ms"] for p in ("encode", "stage", "send", "commit")
+                d[f"{p}_ms"]
+                for p in ("encode", "stage", "send", "ack", "commit")
             )
             assert d["wall_ms"] > 0
             assert 0.4 * d["wall_ms"] <= total <= 2.0 * d["wall_ms"], (
@@ -513,3 +517,150 @@ def test_locate_epoch_clear_bumps_generation():
     # and without a clear, tokens do still match across a quiet period
     quiet = client._locate_token(inode)
     assert client._locate_token(inode) == quiet
+
+
+# --- same-host shared-memory part rings (native/shm_ring.h) -----------------
+
+
+@pytest.mark.asyncio
+async def test_shm_ring_byte_identity_on_off_depths(tmp_path, monkeypatch):
+    """Windowed striped writes with the shm ring ON and OFF
+    (LZ_SHM_RING=0) at depths {1, 2, 8} must produce identical chunk
+    bytes and stored CRC tables — and match the strictly serial golden
+    reference. The copy-free descriptor path may only change HOW bytes
+    move, never what lands on disk."""
+    from lizardfs_tpu.core import native_io
+
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    payload = _payload(12 * 2**20 + 12345)  # multi-stripe + ragged tail
+    cluster = Cluster(tmp_path, n_cs=6)
+    await cluster.start(health_interval=5.0)
+    try:
+        client = await cluster.client()
+        client.WRITE_PIPELINE_MIN_BYTES = 1
+        assert client.write_window is not None
+        inodes: dict[object, int] = {}
+        for ring_on in (True, False):
+            if ring_on:
+                monkeypatch.delenv("LZ_SHM_RING", raising=False)
+            else:
+                monkeypatch.setenv("LZ_SHM_RING", "0")
+            for depth in (1, 2, 8):
+                client.write_window.max_depth = depth
+                client.write_window.depth = min(2, depth)
+                before_shm = client.op_counters.get("write_shm", 0)
+                key = ("ring" if ring_on else "sock", depth)
+                inodes[key] = await _write_and_read_back(
+                    cluster, client, EC84_GOAL,
+                    f"shm_{ring_on}_{depth}.bin", payload,
+                )
+                engaged = client.op_counters.get("write_shm", 0) > before_shm
+                assert engaged == ring_on, (
+                    f"ring engagement mismatch at depth {depth}: "
+                    f"on={ring_on} engaged={engaged}"
+                )
+        # strictly serial golden reference
+        client.write_pipeline = False
+        inodes["serial"] = await _write_and_read_back(
+            cluster, client, EC84_GOAL, "shm_serial.bin", payload
+        )
+        loc_ref = await client.chunk_info(inodes["serial"], 0)
+        parts_ref = _find_part_files(cluster, loc_ref.chunk_id)
+        assert parts_ref
+        for variant, ino in inodes.items():
+            if variant == "serial":
+                continue
+            loc = await client.chunk_info(ino, 0)
+            parts = _find_part_files(cluster, loc.chunk_id)
+            assert set(parts) == set(parts_ref), f"{variant}: part set"
+            for part_id in sorted(parts):
+                cpt = geometry.ChunkPartType.from_id(part_id)
+                data_v, crcs_v = _read_part(parts[part_id])
+                data_r, crcs_r = _read_part(parts_ref[part_id])
+                assert data_v == data_r, \
+                    f"{variant}: part {cpt.part} bytes differ from serial"
+                assert crcs_v == crcs_r, \
+                    f"{variant}: part {cpt.part} CRC tables differ"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_shm_ring_mid_stripe_failure_falls_back(tmp_path):
+    """A transport failure during a ring descriptor send mid-chunk must
+    degrade — scatterv/serial heal the torn segments — and still
+    produce a correct file, with the fallback recorded."""
+    from lizardfs_tpu.core import native_io
+
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    payload = _payload(9 * 2**20)
+    cluster = Cluster(tmp_path, n_cs=6)
+    await cluster.start(health_interval=5.0)
+    try:
+        client = await cluster.client()
+        client.WRITE_PIPELINE_MIN_BYTES = 1
+        assert client.write_window is not None
+        orig = native_io.PartsScatterSession._ring_send_descs
+        calls = {"n": 0}
+
+        def broken(self, *args, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:  # mid-chunk: segment 1 already landed
+                self.close()
+                raise native_io.NativeIOError(-1, "injected")
+            return orig(self, *args, **kw)
+
+        native_io.PartsScatterSession._ring_send_descs = broken
+        try:
+            await _write_and_read_back(
+                cluster, client, EC84_GOAL, "ring_fb.bin", payload
+            )
+        finally:
+            native_io.PartsScatterSession._ring_send_descs = orig
+        assert calls["n"] >= 2, "injection never hit the ring path"
+        assert client.op_counters.get("write_pipeline_fallback", 0) >= 1
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_shm_ring_chunkserver_death_mid_write_recovers(tmp_path):
+    """Killing a part holder in the middle of a ring write must not
+    lose data: the windowed path fails, the client re-locates and
+    rewrites through the fallback chain, and the bytes read back."""
+    from lizardfs_tpu.core import native_io
+
+    if not native_io.parts_shm_available():
+        pytest.skip("native shm ring not built")
+    payload = _payload(9 * 2**20)
+    cluster = Cluster(tmp_path, n_cs=12)
+    await cluster.start(health_interval=30.0)
+    try:
+        client = await cluster.client()
+        client.WRITE_PIPELINE_MIN_BYTES = 1
+        assert client.write_window is not None
+        orig = native_io.PartsScatterSession.send_segment_window
+        state = {"n": 0}
+
+        def killing(self, *args, **kw):
+            state["n"] += 1
+            if state["n"] == 2:
+                # emulate the holder dying mid-stripe: every ring
+                # connection of this session drops (the proactor tears
+                # its segments down exactly as on a real SIGKILL)
+                self.close()
+                raise native_io.NativeIOError(-1, "holder died")
+            return orig(self, *args, **kw)
+
+        native_io.PartsScatterSession.send_segment_window = killing
+        try:
+            await _write_and_read_back(
+                cluster, client, EC84_GOAL, "ring_cs_death.bin", payload
+            )
+        finally:
+            native_io.PartsScatterSession.send_segment_window = orig
+        assert client.op_counters.get("write_pipeline_fallback", 0) >= 1
+    finally:
+        await cluster.stop()
