@@ -446,6 +446,31 @@ def cluster_throughput() -> dict:
                 out["cluster_rebuild_MBps"] = r["rebuild_MBps"]
                 out["cluster_rebuild_s"] = r["rebuild_s"]
                 out["cluster_rebuild_parts"] = r["parts_rebuilt"]
+            elif "primary_only" in r:
+                # locate storm (ISSUE 7): aggregate locate QPS primary-
+                # only vs primary+shadow, p99, replica engagement + lag
+                a, b = r["primary_only"], r.get("with_replica", {})
+                out["cluster_locate_qps"] = {
+                    "primary": a["locate_qps"],
+                    "replica_topo": b.get("locate_qps", 0),
+                    "x": r.get("locate_qps_x", 0),
+                    "target_x": r.get("locate_qps_target_x", 1.8),
+                    "target_met": r.get("locate_qps_target_met", False),
+                    "shadow_served": b.get("shadow_reads", 0),
+                    "stale_retries": b.get("stale_retries", 0),
+                }
+                out["cluster_locate_p99_ms"] = {
+                    "primary": a["locate_p99_ms"],
+                    "replica_topo": b.get("locate_p99_ms", 0),
+                }
+                out["cluster_locate_storm_detail"] = {
+                    "files": r.get("files", 0),
+                    "servers": r.get("servers", 0),
+                    "populate_s": r.get("populate_s", 0),
+                    "cs_ingest": r.get("cs_ingest", {}),
+                    "loop_stalls": r.get("loop_stalls", 0),
+                    "shadow_lag": r.get("shadow_lag", 0),
+                }
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]
@@ -697,6 +722,19 @@ def _summary_row(row: dict) -> dict:
     ):
         if key in row:
             s[key] = row[key]
+    if "cluster_locate_qps" in row:
+        # locate storm (ISSUE 7): the metadata-plane A/B verdict —
+        # aggregate locate QPS primary-only vs +shadow with its 1.8x
+        # target_met flag, compacted to the verdict-bearing fields
+        # (engagement counters + storm detail live in BENCH_FULL.json)
+        q = row["cluster_locate_qps"]
+        s["cluster_locate_qps"] = {
+            "primary": q.get("primary", 0),
+            "replica_topo": q.get("replica_topo", 0),
+            "x": q.get("x", 0), "target_met": q.get("target_met", False),
+        }
+    if "cluster_locate_p99_ms" in row:
+        s["cluster_locate_p99_ms"] = row["cluster_locate_p99_ms"]
     targeted = {
         key[: -len("_target_met")]
         for key in row
@@ -764,10 +802,12 @@ SUMMARY_BUDGET_BYTES = 1900
 # least-verdict-bearing first; each drop is recorded so the tail shows
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
-    "cluster_slo_breaches_by_class", "kernel_ladder",
+    "cluster_slo_breaches_by_class", "cluster_locate_p99_ms",
+    "kernel_ladder",
     "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
-    "cluster_ec8_4_write_shm", "cluster_ec8_4_write_phases",
+    "cluster_ec8_4_write_shm", "cluster_locate_qps",
+    "cluster_ec8_4_write_phases",
 )
 
 

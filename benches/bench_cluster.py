@@ -718,6 +718,26 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             import logging
 
             logging.getLogger("bench").exception("rebuild row failed")
+
+        # locate storm: the metadata-plane A/B (ISSUE 7 tentpole) —
+        # separate primary/shadow/worker PROCESSES (this in-process
+        # cluster idles meanwhile), synthetic 20k-inode namespace +
+        # 200 synthetic chunkservers, aggregate locate QPS primary-only
+        # vs primary+shadow. Compact parameters here; the full
+        # 1k-server/100k-inode (and slow-marked 1M) storm runs via
+        # `python benches/bench_master_storm.py`.
+        try:
+            from benches.bench_master_storm import run_storm
+
+            storm = await run_storm(
+                files=20_000, servers=200, secs=3.0, workers=None,
+                conns=2, real_cs=64,
+            )
+            rows.append(storm)
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("locate storm row failed")
     finally:
         await client.close()
         for cs in servers:
@@ -758,6 +778,13 @@ def main(argv=None) -> int:
         elif "rebuild_MBps" in r:
             print(f"{r['goal']:>18s}:  {r['rebuild_MBps']:8.1f} MB/s"
                   f"   ({r['parts_rebuilt']} parts in {r['rebuild_s']}s)")
+        elif "primary_only" in r:
+            a, b = r["primary_only"], r.get("with_replica", {})
+            print(f"{r['goal']:>18s}:  primary {a['locate_qps']:8.1f} q/s"
+                  f"   +shadow {b.get('locate_qps', 0):8.1f} q/s"
+                  f"   ({r.get('locate_qps_x', 0)}x, "
+                  f"p99 {a['locate_p99_ms']}/"
+                  f"{b.get('locate_p99_ms', 0)} ms)")
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")

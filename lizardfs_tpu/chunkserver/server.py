@@ -156,6 +156,17 @@ class ChunkServer(Daemon):
         # blocked serve threads see EPIPE instead of waiting out their
         # deadline (a ThreadPoolExecutor joins its workers at exit)
         self._native_streams: set = set()
+        # passive mirror links to NON-active configured masters (shadow
+        # read replicas): addr -> {"conn", "cs_id", "rereg_at"}. The
+        # shadow learns this server's part locations from them (volatile
+        # state the changelog cannot carry) so replica locates have
+        # locations to serve; the link carries registrations/heartbeats
+        # only, never commands. LZ_SHADOW_READS=0 disables the plane.
+        self._mirror: dict[tuple[str, int], dict] = {}
+        # full part list re-report period (seconds): wholesale refresh
+        # bounds shadow location drift (parts created by client writes
+        # are recorded master-side only, never reported incrementally)
+        self.mirror_reregister_interval = 60.0
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -191,6 +202,10 @@ class ChunkServer(Daemon):
                         )
                         await asyncio.sleep(0.2 * (attempt + 1))
         self.add_timer(self.heartbeat_interval, self._heartbeat)
+        # mirror maintenance runs on its OWN timer: a sick shadow
+        # (accepted connect, hung register — the 30 s call_ok bound)
+        # must never stall the command-plane heartbeat to the active
+        self.add_timer(self.heartbeat_interval, self._mirror_maintain)
         self.add_timer(60.0, self._test_chunks)
 
     async def start(self) -> None:
@@ -210,6 +225,10 @@ class ChunkServer(Daemon):
             self.data_server = None
         if self.master is not None:
             await self.master.close()
+        for entry in list(self._mirror.values()):
+            if entry.get("conn") is not None:
+                await entry["conn"].close()
+        self._mirror.clear()
 
     async def _connect_master(self) -> None:
         from lizardfs_tpu.proto.status import StatusError
@@ -227,6 +246,14 @@ class ChunkServer(Daemon):
                     self.master = None
         raise ConnectionError(f"no active master reachable: {last}")
 
+    def _part_report(self) -> list[m.ChunkPartInfo]:
+        return [
+            m.ChunkPartInfo(
+                chunk_id=cf.chunk_id, version=cf.version, part_id=cf.part_id
+            )
+            for cf in self.store.all_parts()
+        ]
+
     async def _connect_master_at(self, addr: tuple[str, int]) -> None:
         self.master = await RpcConnection.connect(*addr)
         for cls, handler in (
@@ -243,12 +270,7 @@ class ChunkServer(Daemon):
             m.CstomaRegister,
             addr=m.Addr(host=self.host, port=self.port),
             label=self.label,
-            chunks=[
-                m.ChunkPartInfo(
-                    chunk_id=cf.chunk_id, version=cf.version, part_id=cf.part_id
-                )
-                for cf in self.store.all_parts()
-            ],
+            chunks=self._part_report(),
             total_space=total,
             used_space=used,
             data_port=self.data_server.port if self.data_server else 0,
@@ -326,6 +348,118 @@ class ChunkServer(Daemon):
             )
         except (ConnectionError, asyncio.TimeoutError):
             pass
+
+    async def _mirror_maintain(self) -> None:
+        """Own-timer wrapper for _mirror_tick (never inline in the
+        heartbeat: mirror-plane trouble must not cost the active its
+        heartbeats)."""
+        if self.master_addr is None:
+            return
+        total, used = self.store.space()
+        await self._mirror_tick(total, used)
+
+    async def _mirror_tick(self, total: int, used: int) -> None:
+        """Maintain passive mirror links to every configured NON-active
+        master address: shadow read replicas learn this server's part
+        locations from the registration (volatile state the changelog
+        cannot carry) so their locate replies have locations to serve.
+        Mirror links carry registrations/heartbeats only — a shadow
+        never commands a chunkserver. The full part list re-reports
+        every ``mirror_reregister_interval`` seconds (wholesale
+        replacement on the shadow) so locations drift-heals; between
+        reports a lagging location set is caught by the client's
+        read-retry path, which re-locates through the primary."""
+        from lizardfs_tpu.constants import shadow_reads_enabled
+
+        if (
+            not shadow_reads_enabled()
+            or not self.master_addrs
+            or len(self.master_addrs) < 2
+        ):
+            return
+        now = asyncio.get_running_loop().time()
+        for addr in self.master_addrs:
+            if addr == self.master_addr:
+                # became (or is) the active command link: a leftover
+                # mirror entry is stale
+                entry = self._mirror.pop(addr, None)
+                if entry is not None and entry.get("conn") is not None:
+                    await entry["conn"].close()
+                continue
+            entry = self._mirror.get(addr)
+            if entry is not None and entry.get("conn") is None:
+                if now < entry["retry_at"]:
+                    continue  # negative cache: peer refused recently
+                entry = None
+            if entry is not None and entry["conn"].closed:
+                entry = None
+            async def mirror_register(c):
+                # ONE field list for initial registration and the 60 s
+                # wholesale re-report — only the connection varies
+                return await c.call_ok(
+                    m.CstomaRegister,
+                    addr=m.Addr(host=self.host, port=self.port),
+                    label=self.label,
+                    chunks=self._part_report(),
+                    total_space=total,
+                    used_space=used,
+                    data_port=(
+                        self.data_server.port if self.data_server else 0
+                    ),
+                    mirror=1,
+                    timeout=30.0,
+                )
+
+            conn = None  # a dial not yet handed to self._mirror
+            try:
+                if entry is None:
+                    # bounded dial: this runs inside the heartbeat
+                    # timer, and an unbounded connect to a blackholed
+                    # shadow would stall command-plane heartbeats to
+                    # the ACTIVE for the OS connect timeout
+                    conn = await asyncio.wait_for(
+                        RpcConnection.connect(*addr), timeout=5.0
+                    )
+                    reply = await mirror_register(conn)
+                    self._mirror[addr] = {
+                        "conn": conn, "cs_id": reply.cs_id,
+                        "rereg_at": now + self.mirror_reregister_interval,
+                    }
+                    conn = None  # owned by the entry now
+                    self.log.info(
+                        "mirror-registered with shadow %s:%d", *addr
+                    )
+                elif now >= entry["rereg_at"]:
+                    # wholesale part re-report on the SAME connection
+                    # (the shadow replaces this server's recorded set)
+                    reply = await mirror_register(entry["conn"])
+                    entry["cs_id"] = reply.cs_id
+                    entry["rereg_at"] = (
+                        now + self.mirror_reregister_interval
+                    )
+                else:
+                    await entry["conn"].call(
+                        m.CstomaHeartbeat,
+                        cs_id=entry["cs_id"],
+                        total_space=total,
+                        used_space=used,
+                        health_json="",
+                        timeout=5.0,
+                    )
+            except (OSError, ConnectionError, asyncio.TimeoutError,
+                    st.StatusError):
+                # peer down, not a shadow, or refusing (e.g. the
+                # ACTIVE master answers this addr, or its kill switch
+                # is off): drop the link and back off
+                if conn is not None:
+                    # dialed but refused before it was stored
+                    await conn.close()
+                stale = self._mirror.pop(addr, None)
+                if stale is not None and stale.get("conn") is not None:
+                    await stale["conn"].close()
+                elif entry is not None and entry.get("conn") is not None:
+                    await entry["conn"].close()
+                self._mirror[addr] = {"conn": None, "retry_at": now + 30.0}
 
     def _fold_native_trace(self) -> None:
         """Drain the native data plane's per-op trace ring into this
@@ -519,18 +653,28 @@ class ChunkServer(Daemon):
         if code == st.OK and self.master is not None:
             cf = self.store.get(msg.chunk_id, msg.part_id)
             if cf is not None:
-                await self.master.send(
-                    m.CstomaChunkNew(
-                        cs_id=self.cs_id,
-                        chunks=[
-                            m.ChunkPartInfo(
-                                chunk_id=cf.chunk_id,
-                                version=cf.version,
-                                part_id=cf.part_id,
-                            )
-                        ],
-                    )
+                new = m.CstomaChunkNew(
+                    cs_id=self.cs_id,
+                    chunks=[
+                        m.ChunkPartInfo(
+                            chunk_id=cf.chunk_id,
+                            version=cf.version,
+                            part_id=cf.part_id,
+                        )
+                    ],
                 )
+                await self.master.send(new)
+                # shadow mirrors accept the same frame: a rebuilt part
+                # becomes replica-locatable now instead of at the next
+                # wholesale re-report (best-effort; the re-report
+                # drift-heals a miss)
+                for entry in self._mirror.values():
+                    conn = entry.get("conn")
+                    if conn is not None and not conn.closed:
+                        try:
+                            await conn.send(new)
+                        except (ConnectionError, OSError, RuntimeError):
+                            pass
 
     def _replicator_encoder(self):
         """The rebuild compute backend: try the encoder auto-ladder's

@@ -247,6 +247,40 @@ class Client:
             )
             if _depth > 0 else None
         )
+        # shadow read replicas (LZ_SHADOW_READS kill switch, default on
+        # when more than one master address is configured): read-mostly
+        # metadata RPCs route to a shadow serving consistency-tokened
+        # replies; anything mutating still goes to the primary only.
+        # Monotonic reads: every reply's token (meta_version = applied
+        # changelog position) ratchets _meta_floor, and a replica reply
+        # older than the floor is retried through the primary. With the
+        # switch off (or a single address) every RPC goes to the
+        # primary exactly as before.
+        from lizardfs_tpu.constants import shadow_reads_enabled
+
+        self.shadow_reads = (
+            shadow_reads_enabled() and len(self.master_addrs) > 1
+        )
+        self._meta_floor = 0
+        self._replica: RpcConnection | None = None
+        self._replica_addr: tuple[str, int] | None = None
+        self._replica_retry_at = 0.0
+        self._replica_dialing = False
+        if self.shadow_reads:
+            self.metrics.counter(
+                "shadow_reads",
+                help="read RPCs served by a shadow replica",
+            )
+            self.metrics.counter(
+                "shadow_stale_retries",
+                help="replica replies older than the monotonic-reads "
+                     "floor, retried through the primary",
+            )
+            self.metrics.counter(
+                "shadow_fallbacks",
+                help="replica RPCs rerouted to the primary (connection "
+                     "failure or replica refusal)",
+            )
 
     def _io_group_of_caller(self) -> str:
         import os
@@ -367,6 +401,13 @@ class Client:
                 self.master = conn
                 self.current_master_addr = addr  # failover moves this
                 self.session_id = reply.session_id
+                # the primary's position at registration seeds the
+                # monotonic-reads floor: a replica must be at least
+                # this caught up before any of its replies are accepted
+                self._note_token(reply)
+                if self._replica_addr == addr:
+                    # the old replica peer is the new primary
+                    await self._drop_replica()
                 conn.on_push(m.MatoclLockGranted, self._on_lock_granted)
                 conn.on_push(
                     m.MatoclCacheInvalidate, self._on_cache_invalidate
@@ -421,6 +462,131 @@ class Client:
         except (ConnectionError, asyncio.TimeoutError):
             await self._reconnect()
             r = await self.master.call_ok(msg_cls, **fields)
+        self._note_token(r)
+        self._note_eattr(getattr(r, "attr", None))
+        return r
+
+    @staticmethod
+    def _token_of(reply) -> int:
+        """Consistency token of a reply: its trailing ``meta_version``,
+        or the nested Attr's (MatoclAttrReply carries the token on the
+        Attr tail — Attr must stay the message's terminal field)."""
+        mv = getattr(reply, "meta_version", 0)
+        if not mv:
+            mv = getattr(getattr(reply, "attr", None), "meta_version", 0)
+        return mv
+
+    def _note_token(self, reply) -> None:
+        """Ratchet the monotonic-reads floor from any tokened reply
+        (primary or replica — the floor is what the session has
+        OBSERVED, wherever it observed it)."""
+        mv = self._token_of(reply)
+        if mv > self._meta_floor:
+            self._meta_floor = mv
+
+    async def _drop_replica(self) -> None:
+        conn, self._replica = self._replica, None
+        self._replica_addr = None
+        if conn is not None:
+            await conn.close()
+
+    async def _replica_conn(self) -> "RpcConnection | None":
+        """The live replica connection, dialing one lazily. Dial
+        failures back off 5 s and the caller falls through to the
+        primary — replica trouble must never add latency beyond the one
+        failed attempt (primary-fallback contract)."""
+        conn = self._replica
+        if conn is not None and not conn.closed:
+            return conn
+        now = _time.monotonic()
+        if (
+            self._replica_dialing
+            or now < self._replica_retry_at
+            or not self.session_id
+        ):
+            return None
+        self._replica_dialing = True
+        self._replica_retry_at = now + 5.0
+        try:
+            for addr in self.master_addrs:
+                if addr == self.current_master_addr:
+                    continue
+                conn = None
+                try:
+                    # bounded dial: a blackholed shadow must cost the
+                    # caller ~2 s once per retry window, never the OS
+                    # connect timeout (primary-fallback contract)
+                    conn = await asyncio.wait_for(
+                        RpcConnection.connect(*addr), timeout=2.0
+                    )
+                    reply = await conn.call(
+                        m.CltomaRegister, session_id=self.session_id,
+                        info=self._info + "/replica",
+                        password=getattr(self, "_password", ""),
+                        replica_ok=1, timeout=5.0,
+                    )
+                    if getattr(reply, "status", 1) == st.OK:
+                        self._note_token(reply)
+                        self._replica = conn
+                        self._replica_addr = addr
+                        return conn
+                    await conn.close()
+                except (OSError, ConnectionError, asyncio.TimeoutError):
+                    if conn is not None:
+                        await conn.close()
+            return None
+        finally:
+            self._replica_dialing = False
+
+    async def _call_read(self, msg_cls, **fields):
+        """Read-mostly RPC, routed to a shadow replica when one serves.
+
+        The monotonic-reads contract: accept a replica reply only when
+        its token is >= the floor this session has observed; otherwise
+        count a stale retry and re-issue through the primary. Replica
+        connection failures and refusals (NOT_POSSIBLE — promoted
+        shadow, server-side kill switch, non-servable op) fall through
+        to the primary too."""
+        if not self.shadow_reads:
+            return await self._call(msg_cls, **fields)
+        conn = await self._replica_conn()
+        if conn is None:
+            return await self._call(msg_cls, **fields)
+        # same trace attachment as _call: a replica-served read must
+        # not vanish from request traces (the serving-master span is
+        # exactly what replica-latency debugging needs)
+        if msg_cls.FIELDS and msg_cls.FIELDS[-1][0] == "trace_id":
+            tid = tracing.current_trace_id()
+            if tid:
+                fields.setdefault("trace_id", tid)
+        try:
+            r = await conn.call(msg_cls, timeout=10.0, **fields)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            await self._drop_replica()
+            self.metrics.counter("shadow_fallbacks").inc()
+            return await self._call(msg_cls, **fields)
+        status = getattr(r, "status", 0)
+        if status == st.NOT_POSSIBLE:
+            # refusal (promoted shadow, cut follow link, server-side
+            # kill switch): drop the link and back off — keeping it
+            # would pay a wasted round trip on EVERY read for as long
+            # as the condition lasts
+            await self._drop_replica()
+            self._replica_retry_at = _time.monotonic() + 5.0
+            self.metrics.counter("shadow_fallbacks").inc()
+            return await self._call(msg_cls, **fields)
+        if self._token_of(r) < self._meta_floor:
+            self.metrics.counter("shadow_stale_retries").inc()
+            return await self._call(msg_cls, **fields)
+        self._note_token(r)
+        self.metrics.counter("shadow_reads").inc()
+        # record ONLY on the replica-served path: every fallback above
+        # re-enters _call, which records — one logical op must count
+        # once in op_counters/oplog wherever it was served
+        self._record(msg_cls.__name__)
+        r._replica_served = True  # read-path guards key off this
+        if status != st.OK:
+            raise st.StatusError(status, msg_cls.__name__)
         self._note_eattr(getattr(r, "attr", None))
         return r
 
@@ -513,6 +679,7 @@ class Client:
         if self._limits_probe_task is not None:
             self._limits_probe_task.cancel()
             self._limits_probe_task = None
+        await self._drop_replica()
         if self.master is not None:
             try:
                 # clean goodbye: the master releases our locks now
@@ -527,7 +694,7 @@ class Client:
 
     async def lookup(self, parent: int, name: str, uid: int | None = None,
                      gids: list[int] | None = None) -> m.Attr:
-        r = await self._call(
+        r = await self._call_read(
             m.CltomaLookup, parent=parent, name=name, **self._ident(uid, gids)
         )
         return r.attr
@@ -560,7 +727,7 @@ class Client:
             pass
 
     async def getattr(self, inode: int) -> m.Attr:
-        r = await self._call(m.CltomaGetattr, inode=inode)
+        r = await self._call_read(m.CltomaGetattr, inode=inode)
         return r.attr
 
     async def tape_info(self, inode: int) -> dict:
@@ -595,7 +762,7 @@ class Client:
 
     async def readdir(self, inode: int, uid: int | None = None,
                       gids: list[int] | None = None) -> list[m.DirEntry]:
-        r = await self._call(
+        r = await self._call_read(
             m.CltomaReaddir, inode=inode, **self._ident(uid, gids)
         )
         return r.entries
@@ -635,7 +802,7 @@ class Client:
         return r.attr
 
     async def readlink(self, inode: int) -> str:
-        r = await self._call(m.CltomaReadlink, inode=inode)
+        r = await self._call_read(m.CltomaReadlink, inode=inode)
         return r.target
 
     async def link(self, inode: int, parent: int, name: str,
@@ -747,7 +914,7 @@ class Client:
 
     async def chunk_info(self, inode: int, chunk_index: int) -> m.MatoclReadChunk:
         """Chunk id/version/locations at a file position (fileinfo)."""
-        return await self._call(
+        return await self._call_read(
             m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
             **self._ident(None, None),
         )
@@ -873,7 +1040,7 @@ class Client:
         self, inode: int, uid: int, gids: list[int], mask: int
     ) -> bool:
         try:
-            await self._call(
+            await self._call_read(
                 m.CltomaAccess, inode=inode, uid=uid, gids=gids, mask=mask
             )
             return True
@@ -926,7 +1093,10 @@ class Client:
     async def _on_cache_invalidate(self, push) -> None:
         """Master push: another session mutated this file — drop its
         cached blocks (reference: matoclserv.cc data-cache
-        invalidation to mounts)."""
+        invalidation to mounts). The push carries the mutation's
+        changelog position: raising the floor here means the NEXT read
+        can't be served pre-mutation by a lagging replica."""
+        self._note_token(push)
         ci = None if push.chunk_index == 0xFFFFFFFF else push.chunk_index
         self.cache.invalidate(push.inode, ci)
         self._record("cache_invalidate", inode=push.inode)
@@ -2236,11 +2406,32 @@ class Client:
                     )
             if loc is None:
                 token = self._locate_token(inode)
-                loc = await self._call(
+                # first attempt may serve the locate from a replica;
+                # RETRY locates go to the primary — a failed read may
+                # mean the replica's mirrored location set lags (e.g.
+                # empty for a chunk just written), and the primary's is
+                # authoritative
+                locate = self._call_read if attempt == 0 else self._call
+                loc = await locate(
                     m.CltomaReadChunk, inode=inode, chunk_index=chunk_index,
                     **self._ident(None, None),
                 )
                 fresh = True
+                if (
+                    loc.chunk_id and not loc.locations
+                    and getattr(loc, "_replica_served", False)
+                ):
+                    # a real chunk with no locations FROM A REPLICA: its
+                    # mirrored location set lags (parts registered with
+                    # the primary only so far). Re-locate through the
+                    # primary instead of failing the plan. A primary
+                    # answer with no locations is authoritative — never
+                    # re-ask (that would double locate load during a
+                    # chunkserver outage).
+                    loc = await self._call(
+                        m.CltomaReadChunk, inode=inode,
+                        chunk_index=chunk_index, **self._ident(None, None),
+                    )
                 if self._locate_token(inode) == token:
                     # refuse stores that raced an invalidation: the
                     # reply may predate the mutation that bumped epoch

@@ -45,6 +45,22 @@ XOR_MAX_LEVEL = 9
 # as the owner for permission checks; NOCACHE forbids client-side data
 # caching of the inode's blocks; NOENTRYCACHE forbids caching its
 # lookup/attr entries (dentry + NFS attr/access caches).
+def shadow_reads_enabled() -> bool:
+    """LZ_SHADOW_READS kill switch (default ON) for the shadow
+    read-replica plane. Consulted by all three roles: the master
+    (shadows serve tokened reads, accept passive chunkserver mirrors),
+    the chunkserver (mirror registrations to shadow addresses), and the
+    client (routing read RPCs to a replica). Lives here because
+    constants is the one dependency-free module every role already
+    imports. All four documented off spellings are honored, spelling-
+    parity with the other data-plane switches."""
+    import os
+
+    return os.environ.get("LZ_SHADOW_READS", "1").lower() not in (
+        "0", "off", "false", "no"
+    )
+
+
 EATTR_NOOWNER = 0x01
 EATTR_NOCACHE = 0x02
 EATTR_NOENTRYCACHE = 0x04

@@ -32,6 +32,10 @@ class ChunkServerInfo:
     used_space: int = 0
     connected: bool = True
     data_port: int = 0  # native data-plane port (0 = use control port)
+    # True while the entry is fed by a PASSIVE mirror link (shadow
+    # side): locations are servable but no command link exists — admin
+    # tooling must not mistake a mirror-fed shadow for the active
+    mirror: bool = False
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -95,6 +99,12 @@ class ChunkRegistry:
     def __init__(self):
         self.chunks: dict[int, ChunkInfo] = {}
         self.servers: dict[int, ChunkServerInfo] = {}
+        # (host, port) -> ChunkServerInfo: registration at 10k-server
+        # scale must not scan the whole server table per register (a
+        # storm of N registrations was O(N^2); test_scalability pins
+        # the bound). Maintained by register_server only — servers are
+        # never removed, only marked disconnected.
+        self._server_by_addr: dict[tuple[str, int], ChunkServerInfo] = {}
         self.next_chunk_id = 1
         self.next_cs_id = 1
         # endangered queue served before routine work (chunks.cc:2562):
@@ -123,6 +133,25 @@ class ChunkRegistry:
         # full cycle instead of being rebuilt every tick
         self._scan_ids: list[int] = []
         self._scan_idx = 0
+        # chunk-danger aggregates maintained BY the routine walk: each
+        # full cursor cycle counts endangered/lost as a side effect of
+        # the evaluations it already performs, and publishes the totals
+        # at wrap — health/stats probes read the published aggregate
+        # instead of walking the whole table (the O(all-chunks) sweeps
+        # at server.py cluster_health/chunks-health were the master's
+        # biggest per-probe stall at 1M chunks).
+        # (endangered, lost, chunks_at_publish); scanned_monotonic
+        # counts total evaluations so tests can assert progress.
+        self.danger_counts: tuple[int, int, int] = (0, 0, 0)
+        self._cycle_endangered = 0
+        self._cycle_lost = 0
+        self.danger_scanned_total = 0
+        # bootstrap cursor: bounds time-to-first-publish after a
+        # (re)start (see danger_bootstrap)
+        self._boot_ids: list[int] = []
+        self._boot_idx = 0
+        self._boot_endangered = 0
+        self._boot_lost = 0
         self._rebalance_ids: list[int] = []
         # chunks released from metadata whose on-disk parts still need
         # deleting on chunkservers (drained by the master's health tick;
@@ -137,21 +166,24 @@ class ChunkRegistry:
         self, host: str, port: int, label: str, total: int, used: int,
         data_port: int = 0,
     ) -> ChunkServerInfo:
-        # reconnection of the same host:port replaces the old entry
-        for srv in self.servers.values():
-            if (srv.host, srv.port) == (host, port):
-                srv.connected = True
-                srv.label = label
-                srv.total_space = total
-                srv.used_space = used
-                srv.data_port = data_port
-                return srv
+        # reconnection of the same host:port replaces the old entry —
+        # O(1) via the addr index (a 10k-server registration storm was
+        # O(N^2) when this scanned the table)
+        srv = self._server_by_addr.get((host, port))
+        if srv is not None:
+            srv.connected = True
+            srv.label = label
+            srv.total_space = total
+            srv.used_space = used
+            srv.data_port = data_port
+            return srv
         cs = ChunkServerInfo(
             self.next_cs_id, host, port, label, total, used,
             data_port=data_port,
         )
         self.next_cs_id += 1
         self.servers[cs.cs_id] = cs
+        self._server_by_addr[(host, port)] = cs
         return cs
 
     def server_disconnected(self, cs_id: int) -> list[int]:
@@ -164,13 +196,7 @@ class ChunkRegistry:
         srv = self.servers.get(cs_id)
         if srv is not None:
             srv.connected = False
-        affected = []
-        append = affected.append
-        for (chunk_id, part), chunk in self._server_parts.pop(
-            cs_id, {}
-        ).items():
-            chunk.parts.discard((cs_id, part))
-            append(chunk_id)
+        affected = self.reset_server_parts(cs_id)
         # a dead server's stale-version parts are gone with it
         for cid in list(self.stale_versions):
             entries = self.stale_versions[cid]
@@ -178,6 +204,21 @@ class ChunkRegistry:
                 del entries[key]
             if not entries:
                 del self.stale_versions[cid]
+        return affected
+
+    def reset_server_parts(self, cs_id: int) -> list[int]:
+        """Drop every part recorded for ``cs_id`` WITHOUT marking it
+        disconnected — a mirror re-registration (shadow side) replaces
+        the server's part set wholesale with the fresh report. Returns
+        the affected chunk ids (the one part-drop loop both this and
+        server_disconnected share)."""
+        affected = []
+        append = affected.append
+        for (chunk_id, part), chunk in self._server_parts.pop(
+            cs_id, {}
+        ).items():
+            chunk.parts.discard((cs_id, part))
+            append(chunk_id)
         return affected
 
     def connected_servers(self) -> list[ChunkServerInfo]:
@@ -420,8 +461,18 @@ class ChunkRegistry:
     def _scan_batch(self, n: int) -> list[int]:
         """Next ``n`` chunk ids from the persistent cursor; the id list
         re-snapshots once per full cycle (O(all chunks) amortized over
-        a whole sweep, never per tick)."""
+        a whole sweep, never per tick). A wrap publishes the finished
+        cycle's danger aggregate."""
         if self._scan_idx >= len(self._scan_ids):
+            if self._scan_ids or not self.chunks:
+                # a completed cycle (or an empty table) defines the
+                # aggregate; a fresh registry's first wrap publishes 0s
+                self.danger_counts = (
+                    self._cycle_endangered, self._cycle_lost,
+                    len(self._scan_ids),
+                )
+            self._cycle_endangered = 0
+            self._cycle_lost = 0
             self._scan_ids = list(self.chunks.keys())
             self._scan_idx = 0
             if not self._scan_ids:
@@ -430,8 +481,60 @@ class ChunkRegistry:
         self._scan_idx += len(batch)
         return batch
 
-    def _chunk_work(self, chunk: ChunkInfo, out: list) -> None:
-        state = self.evaluate(chunk)
+    def danger_bootstrap(self, budget: int = 4096) -> None:
+        """Bound time-to-first-publish of the danger aggregate.
+
+        The routine walk publishes at cycle WRAP — after a master
+        (re)start with 1M chunks that is a full sweep at
+        SCAN_BUDGET/tick (~an hour), during which /health would report
+        ``lost: 0`` for a table full of unreadable chunks. Until the
+        first publish, each health tick also advances this count-only
+        cursor (``budget`` evaluations, a few ms); whichever cursor
+        completes first publishes. No-op once danger_counts carries a
+        published cycle."""
+        if self.danger_counts[2] or not self.chunks:
+            if self._boot_ids:
+                # routine walk published first: free the snapshot (1M
+                # ids is ~40 MB — must not pin for the registry's life)
+                self._boot_ids = []
+                self._boot_idx = 0
+            return
+        if not self._boot_ids:
+            self._boot_ids = list(self.chunks.keys())
+            self._boot_idx = 0
+            self._boot_endangered = 0
+            self._boot_lost = 0
+        end = min(self._boot_idx + budget, len(self._boot_ids))
+        for cid in self._boot_ids[self._boot_idx:end]:
+            chunk = self.chunks.get(cid)
+            if chunk is None:
+                continue
+            state = self.evaluate(chunk)
+            self.danger_scanned_total += 1
+            if not state.is_readable:
+                self._boot_lost += 1
+            elif state.is_endangered or state.missing_parts:
+                self._boot_endangered += 1
+        self._boot_idx = end
+        if end >= len(self._boot_ids):
+            if not self.danger_counts[2]:
+                self.danger_counts = (
+                    self._boot_endangered, self._boot_lost,
+                    len(self._boot_ids),
+                )
+            self._boot_ids = []
+
+    def _count_danger(self, state: RedundancyState) -> None:
+        self.danger_scanned_total += 1
+        if not state.is_readable:
+            self._cycle_lost += 1
+        elif state.is_endangered or state.missing_parts:
+            self._cycle_endangered += 1
+
+    def _chunk_work(self, chunk: ChunkInfo, out: list,
+                    state: RedundancyState | None = None) -> None:
+        if state is None:
+            state = self.evaluate(chunk)
         for p in state.missing_parts:
             out.append(("replicate", chunk, p))
         for cs_id, p in state.redundant:
@@ -480,7 +583,12 @@ class ChunkRegistry:
             chunk = self.chunks.get(cid)
             if chunk is None:
                 continue
-            self._chunk_work(chunk, out)
+            state = self.evaluate(chunk)
+            # danger aggregate rides the evaluation the walk already
+            # pays for (rewound chunks are re-counted next tick, never
+            # skipped: the cursor only rewinds over UNvisited ids)
+            self._count_danger(state)
+            self._chunk_work(chunk, out, state)
         if not out:
             move = self.rebalance_candidate()
             if move is not None:

@@ -465,6 +465,64 @@ class MetadataStore:
             return self._h("misc", self.next_session)
         raise ValueError(f"unknown entity kind {kind!r}")
 
+    def _op_synth_populate(self, op):
+        """Storm-bench bulk load: deterministically create ``count``
+        synthetic file nodes (each with one standard chunk whose parts
+        sit on synthetic registry servers) in ONE changelog op, so an
+        active master and its shadows converge on the same million-inode
+        namespace without a million changelog lines.
+
+        Digest discipline: this op maintains the incremental digest
+        itself (``_touched`` would be O(count) twice; here each fresh
+        entity hashes exactly once, plus pre/post for the parent and the
+        uid/gid-0 usage rows), so shadow divergence detection still
+        holds — test_scalability pins digest == full_digest after it."""
+        parent = op["parent"]
+        count = op["count"]
+        base_inode = op["base_inode"]
+        base_chunk = op["base_chunk"]
+        n_servers = op.get("servers", 0)
+        copies = op.get("copies", 1)
+        length = op.get("length", 65536)
+        ts = op["ts"]
+        prefix = op.get("prefix", "sf")
+        d = 0
+        pre_keys = [("node", parent), ("quota", "user", 0),
+                    ("quota", "group", 0)]
+        for key in pre_keys:
+            d ^= self._entity_hash(key)
+        servers = [
+            self.registry.register_server(
+                "synth", 1 + j, "_", 1 << 40, 0
+            )
+            for j in range(n_servers)
+        ]
+        for i in range(count):
+            inode = base_inode + i
+            name = f"{prefix}{inode}"
+            self.fs.apply_mknode(
+                parent, name, inode, 1, 0o644, 0, 0, ts, 1, 0
+            )
+            node = self.fs.nodes[inode]
+            cid = base_chunk + i
+            node.length = length
+            node.chunks = [cid]
+            self.fs._add_stats(parent, 0, length)
+            chunk = self.registry.create_chunk(
+                0, chunk_id=cid, version=1, copies=copies
+            )
+            if servers:
+                for r in range(copies):
+                    srv = servers[(i + r) % len(servers)]
+                    self.registry.record_part(chunk, srv.cs_id, 0)
+            d ^= self._entity_hash(("node", inode))
+            d ^= self._entity_hash(("edge", parent, name))
+            d ^= self._entity_hash(("chunk", cid))
+        self.quotas.charge(0, 0, count, count * length)
+        for key in pre_keys:
+            d ^= self._entity_hash(key)
+        self._digest ^= d
+
     def _op_tape_copy(self, op):
         copies = self.tape_copies.setdefault(op["inode"], [])
         # one copy per tape-server label; a fresh copy replaces a stale

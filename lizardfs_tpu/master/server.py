@@ -62,6 +62,9 @@ def _fork_safe() -> bool:
 
 CHUNK_LOCK_SECONDS = 30.0
 
+# LZ_SHADOW_READS kill switch (shared across roles — constants.py)
+from lizardfs_tpu.constants import shadow_reads_enabled  # noqa: E402
+
 
 class _CsLink:
     """Server-side link to one registered chunkserver: lets the master
@@ -209,6 +212,22 @@ class MasterServer(Daemon):
         self.personality = personality
         self.active_addr = active_addr
         self._shadow_task: asyncio.Task | None = None
+        # shadow replication-lag tracking (active side): connected
+        # shadows ack their applied changelog position (MltomaAck);
+        # keyed by the stream writer so a dead link's entry dies with
+        # its loop. Surfaced in cluster_health + the shadow_lag gauge.
+        self.shadow_status: dict[int, dict] = {}
+        # shadow side: True while the changelog follow link is up —
+        # replica reads are refused without it (a cut-off shadow would
+        # otherwise serve unbounded staleness behind a valid token)
+        self._follow_connected = False
+        self._last_shadow_ack = 0.0
+        # passive chunkserver mirror connections (shadow side): closed
+        # on promotion so chunkservers re-register command-capable
+        self._mirror_cs_writers: set[asyncio.StreamWriter] = set()
+        # cs_id -> the writer whose mirror loop currently owns that
+        # server's registration (supersession guard for teardown)
+        self._mirror_cs_owner: dict[int, asyncio.StreamWriter] = {}
         # config file paths for SIGHUP / admin `reload` (cfg_reload
         # analog): keys "goals", "exports", "topology", "iolimits"
         self.config_paths = dict(config_paths or {})
@@ -329,6 +348,10 @@ class MasterServer(Daemon):
             self.spawn(self._run_timer(
                 self.shadow_verify_interval, self._shadow_verify_checksum
             ))
+            # periodic applied-position ack: an IDLE shadow at tip must
+            # keep reporting (lag telemetry ages out otherwise — acks
+            # also ride every applied line, throttled)
+            self.spawn(self._run_timer(2.0, self._shadow_ack_tick))
 
     @property
     def is_active(self) -> bool:
@@ -561,10 +584,50 @@ class MasterServer(Daemon):
 
     # --- client service (matoclserv analog) -----------------------------------------
 
+    def _stamp_token(self, reply) -> None:
+        """Stamp the consistency token (applied changelog position) on
+        any reply carrying a trailing ``meta_version`` field — directly,
+        or on its nested Attr (MatoclAttrReply's token rides the Attr
+        tail). Read AFTER the op was handled, so a mutation's ack
+        carries the version that includes it (read-your-writes through
+        replicas)."""
+        if reply is None:
+            return
+        target = reply if hasattr(reply, "meta_version") else getattr(
+            reply, "attr", None
+        )
+        if target is not None and hasattr(target, "meta_version") \
+                and not target.meta_version:
+            target.meta_version = self.changelog.version
+
     async def _client_loop(self, reader, writer, first: m.CltomaRegister) -> None:
         if not self.is_active:
+            if (
+                getattr(first, "replica_ok", 0)
+                and first.session_id
+                and self.personality == "shadow"
+                and shadow_reads_enabled()
+            ):
+                await self._replica_loop(reader, writer, first)
+                return
             # clients cycle through master addresses until they find the
             # active one (modern replacement for the floating-IP dance)
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, session_id=0
+                ),
+            )
+            return
+        if getattr(first, "replica_ok", 0):
+            # replica-mode registrations must never become command
+            # sessions (mirror of the mirror=1 guard on the cs side): a
+            # promoted shadow would otherwise adopt a client's replica
+            # REDIAL as the session's push link — superseding the real
+            # primary writer, whose connection has the push handlers —
+            # and lock-grant/invalidation pushes would be lost. Refuse;
+            # the client's replica dial treats non-OK as "no replica
+            # here" and its primary link is unaffected.
             await framing.send_message(
                 writer,
                 m.MatoclRegister(
@@ -582,21 +645,15 @@ class MasterServer(Daemon):
                 ),
             )
             return
-        root_inode = fsmod.ROOT_INODE
-        if rule.path not in ("/", ""):
-            try:
-                node = self.meta.fs.node(fsmod.ROOT_INODE)
-                for comp in rule.path.strip("/").split("/"):
-                    node = self.meta.fs.lookup(node.inode, comp)
-                root_inode = node.inode
-            except fsmod.FsError:
-                await framing.send_message(
-                    writer,
-                    m.MatoclRegister(
-                        req_id=first.req_id, status=st.ENOENT, session_id=0
-                    ),
-                )
-                return
+        root_inode = self._resolve_export_root(rule)
+        if root_inode is None:
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.ENOENT, session_id=0
+                ),
+            )
+            return
         session_id = first.session_id or self.meta.next_session
         # replicate the allocation: a promoted shadow must never re-issue
         # an id whose locks are still held (and whose disconnect would
@@ -612,7 +669,12 @@ class MasterServer(Daemon):
         self._lock_grace.pop(session_id, None)
         await framing.send_message(
             writer,
-            m.MatoclRegister(req_id=first.req_id, status=st.OK, session_id=session_id),
+            m.MatoclRegister(
+                req_id=first.req_id, status=st.OK, session_id=session_id,
+                # seeds the client's monotonic-reads floor: a replica
+                # must be at least this caught up to serve this client
+                meta_version=self.changelog.version,
+            ),
         )
         try:
             while True:
@@ -649,6 +711,7 @@ class MasterServer(Daemon):
                         name=type(msg).__name__,
                     )
                 if reply is not None:
+                    self._stamp_token(reply)
                     await framing.send_message(writer, reply)
         finally:
             # a reconnected client may have superseded this connection
@@ -697,6 +760,143 @@ class MasterServer(Daemon):
                 if clean:
                     # open handles die with a clean goodbye
                     self._release_session_opens(session_id)
+
+    # read-mostly RPCs a shadow replica serves; everything else gets
+    # NOT_POSSIBLE so the client routes it to the primary. Mutations are
+    # structurally impossible here: none of these handlers commit.
+    # ONLY ops whose reply types carry a meta_version token belong here
+    # (MatoclAttrReply/Readdir/Readlink/StatusReply/ReadChunk): a
+    # tokenless reply can never pass the client's monotonic-reads floor
+    # and would count a spurious stale retry on every call.
+    _REPLICA_SERVABLE = (
+        "CltomaLookup", "CltomaGetattr", "CltomaReaddir", "CltomaReadlink",
+        "CltomaAccess", "CltomaReadChunk",
+    )
+
+    def _resolve_export_root(self, rule) -> "int | None":
+        """Export subtree root inode for ``rule``, or None when the
+        path does not (yet) resolve. ONE implementation shared by the
+        primary client loop and the shadow replica loop — their views
+        of the export subtree must never diverge."""
+        if rule.path in ("/", ""):
+            return fsmod.ROOT_INODE
+        try:
+            node = self.meta.fs.node(fsmod.ROOT_INODE)
+            for comp in rule.path.strip("/").split("/"):
+                node = self.meta.fs.lookup(node.inode, comp)
+            return node.inode
+        except fsmod.FsError:
+            return None
+
+    def _replica_ready(self) -> bool:
+        """A shadow serves replica reads only while its changelog follow
+        link is live — a partitioned shadow would otherwise serve
+        unbounded staleness behind a formally valid token."""
+        return (
+            self.personality == "shadow"
+            and self._follow_connected
+            and shadow_reads_enabled()
+        )
+
+    async def _replica_loop(
+        self, reader, writer, first: m.CltomaRegister
+    ) -> None:
+        """Shadow-side client service: consistency-tokened read replica.
+
+        The session id was issued (and committed) by the primary — the
+        shadow accepts it without a commit of its own (shadows never
+        write the changelog) and serves ONLY _REPLICA_SERVABLE ops, each
+        reply stamped with the applied changelog position. The client
+        enforces monotonic reads against that token and retries through
+        the primary on staleness (client/client.py _call_read)."""
+        peer = writer.get_extra_info("peername") or ("127.0.0.1", 0)
+        rule = self.exports.match(peer[0], getattr(first, "password", ""))
+        if rule is None or not self._replica_ready():
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id,
+                    status=st.EACCES if rule is None else st.NOT_POSSIBLE,
+                    session_id=0,
+                ),
+            )
+            return
+        root_inode = self._resolve_export_root(rule)
+        if root_inode is None:
+            # the exported subtree may not have replicated yet —
+            # refuse; the client stays primary-only and retries the
+            # replica link later
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.ENOENT, session_id=0
+                ),
+            )
+            return
+        session_id = first.session_id
+        entry = {
+            "info": first.info, "connected": True, "ip": peer[0],
+            "readonly": True, "maproot": rule.maproot, "root": root_inode,
+            "replica": True,
+        }
+        self.sessions[session_id] = entry
+        await framing.send_message(
+            writer,
+            m.MatoclRegister(
+                req_id=first.req_id, status=st.OK, session_id=session_id,
+                meta_version=self.changelog.version,
+            ),
+        )
+        served = self.metrics.counter(
+            "shadow_reads",
+            help="read RPCs served by this shadow in replica mode",
+        )
+        try:
+            while True:
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if isinstance(msg, m.CltomaGoodbye):
+                    reply = m.MatoclStatusReply(
+                        req_id=msg.req_id, status=st.OK
+                    )
+                elif (
+                    type(msg).__name__ not in self._REPLICA_SERVABLE
+                    or not self._replica_ready()
+                ):
+                    # promoted mid-session, kill switch flipped, or an
+                    # op outside the allowlist: the client reroutes to
+                    # the primary (its own conn fails over if WE are
+                    # the new primary)
+                    reply = self._error_reply(msg, st.NOT_POSSIBLE)
+                else:
+                    t0 = time.perf_counter()
+                    try:
+                        reply = await self._handle_client(msg, session_id)
+                        served.inc()
+                    except fsmod.FsError as e:
+                        reply = self._error_reply(msg, e.code)
+                    except Exception:
+                        self.log.exception(
+                            "replica op %s failed", type(msg).__name__
+                        )
+                        reply = self._error_reply(msg, st.EIO)
+                    self.metrics.timing(type(msg).__name__).record(
+                        time.perf_counter() - t0
+                    )
+                if reply is not None:
+                    self._stamp_token(reply)
+                    await framing.send_message(writer, reply)
+        finally:
+            # supersession guard (mirror of _client_loop's `is writer`
+            # check): a half-open old replica connection must not
+            # delete the session entry a REDIALED replica loop (or a
+            # post-promotion command registration) installed for the
+            # same id — ops running against a missing entry would skip
+            # the export-subtree remap entirely
+            if self.sessions.get(session_id) is entry:
+                del self.sessions[session_id]
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -975,12 +1175,22 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if isinstance(msg, m.CltomaStatFs):
-            servers = self.meta.registry.connected_servers()
-            total = sum(s.total_space for s in servers)
-            avail = sum(s.free_space for s in servers)
+            # the space sum is O(servers) — memoize briefly so a statfs
+            # storm against a 10k-chunkserver master stays O(1) per call
+            # (space figures move at heartbeat pace anyway)
+            mono = time.monotonic()
+            cached = getattr(self, "_statfs_cache", None)
+            if cached is None or mono - cached[0] > 2.0:
+                servers = self.meta.registry.connected_servers()
+                cached = (
+                    mono,
+                    sum(s.total_space for s in servers),
+                    sum(s.free_space for s in servers),
+                )
+                self._statfs_cache = cached
             return m.MatoclStatFsReply(
-                req_id=msg.req_id, status=st.OK, total_space=total,
-                avail_space=avail, inodes=len(fs.nodes),
+                req_id=msg.req_id, status=st.OK, total_space=cached[1],
+                avail_space=cached[2], inodes=len(fs.nodes),
             )
         if isinstance(msg, m.CltomaMkdir):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
@@ -1710,7 +1920,11 @@ class MasterServer(Daemon):
                 framing.write_message(
                     w,
                     m.MatoclCacheInvalidate(
-                        inode=inode, chunk_index=chunk_index
+                        inode=inode, chunk_index=chunk_index,
+                        # raises the watcher's monotonic-reads floor so
+                        # its next read can't be served pre-mutation by
+                        # a lagging replica
+                        meta_version=self.changelog.version,
                     ),
                 )
             except (ConnectionError, RuntimeError):
@@ -2034,8 +2248,56 @@ class MasterServer(Daemon):
 
     # --- chunkserver service (matocsserv analog) --------------------------------------
 
+    # registration ingest slice: a 10k-server storm piles up megapart
+    # reports; apply them in slices with yield points so client service
+    # keeps running between slices (stall-watchdog pinned in the storm
+    # test)
+    REGISTER_INGEST_SLICE = 4096
+
+    async def _ingest_parts(
+        self, cs_id: int, infos, collect_stale: bool
+    ) -> list:
+        """Apply a registration's part report in slices, yielding the
+        event loop between slices (chunked apply — one 1M-part report
+        must not stall every other connection for its whole walk)."""
+        stale = []
+        registry = self.meta.registry
+        for i, info in enumerate(infos):
+            if not registry.add_part(
+                info.chunk_id, cs_id, info.part_id, info.version
+            ):
+                if collect_stale:
+                    stale.append(info)
+            if (i + 1) % self.REGISTER_INGEST_SLICE == 0:
+                await asyncio.sleep(0)
+        return stale
+
     async def _cs_loop(self, reader, writer, first: m.CstomaRegister) -> None:
         if not self.is_active:
+            if (
+                self.personality == "shadow"
+                and shadow_reads_enabled()
+                and getattr(first, "mirror", 0)
+            ):
+                # passive mirror registration: the shadow learns part
+                # locations (volatile state the changelog cannot carry)
+                # so replica locates have locations to serve; it never
+                # commands the chunkserver. Non-mirror registrations
+                # still get NOT_POSSIBLE — the chunkserver's command
+                # link must keep cycling until it finds the active.
+                await self._mirror_cs_loop(reader, writer, first)
+                return
+            await framing.send_message(
+                writer,
+                m.MatocsRegisterReply(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0
+                ),
+            )
+            return
+        if getattr(first, "mirror", 0):
+            # a mirror link never carries commands; the ACTIVE must not
+            # adopt one as a command link (its pushes would be dropped
+            # by the peer's pump) — refuse so the chunkserver backs off
             await framing.send_message(
                 writer,
                 m.MatocsRegisterReply(
@@ -2049,14 +2311,13 @@ class MasterServer(Daemon):
             first.total_space, first.used_space,
             data_port=getattr(first, "data_port", 0),
         )
+        srv.mirror = False  # command link (a promoted shadow's entry
+        # for this addr may still carry the mirror flag)
         link.cs_id = srv.cs_id
         self.cs_links[srv.cs_id] = link
-        stale: list[m.ChunkPartInfo] = []
-        for info in first.chunks:
-            if not self.meta.registry.add_part(
-                info.chunk_id, srv.cs_id, info.part_id, info.version
-            ):
-                stale.append(info)
+        stale: list[m.ChunkPartInfo] = await self._ingest_parts(
+            srv.cs_id, first.chunks, collect_stale=True
+        )
         await framing.send_message(
             writer,
             m.MatocsRegisterReply(req_id=first.req_id, status=st.OK, cs_id=srv.cs_id),
@@ -2119,19 +2380,122 @@ class MasterServer(Daemon):
                             info.chunk_id, srv.cs_id, info.part_id, info.version
                         )
         finally:
-            self.cs_links.pop(srv.cs_id, None)
-            # drop the health snapshot with the link: a dead server's
-            # frozen burn/breach figures must not haunt the rollup (a
-            # reconnect re-registers and heartbeats fresh state)
-            self.cs_health.pop(srv.cs_id, None)
             link.fail_all()
-            affected = self.meta.registry.server_disconnected(srv.cs_id)
-            for cid in affected:
-                self.meta.registry.mark_endangered(cid)
-            self.log.info(
-                "chunkserver %d disconnected (%d chunks affected)",
-                srv.cs_id, len(affected),
+            # supersession guard: a quick reconnect registers the same
+            # cs_id (addr index) and its sliced ingest YIELDS — this
+            # old connection's teardown must not tear down the live
+            # replacement's registration mid-ingest
+            if self.cs_links.get(srv.cs_id) is link:
+                self.cs_links.pop(srv.cs_id, None)
+                # drop the health snapshot with the link: a dead
+                # server's frozen burn/breach figures must not haunt
+                # the rollup (a reconnect re-registers and heartbeats
+                # fresh state)
+                self.cs_health.pop(srv.cs_id, None)
+                affected = self.meta.registry.server_disconnected(srv.cs_id)
+                for cid in affected:
+                    self.meta.registry.mark_endangered(cid)
+                self.log.info(
+                    "chunkserver %d disconnected (%d chunks affected)",
+                    srv.cs_id, len(affected),
+                )
+
+    async def _mirror_cs_loop(
+        self, reader, writer, first: m.CstomaRegister
+    ) -> None:
+        """Shadow-side chunkserver mirror: accept the registration's
+        part report (and follow-up heartbeats / gain-loss reports) into
+        THIS master's registry so replica locates can serve locations —
+        but never send a command (stale parts are the ACTIVE master's to
+        reclaim; a shadow deleting parts would be catastrophic).
+        Chunkservers re-send their full part list periodically on the
+        same connection; each re-registration replaces the server's
+        recorded part set wholesale (drift between reports self-heals).
+        Closed on promotion so the chunkserver re-registers over a
+        command-capable link.
+
+        ``self.meta.registry`` is re-read at every use: a shadow image
+        re-download REPLACES the registry object (load_sections), and a
+        captured reference would orphan every live mirror link onto the
+        old table while _ingest_parts wrote the new one."""
+        self._mirror_cs_writers.add(writer)
+
+        async def ingest_registration(msg: m.CstomaRegister):
+            registry = self.meta.registry
+            srv = registry.register_server(
+                msg.addr.host, msg.addr.port, msg.label,
+                msg.total_space, msg.used_space,
+                data_port=getattr(msg, "data_port", 0),
             )
+            srv.mirror = True  # passive location feed, not a command link
+            # supersession marker (same race as _cs_loop's `is link`
+            # guard): a re-dialed mirror link registers the same cs_id
+            # while the half-open old loop lingers in read_message —
+            # the old loop's teardown must not drop the new link's parts
+            self._mirror_cs_owner[srv.cs_id] = writer
+            registry.reset_server_parts(srv.cs_id)
+            await self._ingest_parts(srv.cs_id, msg.chunks,
+                                     collect_stale=False)
+            await framing.send_message(
+                writer,
+                m.MatocsRegisterReply(
+                    req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                ),
+            )
+            return srv
+
+        srv = None
+        try:
+            srv = await ingest_registration(first)
+            self.log.info(
+                "chunkserver mirror-registered (%s:%d, %d parts)",
+                srv.host, srv.port, len(first.chunks),
+            )
+            while self.personality == "shadow":
+                try:
+                    msg = await framing.read_message(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if not self.personality == "shadow":
+                    break
+                if isinstance(msg, m.CstomaRegister):
+                    srv = await ingest_registration(msg)
+                elif isinstance(msg, m.CstomaHeartbeat):
+                    srv.total_space = msg.total_space
+                    srv.used_space = msg.used_space
+                    await framing.send_message(
+                        writer, m.MatocsRegisterReply(
+                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                        )
+                    )
+                elif isinstance(msg, (m.CstomaChunkDamaged, m.CstomaChunkLost)):
+                    for info in msg.chunks:
+                        self.meta.registry.drop_part(
+                            info.chunk_id, srv.cs_id, info.part_id
+                        )
+                elif isinstance(msg, m.CstomaChunkNew):
+                    for info in msg.chunks:
+                        self.meta.registry.add_part(
+                            info.chunk_id, srv.cs_id, info.part_id,
+                            info.version,
+                        )
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # peer died mid-registration; cleanup below
+        finally:
+            self._mirror_cs_writers.discard(writer)
+            if (
+                srv is not None
+                and self.personality == "shadow"
+                and self._mirror_cs_owner.get(srv.cs_id) is writer
+            ):
+                # still a shadow AND still the owning link: the mirror
+                # peer is gone, drop its parts. A superseded loop (the
+                # chunkserver re-dialed; owner moved on) must not wipe
+                # the live link's fresh report, and after PROMOTION the
+                # chunkserver re-registers command-capable on the same
+                # addr-indexed entry — disconnecting would race that.
+                self._mirror_cs_owner.pop(srv.cs_id, None)
+                self.meta.registry.server_disconnected(srv.cs_id)
 
     async def _delete_stale(self, link: _CsLink, info: m.ChunkPartInfo) -> None:
         try:
@@ -2368,6 +2732,18 @@ class MasterServer(Daemon):
             help="registered chunkservers down or reporting degraded/"
                  "critical health",
         ).set(report["summary"]["cs_unhealthy"])
+        # shadow replication lag (changelog positions): the incident
+        # metric for the read-replica plane — staleness retries climb
+        # when this does
+        self.metrics.gauge(
+            "shadow_lag",
+            help="worst connected-shadow replication lag in changelog "
+                 "positions (0 = all shadows caught up or none connected)",
+        ).set(report["summary"]["shadow_lag_max"])
+        self.metrics.gauge(
+            "shadows_connected",
+            help="shadow/metalogger changelog subscribers connected",
+        ).set(report["summary"]["shadows"])
         # released chunks: delete their on-disk parts
         drained = self.meta.registry.pending_deletes[:16]
         del self.meta.registry.pending_deletes[:16]
@@ -2385,6 +2761,10 @@ class MasterServer(Daemon):
             self._repl_fail_until = {
                 cid: t for cid, t in self._repl_fail_until.items() if t > now
             }
+        # until the first danger-aggregate publish, also advance the
+        # bootstrap counter so /health's lost/endangered become exact
+        # within minutes of a restart, not after a full cursor cycle
+        self.meta.registry.danger_bootstrap()
         work = self.meta.registry.health_work(limit=16)
         for item in work:
             if item[0] == "replicate":
@@ -2682,12 +3062,20 @@ class MasterServer(Daemon):
         )
         try:
             # serve image download requests; changelog lines are pushed by
-            # commit()
+            # commit(); shadows ack their applied position (MltomaAck) so
+            # health/admin can report per-shadow replication lag
             while True:
                 try:
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                if isinstance(msg, m.MltomaAck):
+                    self.shadow_status[id(writer)] = {
+                        "version": msg.version,
+                        "serving": bool(getattr(msg, "serving", 0)),
+                        "ts": time.monotonic(),
+                    }
+                    continue
                 if isinstance(msg, m.MltomaDownloadImage):
                     doc = {
                         "format": "inline",
@@ -2704,6 +3092,7 @@ class MasterServer(Daemon):
         finally:
             if writer in self.shadow_writers:
                 self.shadow_writers.remove(writer)
+            self.shadow_status.pop(id(writer), None)
 
     # --- shadow personality: follow the active master -------------------------------------
 
@@ -2803,16 +3192,45 @@ class MasterServer(Daemon):
             ):
                 self._force_image_download = False
                 await self._shadow_download_image(reader, writer)
+            # replica reads may serve from here on: the stream is live
+            # and we are at (or catching up to) the active's position
+            self._follow_connected = True
+            self._shadow_ack(writer, force=True)
             while self.personality == "shadow":
                 msg = await framing.read_message(reader)
                 if isinstance(msg, m.MatomlChangelogLine):
                     await self._shadow_apply(msg, reader, writer)
+                    self._shadow_ack(writer)
         finally:
+            self._follow_connected = False
             writer.close()
             try:
                 await writer.wait_closed()
             except (ConnectionError, asyncio.CancelledError):
                 pass
+
+    async def _shadow_ack_tick(self) -> None:
+        w = getattr(self, "_follow_writer", None)
+        if self._follow_connected and w is not None:
+            self._shadow_ack(w, force=True)
+
+    def _shadow_ack(self, writer, force: bool = False) -> None:
+        """Throttled applied-position report to the active (lag
+        telemetry input for `health` / the shadow_lag gauge)."""
+        now = time.monotonic()
+        if not force and now - self._last_shadow_ack < 1.0:
+            return
+        self._last_shadow_ack = now
+        try:
+            framing.write_message(
+                writer,
+                m.MltomaAck(
+                    version=self.changelog.version,
+                    serving=int(shadow_reads_enabled()),
+                ),
+            )
+        except (ConnectionError, RuntimeError):
+            pass  # the follow loop notices the dead link itself
 
     async def _shadow_download_image(self, reader, writer) -> None:
         await framing.send_message(writer, m.MltomaDownloadImage(req_id=2))
@@ -2829,6 +3247,14 @@ class MasterServer(Daemon):
         self.changelog.version = msg.version
         self.changelog.open()
         save_image(self.data_dir, msg.version, self.meta.to_sections())
+        # load_sections REPLACED self.meta.registry: live mirror links
+        # hold cs_ids from the old table — close them so chunkservers
+        # re-register (fresh part reports) against the new registry
+        for w in list(self._mirror_cs_writers):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
         self.log.info("shadow: downloaded metadata image at v%d", msg.version)
 
     async def _shadow_apply(self, line: m.MatomlChangelogLine, reader, writer) -> None:
@@ -2852,9 +3278,18 @@ class MasterServer(Daemon):
         if self.personality == "master":
             return
         self.personality = "master"
+        self._follow_connected = False
         if self._shadow_task is not None:
             self._shadow_task.cancel()
             self._shadow_task = None
+        # passive chunkserver mirror links never carry commands: close
+        # them so every chunkserver re-registers over a command-capable
+        # link (their heartbeat loops reconnect within one interval)
+        for w in list(self._mirror_cs_writers):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
         self.log.info("promoted to active master at v%d", self.changelog.version)
 
     def follow(self, addr: tuple[str, int]) -> None:
@@ -2883,6 +3318,7 @@ class MasterServer(Daemon):
     ADMIN_PRIVILEGED = frozenset({
         "tweaks-set", "save-metadata", "promote-shadow", "reload", "stop",
         "rremove-task", "setgoal-task", "settrashtime-task",
+        "synth-populate",
     })
 
     async def _admin_message(self, writer, msg, state: dict | None = None) -> None:
@@ -2903,6 +3339,10 @@ class MasterServer(Daemon):
                         "cs_id": s.cs_id, "host": s.host, "port": s.port,
                         "label": s.label, "connected": s.connected,
                         "total_space": s.total_space, "used_space": s.used_space,
+                        # mirror=True: a shadow's passive location feed,
+                        # NOT a command link — active-discovery tooling
+                        # must skip these
+                        "mirror": s.mirror,
                     }
                     for s in self.meta.registry.servers.values()
                 ],
@@ -2923,32 +3363,35 @@ class MasterServer(Daemon):
     def cluster_health(self, evaluate_chunks: bool = True) -> dict:
         """The cluster-wide health rollup: this master's own snapshot,
         every chunkserver's heartbeat-folded snapshot, and chunk-level
-        danger, aggregated to one status. ``evaluate_chunks=False``
-        skips the O(chunks) endangered/lost evaluation (the per-tick
-        gauge path) and uses the endangered queue length instead."""
+        danger, aggregated to one status.
+
+        Chunk danger comes from the registry's maintained aggregate
+        (published by the routine health-walk cycle — the evaluations
+        the walk already pays for), NEVER a full-table sweep: /health
+        is a probe endpoint monitors may poll every few seconds, and
+        the old O(all-chunks) evaluation was the master's biggest
+        per-probe stall at 1M chunks (test_scalability pins the bound).
+        ``evaluate_chunks=False`` (the per-tick gauge path) uses the
+        endangered queue length instead of the aggregate.
+
+        Freshness contract: ``endangered`` is backstopped by the live
+        FIFO (a chunkserver death shows within a tick); ``lost`` is
+        cycle-fresh — exact as of the last completed walk cycle (or
+        the post-restart bootstrap sweep, registry.danger_bootstrap),
+        lagging a fresh loss by up to one cycle. Alert on
+        status/endangered for immediacy; ``lost`` is the precise
+        classification, not the tripwire."""
         from lizardfs_tpu.runtime import slo as slomod
 
         master_snap = self.health_snapshot()
-        endangered = lost = 0
         if evaluate_chunks:
-            # /health is a probe endpoint monitors may poll every few
-            # seconds; the full registry evaluation is O(chunks) on the
-            # event loop, so memoize it briefly — chunk danger moves at
-            # health-tick pace anyway
-            now = time.monotonic()
-            cached = getattr(self, "_chunk_danger_cache", None)
-            if cached is not None and now - cached[0] < 5.0:
-                endangered, lost = cached[1], cached[2]
-            else:
-                for chunk in self.meta.registry.chunks.values():
-                    state = self.meta.registry.evaluate(chunk)
-                    if not state.is_readable:
-                        lost += 1
-                    elif state.is_endangered or state.missing_parts:
-                        endangered += 1
-                self._chunk_danger_cache = (now, endangered, lost)
+            endangered, lost, _ = self.meta.registry.danger_counts
+            # a fresh burst (chunkserver died seconds ago) shows in the
+            # endangered FIFO before the walk cycle republishes
+            endangered = max(endangered, len(self.meta.registry.endangered))
         else:
             endangered = len(self.meta.registry.endangered)
+            lost = 0
         servers = {}
         cs_unhealthy = 0
         breaches = master_snap.get("breaches_total", 0)
@@ -2984,16 +3427,35 @@ class MasterServer(Daemon):
             status = slomod.worst_status(status, "critical")
         for cls in master_snap.get("slo", {}).values():
             worst_burn = max(worst_burn, cls.get("burn_fast", 0.0))
+        # per-shadow replication lag (changelog positions): shadows ack
+        # their applied version over the changelog stream; `health`
+        # names each one so a lagging replica is visible before clients
+        # notice the staleness retries
+        now_m = time.monotonic()
+        shadows = [
+            {
+                "version": snap["version"],
+                "lag": max(self.changelog.version - snap["version"], 0),
+                "serving": snap["serving"],
+                "age_s": round(now_m - snap["ts"], 1),
+            }
+            for snap in self.shadow_status.values()
+        ]
         return {
             "status": status,
             "master": master_snap,
             "chunkservers": servers,
+            "shadows": shadows,
             "summary": {
                 "endangered": endangered,
                 "lost": lost,
                 "cs_unhealthy": cs_unhealthy,
                 "breaches_total": breaches,
                 "worst_burn_fast": round(worst_burn, 3),
+                "shadows": len(self.shadow_writers),
+                "shadow_lag_max": max(
+                    (s["lag"] for s in shadows), default=0
+                ),
             },
         }
 
@@ -3035,15 +3497,27 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if msg.command == "chunks-health":
+            # budgeted incremental walk: an accurate on-demand count
+            # still visits every chunk, but in slices with yield points
+            # so a 1M-chunk table never stalls client service for the
+            # whole evaluation (the old loop was a single synchronous
+            # full-registry sweep)
             healthy = endangered = lost = 0
-            for chunk in self.meta.registry.chunks.values():
-                state = self.meta.registry.evaluate(chunk)
-                if not state.is_readable:
-                    lost += 1
-                elif state.is_endangered or state.missing_parts:
-                    endangered += 1
-                else:
-                    healthy += 1
+            registry = self.meta.registry
+            ids = list(registry.chunks.keys())
+            for start in range(0, len(ids), 4096):
+                for cid in ids[start:start + 4096]:
+                    chunk = registry.chunks.get(cid)
+                    if chunk is None:
+                        continue  # deleted while we yielded
+                    state = registry.evaluate(chunk)
+                    if not state.is_readable:
+                        lost += 1
+                    elif state.is_endangered or state.missing_parts:
+                        endangered += 1
+                    else:
+                        healthy += 1
+                await asyncio.sleep(0)
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps({
@@ -3094,6 +3568,66 @@ class MasterServer(Daemon):
                 json=json.dumps([
                     t.to_dict() for t in self.task_manager.tasks.values()
                 ]),
+            )
+        if msg.command == "synth-populate":
+            # storm-bench loader: bulk-create a synthetic namespace +
+            # chunk registry (files/chunks/servers) through the normal
+            # commit path so shadows converge on it from the changelog.
+            # Batched commits with yield points: the master keeps
+            # serving while a million inodes stream in.
+            if not self.is_active:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json='{"error": "not the active master"}',
+                )
+            try:
+                payload = json.loads(msg.json or "{}")
+                files = int(payload.get("files", 0))
+                servers = int(payload.get("servers", 0))
+                copies = int(payload.get("copies", 1))
+                dir_name = str(payload.get("dir", "synthstorm"))
+            except (ValueError, TypeError) as e:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json=json.dumps({"error": str(e)[:200]}),
+                )
+            fs = self.meta.fs
+            now = int(time.time())
+            root = fs.node(fsmod.ROOT_INODE)
+            dir_inode = root.children.get(dir_name)
+            if dir_inode is None:
+                dir_inode = fs.alloc_inode()
+                self.commit({
+                    "op": "mknode", "parent": fsmod.ROOT_INODE,
+                    "name": dir_name, "inode": dir_inode, "ftype":
+                    fsmod.TYPE_DIR, "mode": 0o755, "uid": 0, "gid": 0,
+                    "ts": now, "goal": 1, "trash_time": 0,
+                })
+            created = 0
+            batch = 10_000
+            while created < files:
+                n = min(batch, files - created)
+                base_inode = fs.next_inode
+                fs.next_inode += n  # pre-reserve like alloc_inode
+                base_chunk = self.meta.registry.next_chunk_id
+                self.meta.registry.next_chunk_id += n
+                self.commit({
+                    "op": "synth_populate", "parent": dir_inode,
+                    "base_inode": base_inode, "base_chunk": base_chunk,
+                    "count": n, "servers": servers, "copies": copies,
+                    "ts": now,
+                })
+                created += n
+                await asyncio.sleep(0)
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps({
+                    "files": files, "servers": servers,
+                    "dir_inode": dir_inode,
+                    "inodes": len(fs.nodes),
+                    "chunks": len(self.meta.registry.chunks),
+                    "version": self.changelog.version,
+                }),
             )
         if msg.command == "metadata-checksum":
             return m.AdminReply(

@@ -39,7 +39,13 @@ class Attr(Message):
     ``eattr`` (trailing, skew-tolerant): the per-inode extra-attribute
     flags (EATTR_NOOWNER/NOCACHE/NOENTRYCACHE, constants.py) — carried
     on every attr reply so clients can enforce cache semantics without
-    an extra RPC; peers predating the field read/serve 0."""
+    an extra RPC; peers predating the field read/serve 0.
+
+    ``meta_version`` (trailing, skew-tolerant): the consistency token —
+    NOT a file attribute but the serving master's applied changelog
+    position, stamped at reply time. It rides Attr because Attr is the
+    skew-variable terminal field of MatoclAttrReply (the codec forbids
+    fields after it); see MatoclReadChunk for the token semantics."""
 
     SKEW_TOLERANT_FROM = 12
     FIELDS = (
@@ -56,6 +62,7 @@ class Attr(Message):
         ("goal", "u8"),
         ("trash_time", "u32"),
         ("eattr", "u8"),
+        ("meta_version", "u64"),
     )
 
 
@@ -86,18 +93,35 @@ class ChunkPartInfo(Message):
 
 
 class CltomaRegister(Message):
+    """``replica_ok`` (trailing, skew-tolerant): set by clients willing
+    to be served by a shadow master in read-replica mode — the shadow
+    accepts the (primary-issued) ``session_id`` without committing a
+    session allocation and serves only the read-mostly RPC allowlist.
+    Old peers send 0 and are refused by shadows as before."""
+
     MSG_TYPE = 1000
+    SKEW_TOLERANT_FROM = 4
     FIELDS = (
         ("req_id", "u32"),
         ("session_id", "u64"),
         ("info", "str"),
         ("password", "str"),
+        ("replica_ok", "u8"),
     )
 
 
 class MatoclRegister(Message):
+    # trailing ``meta_version``: the serving master's applied changelog
+    # position — seeds the client's monotonic-reads floor (see
+    # MatoclAttrReply); old masters send 0 = no floor
     MSG_TYPE = 1001
-    FIELDS = (("req_id", "u32"), ("status", "u8"), ("session_id", "u64"))
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("session_id", "u64"),
+        ("meta_version", "u64"),
+    )
 
 
 class CltomaLookup(Message):
@@ -112,7 +136,15 @@ class CltomaLookup(Message):
 
 
 class MatoclAttrReply(Message):
-    """Shared reply for lookup/getattr/mkdir/create/setattr."""
+    """Shared reply for lookup/getattr/mkdir/create/setattr.
+
+    The consistency token rides ``attr.meta_version`` (Attr must stay
+    the terminal field — its own skew-tolerant tail elides): the
+    serving master's applied changelog position at reply time. A client
+    routing reads to a shadow replica keeps the max token it has
+    observed (its monotonic-reads floor; mutations through the primary
+    raise it) and retries through the primary whenever a replica reply
+    carries an older token. Old peers send/read 0 = untokened."""
 
     MSG_TYPE = 1003
     FIELDS = (("req_id", "u32"), ("status", "u8"), ("attr", "msg:Attr"))
@@ -158,11 +190,14 @@ class CltomaReaddir(Message):
 
 
 class MatoclReaddir(Message):
+    # trailing ``meta_version``: consistency token, see MatoclAttrReply
     MSG_TYPE = 1011
+    SKEW_TOLERANT_FROM = 3
     FIELDS = (
         ("req_id", "u32"),
         ("status", "u8"),
         ("entries", "list:msg:DirEntry"),
+        ("meta_version", "u64"),
     )
 
 
@@ -178,10 +213,15 @@ class CltomaUnlink(Message):
 
 
 class MatoclStatusReply(Message):
-    """Generic status-only reply."""
+    """Generic status-only reply.
+
+    ``meta_version`` (trailing, skew-tolerant): consistency token, see
+    MatoclAttrReply — carried on mutation acks too so a client's
+    monotonic-reads floor covers read-your-writes through replicas."""
 
     MSG_TYPE = 1013
-    FIELDS = (("req_id", "u32"), ("status", "u8"))
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("meta_version", "u64"))
 
 
 class CltomaRmdir(Message):
@@ -249,7 +289,12 @@ class CltomaReadChunk(Message):
 
 
 class MatoclReadChunk(Message):
+    # trailing ``meta_version``: consistency token, see MatoclAttrReply.
+    # On locate replies the token pairs with the client's local
+    # locate-epoch machinery: the epoch guards against invalidations
+    # racing the RPC, the token guards against a lagging replica.
     MSG_TYPE = 1021
+    SKEW_TOLERANT_FROM = 6
     FIELDS = (
         ("req_id", "u32"),
         ("status", "u8"),
@@ -257,6 +302,7 @@ class MatoclReadChunk(Message):
         ("version", "u32"),
         ("file_length", "u64"),
         ("locations", "list:msg:PartLocation"),
+        ("meta_version", "u64"),
     )
 
 
@@ -376,8 +422,15 @@ class CltomaReadlink(Message):
 
 
 class MatoclReadlink(Message):
+    # trailing ``meta_version``: consistency token, see MatoclAttrReply
     MSG_TYPE = 1033
-    FIELDS = (("req_id", "u32"), ("status", "u8"), ("target", "str"))
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("target", "str"),
+        ("meta_version", "u64"),
+    )
 
 
 class CltomaLink(Message):
@@ -541,7 +594,16 @@ class MatoclCacheInvalidate(Message):
     fs_readchunk version, src/mount/mastercomm.h:67)."""
 
     MSG_TYPE = 1067
-    FIELDS = (("inode", "u32"), ("chunk_index", "u32"))
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("inode", "u32"),
+        ("chunk_index", "u32"),
+        # the mutation's changelog position (trailing, skew-tolerant):
+        # raises the client's monotonic-reads floor so a post-push read
+        # routed to a still-lagging replica is detected as stale and
+        # retried through the primary
+        ("meta_version", "u64"),
+    )
 
 
 class CltomaOpen(Message):
@@ -749,7 +811,16 @@ class CltomaAppendChunks(Message):
 
 
 class CstomaRegister(Message):
+    """``mirror`` (trailing, skew-tolerant): 1 = a PASSIVE location
+    report to a shadow master (the shadow records parts so replica
+    locates have locations; no commands ever flow on the link). The
+    active master refuses mirror registrations (a command-less link
+    must never be mistaken for a command link) and shadows refuse
+    non-mirror ones (a chunkserver's main link must keep cycling to
+    the active). Old peers send 0 = normal registration."""
+
     MSG_TYPE = 1100
+    SKEW_TOLERANT_FROM = 7
     FIELDS = (
         ("req_id", "u32"),
         ("addr", "msg:Addr"),
@@ -760,6 +831,7 @@ class CstomaRegister(Message):
         # native C++ data-plane listener port (0 = none; data ops then
         # go to the control port's asyncio server)
         ("data_port", "u16"),
+        ("mirror", "u8"),
     )
 
 
@@ -1140,6 +1212,17 @@ class MatomlChangelogLine(Message):
 class MltomaDownloadImage(Message):
     MSG_TYPE = 1302
     FIELDS = (("req_id", "u32"),)
+
+
+class MltomaAck(Message):
+    """Shadow -> active: periodic applied-position report. The active
+    folds per-shadow replication lag (its own changelog position minus
+    the acked ``version``) into ``lizardfs-admin health`` and the
+    ``shadow_lag`` gauge. ``serving`` says whether the shadow is
+    serving replica reads (LZ_SHADOW_READS)."""
+
+    MSG_TYPE = 1305
+    FIELDS = (("version", "u64"), ("serving", "u8"))
 
 
 class MatomlImage(Message):

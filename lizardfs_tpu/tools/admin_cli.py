@@ -236,6 +236,16 @@ def _print_health(doc: dict) -> None:
         f"stalls {master.get('loop_stalls', 0)}  "
         f"span-drops {master.get('span_ring_dropped', 0)}"
     )
+    # shadow read replicas: applied-position lag per connected shadow
+    # (the incident metric for the replica plane — staleness retries
+    # climb when lag does)
+    for i, sh in enumerate(doc.get("shadows", [])):
+        print(
+            f"  shadow{i:<7d} "
+            f"{'serving' if sh.get('serving') else 'standby':<9s} "
+            f"v{sh.get('version', 0)}  lag {sh.get('lag', 0)}  "
+            f"acked {sh.get('age_s', 0)}s ago"
+        )
     for cs_id, snap in sorted(doc.get("chunkservers", {}).items(),
                               key=lambda kv: int(kv[0])):
         print(

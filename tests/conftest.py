@@ -44,3 +44,7 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test in an event loop")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy variants excluded from tier-1 (-m 'not slow')",
+    )

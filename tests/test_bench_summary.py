@@ -96,6 +96,19 @@ def _fat_row() -> dict:
     row["cluster_rebuild_MBps"] = 1234.5
     row["cluster_rebuild_s"] = 12.34
     row["cluster_rebuild_parts"] = 48
+    # locate storm fiducials (round 7: shadow read replicas — the
+    # metadata-plane A/B with its 1.8x aggregate-QPS target verdict)
+    row["cluster_locate_qps"] = {
+        "primary": 12345.6, "replica_topo": 23456.7, "x": 1.9,
+        "target_x": 1.8, "target_met": True,
+        "shadow_served": 123456, "stale_retries": 12,
+    }
+    row["cluster_locate_p99_ms"] = {"primary": 12.34, "replica_topo": 10.56}
+    row["cluster_locate_storm_detail"] = {
+        "files": 100000, "servers": 1000, "populate_s": 4.2,
+        "cs_ingest": {"real_cs": 128, "parts_each": 2000, "ingest_s": 1.9},
+        "loop_stalls": 0, "shadow_lag": 0,
+    }
     return row
 
 
@@ -140,6 +153,13 @@ def test_summary_line_fits_driver_tail():
     # the rebuild row survives compaction (RebuildEngine fiducials)
     assert parsed["cluster_rebuild_MBps"] == 1234.5
     assert parsed["cluster_rebuild_s"] == 12.34
+    # the locate-storm A/B verdict rides the tail (or its drop is
+    # recorded); the detail dict is full-file-only
+    assert (
+        parsed.get("cluster_locate_qps", {}).get("target_met") is True
+        or "cluster_locate_qps" in parsed.get("dropped", [])
+    )
+    assert "cluster_locate_storm_detail" not in parsed
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail
     assert not any("C_client" in k for k in parsed)

@@ -504,3 +504,95 @@ async def test_dead_connections_fail_fast():
         await link.command(m.MatocsSetVersion, chunk_id=1, old_version=1,
                            new_version=2, part_id=650)
     assert time.monotonic() - t0 < 1.0
+
+
+@pytest.mark.asyncio
+async def test_shadow_promotion_mid_replica_serving(tmp_path):
+    """ISSUE 7: promote the shadow WHILE it serves replica reads under
+    continuous load. Every read must keep answering correctly through
+    the transition (replica refusals fall back to the primary link,
+    which itself fails over to the promoted shadow); afterwards the
+    promoted master serves mutations, its passive mirror links are
+    closed, and the chunkservers re-register command-capable."""
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    addrs = [("127.0.0.1", active.port), ("127.0.0.1", shadow.port)]
+    servers = [
+        ChunkServer(
+            str(tmp_path / f"cs{i}"), master_addr=addrs,
+            heartbeat_interval=0.2, wave_timeout=0.2,
+        )
+        for i in range(3)
+    ]
+    for cs in servers:
+        cs.mirror_reregister_interval = 0.2
+        await cs.start()
+    c = Client("", 0, master_addrs=addrs, wave_timeout=0.2)
+    await c.connect()
+    try:
+        assert c.shadow_reads
+        d = await c.mkdir(1, "dir")
+        f = await c.create(d.inode, "f.bin")
+        await c.write_file(f.inode, b"z" * 8192)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if shadow.changelog.version == active.changelog.version:
+                break
+        # prime the replica path: reads are being served by the shadow
+        assert (await c.getattr(f.inode)).length == 8192
+        assert c.metrics.series["shadow_reads"].total >= 1
+
+        errors: list[Exception] = []
+        done = asyncio.Event()
+
+        async def reader_storm():
+            # continuous read-mostly load across the promotion window
+            while not done.is_set():
+                try:
+                    a = await c.getattr(f.inode)
+                    assert a.length == 8192
+                    assert (await c.lookup(1, "dir")).inode == d.inode
+                    names = [e.name for e in await c.readdir(d.inode)]
+                    assert names == ["f.bin"]
+                except Exception as e:  # noqa: BLE001 — collected, test asserts
+                    errors.append(e)
+                    return
+                await asyncio.sleep(0.01)
+
+        storm = asyncio.ensure_future(reader_storm())
+        await asyncio.sleep(0.3)  # reads flowing through the replica
+        await active.stop()  # the active dies mid-storm
+        shadow.promote()
+        # the promoted master closed its passive mirror links (the
+        # loops' cleanup drains the set as the closes land)
+        for _ in range(50):
+            if not shadow._mirror_cs_writers:
+                break
+            await asyncio.sleep(0.05)
+        assert not shadow._mirror_cs_writers
+        # reads keep flowing while chunkservers re-register
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            if len(shadow.cs_links) == len(servers):
+                break
+        assert len(shadow.cs_links) == len(servers)
+        await asyncio.sleep(0.3)  # more reads against the new topology
+        done.set()
+        await storm
+        assert not errors, f"read failed across promotion: {errors[:1]}"
+        # the promoted master is no longer a replica server: it refuses
+        # replica registrations outright
+        assert not shadow._replica_ready()
+        # and serves mutations (the client's primary link failed over)
+        f2 = await c.create(d.inode, "post-promotion")
+        assert (await c.lookup(d.inode, "post-promotion")).inode == f2.inode
+    finally:
+        await c.close()
+        for cs in servers:
+            await cs.stop()
+        await shadow.stop()

@@ -94,7 +94,10 @@ class ProcCluster:
             w.close()
             return sum(
                 1 for s in json.loads(reply.json)["chunkservers"]
-                if s["connected"]
+                # mirror=True entries are a shadow's passive location
+                # feed — counting them would mistake a mirror-fed
+                # shadow for the active during active-discovery
+                if s["connected"] and not s.get("mirror")
             )
         except (ConnectionError, OSError):
             return 0
@@ -529,5 +532,111 @@ async def test_shm_segment_lifecycle_survives_peer_sigkill(tmp_path):
                 f"cycle {cycle}: segment leaked past peer SIGKILL "
                 "(proactor did not unmap on disconnect)"
             )
+    finally:
+        cluster.stop()
+
+
+async def test_shadow_replica_reads_process_level(tmp_path):
+    """ISSUE 7 e2e with real processes: a primary + shadow master pair,
+    chunkservers mirror-registering to both, a client routing read RPCs
+    to the shadow replica (tokened replies — counters climb on the
+    client), the primary's admin `health` naming the shadow with its
+    replication lag, and a SIGKILL of the shadow mid-reads degrading to
+    primary-only without one failed read."""
+    import json
+
+    from lizardfs_tpu.proto import framing
+    from lizardfs_tpu.proto import messages as m
+
+    cluster = ProcCluster(tmp_path, n_cs=2)
+    pp, sp = _free_port(), _free_port()
+    (tmp_path / "goals.cfg").write_text("1 one : _\n5 ec32 : $ec(3,2)\n")
+
+    async def admin(port: int, command: str) -> dict:
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        await framing.send_message(
+            w, m.AdminCommand(req_id=1, command=command, json="{}")
+        )
+        reply = await framing.read_message(r)
+        w.close()
+        return json.loads(reply.json)
+
+    try:
+        cluster._spawn(
+            "primary", "lizardfs_tpu.master",
+            f"DATA_PATH = {tmp_path}/primary\n"
+            f"LISTEN_PORT = {pp}\n"
+            f"GOALS_CFG = {tmp_path}/goals.cfg\n"
+            "HEALTH_INTERVAL = 0.3\n",
+        )
+        await cluster._wait_port(pp)
+        cluster._spawn(
+            "shadow", "lizardfs_tpu.master",
+            f"DATA_PATH = {tmp_path}/shadow\n"
+            f"LISTEN_PORT = {sp}\n"
+            f"GOALS_CFG = {tmp_path}/goals.cfg\n"
+            "HEALTH_INTERVAL = 0.3\n"
+            "PERSONALITY = shadow\n"
+            f"ACTIVE_MASTER = 127.0.0.1:{pp}\n",
+        )
+        await cluster._wait_port(sp)
+        for i in range(cluster.n_cs):
+            cluster._spawn(
+                f"cs{i}", "lizardfs_tpu.chunkserver",
+                f"DATA_PATH = {tmp_path}/cs{i}\n"
+                f"LISTEN_PORT = {_free_port()}\n"
+                f"MASTER_ADDRS = 127.0.0.1:{pp},127.0.0.1:{sp}\n"
+                "HEARTBEAT_INTERVAL = 0.3\n",
+            )
+        cluster.master_port = pp
+        for _ in range(100):
+            if await cluster._cs_count() >= cluster.n_cs:
+                break
+            await asyncio.sleep(0.1)
+
+        addrs = [("127.0.0.1", pp), ("127.0.0.1", sp)]
+        c = Client("", 0, master_addrs=addrs, wave_timeout=0.3)
+        await c.connect("shadow-e2e")
+        assert c.shadow_reads
+        f = await c.create(1, "rep.bin")
+        payload = data_generator.generate(3, 2 * 65536 + 5).tobytes()
+        await c.write_file(f.inode, payload)
+
+        # reads route to the replica once it is caught up; the client
+        # only accepts tokens >= its floor, so every answer is current
+        for _ in range(150):
+            a = await c.getattr(f.inode)
+            assert a.length == len(payload)
+            assert (await c.lookup(1, "rep.bin")).inode == f.inode
+            if c.metrics.series["shadow_reads"].total >= 2:
+                break
+            await asyncio.sleep(0.1)
+        assert c.metrics.series["shadow_reads"].total >= 2, \
+            "client never engaged the shadow replica"
+
+        # the PRIMARY's health rollup names the shadow and its lag
+        # (MltomaAck plane, throttled to ~1/s — poll briefly)
+        shadows = []
+        for _ in range(50):
+            h = await admin(pp, "health")
+            shadows = h.get("shadows", [])
+            if shadows and any(s["lag"] == 0 for s in shadows):
+                break
+            await asyncio.sleep(0.1)
+        assert shadows, "primary health never reported the shadow"
+        assert h["summary"]["shadows"] >= 1
+        assert any(s["serving"] for s in shadows)
+
+        # SIGKILL the shadow mid-reads: every read keeps answering
+        # (primary fallback), fallbacks counter climbs
+        cluster.kill9("shadow")
+        before = c.metrics.series["shadow_fallbacks"].total
+        for _ in range(20):
+            a = await c.getattr(f.inode)
+            assert a.length == len(payload)
+            await asyncio.sleep(0.02)
+        assert (await c.read_file(f.inode)) == payload
+        assert c.metrics.series["shadow_fallbacks"].total > before
+        await c.close()
     finally:
         cluster.stop()

@@ -210,7 +210,9 @@ def test_server_disconnect_is_o_parts_not_o_chunks():
     affected = reg.server_disconnected(victim)
     dt = time.perf_counter() - t0
     assert len(affected) == n_chunks // n_servers
-    assert dt < 0.05, f"disconnect took {dt*1e3:.1f} ms"
+    # bound sized for slow 2-core CI boxes; an O(all chunks) walk would
+    # be ~20x the O(parts) one, so the margin still pins the property
+    assert dt < 0.2, f"disconnect took {dt*1e3:.1f} ms"
     # the dropped parts are really gone from the chunk-side sets
     assert all(
         (victim, 0) not in reg.chunks[cid].parts for cid in affected[:100]
@@ -271,3 +273,219 @@ def test_bytes_per_inode_budget():
     tracemalloc.stop()
     per_inode = cur / n_files
     assert per_inode < 800, f"{per_inode:.0f} bytes/inode exceeds budget"
+
+
+# --- ISSUE 7: locate-storm scan bounds ------------------------------------
+# The storm bench (benches/bench_master_storm.py) exposed the master's
+# remaining full-registry walks; these tests pin the fixes so they
+# cannot regress into the health/stats/heartbeat tick paths.
+
+
+@pytest.mark.asyncio
+async def test_health_probe_never_sweeps_the_chunk_table(tmp_path):
+    """/health (cluster_health with chunk evaluation) must read the
+    danger aggregate the routine walk maintains — NEVER evaluate the
+    whole table per probe. Pinned hard: with evaluate() poisoned, the
+    probe still answers, and its numbers match the published cycle."""
+    master = MasterServer(str(tmp_path / "m"), image_interval=3600.0)
+    await master.start()
+    try:
+        reg = master.meta.registry
+        srv = reg.register_server("127.0.0.1", 9901, "_", 1 << 40, 0)
+        # a mostly-HEALTHY 20k-chunk table (a broken-everywhere table
+        # legitimately pins the cursor to the repair work limit) with a
+        # known sprinkle of danger SPREAD across the id space so no
+        # scan batch's work fills the limit: 50 endangered (copies=2,
+        # one part), 50 lost (no parts)
+        for i in range(20_000):
+            cid = 100 + i
+            endangered_here = i % 400 == 0
+            lost_here = i % 400 == 200
+            reg.create_chunk(
+                0, chunk_id=cid, version=1,
+                copies=2 if endangered_here else 1,
+            )
+            if not lost_here:
+                reg.record_part(reg.chunks[cid], srv.cs_id, 0)
+        # drive the cursor through one full cycle + wrap so the cycle's
+        # aggregate publishes (work items per tick stay far below the
+        # limit at 0.5% danger density, so the cursor never rewinds)
+        ticks = (len(reg.chunks) // reg.SCAN_BUDGET) + 3
+        for _ in range(ticks):
+            reg.health_work(limit=16)
+        endangered, lost, scanned = reg.danger_counts
+        assert scanned == 20_000
+        assert endangered == 50
+        assert lost == 50
+        # the probe path: poison evaluate — a full-table sweep would
+        # blow up, the aggregate read must not
+        real_evaluate = reg.evaluate
+
+        def poisoned(chunk):
+            raise AssertionError("health probe swept the chunk table")
+
+        reg.evaluate = poisoned
+        try:
+            h = master.cluster_health(evaluate_chunks=True)
+        finally:
+            reg.evaluate = real_evaluate
+        assert h["summary"]["lost"] == 50
+        assert h["summary"]["endangered"] >= 50
+        # and it is O(1)-cheap: 100 probes well under a single sweep
+        t0 = time.perf_counter()
+        for _ in range(100):
+            master.cluster_health(evaluate_chunks=True)
+        per_probe = (time.perf_counter() - t0) / 100
+        assert per_probe < 0.005, f"health probe {per_probe*1e3:.2f} ms"
+    finally:
+        await master.stop()
+
+
+def test_register_server_is_o1_per_registration():
+    """A 10k-chunkserver registration storm must cost O(N) total, not
+    O(N^2): reconnect lookup rides the addr index, never a table scan."""
+    reg = ChunkRegistry()
+    n = 10_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        reg.register_server("10.0.0.1", 20000 + i, "_", 1 << 40, 0)
+    fresh_s = time.perf_counter() - t0
+    assert len(reg.servers) == n
+    assert fresh_s < 1.0, f"10k fresh registrations took {fresh_s:.2f}s"
+    # reconnections resolve to the SAME entry, still O(1)
+    t0 = time.perf_counter()
+    for i in range(n):
+        srv = reg.register_server("10.0.0.1", 20000 + i, "relabel",
+                                  2 << 40, 1)
+        assert srv.cs_id == i + 1
+    reconn_s = time.perf_counter() - t0
+    assert len(reg.servers) == n  # no duplicates
+    assert reconn_s < 1.0, f"10k reconnections took {reconn_s:.2f}s"
+
+
+@pytest.mark.asyncio
+async def test_registration_ingest_yields_event_loop(tmp_path):
+    """One chunkserver registering a huge part report must not stall
+    every other connection for the whole walk: _ingest_parts applies in
+    slices with yield points (the storm test's stall-watchdog pin)."""
+    from lizardfs_tpu.proto import messages as m
+
+    master = MasterServer(str(tmp_path / "m"), image_interval=3600.0)
+    await master.start()
+    try:
+        _populate(master.meta, n_files=100_000)
+        reg = master.meta.registry
+        srv = reg.register_server("127.0.0.1", 9902, "_", 1 << 40, 0)
+        infos = [
+            m.ChunkPartInfo(chunk_id=100 + i, version=1, part_id=0)
+            for i in range(100_000)
+        ]
+        gaps = []
+
+        async def ticker():
+            prev = time.perf_counter()
+            while True:
+                await asyncio.sleep(0.002)
+                now = time.perf_counter()
+                gaps.append(now - prev - 0.002)
+                prev = now
+
+        t = asyncio.ensure_future(ticker())
+        await asyncio.sleep(0.02)
+        t0 = time.perf_counter()
+        stale = await master._ingest_parts(
+            srv.cs_id, infos, collect_stale=True
+        )
+        ingest_s = time.perf_counter() - t0
+        t.cancel()
+        assert not stale
+        assert len(reg._server_parts[srv.cs_id]) == 100_000
+        worst = max(gaps)
+        # each slice is REGISTER_INGEST_SLICE applies; the loop must
+        # breathe between slices (the whole walk would be ~ingest_s)
+        assert worst < max(0.05, ingest_s / 4), (
+            f"loop stalled {worst*1e3:.0f} ms during a "
+            f"{ingest_s*1e3:.0f} ms ingest"
+        )
+    finally:
+        await master.stop()
+
+
+def test_synth_populate_op_digest_and_convergence():
+    """The storm loader's one-op bulk create: incremental digest stays
+    exact (shadow divergence detection holds) and two stores applying
+    the same op land on the same checksum (what shadow convergence
+    rides)."""
+    op = {
+        "op": "synth_populate", "parent": 1, "base_inode": 1000,
+        "base_chunk": 500, "count": 5_000, "servers": 8, "copies": 2,
+        "ts": 1234,
+    }
+    stores = [MetadataStore(), MetadataStore()]
+    for s in stores:
+        s.apply(dict(op))
+        assert s._digest == s.full_digest(), "digest drifted"
+    a, b = stores
+    assert a.checksum() == b.checksum()
+    assert len(a.fs.nodes) == 5_001  # root + files
+    assert len(a.registry.chunks) == 5_000
+    # parts landed on the synthetic servers (replica locates need them)
+    chunk = a.registry.chunks[500]
+    assert len(chunk.parts) == 2
+    # and the synthetic namespace is a real one: lookup works
+    node = a.fs.lookup(1, "sf1000")
+    assert node.chunks == [500]
+    assert node.length == 65536
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_locate_storm_million_inodes():
+    """The full-fat storm (ISSUE 7 acceptance shape): ~1M inodes/chunks
+    bulk-loaded through the changelog, thousands of synthetic servers,
+    real primary+shadow+worker processes. Slow-marked — minutes, not
+    tier-1; the compact storm rides bench_cluster and the process-level
+    e2e lives in test_process_cluster.py."""
+    from benches.bench_master_storm import run_storm
+
+    row = await run_storm(
+        files=1_000_000, servers=10_000, secs=5.0, real_cs=64,
+        parts_per_cs=2_000,
+    )
+    assert row["shadow_caught_up"], "shadow never converged on 1M inodes"
+    assert row["primary_only"]["locate_qps"] > 0
+    assert row["with_replica"]["shadow_reads"] > 0, \
+        "replica never engaged under the 1M-inode storm"
+    # the loop must keep breathing through populate + ingest + storm
+    # (yield-point discipline; a handful of stalls is scheduler noise,
+    # a synchronous full walk would be hundreds)
+    assert row["loop_stalls"] < 20
+
+
+def test_danger_aggregate_bootstrap_bounds_first_publish():
+    """After a (re)start the danger aggregate must become exact within
+    a bounded number of health ticks (budget-sized bootstrap sweeps) —
+    NOT after the routine cursor's full cycle (review finding: /health
+    reported lost=0 for ~an hour at 1M chunks post-restart)."""
+    reg = ChunkRegistry()
+    srv = reg.register_server("h", 1, "_", 1 << 40, 0)
+    n = 20_000
+    for i in range(n):
+        cid = 100 + i
+        reg.create_chunk(0, chunk_id=cid, version=1, copies=1)
+        if i % 100 != 0:  # every 100th chunk is partless -> lost
+            reg.record_part(reg.chunks[cid], srv.cs_id, 0)
+    assert reg.danger_counts == (0, 0, 0)
+    ticks = 0
+    while not reg.danger_counts[2]:
+        reg.danger_bootstrap(budget=4096)
+        ticks += 1
+        assert ticks <= (n // 4096) + 2, "bootstrap never published"
+    endangered, lost, scanned = reg.danger_counts
+    assert scanned == n
+    assert lost == n // 100
+    assert endangered == 0
+    # once published, bootstrap is a no-op (the routine walk owns the
+    # aggregate from here) and the counts stay put
+    reg.danger_bootstrap()
+    assert reg.danger_counts == (endangered, lost, scanned)
