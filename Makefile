@@ -1,0 +1,29 @@
+# Top-level CI/tooling targets. Native-code targets live in native/Makefile.
+
+PY ?= python
+SEEDS ?= 1,2,3
+
+# tier-1: the fast suite CI gates on (ROADMAP.md "Tier-1 verify")
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider
+
+# chaos: the full seeded fault-schedule set against REAL multi-process
+# clusters (tools/chaos.py). Every schedule runs at every seed in
+# $(SEEDS); on failure the driver prints the exact seed + replay
+# command, so a red run reproduces deterministically:
+#   make chaos SEEDS=1,2,3,4,5
+chaos:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) -m lizardfs_tpu.tools.chaos \
+	  --all --seeds $(SEEDS)
+
+# chaos-slow: the same matrix through pytest (includes the slow-marked
+# parametrization in tests/test_chaos.py)
+chaos-slow:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q \
+	  -p no:cacheprovider
+
+native:
+	$(MAKE) -C native
+
+.PHONY: test chaos chaos-slow native

@@ -9,9 +9,15 @@ registration loop cycles until the ACTIVE master accepts; overrides
 MASTER_HOST/PORT), LABEL, ENCODER (cpu|cpp|tpu|auto),
 HEARTBEAT_INTERVAL (seconds; also the master-reconnect cadence),
 NATIVE_DATA_PLANE (default true; false serves data ops from the
-asyncio path — needed for the debug_read_delay_ms fault drill),
-ADMIN_PASSWORD (challenge-response auth for privileged admin
-commands), LOG_LEVEL.
+asyncio path — the fault-injection choke points live there, and a
+server that starts with LZ_FAULTS rules armed stands the native plane
+down on its own), ADMIN_PASSWORD (challenge-response auth for
+privileged admin commands), LOG_LEVEL.
+
+Fault injection: LZ_FAULTS="seed=N; role:site[:op[:peer]] action,..."
+arms seeded fault rules at startup (runtime/faults.py; also steerable
+live via `lizardfs-admin faults`); the debug_read_delay_ms tweak is an
+alias arming the serve_read delay rule.
 """
 
 import asyncio
@@ -72,8 +78,8 @@ def main() -> None:
         label=cfg.get_str("LABEL", "_"),
         encoder_name=cfg.get_str("ENCODER", "cpu"),
         heartbeat_interval=cfg.get_float("HEARTBEAT_INTERVAL", 5.0, min_value=0.05),
-        # off routes data ops through the asyncio server — needed for
-        # ops drills that use the debug_read_delay_ms fault tweak
+        # off routes data ops through the asyncio server, where the
+        # fault-injection choke points (disk_pread/serve_read/...) live
         native_data_plane=cfg.get_bool("NATIVE_DATA_PLANE", True),
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
     )

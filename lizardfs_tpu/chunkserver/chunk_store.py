@@ -22,6 +22,7 @@ import fcntl
 import os
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -29,6 +30,7 @@ from lizardfs_tpu.constants import MFSBLOCKSIZE, MFSBLOCKSINCHUNK
 from lizardfs_tpu.core import geometry
 from lizardfs_tpu.ops import crc32 as crc_mod
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import faults as _faults
 
 MAGIC = b"LIZTPU10"
 SIGNATURE_SIZE = 1024
@@ -62,6 +64,29 @@ class ChunkStoreError(Exception):
     def __init__(self, code: int, msg: str = ""):
         self.code = code
         super().__init__(f"{st.name(code)}{(': ' + msg) if msg else ''}")
+
+
+def _disk_fault(site: str, chunk_id: int, part_id: int):
+    """Fault choke point for the block-IO layer (runtime/faults.py).
+    Runs in worker threads, so delays are plain sleeps. Returns a
+    Decision for the payload actions (flip/short) the caller applies;
+    delay/error/drop resolve here. A disk op's role is always
+    "chunkserver" — only chunkservers own stores."""
+    dec = _faults.decide(
+        site, op=f"{chunk_id:016X}:{part_id}", role="chunkserver"
+    )
+    if dec is None:
+        return None
+    if dec.action == "delay":
+        time.sleep(dec.ms / 1e3)
+        return None
+    if dec.action in ("error", "drop"):
+        raise ChunkStoreError(
+            dec.code or st.EIO,
+            f"fault injected: {dec.action} {site} "
+            f"chunk {chunk_id:016X}:{part_id}",
+        )
+    return dec  # flip / short: payload actions, site-specific
 
 
 def chunk_filename(chunk_id: int, part_id: int, version: int) -> str:
@@ -293,6 +318,10 @@ class ChunkStore:
         max_bytes = cf.max_blocks() * MFSBLOCKSIZE
         if offset < 0 or size < 0 or offset + size > max_bytes:
             raise ChunkStoreError(st.EINVAL, f"read range {offset}+{size}")
+        fault = (
+            _disk_fault("disk_pread", chunk_id, part_id)
+            if _faults.ACTIVE else None
+        )
         pieces = []
         with cf.lock, open(cf.path, "rb") as f, _flocked(f, exclusive=False):
             data_len = cf.data_length()
@@ -325,6 +354,19 @@ class ChunkStore:
                     crc = crc_mod.crc32(piece)
                 pieces.append((pos, piece, crc))
                 pos = piece_end
+        if fault is not None and pieces:
+            if fault.action == "flip":
+                # corrupt one bit of one piece AFTER the store's own CRC
+                # verification, keeping the advertised CRC: the receiver
+                # (client / replicator) must catch it — the degraded-
+                # read CRC-reject drill
+                idx = fault.rule.rand_index(len(pieces))
+                pos0, piece, crc = pieces[idx]
+                pieces[idx] = (
+                    pos0, _faults.flip_bit(piece, fault.rule), crc
+                )
+            elif fault.action == "short":
+                pieces.pop()  # short read: the final piece goes missing
         return pieces
 
     def write(
@@ -346,6 +388,10 @@ class ChunkStore:
             raise ChunkStoreError(st.EINVAL, "write crosses block boundary")
         if crc_mod.crc32(data) != data_crc:
             raise ChunkStoreError(st.CRC_ERROR, "piece crc mismatch on write")
+        fault = (
+            _disk_fault("disk_pwrite", chunk_id, part_id)
+            if _faults.ACTIVE else None
+        )
         with cf.lock, open(cf.path, "r+b") as f, _flocked(f, exclusive=True):
             block_start = block * MFSBLOCKSIZE
             if len(data) == MFSBLOCKSIZE:
@@ -358,8 +404,16 @@ class ChunkStore:
                 raw[offset_in_block : offset_in_block + len(data)] = data
                 new_block = bytes(raw)
                 new_crc = crc_mod.crc32(new_block)
+            if fault is not None and fault.action == "flip":
+                # latent corruption: the block lands with a bit flipped
+                # AFTER its CRC was computed, so the stored slot no
+                # longer matches — a later read (or the scrubber)
+                # raises CRC_ERROR
+                new_block = _faults.flip_bit(new_block, fault.rule)
             f.seek(HEADER_SIZE + block_start)
             f.write(new_block)
+            if fault is not None and fault.action == "short":
+                return  # torn write: data landed, CRC slot never updated
             self._write_crc_slot(f, block, new_crc)
 
     def truncate_part(

@@ -43,6 +43,8 @@ from lizardfs_tpu.core.encoder import get_encoder
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import faults as faultsmod
+from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
 from lizardfs_tpu.runtime.rpc import RpcConnection
@@ -140,6 +142,10 @@ class ChunkServer(Daemon):
         # with live writers
         self.test_budget_bytes = 16 * 2**20
         self._test_cursor = 0
+        # write-chain next-hop init reply bound (unbounded-await audit
+        # regression pin rides tests/test_chaos.py); class-level default
+        # overridable per instance for tests
+        self.CHAIN_INIT_TIMEOUT = 10.0
         self.log = logging.getLogger("chunkserver")
         # replication bandwidth cap (bytes/s, 0 = unlimited) — tweakable
         # at runtime (replication_bandwidth_limiter analog)
@@ -148,10 +154,14 @@ class ChunkServer(Daemon):
         self._repl_bps = self.tweaks.register("replication_bps", 0)
         self._repl_bucket = TokenBucket(0.0)
         # fault injection for the SLO/flight-recorder e2e path: delays
-        # every asyncio-plane read by this many ms (0 = off). A tweak so
-        # the in-process harness (and an operator drilling incident
-        # response) can trip a latency breach without touching disks.
-        self._read_delay_ms = self.tweaks.register("debug_read_delay_ms", 0)
+        # every asyncio-plane read by this many ms (0 = off). The tweak
+        # name survives as an ALIAS onto the general fault framework —
+        # setting it arms (or clears, at 0) the equivalent serve_read
+        # delay rule in runtime/faults.py, so `tweaks-set
+        # debug_read_delay_ms N` and `faults-arm` steer the same engine.
+        self._read_delay_ms = self.tweaks.register(
+            "debug_read_delay_ms", 0, on_set=self._read_delay_alias
+        )
         # sockets with a native stream in flight; shutdown() on stop so
         # blocked serve threads see EPIPE instead of waiting out their
         # deadline (a ThreadPoolExecutor joins its workers at exit)
@@ -178,6 +188,16 @@ class ChunkServer(Daemon):
         await asyncio.to_thread(self.store.scan)
         for folder in self.store.damaged_folders:
             self.log.warning("data folder %s is damaged; skipping", folder)
+        if self._want_native_plane and faultsmod.ACTIVE:
+            # fault rules armed at startup: the C++ data plane cannot be
+            # instrumented from Python, so it stands down and every data
+            # byte flows through the hookable asyncio path. A documented
+            # behavior change OF THE ARMED STATE ONLY — LZ_FAULTS unset
+            # leaves the plane untouched (kill-switch discipline).
+            self.log.info(
+                "fault injection armed: native data plane standing down"
+            )
+            self._want_native_plane = False
         if self._want_native_plane:
             from lizardfs_tpu.chunkserver import native_serve
 
@@ -220,6 +240,10 @@ class ChunkServer(Daemon):
             await self._connect_master()
 
     async def teardown(self) -> None:
+        # the debug_read_delay_ms alias rule is process-global state
+        # armed on this daemon's behalf — it must not outlive the
+        # daemon (in-process test clusters share one process)
+        faultsmod.clear(alias="debug_read_delay_ms")
         if self.data_server is not None:
             await asyncio.to_thread(self.data_server.stop)
             self.data_server = None
@@ -1018,13 +1042,32 @@ class ChunkServer(Daemon):
         )
         await ack(code)
 
+    @staticmethod
+    def _read_delay_alias(ms) -> None:
+        """``debug_read_delay_ms`` tweak setter: arm (or clear, at 0)
+        the equivalent fault rule. Alias slot = one live rule max."""
+        try:
+            ms = int(ms)
+        except (TypeError, ValueError):
+            return
+        if ms > 0:
+            faultsmod.arm(
+                f"chunkserver:serve_read delay={ms}",
+                alias="debug_read_delay_ms",
+            )
+        else:
+            faultsmod.clear(alias="debug_read_delay_ms")
+
     async def _debug_read_delay(self) -> None:
-        """Fault injection (tweak ``debug_read_delay_ms``): stall the
-        asyncio-plane read path so SLO breach -> flight-record ->
-        health-degrade can be exercised end to end in-process."""
-        delay = float(self._read_delay_ms.value)
-        if delay > 0:
-            await asyncio.sleep(delay / 1e3)
+        """The ``serve_read`` fault choke point on the asyncio-plane
+        read path (runtime/faults.py). The ``debug_read_delay_ms``
+        tweak arms a delay rule here; LZ_FAULTS/admin-armed rules can
+        also stall or abort the path, so SLO breach -> flight-record ->
+        health-degrade stays drillable end to end."""
+        if faultsmod.ACTIVE:
+            await faultsmod.async_point(
+                "serve_read", op="cs_read", role="chunkserver"
+            )
 
     async def _serve_admin(self, writer, msg, state: dict | None = None) -> None:
         import json
@@ -1061,6 +1104,10 @@ class ChunkServer(Daemon):
             native_ok
             and native_io.available()
             and msg.size >= native_io.NATIVE_READ_THRESHOLD
+            # armed faults: the native load path bypasses store.read,
+            # where the disk_pread choke point lives — serve through
+            # the instrumented path (LZ_FAULTS unset: unchanged)
+            and not faultsmod.ACTIVE
         ):
             served = await self._serve_read_native(writer, msg)
             if served:
@@ -1291,10 +1338,16 @@ class ChunkServer(Daemon):
             code = e.code
         if code == st.OK and msg.chain:
             # connect to the next server and forward the init with the
-            # rest of the chain (WRITEFWD state analog)
+            # rest of the chain (WRITEFWD state analog). Both the dial
+            # AND the init reply are deadline-bounded (unbounded-await
+            # audit): a next-hop that accepts the connect but never
+            # answers used to wedge this whole write chain forever.
             nxt = msg.chain[0]
             try:
-                dr, dw = await asyncio.open_connection(nxt.addr.host, nxt.addr.port)
+                dr, dw = await retrymod.bounded_wait(
+                    asyncio.open_connection(nxt.addr.host, nxt.addr.port),
+                    5.0,
+                )
                 session.downstream = (dr, dw)
                 await framing.send_message(
                     dw,
@@ -1308,7 +1361,9 @@ class ChunkServer(Daemon):
                         trace_id=msg.trace_id,
                     ),
                 )
-                reply = await framing.read_message(dr)
+                reply = await retrymod.bounded_wait(
+                    framing.read_message(dr), self.CHAIN_INIT_TIMEOUT
+                )
                 if (
                     not isinstance(reply, m.CstoclWriteStatus)
                     or reply.status != st.OK
@@ -1318,6 +1373,8 @@ class ChunkServer(Daemon):
                     session.relay_task = self.spawn(
                         self._relay_down_statuses(session)
                     )
+            except asyncio.TimeoutError:
+                code = st.TIMEOUT
             except OSError:
                 code = st.DISCONNECTED
         if code == st.OK:

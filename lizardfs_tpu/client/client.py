@@ -40,6 +40,8 @@ from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
+from lizardfs_tpu.runtime import faults as _faults
+from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import PhaseBreakdown
 from lizardfs_tpu.runtime.rpc import RpcConnection
@@ -68,7 +70,9 @@ _TRANSIENT = {
 def _is_transient(e: Exception) -> bool:
     if isinstance(e, st.StatusError):
         return e.code in _TRANSIENT
-    return isinstance(e, (ReadError, ConnectionError, OSError))
+    return isinstance(
+        e, (ReadError, ConnectionError, OSError, asyncio.TimeoutError)
+    )
 
 
 class Client:
@@ -157,6 +161,11 @@ class Client:
         # how long a lost master may stay unreachable before ops fail
         # (election + promotion fit well inside this on a sane cluster)
         self.failover_timeout = 15.0
+        # end-to-end budget for one retried data op (_retry_transient):
+        # the RetryPolicy deadline that nested dials/RPC waits inherit,
+        # so a wedged chunk write fails the caller in bounded time
+        # instead of attempts x timeouts wall-clock
+        self.op_deadline = 60.0
         # read-locate cache (reference: src/mount/chunk_locator.h
         # ReadChunkLocator's timed cache): repeat reads of a chunk skip
         # the master RPC entirely. Coherence mirrors the BlockCache's
@@ -262,6 +271,13 @@ class Client:
             shadow_reads_enabled() and len(self.master_addrs) > 1
         )
         self._meta_floor = 0
+        # CRC-rejected parts already reported to the master this
+        # session: one report per (chunk, part, holder) — a degraded
+        # chunk re-read every second must not spam the master
+        self._damage_reported: set = set()
+        # fault-injection fires attributed to the client role land in
+        # this registry (faults_injected{site,action})
+        _faults.attach_metrics("client", self.metrics)
         self._replica: RpcConnection | None = None
         self._replica_addr: tuple[str, int] | None = None
         self._replica_retry_at = 0.0
@@ -361,22 +377,25 @@ class Client:
         self.op_counters[op] = self.op_counters.get(op, 0) + 1
 
     async def _retry_transient(self, what: str, attempt_fn) -> None:
-        """Run ``attempt_fn`` with exponential backoff on TRANSIENT
-        failures; permanent errors surface immediately. Always makes at
-        least one attempt regardless of the retries setting."""
-        last: Exception | None = None
-        for attempt in range(max(self.retries, 1)):
-            if attempt:
-                await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))
-            try:
-                await attempt_fn()
-                return
-            except (st.StatusError, ReadError, ConnectionError, OSError) as e:
-                if not _is_transient(e):
-                    raise
-                last = e
-                log.info("%s retry %d: %s", what, attempt + 1, e)
-        raise st.StatusError(st.EIO, f"{what} failed after retries: {last}")
+        """Run ``attempt_fn`` under the unified RetryPolicy
+        (runtime/retry.py): jittered exponential backoff on TRANSIENT
+        failures, permanent errors surface immediately, and the policy's
+        end-to-end deadline threads through nested calls (dials, RPC
+        timeouts) so stacked retries share ONE budget instead of
+        multiplying. Always makes at least one attempt regardless of
+        the retries setting."""
+        policy = retrymod.RetryPolicy(
+            attempts=max(self.retries, 1),
+            base_delay=0.2, max_delay=2.0,
+            deadline=self.op_deadline,
+            transient=_is_transient,
+        )
+        try:
+            await policy.run(attempt_fn, what=what, log=log)
+        except retrymod.RetryError as e:
+            raise st.StatusError(
+                st.EIO, f"{what} failed after retries: {e.last}"
+            ) from e.last
 
     # --- session -----------------------------------------------------------------
 
@@ -425,7 +444,9 @@ class Client:
                 # would otherwise go unnoticed forever
                 if (self._limits_probe_task is None
                         or self._limits_probe_task.done()):
-                    self._limits_probe_task = asyncio.ensure_future(
+                    # detached: connect() may run inside a failover
+                    # RetryPolicy and this loop outlives its deadline
+                    self._limits_probe_task = retrymod.spawn_detached(
                         self._limits_probe_loop()
                     )
                 return
@@ -610,27 +631,30 @@ class Client:
         an election takes time — during it EVERY address refuses (dead)
         or answers NOT_POSSIBLE (still shadow), and a single pass would
         fail exactly the ops the address list exists to save (reference:
-        the mount's fs_reconnect loop)."""
-        deadline = _time.monotonic() + self.failover_timeout
-        delay = 0.1
-        while True:
-            # bound the whole pass, not just the gap between passes: a
-            # blackholed master host (SYN silently dropped) would
-            # otherwise pin one connect() for the OS ~2 min SYN timeout
-            budget = max(deadline - _time.monotonic(), 0.5)
-            try:
-                await asyncio.wait_for(
-                    self.connect(self._info, getattr(self, "_password", "")),
-                    timeout=min(budget, 5.0 * len(self.master_addrs)),
-                )
-                return
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-                if _time.monotonic() + delay > deadline:
-                    raise ConnectionError(
-                        f"failover window exhausted: {e}"
-                    ) from None
-            await asyncio.sleep(delay)
-            delay = min(delay * 2, 1.0)
+        the mount's fs_reconnect loop). Expressed as a RetryPolicy so
+        the failover window is ONE deadline every nested dial inherits
+        (a blackholed master host — SYN silently dropped — costs a
+        bounded attempt, never the OS ~2 min SYN timeout)."""
+        policy = retrymod.RetryPolicy(
+            attempts=10_000,  # the deadline, not the count, is the bound
+            base_delay=0.1, max_delay=1.0, jitter=0.2,
+            deadline=self.failover_timeout,
+            attempt_timeout=5.0 * len(self.master_addrs),
+            transient=lambda e: isinstance(
+                e, (ConnectionError, OSError, asyncio.TimeoutError)
+            ),
+        )
+        try:
+            await policy.run(
+                lambda: self.connect(
+                    self._info, getattr(self, "_password", "")
+                ),
+                what="master failover", log=log,
+            )
+        except retrymod.RetryError as e:
+            raise ConnectionError(
+                f"failover window exhausted: {e.last}"
+            ) from None
 
     async def _probe_limits_active(self) -> None:
         """Probe-only IoLimitRequest (probe=1: never joins the
@@ -1545,6 +1569,7 @@ class Client:
             try:
                 if (
                     native_io.parts_scatter_available()
+                    and not _faults.ACTIVE
                     and len(items) > 1
                     and all(len(by_part[p]) == 1 for p, _ in items)
                 ):
@@ -1770,6 +1795,10 @@ class Client:
         from lizardfs_tpu.core import native_io
 
         if not native_io.parts_scatter_available():
+            return False
+        if _faults.ACTIVE:
+            # armed faults: native scatter sessions can't be
+            # instrumented — the hookable per-part senders serve
             return False
         if len(chunk_data) < self.WRITE_PIPELINE_MIN_BYTES:
             return False
@@ -2146,6 +2175,10 @@ class Client:
         if (
             native_io.available()
             and length >= native_io.NATIVE_WRITE_THRESHOLD
+            # armed faults: the C++ streamer can't be instrumented —
+            # the framed asyncio path below serves (LZ_FAULTS unset:
+            # byte-identical, the gate is one module-attribute check)
+            and not _faults.ACTIVE
         ):
             if cell is not None:
                 # marked BEFORE the executor hand-off: an abort racing
@@ -2166,8 +2199,15 @@ class Client:
             except (OSError, ConnectionError) as e:
                 raise st.StatusError(st.EIO, f"native write: {e}") from None
 
-        reader, writer = await asyncio.open_connection(
-            head.addr.host, head.addr.port
+        if _faults.ACTIVE:
+            # client data-plane dial choke point (runtime/faults.py)
+            await _faults.dial_point(
+                "cs", f"{head.addr.host}:{head.addr.port}", role="client"
+            )
+        # bounded dial (unbounded-await audit): honors any ambient
+        # RetryPolicy deadline on top of the 5 s cap
+        reader, writer = await retrymod.bounded_wait(
+            asyncio.open_connection(head.addr.host, head.addr.port), 5.0
         )
         try:
             await framing.send_message(
@@ -2181,7 +2221,13 @@ class Client:
                     create=False,
                 ),
             )
-            init = await framing.read_message(reader)
+            # every reply wait is deadline-bounded (unbounded-await
+            # audit): a chunkserver that accepts frames but never acks
+            # fails this part write in bounded time instead of wedging
+            # the session forever
+            init = await retrymod.bounded_wait(
+                framing.read_message(reader), 30.0
+            )
             if not isinstance(init, m.CstoclWriteStatus) or init.status != st.OK:
                 raise st.StatusError(getattr(init, "status", st.EIO), "write init")
             nbytes = max(length, 0)
@@ -2214,7 +2260,9 @@ class Client:
                     ),
                 )
             while expected:
-                msg = await framing.read_message(reader)
+                msg = await retrymod.bounded_wait(
+                    framing.read_message(reader), 30.0
+                )
                 if not isinstance(msg, m.CstoclWriteStatus):
                     raise st.StatusError(st.EIO, "unexpected write reply")
                 if msg.status != st.OK:
@@ -2223,7 +2271,9 @@ class Client:
             await framing.send_message(
                 writer, m.CltocsWriteEnd(req_id=0, chunk_id=chunk_id)
             )
-            end = await framing.read_message(reader)
+            end = await retrymod.bounded_wait(
+                framing.read_message(reader), 30.0
+            )
             if not isinstance(end, m.CstoclWriteStatus) or end.status != st.OK:
                 raise st.StatusError(getattr(end, "status", st.EIO), "write end")
         finally:
@@ -2603,6 +2653,45 @@ class Client:
             last.used_addrs = failed_addrs
         raise last
 
+    def _part_failure_observer(self, loc):
+        """execute_plan ``on_part_failure`` hook: a CRC-flagged part
+        failure (the holder SERVED bytes that fail their checksum)
+        reports the damaged part to the master, which drops it from the
+        holder and queues the chunk through the RebuildEngine — closing
+        the loop from client-side detection to re-replication even
+        though the read itself recovers via decode."""
+        def observe(part, wire_part_id, addr, exc):
+            if not getattr(exc, "crc", False):
+                return
+            key = (loc.chunk_id, wire_part_id, addr)
+            if key in self._damage_reported:
+                return
+            if len(self._damage_reported) > 4096:
+                self._damage_reported.clear()
+            self._damage_reported.add(key)
+            self.metrics.counter(
+                "damaged_parts_reported",
+                help="chunk parts this client CRC-rejected and "
+                     "reported to the master for rebuild",
+            ).inc()
+            # detached: the report must not inherit (and die with) the
+            # reading op's retry deadline
+            retrymod.spawn_detached(
+                self._report_damaged(loc.chunk_id, wire_part_id, addr)
+            )
+        return observe
+
+    async def _report_damaged(self, chunk_id: int, part_id: int,
+                              addr: tuple[str, int]) -> None:
+        try:
+            await self._call(
+                m.CltomaChunkDamaged, chunk_id=chunk_id, part_id=part_id,
+                host=addr[0], port=addr[1],
+            )
+        except (st.StatusError, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            pass  # best-effort: the scrubber is the backstop
+
     async def _read_slice(
         self, slice_type, copies, loc, chunk_index: int, off: int,
         size: int, file_length: int, attempt: int = 0,
@@ -2656,6 +2745,7 @@ class Client:
                     plan, loc.chunk_id, loc.version, by_part,
                     wave_timeout=self.wave_timeout,
                     buffer=buffer,
+                    on_part_failure=self._part_failure_observer(loc),
                 )
             except (ReadError, ConnectionError, OSError) as e:
                 raise _tag(e)
@@ -2683,6 +2773,9 @@ class Client:
         region_blocks = hi_block - lo_block + 1
         if (
             native_io.parts_gather_available()
+            # armed faults: the C gather can't be instrumented — the
+            # wave executor below serves (LZ_FAULTS unset: unchanged)
+            and not _faults.ACTIVE
             and into is not None
             and off == lo_slot * d * MFSBLOCKSIZE
             and size == region_blocks * MFSBLOCKSIZE
@@ -2742,6 +2835,7 @@ class Client:
         buf = await execute_plan(
             plan, loc.chunk_id, loc.version, by_part,
             wave_timeout=self.wave_timeout,
+            on_part_failure=self._part_failure_observer(loc),
         )
         # reassemble the stripes we read, then slice the requested bytes.
         # The gather runs off-loop (native stripe_gather releases the

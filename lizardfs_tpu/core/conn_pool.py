@@ -13,6 +13,14 @@ from __future__ import annotations
 import asyncio
 import time
 
+from lizardfs_tpu.runtime import faults as _faults
+from lizardfs_tpu.runtime import retry as _retry
+
+# dial bound: a blackholed chunkserver (SYN dropped) must cost a read
+# attempt seconds, not the OS connect timeout; tighter ambient
+# RetryPolicy deadlines shrink this further (runtime/retry.py)
+DIAL_TIMEOUT = 5.0
+
 
 class PooledConnection:
     __slots__ = ("reader", "writer", "idle_since", "loop")
@@ -51,7 +59,11 @@ class ConnectionPool:
                 conn.writer.close()
                 continue
             return conn
-        reader, writer = await asyncio.open_connection(*addr)
+        if _faults.ACTIVE:
+            await _faults.dial_point("cs", f"{addr[0]}:{addr[1]}")
+        reader, writer = await _retry.bounded_wait(
+            asyncio.open_connection(*addr), DIAL_TIMEOUT
+        )
         return PooledConnection(reader, writer)
 
     def release(self, addr: tuple[str, int], conn: PooledConnection) -> None:

@@ -20,6 +20,7 @@ from lizardfs_tpu.ops import crc32 as crc_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import faults as _faults
 from lizardfs_tpu.runtime import tracing
 
 log = logging.getLogger("read_executor")
@@ -29,7 +30,13 @@ DEFAULT_TOTAL_TIMEOUT = 30.0
 
 
 class ReadError(Exception):
-    pass
+    """``crc`` marks end-to-end checksum rejections (the part's bytes
+    arrived but are corrupt) — the signal the client's damaged-part
+    reporting keys off, distinct from a merely unreachable holder."""
+
+    def __init__(self, msg: str, crc: bool = False):
+        self.crc = crc
+        super().__init__(msg)
 
 
 async def read_part_range(
@@ -58,7 +65,13 @@ async def read_part_range(
     # (framing + CRC + scatter with the GIL released)
     from lizardfs_tpu.core import native_io
 
-    if native_io.available() and size >= native_io.NATIVE_READ_THRESHOLD:
+    if (
+        native_io.available()
+        and size >= native_io.NATIVE_READ_THRESHOLD
+        # armed faults: the C++ exchange cannot be instrumented, so the
+        # hookable asyncio path below serves (LZ_FAULTS unset: no change)
+        and not _faults.ACTIVE
+    ):
         # scatter straight into the caller's buffer whenever it is
         # contiguous: each op owns a disjoint region, and the cancel
         # path below aborts the socket and JOINS the executor thread, so
@@ -106,7 +119,7 @@ async def read_part_range(
             raise
         except native_io.NativeIOError as e:
             GLOBAL_STATS.record_failure(addr)
-            raise ReadError(str(e)) from None
+            raise ReadError(str(e), crc="crc" in str(e).lower()) from None
         except (OSError, ConnectionError) as e:
             GLOBAL_STATS.record_failure(addr)
             raise ReadError(f"native read failed: {e}") from None
@@ -133,7 +146,9 @@ async def read_part_range(
             if isinstance(msg, m.CstoclReadData):
                 data = np.frombuffer(msg.data, dtype=np.uint8)
                 if crc_mod.crc32(msg.data) != msg.crc:
-                    raise ReadError("piece CRC mismatch from chunkserver")
+                    raise ReadError(
+                        "piece CRC mismatch from chunkserver", crc=True
+                    )
                 rel = msg.offset - offset
                 if rel < 0 or rel + len(data) > size:
                     raise ReadError("piece outside requested range")
@@ -143,7 +158,10 @@ async def read_part_range(
                 clean = True  # stream fully drained, even on error status
                 if msg.status != st.OK:
                     GLOBAL_STATS.record_failure(addr)
-                    raise ReadError(f"read failed: {st.name(msg.status)}")
+                    raise ReadError(
+                        f"read failed: {st.name(msg.status)}",
+                        crc=msg.status == st.CRC_ERROR,
+                    )
                 if received < size:
                     GLOBAL_STATS.record_failure(addr)
                     raise ReadError(
@@ -176,6 +194,7 @@ async def execute_plan(
     wave_timeout: float = DEFAULT_WAVE_TIMEOUT,
     total_timeout: float = DEFAULT_TOTAL_TIMEOUT,
     buffer: np.ndarray | None = None,
+    on_part_failure=None,
 ) -> np.ndarray:
     """Execute a plan; returns the post-processed result bytes.
 
@@ -183,6 +202,10 @@ async def execute_plan(
     ``buffer`` (optional, C-contiguous uint8 of plan.buffer_size) lets
     the caller provide the scatter target so successful single-op plans
     write the result in place.
+    ``on_part_failure`` (optional ``fn(part, wire_part_id, addr, exc)``)
+    observes every per-part failure as it happens — the client threads
+    its damaged-part reporter through here so a CRC-rejected part is
+    reported to the master even when the read itself recovers.
     """
     if buffer is None:
         buffer = np.zeros(plan.buffer_size, dtype=np.uint8)
@@ -253,6 +276,13 @@ async def execute_plan(
                     available.append(part)
                 else:
                     log.debug("part %d failed: %s", part, exc)
+                    if on_part_failure is not None and part in locations:
+                        addr, wire_part_id = locations[part]
+                        try:
+                            on_part_failure(part, wire_part_id, addr, exc)
+                        except Exception:  # noqa: BLE001
+                            log.debug("part-failure observer failed",
+                                      exc_info=True)
                     unreadable.append(part)
                     if not plan.is_finishing_possible(unreadable):
                         raise ReadError(f"too many failed parts: {unreadable}")

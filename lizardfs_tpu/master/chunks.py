@@ -224,6 +224,11 @@ class ChunkRegistry:
     def connected_servers(self) -> list[ChunkServerInfo]:
         return [s for s in self.servers.values() if s.connected]
 
+    def server_at(self, host: str, port: int):
+        """Addr-indexed lookup (O(1)): client damaged-part reports name
+        holders by address — clients never learn cs_ids."""
+        return self._server_by_addr.get((host, port))
+
     def audit_index(self) -> list[str]:
         """Consistency check (tests/debug): chunk.parts and the
         per-server index must describe the same (cs, chunk, part)

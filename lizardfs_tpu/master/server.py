@@ -38,6 +38,7 @@ from lizardfs_tpu.master import rebuild as rebuild_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
 
@@ -1192,6 +1193,26 @@ class MasterServer(Daemon):
                 req_id=msg.req_id, status=st.OK, total_space=cached[1],
                 avail_space=cached[2], inodes=len(fs.nodes),
             )
+        if isinstance(msg, m.CltomaChunkDamaged):
+            # client-side CRC rejection: the named holder's copy of the
+            # part is bad. Volatile-registry handling identical to a
+            # chunkserver scrubber report — drop the part and queue the
+            # chunk through the RebuildEngine's endangered feed. The
+            # file itself stays readable (the client already recovered
+            # via decode); this report is what closes the loop from
+            # detection to re-replication.
+            srv = self.meta.registry.server_at(msg.host, msg.port)
+            if srv is not None:
+                self.meta.registry.drop_part(
+                    msg.chunk_id, srv.cs_id, msg.part_id
+                )
+                self.meta.registry.mark_endangered(msg.chunk_id)
+                self.log.warning(
+                    "client reported damaged chunk %016X part %d on "
+                    "cs %d (%s:%d)", msg.chunk_id, msg.part_id,
+                    srv.cs_id, msg.host, msg.port,
+                )
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaMkdir):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, [msg.gid], 2 | 1)
             self._check_quota(msg.parent, msg.uid, msg.gid, 1, 0)
@@ -3113,7 +3134,9 @@ class MasterServer(Daemon):
         if self.personality != "shadow":
             return
         try:
-            reader, writer = await asyncio.open_connection(*self.active_addr)
+            reader, writer = await retrymod.bounded_wait(
+                asyncio.open_connection(*self.active_addr), 5.0
+            )
             await framing.send_message(
                 writer,
                 m.AdminCommand(
@@ -3176,7 +3199,12 @@ class MasterServer(Daemon):
             w.close()  # the follow loop reconnects and re-downloads
 
     async def _shadow_follow_once(self) -> None:
-        reader, writer = await asyncio.open_connection(*self.active_addr)
+        # bounded dial (unbounded-await audit): a blackholed active must
+        # cost one 5 s attempt per follow-loop lap, never the OS SYN
+        # timeout — an electing shadow has to notice promotion promptly
+        reader, writer = await retrymod.bounded_wait(
+            asyncio.open_connection(*self.active_addr), 5.0
+        )
         self._follow_writer = writer
         try:
             await framing.send_message(

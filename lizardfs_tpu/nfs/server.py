@@ -30,6 +30,7 @@ from lizardfs_tpu.nfs import rpc
 from lizardfs_tpu.nfs.xdr import Packer, Unpacker
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import Metrics
@@ -430,7 +431,16 @@ class NfsGateway:
                     self._ra_advisers.pop(inode, None)
 
     async def start(self) -> None:
-        await self.client.connect(info="nfs-gateway")
+        # unified RetryPolicy: a gateway racing master startup (or an
+        # election) retries under one 30 s end-to-end budget instead of
+        # dying on the first refused connect; every dial the nested
+        # Client.connect makes inherits the same deadline
+        await retrymod.RetryPolicy(
+            attempts=10, base_delay=0.2, max_delay=2.0, deadline=30.0,
+        ).run(
+            lambda: self.client.connect(info="nfs-gateway"),
+            what="nfs gateway master connect", log=log,
+        )
         self._gather_task = asyncio.ensure_future(self._gather_sweep())
         for target in self.exports.values():
             # pre-resolve export roots: clients reusing cached handles

@@ -12,6 +12,7 @@ import asyncio
 import struct
 
 from lizardfs_tpu.proto.codec import Message, message_class_for
+from lizardfs_tpu.runtime import faults as _faults
 
 HEADER = struct.Struct(">II")
 PROTO_VERSION = 1
@@ -37,12 +38,33 @@ def decode(msg_type: int, payload: bytes) -> Message:
     return message_class_for(msg_type).parse(payload[1:])
 
 
+def _msg_name(msg_type: int) -> str:
+    try:
+        return message_class_for(msg_type).__name__
+    except KeyError:
+        return str(msg_type)
+
+
+def _peer_of(writer: asyncio.StreamWriter) -> str:
+    peer = writer.get_extra_info("peername")
+    if isinstance(peer, tuple) and len(peer) >= 2:
+        return f"{peer[0]}:{peer[1]}"
+    return str(peer) if peer else ""
+
+
 async def read_message(reader: asyncio.StreamReader) -> Message:
     header = await reader.readexactly(HEADER.size)
     msg_type, length = HEADER.unpack(header)
     if length > MAX_PACKET_SIZE:
         raise ProtocolError(f"packet too large: {length}")
     payload = await reader.readexactly(length)
+    if _faults.ACTIVE:
+        # fault choke point (runtime/faults.py): delay/drop/flip the
+        # received frame. One module-attribute check when injection is
+        # off — the clean path is byte-identical.
+        payload = await _faults.frame_point(
+            "frame_recv", _msg_name(msg_type), payload
+        )
     return decode(msg_type, payload)
 
 
@@ -51,5 +73,16 @@ def write_message(writer: asyncio.StreamWriter, msg: Message) -> None:
 
 
 async def send_message(writer: asyncio.StreamWriter, msg: Message) -> None:
+    if _faults.ACTIVE:
+        # fault choke point: delay/drop/flip/short-write the outbound
+        # frame (runtime/faults.py). The sync write_message fast path
+        # (shadow acks) stays unhooked by design.
+        data = await _faults.frame_point(
+            "frame_send", type(msg).__name__, encode(msg),
+            peer=_peer_of(writer), writer=writer,
+        )
+        writer.write(data)
+        await writer.drain()
+        return
     write_message(writer, msg)
     await writer.drain()

@@ -376,6 +376,24 @@ class CltomaWriteChunkEndBatch(Message):
     )
 
 
+class CltomaChunkDamaged(Message):
+    """Client-side corruption report: a read CRC-rejected this part
+    (the bytes arrived but fail their checksum — the HOLDER's copy is
+    bad). The master drops the part from the holder's recorded set and
+    queues the chunk through the RebuildEngine, the same handling a
+    chunkserver scrubber report (CstomaChunkDamaged) gets; the holder
+    is named by address because clients never learn cs_ids."""
+
+    MSG_TYPE = 1076
+    FIELDS = (
+        ("req_id", "u32"),
+        ("chunk_id", "u64"),
+        ("part_id", "u32"),
+        ("host", "str"),
+        ("port", "u16"),
+    )
+
+
 class CltomaTruncate(Message):
     MSG_TYPE = 1026
     FIELDS = (

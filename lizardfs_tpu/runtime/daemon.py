@@ -13,6 +13,7 @@ import logging
 import signal
 import sys
 
+from lizardfs_tpu.runtime import faults as faultsmod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import Metrics
@@ -260,6 +261,39 @@ class Daemon:
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps(self.tweaks.to_dict()),
             )
+        if command == "faults":
+            # live fault-injection view: armed rules + fire counts +
+            # the bounded event log (runtime/faults.py)
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(faultsmod.describe()),
+            )
+        if command == "faults-arm":
+            # arm one rule (payload {"rule": "..."}) or replace the
+            # whole set from a spec (payload {"spec": "...", "seed": N})
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+                if "spec" in payload:
+                    faultsmod.install(
+                        str(payload["spec"]), seed=payload.get("seed")
+                    )
+                else:
+                    faultsmod.arm(str(payload["rule"]))
+            except (ValueError, KeyError, faultsmod.FaultSpecError) as e:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json=json.dumps({"error": str(e)}),
+                )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(faultsmod.describe()),
+            )
+        if command == "faults-clear":
+            faultsmod.clear()
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(faultsmod.describe()),
+            )
         if getattr(msg, "command", None) == "tweaks-set":
             try:
                 payload = json.loads(msg.json)
@@ -284,13 +318,27 @@ class Daemon:
         signals (runtime/slo.py health_from). Subclasses extend via
         ``_health_extra``; the master aggregates the fleet's snapshots
         into the cluster `health` rollup."""
-        return slomod.health_from(
+        snap = slomod.health_from(
             self.name, self.slo,
             loop_stalls=self.metrics.counter("loop_stalls").total,
             span_ring_dropped=self.trace_ring.dropped,
             disk_errors=self._health_disk_errors(),
             extra=self._health_extra(),
         )
+        if faultsmod.ACTIVE:
+            # incident output must NAME the injected fault: while rules
+            # are armed, health carries them (with fire counts) so an
+            # operator reading a degraded rollup sees the chaos drill,
+            # not a mystery
+            desc = faultsmod.describe()
+            snap["faults"] = {
+                "seed": desc["seed"],
+                "rules": [
+                    f"{r['rule']} (fired {r['fired']})"
+                    for r in desc["rules"]
+                ],
+            }
+        return snap
 
     def _health_disk_errors(self) -> int:
         return 0
@@ -308,7 +356,7 @@ class Daemon:
 
     # commands that mutate daemon/cluster state; subclasses extend
     ADMIN_PRIVILEGED: frozenset[str] = frozenset(
-        {"tweaks-set", "metrics-define"}
+        {"tweaks-set", "metrics-define", "faults-arm", "faults-clear"}
     )
 
     def handle_admin_auth(self, msg, state: dict) -> object | None:
@@ -426,7 +474,12 @@ class Daemon:
         peer = writer.get_extra_info("peername")
         self._conn_writers.add(writer)
         try:
-            await self.handle_connection(reader, writer)
+            # fault-role scoping: everything this connection's handler
+            # does (incl. to_thread disk work — context propagates) is
+            # attributed to THIS daemon's role, so in-process multi-
+            # daemon tests match (role, site, op, peer) rules correctly
+            with faultsmod.role_scope(self.name):
+                await self.handle_connection(reader, writer)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer went away
         except asyncio.CancelledError:
@@ -442,6 +495,9 @@ class Daemon:
                 pass
 
     async def start(self) -> None:
+        # fault fires attributed to this role land in this registry
+        # (faults_injected{site,action}, Prometheus-exported)
+        faultsmod.attach_metrics(self.name, self.metrics)
         await self.setup()
         self._server = await asyncio.start_server(
             self._guarded_connection, self.host, self.port
@@ -481,6 +537,10 @@ class Daemon:
 
     async def run_forever(self) -> None:
         """Start, install signal handlers, run until SIGTERM/SIGINT."""
+        # a real daemon process is single-role: make it the fault
+        # framework's process default (in-process test clusters rely on
+        # the per-connection role_scope instead)
+        faultsmod.set_role(self.name)
         loop = asyncio.get_running_loop()
         stop = asyncio.Event()
         for sig in (signal.SIGTERM, signal.SIGINT):

@@ -175,11 +175,24 @@ def phase_delta(after: dict, before: dict) -> dict:
     }
 
 
+def _label_value(v) -> str:
+    """Sanitize a label value for the 0.0.4 exposition (quotes and
+    backslashes would need escaping; names stay simpler without them)."""
+    return "".join(
+        c if c not in '"\\\n' else "_" for c in str(v)
+    )
+
+
 class Metrics:
     def __init__(self):
         self.series: dict[str, Series] = {}
         self.derived: dict[str, str] = {}  # name -> RPN expression
         self.timings: dict[str, Timing] = {}
+        # labeled counter families (faults_injected{site,action} style):
+        # family name -> {sorted (label, value) tuple -> Series}. One
+        # HELP/TYPE block per family on the Prometheus page, one sample
+        # line per label combination.
+        self.labeled: dict[str, dict[tuple, Series]] = {}
         # per-series HELP text (Prometheus exposition); series without
         # an explicit entry export an auto-generated line so every
         # scraped metric carries help (the metrics-lint contract)
@@ -213,6 +226,24 @@ class Metrics:
         self.describe(name, help)
         return s
 
+    def labeled_counter(
+        self, family: str, labels: dict, help: str | None = None
+    ) -> Series:
+        """One Series per (family, label-set) combination, exported as a
+        single Prometheus counter family with per-combination samples."""
+        variants = self.labeled.setdefault(family, {})
+        key = tuple(sorted(
+            (str(k), _label_value(v)) for k, v in labels.items()
+        ))
+        s = variants.get(key)
+        if s is None:
+            decorated = family + "{" + ",".join(
+                f'{k}="{v}"' for k, v in key
+            ) + "}"
+            s = variants[key] = Series(decorated, "counter")
+        self.describe(family, help)
+        return s
+
     def define(self, name: str, expr: str, help: str | None = None) -> None:
         """Register a derived series: RPN over series names/constants,
         e.g. ``"bytes_read bytes_written ADD"``. Validated eagerly by a
@@ -227,6 +258,9 @@ class Metrics:
         now = time.monotonic() if now is None else now
         for s in self.series.values():
             s.sample(now)
+        for variants in self.labeled.values():
+            for s in variants.values():
+                s.sample(now)
 
     # --- derived-series evaluation (charts.h calc ops) -------------------
 
@@ -324,6 +358,16 @@ class Metrics:
                      self.help_for(name, "counter"))
             else:
                 emit(pname, "gauge", s.value, self.help_for(name, "gauge"))
+        for family, variants in sorted(self.labeled.items()):
+            pname = f"{prefix}_{_prom_name(family)}_total"
+            lines.append(
+                f"# HELP {pname} "
+                f"{_prom_help(self.help_for(family, 'counter'))}"
+            )
+            lines.append(f"# TYPE {pname} counter")
+            for key, s in sorted(variants.items()):
+                suffix = "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+                lines.append(f"{pname}{suffix} {_prom_value(s.total)}")
         for name, expr in sorted(self.derived.items()):
             pname = f"{prefix}_{_prom_name(name)}"
             try:
@@ -353,6 +397,9 @@ class Metrics:
             name: s.to_dict(resolution)
             for name, s in sorted(self.series.items())
         }
+        for variants in self.labeled.values():
+            for s in variants.values():
+                out[s.name] = s.to_dict(resolution)
         for name, expr in sorted(self.derived.items()):
             try:
                 points = self.eval_rpn(expr, resolution)

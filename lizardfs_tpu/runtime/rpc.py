@@ -14,6 +14,8 @@ import itertools
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto.codec import Message
 from lizardfs_tpu.proto.status import StatusError
+from lizardfs_tpu.runtime import faults as _faults
+from lizardfs_tpu.runtime import retry as _retry
 
 
 class RpcConnection:
@@ -27,15 +29,27 @@ class RpcConnection:
         self._pump_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
 
+    # dial bound (unbounded-await audit): an RPC link to a blackholed
+    # peer fails in seconds, not the OS SYN timeout; ambient RetryPolicy
+    # deadlines (runtime/retry.py) shrink it further
+    DIAL_TIMEOUT = 5.0
+
     @classmethod
     async def connect(cls, host: str, port: int) -> "RpcConnection":
-        reader, writer = await asyncio.open_connection(host, port)
+        if _faults.ACTIVE:
+            await _faults.dial_point("rpc", f"{host}:{port}")
+        reader, writer = await _retry.bounded_wait(
+            asyncio.open_connection(host, port), cls.DIAL_TIMEOUT
+        )
         conn = cls(reader, writer)
         conn.start()
         return conn
 
     def start(self) -> None:
-        self._pump_task = asyncio.get_running_loop().create_task(self._pump())
+        # detached: the pump (and the push-handler tasks it spawns)
+        # outlives any RetryPolicy attempt that dialed this connection —
+        # it must not inherit that attempt's deadline budget
+        self._pump_task = _retry.spawn_detached(self._pump())
 
     def on_push(self, msg_cls: type, handler) -> None:
         """Register an async handler for unsolicited messages of a type."""
@@ -85,7 +99,12 @@ class RpcConnection:
         self._pending[req_id] = fut
         try:
             await framing.send_message(self.writer, msg_cls(req_id=req_id, **fields))
-            return await asyncio.wait_for(fut, timeout)
+            # the per-call timeout is additionally clamped by any
+            # ambient RetryPolicy deadline: nested retries share one
+            # end-to-end budget instead of multiplying their waits
+            return await asyncio.wait_for(
+                fut, max(_retry.budget(timeout), 0.001)
+            )
         finally:
             self._pending.pop(req_id, None)
 

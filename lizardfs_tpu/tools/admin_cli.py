@@ -3,7 +3,14 @@
     python -m lizardfs_tpu.tools.admin_cli <host:port> <command>
 
 Commands: info, list-chunkservers, list-sessions, chunks-health,
-save-metadata, metadata-checksum, promote-shadow.
+save-metadata, metadata-checksum, promote-shadow, faults.
+
+``faults`` steers the live fault-injection rule set of any daemon
+(runtime/faults.py) over the tweaks/admin channel::
+
+    lizardfs-admin HOST:PORT faults                 # list rules + fires
+    lizardfs-admin HOST:PORT faults arm 'chunkserver:disk_pread flip,limit=1'
+    lizardfs-admin HOST:PORT faults clear
 """
 
 from __future__ import annotations
@@ -75,12 +82,13 @@ async def _amain(argv) -> int:
             "info", "list-chunkservers", "list-sessions", "chunks-health",
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
-            "trace-dump", "health", "slowops", "rebuild-status",
+            "trace-dump", "health", "slowops", "rebuild-status", "faults",
         ],
     )
     p.add_argument("extra", nargs="*",
                    help="tweaks-set: NAME VALUE; metrics: [resolution]; "
-                        "trace-dump: [trace_id]")
+                        "trace-dump: [trace_id]; "
+                        "faults: [arm RULE | clear]")
     p.add_argument("--password", default=None,
                    help="admin password (challenge-response)")
     args = p.parse_args(argv)
@@ -119,6 +127,29 @@ async def _amain(argv) -> int:
                 ))
             else:
                 print(json.dumps(spans, indent=2))
+            return 0
+    elif cmd == "faults":
+        sub = args.extra[0] if args.extra else "list"
+        if sub == "arm":
+            if len(args.extra) != 2:
+                print("usage: faults arm 'ROLE:SITE[:OP[:PEER]] ACTION...'",
+                      file=sys.stderr)
+                return 2
+            reply = await _admin(
+                addr, "faults-arm",
+                json.dumps({"rule": args.extra[1]}),
+                password=args.password,
+            )
+        elif sub == "clear":
+            reply = await _admin(addr, "faults-clear",
+                                 password=args.password)
+        elif sub == "list":
+            reply = await _admin(addr, "faults", password=args.password)
+        else:
+            print("usage: faults [arm RULE | clear]", file=sys.stderr)
+            return 2
+        if getattr(reply, "status", 1) == st.OK:
+            _print_faults(json.loads(reply.json))
             return 0
     elif cmd == "tweaks-set":
         if len(args.extra) != 2:
@@ -164,6 +195,22 @@ async def _amain(argv) -> int:
     else:
         print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
+
+
+def _print_faults(doc: dict) -> None:
+    """Render a daemon's live fault-injection state."""
+    state = "ARMED" if doc.get("active") else "inactive"
+    print(f"faults: {state}  seed={doc.get('seed', 0)}  "
+          f"role={doc.get('role', '?')}")
+    for r in doc.get("rules", []):
+        alias = f"  (alias {r['alias']})" if r.get("alias") else ""
+        limit = f"/{r['limit']}" if r.get("limit") else ""
+        print(f"  rule {r['rule']}  fired {r['fired']}{limit} "
+              f"of {r['matched']} matches{alias}")
+    if not doc.get("rules"):
+        print("  (no rules armed)")
+    for e in doc.get("events", [])[-8:]:
+        print(f"  event {e['role']}:{e['site']}:{e['op']} -> {e['action']}")
 
 
 def _print_rebuild(doc: dict) -> None:

@@ -1,0 +1,476 @@
+"""Chaos harness: seeded fault schedules against REAL process clusters.
+
+    python -m lizardfs_tpu.tools.chaos --schedule bitflip-read --seed 42
+    python -m lizardfs_tpu.tools.chaos --all --seeds 1,2,3
+
+Each schedule boots a multi-process cluster (master [+ shadow] + N
+chunkservers as subprocesses — the reference's system-test tier,
+tests/tools/lizardfs.sh), injects faults mid-traffic (SIGKILL, rules
+armed over the admin channel into runtime/faults.py, frame partitions),
+and asserts the standing invariants:
+
+  * byte identity — every read returns exactly what was written;
+  * bounded time — the whole schedule completes inside its budget
+    (a wedged session is a failure, not a hang);
+  * rebuild convergence — injected damage drains through the
+    RebuildEngine;
+  * observability — health/`faults` output NAMES the injected fault.
+
+Determinism: the seed steers every choice (victim selection, kill
+timing, fault-rule seeds) through one ``random.Random(seed)``, and the
+armed rules' own draws are seeded server-side, so a failing run replays
+exactly:  the driver prints the seed + replay command on failure.
+
+Schedules:
+  kill-write     SIGKILL a chunkserver mid-windowed-write
+  bitflip-read   flip a stored ec(3,2) part bit under a live read
+                 (client CRC-rejects, decodes, reports; master rebuilds)
+  stall-acks     delay write acks on one chunkserver (adaptive window
+                 back-pressure; no wedged sessions)
+  shadow-stale   partition the chunkserver->shadow mirror plane so the
+                 shadow serves stale locates; clients recover through
+                 the primary
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# per-schedule wall-clock budget: "bounded-time completion" is an
+# asserted invariant, not a hope
+BUDGET_S = 180.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def admin(port: int, command: str, payload: str = "{}"):
+    from lizardfs_tpu.proto import framing
+    from lizardfs_tpu.proto import messages as m
+
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        if command == "info":
+            await framing.send_message(w, m.AdminInfo(req_id=1))
+        else:
+            await framing.send_message(
+                w, m.AdminCommand(req_id=1, command=command, json=payload)
+            )
+        return await framing.read_message(r)
+    finally:
+        w.close()
+
+
+class ChaosCluster:
+    """Master (+ optional shadow) + N chunkservers as subprocesses.
+
+    Chunkservers run with NATIVE_DATA_PLANE=false: fault rules armed
+    over the admin channel mid-run must bite, and the C++ plane is not
+    instrumentable (the same stand-down the servers apply themselves
+    when rules are armed at startup)."""
+
+    def __init__(self, tmp: str, n_cs: int = 4, shadow: bool = False):
+        self.tmp = tmp
+        self.n_cs = n_cs
+        self.want_shadow = shadow
+        self.master_port = _free_port()
+        self.shadow_port = _free_port() if shadow else None
+        self.cs_ports: list[int] = []
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def _spawn(self, name: str, module: str, cfg_text: str) -> None:
+        cfg = os.path.join(self.tmp, f"{name}.cfg")
+        with open(cfg, "w") as f:
+            f.write(cfg_text)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env.pop("LZ_FAULTS", None)  # schedules arm rules explicitly
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, "-m", module, cfg],
+            stdout=open(os.path.join(self.tmp, f"{name}.log"), "wb"),
+            stderr=subprocess.STDOUT, env=env,
+        )
+
+    async def start(self) -> None:
+        with open(os.path.join(self.tmp, "goals.cfg"), "w") as f:
+            f.write("1 one : _\n5 ec32 : $ec(3,2)\n")
+        self._spawn(
+            "master", "lizardfs_tpu.master",
+            f"DATA_PATH = {self.tmp}/master\n"
+            f"LISTEN_PORT = {self.master_port}\n"
+            f"GOALS_CFG = {self.tmp}/goals.cfg\n"
+            "HEALTH_INTERVAL = 0.3\n",
+        )
+        await self._wait_port(self.master_port)
+        if self.want_shadow:
+            self._spawn(
+                "shadow", "lizardfs_tpu.master",
+                f"DATA_PATH = {self.tmp}/shadow\n"
+                f"LISTEN_PORT = {self.shadow_port}\n"
+                f"GOALS_CFG = {self.tmp}/goals.cfg\n"
+                "PERSONALITY = shadow\n"
+                f"ACTIVE_MASTER = 127.0.0.1:{self.master_port}\n"
+                "HEALTH_INTERVAL = 0.3\n",
+            )
+            await self._wait_port(self.shadow_port)
+        addrs = f"127.0.0.1:{self.master_port}"
+        if self.want_shadow:
+            addrs += f",127.0.0.1:{self.shadow_port}"
+        for i in range(self.n_cs):
+            port = _free_port()
+            self.cs_ports.append(port)
+            self._spawn(
+                f"cs{i}", "lizardfs_tpu.chunkserver",
+                f"DATA_PATH = {self.tmp}/cs{i}\n"
+                f"LISTEN_PORT = {port}\n"
+                f"MASTER_ADDRS = {addrs}\n"
+                "HEARTBEAT_INTERVAL = 0.3\n"
+                "NATIVE_DATA_PLANE = false\n",
+            )
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if await self._cs_count() >= self.n_cs:
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError("chunkservers never registered")
+
+    async def _cs_count(self) -> int:
+        try:
+            reply = await admin(self.master_port, "info")
+            return sum(
+                1 for s in json.loads(reply.json)["chunkservers"]
+                if s["connected"] and not s.get("mirror")
+            )
+        except (ConnectionError, OSError):
+            return 0
+
+    async def _wait_port(self, port: int, timeout: float = 20.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                _, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                return
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.1)
+        raise AssertionError(f"port {port} never came up")
+
+    async def arm(self, port: int, rule: str) -> None:
+        reply = await admin(port, "faults-arm", json.dumps({"rule": rule}))
+        assert getattr(reply, "status", 1) == 0, f"faults-arm failed: {rule}"
+
+    async def faults(self, port: int) -> dict:
+        reply = await admin(port, "faults")
+        return json.loads(reply.json)
+
+    def kill9(self, name: str) -> None:
+        self.procs[name].send_signal(signal.SIGKILL)
+        self.procs[name].wait(timeout=10)
+
+    def stop(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def _client(cluster: ChaosCluster, shadow: bool = False):
+    from lizardfs_tpu.client.client import Client
+
+    addrs = [("127.0.0.1", cluster.master_port)]
+    if shadow and cluster.shadow_port:
+        addrs.append(("127.0.0.1", cluster.shadow_port))
+    c = Client(*addrs[0], wave_timeout=0.3, master_addrs=addrs)
+    await c.connect(info="chaos")
+    return c
+
+
+async def _wait_rebuilt(cluster: ChaosCluster, min_completed: int = 1,
+                        timeout: float = 60.0) -> dict:
+    """Rebuild convergence invariant: the engine completed >= N
+    rebuilds and nothing is left in flight."""
+    deadline = time.monotonic() + timeout
+    doc: dict = {}
+    while time.monotonic() < deadline:
+        reply = await admin(cluster.master_port, "rebuild-status")
+        doc = json.loads(reply.json)
+        if (
+            doc.get("completed", 0) >= min_completed
+            and not doc.get("active")
+        ):
+            return doc
+        await asyncio.sleep(0.3)
+    raise AssertionError(f"rebuild never converged: {doc}")
+
+
+async def _wait_redundant(c, inode: int, expected_parts: int,
+                          timeout: float = 90.0) -> None:
+    """Rebuild convergence via the source of truth: the chunk's locate
+    reply lists ``expected_parts`` distinct parts on live servers."""
+    deadline = time.monotonic() + timeout
+    seen: set = set()
+    while time.monotonic() < deadline:
+        loc = await c.chunk_info(inode, 0)
+        seen = {l.part_id for l in loc.locations}
+        if len(seen) >= expected_parts:
+            return
+        await asyncio.sleep(0.3)
+    raise AssertionError(
+        f"redundancy never restored: {len(seen)}/{expected_parts} parts"
+    )
+
+
+def _payload(seed: int, n: int) -> bytes:
+    from lizardfs_tpu.utils import data_generator
+
+    return data_generator.generate(seed, n).tobytes()
+
+
+# --- schedules --------------------------------------------------------------
+
+
+async def run_kill_write(cluster: ChaosCluster, rng: random.Random,
+                         log) -> None:
+    """SIGKILL a chunkserver mid-windowed-write: the write completes
+    through retries, reads stay byte-identical, rebuild restores
+    redundancy."""
+    c = await _client(cluster)
+    try:
+        f = await c.create(1, "victim.bin")
+        await c.setgoal(f.inode, 5)  # ec(3,2)
+        payload = _payload(rng.randrange(1 << 20), 5 * 2**20 + 333)
+        victim = rng.randrange(cluster.n_cs)
+        delay = rng.uniform(0.02, 0.25)
+
+        async def killer():
+            await asyncio.sleep(delay)
+            log(f"  SIGKILL cs{victim} after {delay * 1e3:.0f} ms")
+            cluster.kill9(f"cs{victim}")
+
+        kill_task = asyncio.ensure_future(killer())
+        await c.write_file(f.inode, payload)
+        await kill_task
+        c.cache.invalidate(f.inode)
+        got = await c.read_file(f.inode)
+        assert got == payload, "byte identity after SIGKILL mid-write"
+        # rebuild convergence: all 5 ec(3,2) parts live again on the
+        # 3 survivors (victim may or may not have held parts — the
+        # locate reply, not the engine's counters, is the invariant)
+        await _wait_redundant(c, f.inode, expected_parts=5)
+    finally:
+        await c.close()
+
+
+async def run_bitflip_read(cluster: ChaosCluster, rng: random.Random,
+                           log) -> None:
+    """Flip one stored-part bit under a live read: the client
+    CRC-rejects the part, recovers the stripe via decode, reports the
+    damage, and the master re-queues the part through the
+    RebuildEngine."""
+    from lizardfs_tpu.runtime import faults as faultsmod
+
+    # sentinel rule in the DRIVER process: never matches (no such
+    # site) but sets ACTIVE, standing the client's native fast paths
+    # down so the CRC rejection takes the deterministic Python path
+    faultsmod.arm("client:__sentinel__ delay=1")
+    c = await _client(cluster)
+    try:
+        f = await c.create(1, "flip.bin")
+        await c.setgoal(f.inode, 5)  # ec(3,2)
+        payload = _payload(rng.randrange(1 << 20), 3 * 2**20 + 17)
+        await c.write_file(f.inode, payload)
+        victim = rng.randrange(cluster.n_cs)
+        port = cluster.cs_ports[victim]
+        await cluster.arm(
+            port, "chunkserver:disk_pread flip,limit=1"
+        )
+        log(f"  armed disk_pread flip on cs{victim}")
+        c.cache.invalidate(f.inode)
+        got = await c.read_file(f.inode)
+        assert got == payload, "byte identity through CRC-reject + decode"
+        # the fault actually fired, and the CS's health names it
+        doc = await cluster.faults(port)
+        assert any(r["fired"] for r in doc["rules"]), doc
+        health = json.loads((await admin(port, "health")).json)
+        assert "disk_pread" in json.dumps(health.get("faults", {})), health
+        # detection -> report -> rebuild: the client told the master,
+        # the engine re-replicated the part
+        assert c.metrics.counter("damaged_parts_reported").total >= 1
+        await _wait_rebuilt(cluster, min_completed=1, timeout=90.0)
+        # prometheus surface: the CS exported the labeled fire counter
+        prom = json.loads((await admin(port, "metrics-prom")).json)["text"]
+        assert 'lizardfs_faults_injected_total{' in prom, "faults counter"
+    finally:
+        faultsmod.clear()
+        await c.close()
+
+
+async def run_stall_acks(cluster: ChaosCluster, rng: random.Random,
+                         log) -> None:
+    """Delay write-status acks on one chunkserver: back-pressure must
+    slow the windowed write, never wedge it; bytes stay identical."""
+    c = await _client(cluster)
+    try:
+        victim = rng.randrange(cluster.n_cs)
+        delay_ms = rng.choice((40, 60, 80))
+        await cluster.arm(
+            cluster.cs_ports[victim],
+            f"chunkserver:frame_send:CstoclWriteStatus delay={delay_ms},p=0.5",
+        )
+        log(f"  armed {delay_ms} ms ack stall (p=0.5) on cs{victim}")
+        f = await c.create(1, "stall.bin")
+        await c.setgoal(f.inode, 5)
+        payload = _payload(rng.randrange(1 << 20), 4 * 2**20 + 999)
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        got = await c.read_file(f.inode)
+        assert got == payload, "byte identity under ack stalls"
+        doc = await cluster.faults(cluster.cs_ports[victim])
+        assert any(r["fired"] for r in doc["rules"]), doc
+    finally:
+        await c.close()
+
+
+async def run_shadow_stale(cluster: ChaosCluster, rng: random.Random,
+                           log) -> None:
+    """Partition the chunkserver->shadow mirror plane: the shadow keeps
+    serving (increasingly stale) locates; clients detect missing
+    locations and recover through the primary. Reads stay correct the
+    whole time."""
+    c = await _client(cluster, shadow=True)
+    try:
+        f = await c.create(1, "stale.bin")
+        await c.setgoal(f.inode, 5)
+        payload = _payload(rng.randrange(1 << 20), 2 * 2**20 + 5)
+        await c.write_file(f.inode, payload)
+        # let the shadow catch up + serve a few replica reads
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            await c.getattr(f.inode)
+            if c.metrics.counter("shadow_reads").total > 0:
+                break
+            await asyncio.sleep(0.2)
+        assert c.metrics.counter("shadow_reads").total > 0, \
+            "shadow never served"
+        # partition: every mirror registration/report into the shadow
+        # drops at the frame boundary from now on
+        await cluster.arm(
+            cluster.shadow_port, "master:frame_recv:CstomaRegister drop"
+        )
+        await cluster.arm(
+            cluster.shadow_port, "master:frame_recv:CstomaChunkNew drop"
+        )
+        log("  mirror plane partitioned at the shadow")
+        # new data written AFTER the partition: the shadow's changelog
+        # still flows (follow link untouched) but it has no locations
+        # for the new chunks — replica locates come back empty and the
+        # client re-locates through the primary
+        f2 = await c.create(1, "post-partition.bin")
+        await c.setgoal(f2.inode, 5)
+        payload2 = _payload(rng.randrange(1 << 20), 2 * 2**20 + 77)
+        await c.write_file(f2.inode, payload2)
+        c.cache.invalidate(f.inode)
+        c.cache.invalidate(f2.inode)
+        assert await c.read_file(f.inode) == payload, "pre-partition file"
+        assert await c.read_file(f2.inode) == payload2, \
+            "post-partition file readable despite stale shadow locates"
+        doc = await cluster.faults(cluster.shadow_port)
+        assert doc["active"], doc
+    finally:
+        await c.close()
+
+
+SCHEDULES = {
+    "kill-write": (run_kill_write, dict(n_cs=4)),
+    "bitflip-read": (run_bitflip_read, dict(n_cs=3)),
+    "stall-acks": (run_stall_acks, dict(n_cs=3)),
+    "shadow-stale": (run_shadow_stale, dict(n_cs=3, shadow=True)),
+}
+
+
+async def run_schedule(name: str, seed: int, workdir: str | None = None,
+                       log=print) -> None:
+    """Run one schedule at one seed; raises on any invariant violation.
+    The whole run sits under the bounded-time budget."""
+    fn, topo = SCHEDULES[name]
+    rng = random.Random(seed)
+    tmp_ctx = (
+        tempfile.TemporaryDirectory(prefix=f"chaos-{name}-")
+        if workdir is None else None
+    )
+    tmp = workdir if workdir is not None else tmp_ctx.name
+    cluster = ChaosCluster(tmp, **topo)
+    try:
+        await asyncio.wait_for(_run_body(cluster, fn, rng, log), BUDGET_S)
+    finally:
+        cluster.stop()
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+
+
+async def _run_body(cluster, fn, rng, log) -> None:
+    await cluster.start()
+    await fn(cluster, rng, log)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="chaos", description=__doc__)
+    p.add_argument("--schedule", choices=sorted(SCHEDULES),
+                   help="one schedule (default: --all)")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--seeds", default="1,2,3",
+                   help="comma-separated seed list for --all runs")
+    p.add_argument("--all", action="store_true",
+                   help="run every schedule at every seed")
+    p.add_argument("--workdir", default=None,
+                   help="keep cluster state/logs here instead of a tmpdir")
+    args = p.parse_args(argv)
+
+    names = [args.schedule] if args.schedule else sorted(SCHEDULES)
+    if args.seed is not None:
+        seeds = [args.seed]
+    else:
+        seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    failed = 0
+    for name in names:
+        for seed in seeds:
+            t0 = time.monotonic()
+            print(f"=== {name} seed={seed}")
+            try:
+                asyncio.run(run_schedule(name, seed,
+                                         workdir=args.workdir))
+                print(f"=== {name} seed={seed} PASS "
+                      f"({time.monotonic() - t0:.1f}s)")
+            except (KeyboardInterrupt, SystemExit):
+                raise  # an interrupted matrix must stop, not keep booting
+            except BaseException as e:  # noqa: BLE001 — report + replay line
+                failed += 1
+                print(f"=== {name} seed={seed} FAIL: {e!r}")
+                print(f"    replay: python -m lizardfs_tpu.tools.chaos "
+                      f"--schedule {name} --seed {seed}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

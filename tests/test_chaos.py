@@ -1,0 +1,223 @@
+"""Chaos harness system tier: seeded fault schedules + invariants.
+
+Tier-1 ("not slow") coverage:
+  * an in-process seeded chaos smoke (bitflip under a live ec(3,2)
+    read -> CRC-reject -> decode recovery -> damage report -> rebuild
+    requeue), the ISSUE's corrupt-part failover drill;
+  * the LZ_FAULTS-unset EQUIVALENCE pin: with no rules armed the
+    instrumented choke points never run and a write/read roundtrip is
+    byte-identical (the kill-switch acceptance criterion);
+  * the unbounded-await worst-offender regression: a write-chain
+    next-hop that accepts the connect but never answers the init used
+    to wedge the whole chain forever — now it fails in bounded time;
+  * ack-stall smoke: delayed write acks slow a write, never wedge it.
+
+The full real-multi-process schedule set (tools/chaos.py) runs under
+``-m slow`` and `make chaos`, across >= 3 seeds.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.proto import framing, messages as m, status as st
+from lizardfs_tpu.runtime import faults
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import Cluster, EC_GOAL
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --- LZ_FAULTS-unset equivalence (acceptance criterion) ---------------------
+
+
+async def test_faults_off_equivalence(tmp_path, monkeypatch):
+    """With no rules armed the choke points are one dead flag check:
+    decide() must never run, native paths stay on, and a write/read
+    roundtrip is byte-identical."""
+    assert faults.ACTIVE is False
+
+    def _forbidden(*a, **k):  # pragma: no cover — the assertion IS the test
+        raise AssertionError("faults.decide ran with injection off")
+
+    monkeypatch.setattr(faults, "decide", _forbidden)
+    cluster = Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "equiv.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(5, 512 * 1024 + 123).tobytes()
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode) == payload
+    finally:
+        await cluster.stop()
+
+
+# --- corrupt-part read failover (satellite drill) ---------------------------
+
+
+async def test_bitflip_crc_reject_decode_and_rebuild(tmp_path):
+    """Seeded bit-flip on a stored ec(3,2) part under a live read: the
+    client CRC-rejects the corrupt part, recovers the stripe via decode
+    (byte identity), reports the damaged part to the master, and the
+    part is re-queued through the RebuildEngine until redundancy is
+    back to 5/5."""
+    cluster = Cluster(tmp_path, n_cs=3, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "flip.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(9, 768 * 1024 + 17).tobytes()
+        await c.write_file(f.inode, payload)
+
+        faults.install("seed=42; chunkserver:disk_pread flip,limit=1")
+        c.cache.invalidate(f.inode)
+        got = await c.read_file(f.inode)
+        assert got == payload, "decode recovery under injected corruption"
+        assert faults.fired_total() == 1, "exactly one seeded flip fired"
+
+        # the client CRC-rejected and REPORTED the damaged part...
+        assert c.metrics.counter("damaged_parts_reported").total >= 1
+        # ...the master dropped it and queued the chunk for rebuild...
+        loc = await c.chunk_info(f.inode, 0)
+        registry = cluster.master.meta.registry
+        chunk = registry.chunk(loc.chunk_id)
+
+        async def until(cond, timeout=30.0, what=""):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if cond():
+                    return
+                await asyncio.sleep(0.1)
+            raise AssertionError(f"never converged: {what}")
+
+        # report lands async (fire-and-forget): wait for the drop, then
+        # for the engine to restore all 5 parts
+        await until(lambda: len(chunk.parts) <= 5, what="report")
+        await until(
+            lambda: len({p for _, p in chunk.parts}) == 5
+            and cluster.master.rebuild.completed >= 1,
+            timeout=60.0, what="rebuild convergence",
+        )
+
+        # observability invariants: the fired fault is NAMED in the
+        # chunkserver health snapshot and counted on its metrics page
+        fired_cs = [
+            cs for cs in cluster.chunkservers
+            if "faults_injected" in cs.metrics.labeled
+        ]
+        assert fired_cs, "fire counted in a chunkserver registry"
+        snap = fired_cs[0].health_snapshot()
+        assert any("disk_pread" in r for r in snap["faults"]["rules"])
+        assert "lizardfs_faults_injected_total{" in (
+            fired_cs[0].metrics.to_prometheus()
+        )
+    finally:
+        await cluster.stop()
+
+
+# --- chaos smoke: seeded ack stall (tier-1) ---------------------------------
+
+
+async def test_chaos_smoke_ack_stall_seeded(tmp_path):
+    """Tier-1 chaos smoke: seeded write-ack delays (p=0.5) on the
+    asyncio plane slow a windowed ec(3,2) write but never wedge it —
+    bounded-time completion + byte identity, deterministic per seed."""
+    cluster = Cluster(tmp_path, n_cs=3, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        faults.install(
+            "seed=7; "
+            "chunkserver:frame_send:CstoclWriteStatus delay=15,p=0.5,limit=20"
+        )
+        f = await c.create(1, "stall.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(3, 640 * 1024 + 999).tobytes()
+        t0 = time.monotonic()
+        await asyncio.wait_for(c.write_file(f.inode, payload), 60.0)
+        assert time.monotonic() - t0 < 60.0
+        assert faults.fired_total() > 0, "stalls actually fired"
+        faults.clear()
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode) == payload
+    finally:
+        await cluster.stop()
+
+
+# --- unbounded-await worst offender: write-chain init -----------------------
+
+
+async def test_write_chain_init_reply_bounded(tmp_path):
+    """Regression pin for the audit's worst offender: a chain next-hop
+    that ACCEPTS the dial but never answers the forwarded WriteInit
+    used to hang `await framing.read_message(dr)` forever, wedging the
+    head's connection loop. Now the head answers TIMEOUT in bounded
+    time."""
+    blackhole_conns = []
+
+    async def blackhole(reader, writer):
+        blackhole_conns.append(writer)
+        await asyncio.sleep(3600)
+
+    server = await asyncio.start_server(blackhole, "127.0.0.1", 0)
+    bh_port = server.sockets[0].getsockname()[1]
+    cs = ChunkServer(str(tmp_path), master_addr=None,
+                     native_data_plane=False)
+    cs.CHAIN_INIT_TIMEOUT = 1.0
+    await cs.start()
+    try:
+        r, w = await asyncio.open_connection("127.0.0.1", cs.port)
+        await framing.send_message(
+            w,
+            m.CltocsWriteInit(
+                req_id=1, chunk_id=0xDEAD, version=1, part_id=0,
+                chain=[m.PartLocation(
+                    addr=m.Addr(host="127.0.0.1", port=bh_port), part_id=0,
+                )],
+                create=1,
+            ),
+        )
+        t0 = time.monotonic()
+        reply = await asyncio.wait_for(framing.read_message(r), 30.0)
+        elapsed = time.monotonic() - t0
+        assert isinstance(reply, m.CstoclWriteStatus)
+        assert reply.status == st.TIMEOUT
+        assert elapsed < 10.0, f"chain init not bounded ({elapsed:.1f}s)"
+        w.close()
+    finally:
+        server.close()
+        await cs.stop()
+
+
+# --- full schedule set (real processes, >= 3 seeds) -------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("schedule", [
+    "kill-write", "bitflip-read", "stall-acks", "shadow-stale",
+])
+async def test_chaos_schedules_full(tmp_path, schedule, seed):
+    """The acceptance matrix: every schedule passes deterministically
+    across 3 seeds on a real multi-process cluster. `make chaos` runs
+    the same set via the driver (seeds printed on failure for replay)."""
+    from lizardfs_tpu.tools import chaos
+
+    await chaos.run_schedule(
+        schedule, seed, workdir=str(tmp_path), log=lambda *_: None
+    )

@@ -1,0 +1,348 @@
+"""Unit tier for the fault-injection framework + unified retry policy.
+
+Covers the LZ_FAULTS spec grammar, deterministic seeded decisions, the
+frame/disk site semantics, the debug_read_delay_ms tweak alias, and the
+RetryPolicy deadline-threading contract (nested retries share ONE
+budget). The system tier (real clusters, seeded schedules) lives in
+tests/test_chaos.py.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import faults
+from lizardfs_tpu.runtime import retry as retrymod
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --- spec grammar -----------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    seed, rules = faults.parse_spec(
+        "seed=42; chunkserver:disk_pread flip,limit=1 ;"
+        "client:frame_send:CltocsWrite*:127.0.0.1:* delay=40,p=0.25,after=2;"
+        "*:dial error=CRC_ERROR"
+    )
+    assert seed == 42 and len(rules) == 3
+    assert rules[0].site == "disk_pread" and rules[0].limit == 1
+    # the peer pattern is the REST of the match: host:port addresses
+    # (the documented dial form) keep their colon
+    assert rules[1].op == "CltocsWrite*" and rules[1].peer == "127.0.0.1:*"
+    assert rules[1].ms == 40 and rules[1].prob == 0.25 and rules[1].after == 2
+    assert rules[2].code == st.CRC_ERROR and rules[2].role == "*"
+
+
+def test_peer_pattern_with_port_fires():
+    """Regression: a host:port peer pattern (the documented dial form)
+    must match — earlier parsing truncated it at the colon and the rule
+    silently never fired."""
+    fs = faults.FaultSet(1, [
+        faults.parse_rule("client:dial:cs:10.0.0.5:9422 drop")
+    ])
+    assert fs.match("client", "dial", "cs", "10.0.0.5:9422") is not None
+    assert fs.match("client", "dial", "cs", "10.0.0.5:9999") is None
+
+
+def test_frame_recv_flip_spares_version_byte():
+    """Recv-side flips corrupt CONTENT, never the leading protocol-
+    version byte (a version flip would read as negotiation failure,
+    not data corruption)."""
+    rule = faults.parse_rule("*:frame_recv flip")
+    for i in range(64):
+        rule.seed(i, 0)
+        data = b"\x01" + bytes(32)
+        out = faults.flip_bit(data, rule, lo=1)
+        assert out[0] == 1 and out != data
+
+
+@pytest.mark.parametrize("bad", [
+    "chunkserver:disk_pread",          # no action
+    "x:y explode",                     # unknown action
+    "x:y delay",                       # delay without ms
+    "x:y delay=abc",                   # bad ms
+    "x:y error=NO_SUCH_STATUS",        # unknown status
+    "x:y drop,p=2",                    # probability out of range
+    "x:y drop,frobnicate=1",           # unknown key
+    "seed=zzz; x:y drop",              # bad seed
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_kill_switch_discipline():
+    """LZ_FAULTS unset / cleared => ACTIVE False — the one flag every
+    choke point gates on (zero overhead, byte-identical)."""
+    assert faults.ACTIVE is False
+    faults.arm("client:dial drop")
+    assert faults.ACTIVE is True
+    faults.clear()
+    assert faults.ACTIVE is False
+
+
+# --- deterministic decisions ------------------------------------------------
+
+
+def _fire_pattern(seed: int, n: int = 64) -> list[bool]:
+    fs = faults.FaultSet(seed, [faults.parse_rule("client:dial drop,p=0.5")])
+    return [fs.match("client", "dial", "cs", "p") is not None
+            for _ in range(n)]
+
+
+def test_seeded_decisions_replay_exactly():
+    a, b = _fire_pattern(7), _fire_pattern(7)
+    assert a == b, "same seed + same match sequence => same fires"
+    assert a != _fire_pattern(8), "different seed => different stream"
+    assert 5 < sum(a) < 59, "p=0.5 actually skips and fires"
+
+
+def test_limit_after_and_counts():
+    fs = faults.FaultSet(1, [
+        faults.parse_rule("*:site1 drop,after=2,limit=2")
+    ])
+    hits = [fs.match("r", "site1", "", "") is not None for _ in range(6)]
+    assert hits == [False, False, True, True, False, False]
+    rule = fs.rules[0]
+    assert rule.matched == 6 and rule.fired == 2
+
+
+def test_flip_bit_deterministic_and_single_bit():
+    r1 = faults.parse_rule("*:x flip")
+    r1.seed(3, 0)
+    r2 = faults.parse_rule("*:x flip")
+    r2.seed(3, 0)
+    data = bytes(range(64))
+    a, b = faults.flip_bit(data, r1), faults.flip_bit(data, r2)
+    assert a == b and a != data
+    diff = [i for i in range(64) if a[i] != data[i]]
+    assert len(diff) == 1
+    assert bin(a[diff[0]] ^ data[diff[0]]).count("1") == 1
+
+
+# --- site semantics ---------------------------------------------------------
+
+
+class _FakeWriter:
+    def __init__(self):
+        self.sent = b""
+        self.closed = False
+
+    def write(self, data):
+        self.sent += data
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+    def get_extra_info(self, _name):
+        return ("127.0.0.1", 1234)
+
+
+@pytest.mark.asyncio
+async def test_frame_point_actions():
+    w = _FakeWriter()
+    data = b"HDRHDRHD" + b"\x01" + bytes(32)
+
+    faults.arm("client:frame_send:Victim drop,limit=1")
+    with pytest.raises(ConnectionResetError):
+        await faults.frame_point("frame_send", "Victim", data,
+                                 peer="127.0.0.1:1234", writer=w)
+    assert w.closed
+
+    faults.clear()
+    faults.arm("client:frame_send:Victim flip,limit=1")
+    out = await faults.frame_point("frame_send", "Victim", data, writer=w)
+    assert out != data and len(out) == len(data)
+    assert out[:9] == data[:9], "flip lands in the body, framing survives"
+
+    faults.clear()
+    faults.arm("client:frame_send:Victim short,limit=1")
+    w2 = _FakeWriter()
+    with pytest.raises(ConnectionResetError):
+        await faults.frame_point("frame_send", "Victim", data, writer=w2)
+    assert 0 < len(w2.sent) < len(data) and w2.closed, "torn write"
+
+    # no matching rule: bytes pass through untouched
+    out = await faults.frame_point("frame_send", "Other", data, writer=w)
+    assert out == data
+
+
+@pytest.mark.asyncio
+async def test_frame_point_delay_and_events():
+    faults.arm("client:frame_recv:* delay=30,limit=1")
+    t0 = time.monotonic()
+    out = await faults.frame_point("frame_recv", "Any", b"\x01abc")
+    assert out == b"\x01abc"
+    assert time.monotonic() - t0 >= 0.025
+    desc = faults.describe()
+    assert desc["rules"][0]["fired"] == 1
+    assert desc["events"][-1]["action"] == "delay"
+
+
+def test_disk_site_error_and_flip(tmp_path):
+    from lizardfs_tpu.chunkserver.chunk_store import (
+        ChunkStore, ChunkStoreError,
+    )
+    from lizardfs_tpu.constants import MFSBLOCKSIZE
+    from lizardfs_tpu.ops import crc32 as crc_mod
+
+    store = ChunkStore(str(tmp_path))
+    store.create(0xABC, 1, 0)
+    block = bytes(range(256)) * (MFSBLOCKSIZE // 256)
+    store.write(0xABC, 1, 0, 0, 0, block, crc_mod.crc32(block))
+
+    # error action surfaces as a ChunkStoreError with the asked status
+    faults.arm("chunkserver:disk_pread error=CRC_ERROR,limit=1")
+    with pytest.raises(ChunkStoreError) as e:
+        store.read(0xABC, 1, 0, 0, MFSBLOCKSIZE)
+    assert e.value.code == st.CRC_ERROR
+    # next read is clean (limit spent)
+    pieces = store.read(0xABC, 1, 0, 0, MFSBLOCKSIZE)
+    assert bytes(pieces[0][1]) == block
+
+    # flip: data corrupt but the ADVERTISED crc is the stored one —
+    # exactly what a receiver-side CRC check must catch
+    faults.clear()
+    faults.arm("chunkserver:disk_pread flip,limit=1")
+    pieces = store.read(0xABC, 1, 0, 0, MFSBLOCKSIZE)
+    off, data, crc = pieces[0]
+    assert crc_mod.crc32(bytes(data)) != crc, "flip defeats the piece CRC"
+
+    # disk_pwrite flip = latent corruption the next read catches
+    faults.clear()
+    faults.arm("chunkserver:disk_pwrite flip,limit=1")
+    store.write(0xABC, 1, 0, 1, 0, block, crc_mod.crc32(block))
+    faults.clear()
+    with pytest.raises(ChunkStoreError) as e:
+        store.read(0xABC, 1, 0, MFSBLOCKSIZE, MFSBLOCKSIZE)
+    assert e.value.code == st.CRC_ERROR
+
+
+def test_debug_read_delay_tweak_alias(tmp_path):
+    """The legacy tweak rides the framework: setting it arms the
+    serve_read delay rule, zero clears it, re-setting replaces (never
+    stacks), and the tweaks listing still shows the value."""
+    from lizardfs_tpu.chunkserver.server import ChunkServer
+
+    cs = ChunkServer(str(tmp_path), master_addr=None)
+    assert cs.tweaks.set("debug_read_delay_ms", "150")
+    desc = faults.describe()
+    assert [r for r in desc["rules"] if r["alias"] == "debug_read_delay_ms"]
+    assert "delay=150" in desc["rules"][0]["rule"]
+    assert cs.tweaks.to_dict()["debug_read_delay_ms"] == 150
+    # replace, not stack
+    assert cs.tweaks.set("debug_read_delay_ms", "80")
+    rules = [r for r in faults.describe()["rules"]
+             if r["alias"] == "debug_read_delay_ms"]
+    assert len(rules) == 1 and "delay=80" in rules[0]["rule"]
+    assert cs.tweaks.set("debug_read_delay_ms", "0")
+    assert not faults.describe()["rules"] and not faults.ACTIVE
+
+
+# --- RetryPolicy ------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_retry_policy_transient_then_success():
+    calls = []
+
+    async def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("boom")
+        return "ok"
+
+    policy = retrymod.RetryPolicy(attempts=5, base_delay=0.01, max_delay=0.02)
+    assert await policy.run(attempt) == "ok"
+    assert len(calls) == 3
+
+
+@pytest.mark.asyncio
+async def test_retry_policy_permanent_raises_immediately():
+    calls = []
+
+    async def attempt():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        await retrymod.RetryPolicy(attempts=5, base_delay=0.01).run(attempt)
+    assert len(calls) == 1
+
+
+@pytest.mark.asyncio
+async def test_retry_policy_exhaustion_wraps_last():
+    async def attempt():
+        raise ConnectionResetError("always")
+
+    with pytest.raises(retrymod.RetryError) as e:
+        await retrymod.RetryPolicy(attempts=3, base_delay=0.01).run(attempt)
+    assert isinstance(e.value.last, ConnectionResetError)
+
+
+@pytest.mark.asyncio
+async def test_deadline_threads_through_nested_policies():
+    """The anti-amplification contract: an inner policy with a LARGER
+    deadline still finishes inside the outer budget — stacked retries
+    share one end-to-end allowance."""
+    async def hang():
+        await asyncio.sleep(30.0)
+
+    async def inner():
+        # inner policy asks for 30 s; the ambient (outer) 0.4 s wins
+        await retrymod.RetryPolicy(
+            attempts=50, base_delay=0.01, deadline=30.0
+        ).run(hang)
+
+    t0 = time.monotonic()
+    with pytest.raises(retrymod.RetryError):
+        await retrymod.RetryPolicy(
+            attempts=50, base_delay=0.01, deadline=0.4
+        ).run(inner)
+    assert time.monotonic() - t0 < 3.0, "outer deadline bounded everything"
+
+
+@pytest.mark.asyncio
+async def test_bounded_wait_inherits_ambient_deadline():
+    token = retrymod._DEADLINE.set(retrymod.Deadline(0.2))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(asyncio.TimeoutError):
+            # cap says 60 s, ambient deadline says ~0.2 s: tightest wins
+            await retrymod.bounded_wait(asyncio.sleep(30.0), 60.0)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        retrymod._DEADLINE.reset(token)
+    # outside any policy the cap alone applies (and None = unbounded)
+    assert retrymod.budget() is None
+    assert retrymod.budget(5.0) == 5.0
+
+
+@pytest.mark.asyncio
+async def test_labeled_fault_counters_ride_metrics():
+    from lizardfs_tpu.runtime.metrics import Metrics
+
+    mt = Metrics()
+    faults.attach_metrics("client", mt)
+    faults.arm("client:dial drop,limit=2")
+    decisions = [
+        faults.decide("dial", op="cs", peer="x", role="client")
+        for _ in range(3)
+    ]
+    assert [d is not None for d in decisions] == [True, True, False]
+    fam = mt.labeled.get("faults_injected", {})
+    totals = {k: s.total for k, s in fam.items()}
+    assert totals == {(("action", "drop"), ("site", "dial")): 2.0}
+    assert "lizardfs_faults_injected_total{" in mt.to_prometheus()

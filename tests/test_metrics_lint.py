@@ -115,6 +115,15 @@ def test_lint_synthetic_registry_all_kinds():
     mt.counter("bytes_read", help="bytes served to clients").inc(10)
     mt.gauge("depth").set(1.5)  # auto-help path must still lint
     mt.counter("op.read").inc(3)  # dotted name must sanitize
+    # labeled counter family (faults_injected{site,action} shape): one
+    # HELP/TYPE block, one sample per label combination
+    mt.labeled_counter(
+        "faults_injected", {"site": "disk_pread", "action": "flip"},
+        help="injected faults",
+    ).inc()
+    mt.labeled_counter(
+        "faults_injected", {"site": "dial", "action": 'dr"op\\'},
+    ).inc(2)  # hostile label value must sanitize, not break the page
     mt.sample_all(1.0)
     mt.define("total", "bytes_read 2 MUL", help="derived doubling")
     mt.timing("CltomaCreate", help="create latency").record(0.001)
@@ -122,12 +131,15 @@ def test_lint_synthetic_registry_all_kinds():
     typed = lint_prometheus(mt.to_prometheus())
     assert typed["lizardfs_bytes_read_total"] == "counter"
     assert typed["lizardfs_op_read_total"] == "counter"
+    assert typed["lizardfs_faults_injected_total"] == "counter"
     assert typed["lizardfs_total"] == "gauge"  # derived exports as gauge
     assert typed["lizardfs_timing_CltomaCreate_us"] == "histogram"
     assert typed["lizardfs_slo_read_burn_fast"] == "gauge"
     # the explicit help text made it to the page verbatim
     text = mt.to_prometheus()
     assert "# HELP lizardfs_bytes_read_total bytes served to clients" in text
+    assert ('lizardfs_faults_injected_total'
+            '{action="flip",site="disk_pread"} 1') in text
 
 
 def test_lint_rejects_violations():
@@ -146,7 +158,11 @@ async def test_lint_live_daemon_registries(tmp_path):
     """The real scrape surfaces: master + chunkserver pages after real
     traffic (SLO gauges, timings, native folds included) pass lint —
     both read in-process and as served over the admin link."""
-    cluster = Cluster(tmp_path, n_cs=2)
+    from lizardfs_tpu.runtime import faults
+
+    # asyncio data plane: the serve_read fault fired below must hit the
+    # instrumented path (the native plane pre-dates the armed rule)
+    cluster = Cluster(tmp_path, n_cs=2, native_data_plane=False)
     await cluster.start()
     try:
         c = await cluster.client()
@@ -154,6 +170,18 @@ async def test_lint_live_daemon_registries(tmp_path):
         await c.write_file(f.inode, b"x" * 300_000)
         c.cache.invalidate(f.inode)
         await c.read_file(f.inode, 0, 300_000)
+        # fire one injected fault so the labeled faults_injected family
+        # is present on a LIVE page (new-series lint coverage)
+        faults.install("seed=1; chunkserver:serve_read delay=1,limit=1")
+        try:
+            c.cache.invalidate(f.inode)
+            await c.read_file(f.inode, 0, 1024)
+        finally:
+            faults.clear()
+        assert any(
+            "faults_injected" in cs.metrics.labeled
+            for cs in cluster.chunkservers
+        )
         await cluster.master._health_tick()
         for daemon in [cluster.master, *cluster.chunkservers]:
             lint_prometheus(daemon.metrics.to_prometheus())

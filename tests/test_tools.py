@@ -121,6 +121,29 @@ async def test_admin_cli(tmp_path, capsys):
         assert await admin_cli._amain([master, "rebuild-status"]) == 0
         out = capsys.readouterr().out
         assert "queued: lost 0" in out and "throttle unlimited" in out
+
+        # faults subcommand: list (inactive) -> arm -> list -> clear
+        from lizardfs_tpu.runtime import faults as faultsmod
+
+        try:
+            assert await admin_cli._amain([master, "faults"]) == 0
+            assert "inactive" in capsys.readouterr().out
+            assert await admin_cli._amain(
+                [master, "faults", "arm",
+                 "chunkserver:disk_pread flip,limit=1"]
+            ) == 0
+            out = capsys.readouterr().out
+            assert "ARMED" in out and "disk_pread" in out
+            # malformed rules are refused, not half-armed
+            assert await admin_cli._amain(
+                [master, "faults", "arm", "not-a-rule"]
+            ) == 1
+            capsys.readouterr()
+            assert await admin_cli._amain([master, "faults", "clear"]) == 0
+            assert "inactive" in capsys.readouterr().out
+            assert not faultsmod.ACTIVE
+        finally:
+            faultsmod.clear()
     finally:
         await cluster.stop()
 
