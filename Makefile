@@ -1,12 +1,36 @@
 # Top-level CI/tooling targets. Native-code targets live in native/Makefile.
 
 PY ?= python
+CXX ?= g++
 SEEDS ?= 1,2,3
 
-# tier-1: the fast suite CI gates on (ROADMAP.md "Tier-1 verify")
+# tier-1: the fast suite CI gates on (ROADMAP.md "Tier-1 verify").
+# tests/test_invariant_lint.py rides in it, so tier-1 holds the tree
+# at zero unwaived lint findings by default.
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 	  --continue-on-collection-errors -p no:cacheprovider
+
+# invariant lint engine (lizardfs_tpu/tools/lint): the four repo
+# checkers — cross-await-race, unbounded-await, wire-skew, kill-switch.
+# Exit 0 == zero unwaived findings. Stamps .lint-stamp so `make chaos`
+# can tell when the tree changed since the last lint run.
+lint:
+	$(PY) -m lizardfs_tpu.tools.lint
+	@touch .lint-stamp
+
+# sanitizer matrix over the FULL native surface (native/Makefile
+# `sanitize`: ASan+UBSan and TSan over ec/io/serve + the shm plane),
+# then the C NFS client instrumented under a real Python gateway
+# (LZ_CLIENT_SO points cnfs.py at the ASan build).
+sanitize:
+	$(MAKE) -C native sanitize
+	LZ_CLIENT_SO=$(CURDIR)/native/liblizardfs_client_asan.so \
+	  LD_PRELOAD="$$($(CXX) -print-file-name=libasan.so) $$($(CXX) -print-file-name=libubsan.so)" \
+	  ASAN_OPTIONS=detect_leaks=0,halt_on_error=1 \
+	  UBSAN_OPTIONS=halt_on_error=1,print_stacktrace=1 \
+	  JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_nfs.py -q -k c_client \
+	  -p no:cacheprovider
 
 # chaos: the full seeded fault-schedule set against REAL multi-process
 # clusters (tools/chaos.py). Every schedule runs at every seed in
@@ -14,6 +38,11 @@ test:
 # command, so a red run reproduces deterministically:
 #   make chaos SEEDS=1,2,3,4,5
 chaos:
+	@if [ ! -f .lint-stamp ] || [ -n "$$(find lizardfs_tpu tests doc \
+	  native \( -name '*.py' -o -name '*.h' -o -name '*.cpp' \
+	  -o -name '*.md' \) -newer .lint-stamp -print -quit)" ]; then \
+	  echo "note: invariant lint has not run on this tree state —" \
+	       "run 'make lint' before trusting a chaos verdict"; fi
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) -m lizardfs_tpu.tools.chaos \
 	  --all --seeds $(SEEDS)
 
@@ -26,4 +55,4 @@ chaos-slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test chaos chaos-slow native
+.PHONY: test lint sanitize chaos chaos-slow native

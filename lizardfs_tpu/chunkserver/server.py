@@ -73,11 +73,8 @@ class _WriteSession:
             self.relay_task.cancel()
         if self.downstream is not None:
             _, w = self.downstream
-            w.close()
-            try:
-                await w.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            # bounded: a dead next-hop must not park session close
+            await retrymod.close_writer(w, swallow_cancel=True)
 
 
 class ChunkServer(Daemon):
@@ -1253,6 +1250,7 @@ class ChunkServer(Daemon):
         # drain() only waits below the high-water mark, so under
         # sustained output the loaded buffer is streamed through the
         # transport instead of being thrown away for a second disk pass
+        # lint: waive(unbounded-await): server->client read backpressure on the per-connection serve task — a wedged consumer parks only its own connection, reaped on disconnect; a timer would cut live slow readers
         await writer.drain()
         if writer.transport.get_write_buffer_size() != 0:
             await self._stream_pieces_asyncio(writer, msg, buf, crcs)
@@ -1394,10 +1392,15 @@ class ChunkServer(Daemon):
             while True:
                 msg = await framing.read_message(dr)
                 if isinstance(msg, m.CstoclWriteStatus):
-                    session.down_status[msg.write_id] = msg.status
                     ev = session.down_event.get(msg.write_id)
-                    if ev is not None:
-                        ev.set()
+                    if ev is None:
+                        # late ack: the waiter already timed out (the
+                        # 30 s down_ev bound) and popped its entries —
+                        # storing a status nobody will ever consume
+                        # would leak one dict entry per timed-out write
+                        continue
+                    session.down_status[msg.write_id] = msg.status
+                    ev.set()
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             # downstream died: fail all waiting writes
             for wid, ev in session.down_event.items():
@@ -1447,9 +1450,19 @@ class ChunkServer(Daemon):
             self.log.exception("local write failed")
             code = st.EIO
         if down_ev is not None:
-            await down_ev.wait()
-            down_code = session.down_status.pop(msg.write_id, st.DISCONNECTED)
+            # bounded like the bulk path: a next-hop that accepted the
+            # dial but never acks must fail this write with TIMEOUT,
+            # not park the head's write task forever (the write-chain
+            # cousin of the PR-8 blackholed-WriteInit fix)
+            try:
+                await asyncio.wait_for(down_ev.wait(), 30.0)
+                down_code = session.down_status.pop(
+                    msg.write_id, st.DISCONNECTED
+                )
+            except asyncio.TimeoutError:
+                down_code = st.TIMEOUT
             session.down_event.pop(msg.write_id, None)
+            session.down_status.pop(msg.write_id, None)
             if code == st.OK:
                 code = down_code
         try:
@@ -1529,8 +1542,8 @@ class ChunkServer(Daemon):
         self.metrics.counter("bytes_written").inc(float(len(msg.data)))
         if down_ev is not None:
             if code == st.OK and down_ok == st.OK:
-                if msg.write_id in session.down_status:
-                    down_ev.set()
+                # no pre-set compensation needed: every path that
+                # stores down_status sets the event in the same step
                 try:
                     await asyncio.wait_for(down_ev.wait(), 30.0)
                     code = session.down_status.pop(

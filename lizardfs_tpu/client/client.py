@@ -32,6 +32,7 @@ from lizardfs_tpu.constants import (
     EATTR_NOENTRYCACHE,
     MFSBLOCKSIZE,
     MFSCHUNKSIZE,
+    env_flag,
 )
 from lizardfs_tpu.core import geometry, plans
 from lizardfs_tpu.core.encoder import ChunkEncoder, get_encoder
@@ -161,6 +162,19 @@ class Client:
         # how long a lost master may stay unreachable before ops fail
         # (election + promotion fit well inside this on a sane cluster)
         self.failover_timeout = 15.0
+        # single-flight registration: concurrent ops all failing on a
+        # dead master each call _reconnect; without serialization every
+        # one runs its own registration handshake and the master
+        # allocates a session per loser (the cross-await-race class the
+        # invariant lint flags). The lock serializes registration, the
+        # generation lets queued reconnects detect that a peer already
+        # finished the job while they waited.
+        self._conn_lock = asyncio.Lock()
+        self._conn_gen = 0
+        # bumped when a failover window EXHAUSTS: ops queued on the
+        # lock behind a failed reconnect must fail fast, not each
+        # serially re-run their own full failover_timeout window
+        self._reconnect_fail_gen = 0
         # end-to-end budget for one retried data op (_retry_transient):
         # the RetryPolicy deadline that nested dials/RPC waits inherit,
         # so a wedged chunk write fails the caller in bounded time
@@ -202,9 +216,7 @@ class Client:
         # in flight. LZ_WRITE_PIPELINE=0 is the kill switch (strictly
         # serial stage->encode->send ordering, the byte-identity golden
         # reference); LZ_WRITE_PIPELINE_SEGMENTS tunes pipeline depth.
-        self.write_pipeline = _os.environ.get(
-            "LZ_WRITE_PIPELINE", "1"
-        ).lower() not in ("0", "off", "false", "no")
+        self.write_pipeline = env_flag("LZ_WRITE_PIPELINE")
         try:
             self.write_pipeline_segments = max(
                 2, int(_os.environ.get("LZ_WRITE_PIPELINE_SEGMENTS", "4"))
@@ -400,6 +412,15 @@ class Client:
     # --- session -----------------------------------------------------------------
 
     async def connect(self, info: str = "pyclient", password: str = "") -> None:
+        # single-flight: registration mutates session identity
+        # (session_id, master conn, token floor) across awaits — only
+        # one coroutine may run the handshake at a time. _reconnect
+        # holds the same lock around its whole failover policy.
+        async with self._conn_lock:
+            await self._connect_locked(info, password)
+
+    async def _connect_locked(self, info: str, password: str) -> None:
+        """Registration handshake body. Caller MUST hold _conn_lock."""
         self._info = info
         self._password = password
         # spawn the native-IO pool threads while the process is quiet:
@@ -419,6 +440,7 @@ class Client:
                 )
                 self.master = conn
                 self.current_master_addr = addr  # failover moves this
+                # lint: waive(cross-await-race): every caller holds _conn_lock (connect/_reconnect) — the handshake is single-flight and adopts the server-issued id
                 self.session_id = reply.session_id
                 # the primary's position at registration seeds the
                 # monotonic-reads floor: a replica must be at least
@@ -449,6 +471,9 @@ class Client:
                     self._limits_probe_task = retrymod.spawn_detached(
                         self._limits_probe_loop()
                     )
+                # registration generation: reconnects queued on
+                # _conn_lock see the bump and skip their own handshake
+                self._conn_gen += 1
                 return
             except (OSError, ConnectionError, st.StatusError, asyncio.TimeoutError) as e:
                 last = e
@@ -634,27 +659,46 @@ class Client:
         the mount's fs_reconnect loop). Expressed as a RetryPolicy so
         the failover window is ONE deadline every nested dial inherits
         (a blackholed master host — SYN silently dropped — costs a
-        bounded attempt, never the OS ~2 min SYN timeout)."""
-        policy = retrymod.RetryPolicy(
-            attempts=10_000,  # the deadline, not the count, is the bound
-            base_delay=0.1, max_delay=1.0, jitter=0.2,
-            deadline=self.failover_timeout,
-            attempt_timeout=5.0 * len(self.master_addrs),
-            transient=lambda e: isinstance(
-                e, (ConnectionError, OSError, asyncio.TimeoutError)
-            ),
-        )
-        try:
-            await policy.run(
-                lambda: self.connect(
-                    self._info, getattr(self, "_password", "")
+        bounded attempt, never the OS ~2 min SYN timeout).
+
+        Single-flight: every op failing on the dead master lands here
+        at once. The first holds _conn_lock through the whole failover
+        window; the rest queue on the lock and, once inside, see the
+        bumped registration generation and return without running a
+        second handshake against the fresh master."""
+        gen = self._conn_gen
+        fail_gen = self._reconnect_fail_gen
+        async with self._conn_lock:
+            if self._conn_gen != gen:
+                return  # a queued-ahead reconnect already registered
+            if self._reconnect_fail_gen != fail_gen:
+                # a queued-ahead reconnect already burned a full
+                # failover window and lost — fail this op now instead
+                # of serially burning another window per waiter
+                raise ConnectionError(
+                    "failover window exhausted (concurrent reconnect)"
+                )
+            policy = retrymod.RetryPolicy(
+                attempts=10_000,  # the deadline, not the count, bounds
+                base_delay=0.1, max_delay=1.0, jitter=0.2,
+                deadline=self.failover_timeout,
+                attempt_timeout=5.0 * len(self.master_addrs),
+                transient=lambda e: isinstance(
+                    e, (ConnectionError, OSError, asyncio.TimeoutError)
                 ),
-                what="master failover", log=log,
             )
-        except retrymod.RetryError as e:
-            raise ConnectionError(
-                f"failover window exhausted: {e.last}"
-            ) from None
+            try:
+                await policy.run(
+                    lambda: self._connect_locked(
+                        self._info, getattr(self, "_password", "")
+                    ),
+                    what="master failover", log=log,
+                )
+            except retrymod.RetryError as e:
+                self._reconnect_fail_gen += 1
+                raise ConnectionError(
+                    f"failover window exhausted: {e.last}"
+                ) from None
 
     async def _probe_limits_active(self) -> None:
         """Probe-only IoLimitRequest (probe=1: never joins the
@@ -917,6 +961,7 @@ class Client:
             if attr.ftype == m.FTYPE_DIR and not (
                 attr.eattr & EATTR_NOENTRYCACHE
             ):
+                # lint: waive(cross-await-race): TTL-bounded dentry hint — the key must name the pre-await (parent, comp) the lookup resolved; a racing invalidation costs at most DENTRY_TTL of staleness
                 self._dentry[(parent, comp)] = (
                     attr.inode, now + self.DENTRY_TTL
                 )
@@ -2277,11 +2322,7 @@ class Client:
             if not isinstance(end, m.CstoclWriteStatus) or end.status != st.OK:
                 raise st.StatusError(getattr(end, "status", st.EIO), "write end")
         finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            await retrymod.close_writer(writer, swallow_cancel=True)
 
     # --- read path ---------------------------------------------------------------------
 

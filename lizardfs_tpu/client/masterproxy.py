@@ -31,24 +31,35 @@ class MasterProxy:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # 3.12+ wait_closed also waits for live handlers; a
+                # parked relay must not wedge or crash mount teardown
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
             self._server = None
 
     async def _handle(self, reader, writer) -> None:
         host, port = self.master_addr_fn()
         try:
-            up_reader, up_writer = await asyncio.open_connection(host, port)
-        except OSError:
+            # dial bound: a tool's connection must fail fast when the
+            # advertised master is blackholed, like every other dial
+            up_reader, up_writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), 5.0
+            )
+        except (OSError, asyncio.TimeoutError):
             writer.close()
             return
 
         async def pump(src, dst):
             try:
                 while True:
+                    # lint: waive(unbounded-await): byte-level relay pump — parks on whichever side speaks next by design; liveness is owned by the two endpoints' own timeouts
                     data = await src.read(65536)
                     if not data:
                         break
                     dst.write(data)
+                    # lint: waive(unbounded-await): relay backpressure mirrors the slower endpoint; a timer here would cut live slow tools
                     await dst.drain()
             except (ConnectionError, OSError):
                 pass

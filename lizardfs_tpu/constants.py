@@ -40,25 +40,43 @@ EC_MAX_PARITY = 32
 XOR_MIN_LEVEL = 2
 XOR_MAX_LEVEL = 9
 
-# Per-inode extra-attribute flags (reference: MFSCommunication.h EATTR_*
-# subset; `lizardfs geteattr`/`seteattr`): NOOWNER makes every uid act
-# as the owner for permission checks; NOCACHE forbids client-side data
-# caching of the inode's blocks; NOENTRYCACHE forbids caching its
-# lookup/attr entries (dentry + NFS attr/access caches).
+# The four documented "off" spellings every boolean LZ_* switch honors
+# (spelling parity pinned native-side too: lzshm::ring_disabled). An
+# operator's LZ_X=off must mean OFF on every plane, never "truthy
+# string, so on" — the inversion class the kill-switch lint kills.
+OFF_SPELLINGS = ("0", "off", "false", "no")
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """THE accessor for boolean ``LZ_*`` kill switches. Unset returns
+    ``default``; any set value is ON unless it spells one of the four
+    documented offs. Lives here because constants is the one
+    dependency-free module every role already imports. Read per call,
+    not cached: tests and operators flip switches mid-process. The
+    kill-switch lint rule forbids direct environ reads of boolean
+    switches anywhere else — one accessor, one spelling set."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() not in OFF_SPELLINGS
+
+
 def shadow_reads_enabled() -> bool:
     """LZ_SHADOW_READS kill switch (default ON) for the shadow
     read-replica plane. Consulted by all three roles: the master
     (shadows serve tokened reads, accept passive chunkserver mirrors),
     the chunkserver (mirror registrations to shadow addresses), and the
-    client (routing read RPCs to a replica). Lives here because
-    constants is the one dependency-free module every role already
-    imports. All four documented off spellings are honored, spelling-
-    parity with the other data-plane switches."""
-    import os
+    client (routing read RPCs to a replica)."""
+    return env_flag("LZ_SHADOW_READS")
 
-    return os.environ.get("LZ_SHADOW_READS", "1").lower() not in (
-        "0", "off", "false", "no"
-    )
+
+# Per-inode extra-attribute flags (reference: MFSCommunication.h EATTR_*
+# subset; `lizardfs geteattr`/`seteattr`): NOOWNER makes every uid act
+# as the owner for permission checks; NOCACHE forbids client-side data
+# caching of the inode's blocks; NOENTRYCACHE forbids caching its
+# lookup/attr entries (dentry + NFS attr/access caches).
 
 
 EATTR_NOOWNER = 0x01

@@ -127,6 +127,16 @@ class CpuChunkEncoder(ChunkEncoder):
         return parity_arr, data_crcs, parity_crcs
 
 
+def _tpu_allow_cpu() -> bool:
+    """LZ_TPU_ALLOW_CPU escape hatch (default OFF). Routed through the
+    one spelling-parity accessor: the old bare-truthiness read meant
+    ``LZ_TPU_ALLOW_CPU=0`` *enabled* the hatch (set, therefore truthy)
+    — the exact inversion the kill-switch lint exists to prevent."""
+    from lizardfs_tpu.constants import env_flag
+
+    return env_flag("LZ_TPU_ALLOW_CPU", default=False)
+
+
 class TpuChunkEncoder(ChunkEncoder):
     """JAX/XLA backend: bit-plane MXU matmuls, fused encode+CRC.
 
@@ -152,7 +162,7 @@ class TpuChunkEncoder(ChunkEncoder):
         self._device = device if device is not None else jax.devices()[0]
         if (
             not force_cpu
-            and not os.environ.get("LZ_TPU_ALLOW_CPU")
+            and not _tpu_allow_cpu()
             and getattr(self._device, "platform", "cpu") == "cpu"
         ):
             raise RuntimeError(

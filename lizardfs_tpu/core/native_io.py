@@ -189,9 +189,21 @@ SHM_MEMFD_NAME = "lzshm"  # grep-able in /proc/<pid>/maps (leak tests)
 
 
 def shm_ring_enabled() -> bool:
-    return os.environ.get("LZ_SHM_RING", "1").lower() not in (
-        "0", "off", "false", "no"
-    )
+    from lizardfs_tpu.constants import env_flag
+
+    return env_flag("LZ_SHM_RING")
+
+
+def uds_disabled() -> bool:
+    """LZ_NO_UDS operational kill switch for the same-host UDS fast
+    path (default: UDS stays on). Four-spelling parity like every
+    other switch — LZ_NO_UDS=0/off/false/no means "not disabled"; the
+    old bare-truthiness read treated ``0`` as set-and-therefore-kill
+    (spelling-parity inversion, now linted away). wire.h uds_enabled()
+    mirrors these spellings C-side."""
+    from lizardfs_tpu.constants import env_flag
+
+    return env_flag("LZ_NO_UDS", default=False)
 
 
 def shm_seg_bytes() -> int:
@@ -572,7 +584,10 @@ def _blocking_socket(addr: tuple[str, int], io_timeout: float) -> socket.socket:
     sock = None
     if (
         addr[0] in ("127.0.0.1", "localhost")  # exactly wire.h uds_host()
-        and not os.environ.get("LZ_NO_UDS")  # operational kill-switch
+        # operational kill-switch, default off; env_flag gives it the
+        # four-spelling parity the bare truthiness read lacked
+        # (LZ_NO_UDS=0 used to DISABLE the fast path)
+        and not uds_disabled()
     ):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:

@@ -73,6 +73,7 @@ class FailoverController:
                 # state (e.g. re-add a floating IP) minutes later
                 self.log.warning("%s hook timed out; killing: %s", role, cmd)
                 proc.kill()
+                # lint: waive(unbounded-await): reaping a SIGKILLed child — the kernel completes this; a timer could leak the zombie
                 await proc.wait()
             except OSError as e:
                 self.log.warning("%s hook failed: %s", role, e)

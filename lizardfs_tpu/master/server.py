@@ -3231,11 +3231,7 @@ class MasterServer(Daemon):
                     self._shadow_ack(writer)
         finally:
             self._follow_connected = False
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            await retrymod.close_writer(writer, swallow_cancel=True)
 
     async def _shadow_ack_tick(self) -> None:
         w = getattr(self, "_follow_writer", None)

@@ -28,6 +28,7 @@ async def _run(cfg: Config) -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     await ml.start()
+    # lint: waive(unbounded-await): the daemon parks here until SIGTERM/SIGINT by design
     await stop.wait()
     await ml.stop()
 

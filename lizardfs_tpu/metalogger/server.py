@@ -19,6 +19,7 @@ from lizardfs_tpu.master.changelog import Changelog, save_image
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import retry as retrymod
 
 
 class Metalogger:
@@ -77,14 +78,19 @@ class Metalogger:
             for addr in self.master_addrs:
                 try:
                     await self._follow(addr)
-                except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                except (ConnectionError, OSError,
+                        asyncio.IncompleteReadError, asyncio.TimeoutError):
                     continue
                 except asyncio.CancelledError:
                     return
             await asyncio.sleep(1.0)
 
     async def _follow(self, addr: tuple[str, int]) -> None:
-        reader, writer = await asyncio.open_connection(*addr)
+        # dial bound: a blackholed master costs 5 s, not the OS SYN
+        # timeout, before the follow loop tries the next address
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(*addr), 5.0
+        )
         try:
             await framing.send_message(
                 writer, m.MltomaRegister(req_id=1, version_known=self.version)
@@ -111,8 +117,4 @@ class Metalogger:
                     save_image(self.data_dir, msg.version, doc)
                     self.log.info("archived metadata image v%d", msg.version)
         finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            await retrymod.close_writer(writer, swallow_cancel=True)

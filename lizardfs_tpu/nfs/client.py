@@ -24,6 +24,7 @@ class Nfs3Client:
         )
 
     async def __aenter__(self):
+        # lint: waive(unbounded-await): delegates to RpcClient.connect, whose dial is wait_for-bounded at 5 s
         await self.rpc.connect()
         return self
 

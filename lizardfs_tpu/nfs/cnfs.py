@@ -15,7 +15,10 @@ from __future__ import annotations
 import ctypes
 import os
 
-_LIB_PATH = os.path.join(
+# LZ_CLIENT_SO: alternate library path, mirroring LZ_NATIVE_SO — the
+# sanitizer matrix (`make sanitize`) points it at the ASan+UBSan build
+# so the C NFS client runs instrumented under the real Python gateway
+_LIB_PATH = os.environ.get("LZ_CLIENT_SO") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
     "native", "liblizardfs_client.so",
 )

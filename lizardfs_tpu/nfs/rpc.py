@@ -17,6 +17,8 @@ import struct
 from dataclasses import dataclass, field
 
 from lizardfs_tpu.nfs.xdr import Packer, Unpacker, XdrError
+from lizardfs_tpu.runtime.retry import bounded_wait, close_writer, \
+    spawn_detached
 
 log = logging.getLogger("lizardfs.nfs.rpc")
 
@@ -60,17 +62,22 @@ def parse_auth_sys(body: bytes) -> Credential:
 
 
 async def read_record(reader: asyncio.StreamReader) -> bytes:
-    """One RPC record: fragments with a last-fragment marker bit."""
+    """One RPC record: fragments with a last-fragment marker bit.
+
+    Reads are ambient-deadline-bounded (``bounded_wait`` with no cap):
+    the gateway's server loop parks on the next request by design (no
+    ambient budget), and the client pump runs detached (deadline-free
+    — its budget lives on each ``call()``'s bounded reply wait)."""
     chunks: list[bytes] = []
     total = 0
     while True:
-        hdr = await reader.readexactly(4)
+        hdr = await bounded_wait(reader.readexactly(4))
         (word,) = struct.unpack(">I", hdr)
         last, flen = bool(word & 0x80000000), word & 0x7FFFFFFF
         total += flen
         if total > MAX_RECORD:
             raise XdrError(f"RPC record too long: {total}")
-        chunks.append(await reader.readexactly(flen))
+        chunks.append(await bounded_wait(reader.readexactly(flen)))
         if last:
             return b"".join(chunks)
 
@@ -116,7 +123,13 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # 3.12+ wait_closed also waits for live handlers; a
+                # client parked in read_record must not wedge (or, past
+                # the cap, crash) gateway teardown
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
             self._server = None
 
     async def _serve_conn(
@@ -136,7 +149,10 @@ class RpcServer:
                     return
                 async with wlock:
                     writer.write(frame_record(reply))
-                    await writer.drain()
+                    # ambient-bounded: gateway ops run under the
+                    # cluster client's deadlines; a reply to a wedged
+                    # NFS client charges that budget, not forever
+                    await bounded_wait(writer.drain())
             except (ConnectionError, OSError):
                 pass  # peer went away mid-reply
             except XdrError as e:
@@ -152,6 +168,7 @@ class RpcServer:
                 inflight.add(task)
                 task.add_done_callback(inflight.discard)
                 if len(inflight) >= 64:  # backpressure: stop reading
+                    # lint: waive(unbounded-await): parks on our OWN dispatch tasks, each bounded by the cluster client's op deadlines — a cap here would drop records instead of applying backpressure
                     _, pending = await asyncio.wait(
                         inflight, return_when=asyncio.FIRST_COMPLETED
                     )
@@ -162,11 +179,7 @@ class RpcServer:
         finally:
             for t in inflight:
                 t.cancel()
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+            await close_writer(writer)
 
     async def _dispatch(self, record: bytes) -> bytes | None:
         u = Unpacker(record)
@@ -228,12 +241,18 @@ class RpcClient:
         self._send_lock: asyncio.Lock | None = None
 
     async def connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+        # dial bound like every other dial in the tree (gateway startup
+        # additionally retries under a 30 s RetryPolicy budget)
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), 5.0
         )
         self._pump_dead = False
         self._send_lock = asyncio.Lock()
-        self._pump_task = asyncio.get_running_loop().create_task(self._pump())
+        # detached: the pump outlives any RetryPolicy attempt that
+        # dialed this connection — read_record is ambient-deadline-
+        # bounded now, and a pump that inherited the attempt's budget
+        # would start timing out the moment the budget expired
+        self._pump_task = spawn_detached(self._pump())
 
     async def _pump(self) -> None:
         try:
@@ -264,11 +283,7 @@ class RpcClient:
                 pass
             self._pump_task = None
         if self._writer is not None:
-            self._writer.close()
-            try:
-                await self._writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+            await close_writer(self._writer)
             self._writer = None
 
     def _cred_bytes(self) -> bytes:
@@ -297,8 +312,12 @@ class RpcClient:
         try:
             async with self._send_lock:
                 self._writer.write(frame_record(p.bytes()))
-                await self._writer.drain()
-            u = await fut  # xid already consumed by the pump
+                await bounded_wait(self._writer.drain())
+            # bounded reply wait: the pump is detached (deadline-free
+            # by design), so the budget must live HERE — a gateway
+            # that consumes the request and never answers charges the
+            # caller min(ambient deadline, 30 s), not forever
+            u = await bounded_wait(fut, 30.0)
         finally:
             self._pending.pop(xid, None)
         if u.u32() != REPLY:

@@ -446,7 +446,8 @@ class NfsGateway:
             # pre-resolve export roots: clients reusing cached handles
             # after a gateway restart never re-MNT
             try:
-                self._export_roots.add((await self.client.resolve(target)).inode)
+                root = await self.client.resolve(target)
+                self._export_roots.add(root.inode)
             except st.StatusError:
                 pass  # export target may be created later; MNT re-resolves
         self.rpc.register(PROG_MOUNT, 3, self._mount_dispatch)
@@ -1202,6 +1203,7 @@ async def main(argv: list[str] | None = None) -> None:
                     exports=exports)
     await gw.start()
     try:
+        # lint: waive(unbounded-await): the gateway process parks here until killed by design
         await asyncio.Event().wait()
     finally:
         await gw.stop()

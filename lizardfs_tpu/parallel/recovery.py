@@ -31,7 +31,6 @@ short-circuits to the single-chip paths.
 
 from __future__ import annotations
 
-import os
 
 import jax
 import jax.numpy as jnp
@@ -44,9 +43,9 @@ from lizardfs_tpu.parallel.sharded import shard_map
 
 def enabled() -> bool:
     """The subsystem kill switch (``LZ_SHARDED_RECOVERY=0`` disables)."""
-    return os.environ.get("LZ_SHARDED_RECOVERY", "1").lower() not in (
-        "0", "off", "false", "no"
-    )
+    from lizardfs_tpu.constants import env_flag
+
+    return env_flag("LZ_SHARDED_RECOVERY")
 
 
 def sharded_reconstruct_with_crcs(

@@ -13,6 +13,7 @@ import struct
 
 from lizardfs_tpu.proto.codec import Message, message_class_for
 from lizardfs_tpu.runtime import faults as _faults
+from lizardfs_tpu.runtime.retry import bounded_wait
 
 HEADER = struct.Struct(">II")
 PROTO_VERSION = 1
@@ -53,11 +54,16 @@ def _peer_of(writer: asyncio.StreamWriter) -> str:
 
 
 async def read_message(reader: asyncio.StreamReader) -> Message:
-    header = await reader.readexactly(HEADER.size)
+    # bounded_wait with no cap = ambient-deadline-only: a client op
+    # under a RetryPolicy budget cannot park past it on a wedged peer,
+    # while a server connection loop (no ambient deadline) still parks
+    # on the next request frame by design — liveness there is owned by
+    # heartbeats/TCP, not a per-frame timer
+    header = await bounded_wait(reader.readexactly(HEADER.size))
     msg_type, length = HEADER.unpack(header)
     if length > MAX_PACKET_SIZE:
         raise ProtocolError(f"packet too large: {length}")
-    payload = await reader.readexactly(length)
+    payload = await bounded_wait(reader.readexactly(length))
     if _faults.ACTIVE:
         # fault choke point (runtime/faults.py): delay/drop/flip the
         # received frame. One module-attribute check when injection is
@@ -82,7 +88,9 @@ async def send_message(writer: asyncio.StreamWriter, msg: Message) -> None:
             peer=_peer_of(writer), writer=writer,
         )
         writer.write(data)
-        await writer.drain()
+        await bounded_wait(writer.drain())
         return
     write_message(writer, msg)
-    await writer.drain()
+    # ambient-deadline-bounded like the reads: backpressure from a
+    # dead-slow peer charges the caller's budget, not forever
+    await bounded_wait(writer.drain())

@@ -14,6 +14,7 @@ import signal
 import sys
 
 from lizardfs_tpu.runtime import faults as faultsmod
+from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import Metrics
@@ -488,11 +489,7 @@ class Daemon:
             self.log.exception("connection from %s crashed", peer)
         finally:
             self._conn_writers.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            await retrymod.close_writer(writer, swallow_cancel=True)
 
     async def start(self) -> None:
         # fault fires attributed to this role land in this registry
@@ -547,6 +544,7 @@ class Daemon:
             loop.add_signal_handler(sig, stop.set)
         loop.add_signal_handler(signal.SIGHUP, self.reload)
         await self.start()
+        # lint: waive(unbounded-await): run_forever parks until SIGTERM/SIGINT by design
         await stop.wait()
         self.log.info("shutting down")
         await self.stop()

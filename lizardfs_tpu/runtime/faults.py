@@ -513,8 +513,8 @@ async def frame_point(site: str, name: str, data: bytes,
         # torn write: half a frame on the wire, then the peer sees EOF
         writer.write(data[: max(len(data) // 2, 1)])
         try:
-            await writer.drain()
-        except (ConnectionError, OSError):
+            await asyncio.wait_for(writer.drain(), 5.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
             pass
         writer.close()
         raise ConnectionResetError(f"fault injected: short {name}")

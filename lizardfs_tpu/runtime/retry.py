@@ -101,6 +101,29 @@ async def bounded_wait(awaitable, cap: float | None = None):
     return await asyncio.wait_for(awaitable, max(t, 0.001))
 
 
+async def close_writer(writer, cap: float = 5.0, *,
+                       swallow_cancel: bool = False) -> None:
+    """THE teardown idiom: ``close()`` + bounded ``wait_closed()``,
+    swallowing transport errors and the timeout. ``wait_closed`` on a
+    peer that never drains FIN-ACKs can park forever; teardown paths
+    must not inherit that hang (unbounded-await audit). Cancellation
+    propagates by default; sites whose callers historically absorbed
+    cancellation mid-close pass ``swallow_cancel=True`` — one helper,
+    one cap, one exception policy, instead of seven drifting inline
+    copies."""
+    try:
+        writer.close()
+        t = budget(cap)
+        await asyncio.wait_for(
+            writer.wait_closed(), max(t if t is not None else cap, 0.001)
+        )
+    except (ConnectionError, OSError, asyncio.TimeoutError):
+        pass
+    except asyncio.CancelledError:
+        if not swallow_cancel:
+            raise
+
+
 class RetryPolicy:
     """Jittered exponential backoff with an attempt cap and an optional
     end-to-end deadline.

@@ -129,9 +129,5 @@ class RpcConnection:
             self._pump_task.cancel()
         for task in list(self._handler_tasks):
             task.cancel()
-        self.writer.close()
-        try:
-            await self.writer.wait_closed()
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        await _retry.close_writer(self.writer, swallow_cancel=True)
         self._closed.set()

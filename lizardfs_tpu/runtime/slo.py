@@ -44,9 +44,9 @@ import os
 import time
 from collections import deque
 
-_ENABLED = os.environ.get("LZ_SLO", "1").lower() not in (
-    "0", "off", "false", "no"
-)
+from lizardfs_tpu.constants import env_flag
+
+_ENABLED = env_flag("LZ_SLO")
 
 
 def enabled() -> bool:

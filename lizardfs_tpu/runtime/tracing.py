@@ -37,7 +37,6 @@ inside one process stay monotonic-accurate at the span granularity
 from __future__ import annotations
 
 import contextvars
-import os
 import secrets
 import time
 from collections import deque
@@ -45,9 +44,9 @@ from collections import deque
 # process-wide kill switch: LZ_TRACE=0 disables issuing trace ids, which
 # short-circuits every record path (spans are only recorded for nonzero
 # trace ids)
-_ENABLED = os.environ.get("LZ_TRACE", "1").lower() not in (
-    "0", "off", "false", "no"
-)
+from lizardfs_tpu.constants import env_flag
+
+_ENABLED = env_flag("LZ_TRACE")
 
 # (trace_id, parent_span_id) of the request this task is serving
 CURRENT: contextvars.ContextVar[tuple[int, int] | None] = (

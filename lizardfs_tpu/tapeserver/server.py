@@ -55,6 +55,7 @@ class TapeServer(Daemon):
 
     async def _connect(self) -> None:
         self.client = Client(*self.master_addr)
+        # lint: waive(unbounded-await): delegates to Client.connect — dials via the 5 s-bounded RpcConnection.connect and a 30 s-capped register RPC
         await self.client.connect(info=f"tapeserver:{self.label}")
         self.master = await RpcConnection.connect(*self.master_addr)
         self.master.on_push(m.MatotsPutFile, self._cmd_put)

@@ -27,7 +27,11 @@ from lizardfs_tpu.proto import status as st
 
 async def _admin(addr: tuple[str, int], command: str, payload: str = "{}",
                  password: str | None = None):
-    reader, writer = await asyncio.open_connection(*addr)
+    # bounded dial: an admin command against a blackholed daemon must
+    # error out in seconds, not the OS SYN timeout
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(*addr), 5.0
+    )
     try:
         if password:
             # challenge-response: the password never crosses the wire
@@ -306,8 +310,12 @@ def _print_health(doc: dict) -> None:
 def main(argv=None) -> int:
     try:
         return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
-    except (ConnectionError, OSError) as e:
-        print(f"error: cannot reach daemon: {e}", file=sys.stderr)
+    except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+        # TimeoutError: the bounded 5 s dial — on 3.10 it is not an
+        # OSError subclass, and a blackholed daemon must print the
+        # clean error, not a traceback
+        print(f"error: cannot reach daemon: {str(e) or 'dial timed out'}",
+              file=sys.stderr)
         return 1
 
 

@@ -65,7 +65,9 @@ async def admin(port: int, command: str, payload: str = "{}"):
     from lizardfs_tpu.proto import framing
     from lizardfs_tpu.proto import messages as m
 
-    r, w = await asyncio.open_connection("127.0.0.1", port)
+    r, w = await asyncio.wait_for(
+        asyncio.open_connection("127.0.0.1", port), 5.0
+    )
     try:
         if command == "info":
             await framing.send_message(w, m.AdminInfo(req_id=1))
@@ -165,10 +167,12 @@ class ChaosCluster:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             try:
-                _, w = await asyncio.open_connection("127.0.0.1", port)
+                _, w = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", port), 2.0
+                )
                 w.close()
                 return
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, asyncio.TimeoutError):
                 await asyncio.sleep(0.1)
         raise AssertionError(f"port {port} never came up")
 
@@ -202,6 +206,7 @@ async def _client(cluster: ChaosCluster, shadow: bool = False):
     if shadow and cluster.shadow_port:
         addrs.append(("127.0.0.1", cluster.shadow_port))
     c = Client(*addrs[0], wave_timeout=0.3, master_addrs=addrs)
+    # lint: waive(unbounded-await): delegates to Client.connect — dials via the 5 s-bounded RpcConnection.connect and a 30 s-capped register RPC
     await c.connect(info="chaos")
     return c
 

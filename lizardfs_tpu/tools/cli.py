@@ -56,6 +56,7 @@ def _addrs(s: str) -> list[tuple[str, int]]:
 async def _connect(args) -> Client:
     addrs = _addrs(args.master)
     c = Client("", 0, master_addrs=addrs)
+    # lint: waive(unbounded-await): delegates to Client.connect — dials via the 5 s-bounded RpcConnection.connect and a 30 s-capped register RPC
     await c.connect(info="lizardfs-cli")
     return c
 

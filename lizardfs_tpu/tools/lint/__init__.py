@@ -1,0 +1,37 @@
+"""Invariant lint engine — machine-checks the correctness conventions
+the last several PRs policed by hand.
+
+Four repo-specific rules ride a shared AST visitor framework
+(:mod:`engine`), each one born from a bug class this tree has already
+paid for at review time:
+
+``cross-await-race``   (:mod:`races`)      shared daemon/client state
+    read-modify-written across an ``await`` without an asyncio.Lock or
+    a supersession guard — the interleaving class behind PR 7's four
+    rounds of guard hardening.
+``unbounded-await``    (:mod:`awaits`)     an ``await`` on a blocking
+    primitive (connect/read/readexactly/drain/wait/queue-get) outside
+    ``wait_for``/``bounded_wait`` — PR 8's one-shot audit, permanent.
+``wire-skew``          (:mod:`wire`)       every message's optional
+    fields must be a trailing, ``SKEW_TOLERANT_FROM``-covered suffix
+    (constructor-defaulted + decode default-filled by the codec), with
+    skew-variable messages nested terminally only.
+``kill-switch``        (:mod:`killswitch`) every ``LZ_*`` env read
+    routes through one accessor, boolean switches honor the four
+    documented off spellings, and each var is inventoried, documented,
+    and test-referenced.
+
+Run as ``lizardfs-lint`` / ``python -m lizardfs_tpu.tools.lint`` /
+``make lint``; the tier-1 gate is ``tests/test_invariant_lint.py``
+(tree held at ZERO unwaived findings). Deliberate exceptions carry an
+inline ``# lint: waive(<rule>): <reason>`` the report counts — and a
+waiver that stops matching a finding is itself an error, so silent
+suppressions cannot accumulate.
+"""
+
+from lizardfs_tpu.tools.lint.engine import (  # noqa: F401
+    Finding,
+    LintConfig,
+    LintResult,
+    run_lint,
+)

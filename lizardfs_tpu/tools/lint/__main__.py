@@ -1,0 +1,7 @@
+"""``python -m lizardfs_tpu.tools.lint`` == ``lizardfs-lint``."""
+
+import sys
+
+from lizardfs_tpu.tools.lint.cli import main
+
+sys.exit(main())

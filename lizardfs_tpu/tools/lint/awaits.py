@@ -1,0 +1,101 @@
+"""``unbounded-await``: blocking primitives awaited without a budget.
+
+PR 8's audit bounded every dial and lone reply wait on the data/dial
+planes (``runtime/retry.py``: ``bounded_wait`` inherits the tightest
+ambient :class:`Deadline`; ``RetryPolicy.run`` publishes one). This
+checker makes that audit permanent: a DIRECT ``await`` of one of the
+park-forever primitives —
+
+    connect / open_connection / open_unix_connection,
+    read / readexactly / readuntil / readline,
+    drain, wait, wait_closed, queue ``get()``
+
+— is a finding unless the call itself carries a ``timeout=`` argument
+(``asyncio.wait(..., timeout=t)``). The compliant idioms never match,
+because the awaited call is then ``wait_for``/``bounded_wait``/
+``policy.run``, not the primitive:
+
+    await bounded_wait(reader.readexactly(n), cap)
+    await asyncio.wait_for(writer.drain(), t)
+
+Legitimately unbounded parks — a daemon's ``stop.wait()``, the frame
+pump awaiting the next request on a server connection — carry a
+``# lint: waive(unbounded-await): <why this wait owns no budget>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from lizardfs_tpu.tools.lint.engine import Finding, SourceFile
+
+RULE = "unbounded-await"
+
+RISKY = {
+    "connect",
+    "open_connection",
+    "open_unix_connection",
+    "read",
+    "readexactly",
+    "readuntil",
+    "readline",
+    "drain",
+    "wait",
+    "wait_closed",
+    "get",
+}
+
+
+# classmethod dials that ARE the audited bounded accessors: their
+# bodies wrap the raw open_connection in bounded_wait(DIAL_TIMEOUT)
+# (and are themselves linted here), so awaiting them is the compliant
+# idiom, not a violation
+BOUNDED_DELEGATES = {("RpcConnection", "connect")}
+
+
+def _call_name(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def check_file(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Await):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        name = _call_name(call)
+        if name not in RISKY:
+            continue
+        if (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and (call.func.value.id, name) in BOUNDED_DELEGATES
+        ):
+            continue
+        if name == "get" and (call.args or call.keywords):
+            continue  # queue-get takes no args; obj.get(key, ...) is not it
+        if any(
+            kw.arg == "timeout"
+            and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+            for kw in call.keywords
+        ):
+            continue  # the primitive bounds itself
+        findings.append(
+            Finding(
+                RULE,
+                src.rel,
+                node.lineno,
+                f"direct `await ....{name}(...)` has no budget — wrap in "
+                "bounded_wait()/asyncio.wait_for() (or run under a "
+                "RetryPolicy deadline and waive with the reason)",
+            )
+        )
+    return findings

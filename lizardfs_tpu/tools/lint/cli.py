@@ -1,0 +1,75 @@
+"""``lizardfs-lint`` — run the invariant checkers from the shell.
+
+    lizardfs-lint                     # whole tree, all rules
+    lizardfs-lint --rule wire-skew    # one rule
+    lizardfs-lint path/to/file.py     # explicit scan set
+    lizardfs-lint --json              # machine-readable findings
+    lizardfs-lint --no-cache          # ignore .lint-cache.json
+
+Exit status: 0 = zero unwaived findings, 1 = findings (or stale
+waivers), 2 = bad invocation. ``make lint`` wraps this and stamps
+``.lint-stamp`` on success so ``make chaos`` can nag when lint was
+skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from lizardfs_tpu.tools.lint.engine import LintConfig, all_rules, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lizardfs-lint",
+        description="invariant lint engine (see doc/operations.md)",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: tree)")
+    ap.add_argument(
+        "--rule", action="append", choices=all_rules(),
+        help="run only this rule (repeatable)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON findings")
+    ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument(
+        "--waivers", action="store_true",
+        help="list every waiver with its reason",
+    )
+    args = ap.parse_args(argv)
+
+    cfg = LintConfig.for_tree()
+    if args.paths:
+        cfg.paths = args.paths
+    if args.rule:
+        cfg.rules = args.rule
+    if args.no_cache:
+        cfg.use_cache = False
+    result = run_lint(cfg)
+
+    if args.json:
+        print(json.dumps(
+            {
+                "files": result.files,
+                "findings": [
+                    {
+                        "rule": f.rule, "path": f.path, "line": f.line,
+                        "message": f.message, "waived": f.waived,
+                        "waive_reason": f.waive_reason,
+                    }
+                    for f in result.findings
+                ],
+            },
+            indent=2,
+        ))
+    else:
+        print(result.render())
+        if args.waivers:
+            for w in result.waivers:
+                print(f"waiver {w.path}:{w.line} [{w.rule}] {w.reason}")
+    return 1 if result.unwaived else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

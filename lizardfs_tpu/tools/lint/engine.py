@@ -1,0 +1,350 @@
+"""Shared lint plumbing: parsed-file model, waivers, cache, runner.
+
+The engine owns everything rule-agnostic so a checker is just "walk
+this parsed file (or the whole repo context) and yield findings":
+
+* :class:`SourceFile` — one parse per file per run, shared by every
+  per-file checker (the AST is the expensive part at 60+ files).
+* Waivers — ``# lint: waive(<rule>): <reason>`` on the finding's line
+  or the line directly above. A waiver must carry a reason, is counted
+  in the report, and MUST match a finding: stale waivers are reported
+  as findings themselves (rule ``stale-waiver``), so suppressions
+  cannot quietly outlive the code they excused.
+* Per-file caching — keyed by (content sha1, engine fingerprint);
+  editing any file under ``tools/lint/`` invalidates the whole cache,
+  editing a source file invalidates that file only. A warm hit skips
+  the parse AND the tokenize: findings, waivers, and the global
+  checkers' per-file summaries (``collect_file``) all ride the cache
+  entry. Global passes (wire-skew parses its one catalog file itself;
+  kill-switch aggregates the cached summaries) re-run every time —
+  their verdicts depend on cross-file state no single-file key can
+  capture, but they cost no re-parsing.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_WAIVE_RE = re.compile(
+    r"#\s*lint:\s*waive\(([a-z0-9_*-]+)\)\s*:\s*(\S.*?)\s*$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative where possible
+    line: int
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    def render(self) -> str:
+        tag = " [waived]" if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}]{tag} {self.message}"
+
+
+@dataclass
+class Waiver:
+    rule: str
+    path: str
+    line: int  # line the comment sits on
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """A parsed Python file plus its waiver comments."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.waivers: list[Waiver] = []
+        # real COMMENT tokens only: the waiver pattern quoted inside a
+        # docstring (e.g. this engine's own docs) is not a waiver
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _WAIVE_RE.search(tok.string)
+                if m:
+                    self.waivers.append(
+                        Waiver(m.group(1), rel, tok.start[0], m.group(2))
+                    )
+        except tokenize.TokenError:
+            pass  # ast.parse above succeeded; comments are best-effort
+
+    def sha1(self) -> str:
+        return hashlib.sha1(self.text.encode("utf-8")).hexdigest()
+
+
+@dataclass
+class LintConfig:
+    """What to lint and where the cross-file anchors live. The defaults
+    describe the real tree; tests point the anchors at fixtures."""
+
+    root: str
+    paths: list[str] = field(default_factory=list)
+    rules: list[str] | None = None  # None = every registered rule
+    messages_path: str | None = None  # wire-skew target
+    doc_paths: list[str] = field(default_factory=list)  # kill-switch docs
+    tests_dir: str | None = None  # kill-switch equivalence tests
+    native_dir: str | None = None  # kill-switch C++ getenv sweep
+    use_cache: bool = True
+    cache_path: str | None = None
+
+    @classmethod
+    def for_tree(cls, root: str | None = None, **kw) -> "LintConfig":
+        if root is None:
+            here = os.path.dirname(os.path.abspath(__file__))
+            # tools/lint/engine.py -> repo root is 3 levels up from lint/
+            root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+        pkg = os.path.join(root, "lizardfs_tpu")
+        cfg = cls(
+            root=root,
+            paths=[pkg],
+            messages_path=os.path.join(pkg, "proto", "messages.py"),
+            doc_paths=[os.path.join(root, "doc", "operations.md")],
+            tests_dir=os.path.join(root, "tests"),
+            native_dir=os.path.join(root, "native"),
+            cache_path=os.path.join(root, ".lint-cache.json"),
+        )
+        for k, v in kw.items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]
+    waivers: list[Waiver]
+    files: int
+
+    @property
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    def by_rule(self, *, waived: bool | None = None) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            if waived is not None and f.waived is not waived:
+                continue
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"lint: {self.files} files, {len(self.unwaived)} findings, "
+            f"{len(self.waived)} waived"
+        )
+        wr = self.by_rule(waived=True)
+        if wr:
+            lines.append(
+                "waived by rule: "
+                + ", ".join(f"{r}={n}" for r, n in sorted(wr.items()))
+            )
+        return "\n".join(lines)
+
+
+def _registry():
+    # imported lazily: checker modules import Finding from here
+    from lizardfs_tpu.tools.lint import awaits, killswitch, races, wire
+
+    return {
+        races.RULE: races,
+        awaits.RULE: awaits,
+        wire.RULE: wire,
+        killswitch.RULE: killswitch,
+    }
+
+
+def all_rules() -> list[str]:
+    return sorted(_registry())
+
+
+def _engine_fingerprint() -> str:
+    """sha1 over the lint package's own sources: edit a checker, lose
+    the cache."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1()
+    for name in sorted(os.listdir(here)):
+        if name.endswith(".py"):
+            with open(os.path.join(here, name), "rb") as fh:
+                h.update(name.encode())
+                h.update(fh.read())
+    return h.hexdigest()
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _load_cache_doc(path: str | None) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        return data.get("entries", {}) if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _load_cache(path: str | None, fingerprint: str) -> dict:
+    return _load_cache_doc(path).get(fingerprint, {})
+
+
+def _save_cache(path: str | None, fingerprint: str, files: dict) -> None:
+    """MERGE into the cache, keyed by fingerprint: a targeted run
+    (`lizardfs-lint one_file.py`, or `--rule X` with its own
+    fingerprint) must update only its slice, never clobber the
+    full-tree entries the next `make lint` relies on. Bounded to the
+    8 most-recently-used fingerprints."""
+    if not path:
+        return
+    entries = _load_cache_doc(path)
+    merged = dict(entries.pop(fingerprint, {}))
+    merged.update(files)
+    entries[fingerprint] = merged  # re-insert: most-recently-used last
+    while len(entries) > 8:
+        entries.pop(next(iter(entries)))
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"entries": entries}, fh)
+    except OSError:
+        pass  # caching is best-effort; a read-only tree still lints
+
+
+def run_lint(cfg: LintConfig) -> LintResult:
+    registry = _registry()
+    rules = cfg.rules if cfg.rules is not None else sorted(registry)
+    unknown = [r for r in rules if r not in registry]
+    if unknown:
+        raise ValueError(f"unknown lint rules: {unknown}")
+
+    fingerprint = _engine_fingerprint() + ":" + ",".join(sorted(rules))
+    cache = _load_cache(cfg.cache_path, fingerprint) if cfg.use_cache else {}
+    new_cache: dict = {}
+
+    findings: list[Finding] = []
+    waivers: list[Waiver] = []
+    per_file = [registry[r] for r in rules if hasattr(registry[r], "check_file")]
+    collectors = {
+        r: registry[r] for r in rules if hasattr(registry[r], "collect_file")
+    }
+    # rule -> rel -> cacheable per-file summary fed to check_global
+    collections: dict[str, dict] = {r: {} for r in collectors}
+    nfiles = 0
+    for path in iter_py_files(cfg.paths):
+        rel = os.path.relpath(path, cfg.root)
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError as e:
+            findings.append(Finding("parse", rel, 0, str(e)))
+            continue
+        nfiles += 1
+        key = hashlib.sha1(raw).hexdigest()
+        cached = cache.get(rel)
+        if (
+            cached is not None
+            and cached.get("sha1") == key
+            and set(cached.get("collected", {})) >= set(collections)
+        ):
+            # warm hit: findings, waivers, AND the global checkers'
+            # per-file summaries all ride the entry — the file is
+            # neither parsed nor tokenized again
+            for rule, line, message in cached["findings"]:
+                findings.append(Finding(rule, rel, line, message))
+            for rule, line, reason in cached.get("waivers", ()):
+                waivers.append(Waiver(rule, rel, line, reason))
+            for r in collections:
+                collections[r][rel] = cached["collected"][r]
+            new_cache[rel] = cached
+            continue
+        try:
+            src = SourceFile(path, rel, raw.decode("utf-8"))
+        except (UnicodeDecodeError, SyntaxError) as e:
+            findings.append(
+                Finding("parse", rel, getattr(e, "lineno", 0) or 0, str(e))
+            )
+            continue
+        waivers.extend(src.waivers)
+        file_findings: list[Finding] = []
+        for checker in per_file:
+            file_findings.extend(checker.check_file(src))
+        findings.extend(file_findings)
+        collected = {r: c.collect_file(src) for r, c in collectors.items()}
+        for r in collections:
+            collections[r][rel] = collected[r]
+        new_cache[rel] = {
+            "sha1": key,
+            "findings": [[f.rule, f.line, f.message] for f in file_findings],
+            "waivers": [[w.rule, w.line, w.reason] for w in src.waivers],
+            "collected": collected,
+        }
+
+    for rule in rules:
+        checker = registry[rule]
+        if hasattr(checker, "check_global"):
+            findings.extend(
+                checker.check_global(cfg, collections.get(rule, {}))
+            )
+
+    # ---- waiver matching -------------------------------------------------
+    # a waiver covers findings of its rule on its own line or the line
+    # below (comment-above style for statements that don't fit inline)
+    wmap: dict[tuple[str, str, int], Waiver] = {}
+    for w in waivers:
+        wmap[(w.rule, w.path, w.line)] = w
+    for f in findings:
+        w = wmap.get((f.rule, f.path, f.line)) or wmap.get(
+            (f.rule, f.path, f.line - 1)
+        )
+        if w is not None:
+            f.waived = True
+            f.waive_reason = w.reason
+            w.used = True
+    for w in waivers:
+        if not w.used and (cfg.rules is None or w.rule in rules):
+            findings.append(
+                Finding(
+                    "stale-waiver",
+                    w.path,
+                    w.line,
+                    f"waiver for [{w.rule}] matches no finding — remove it "
+                    f"(reason was: {w.reason})",
+                )
+            )
+
+    if cfg.use_cache:
+        _save_cache(cfg.cache_path, fingerprint, new_cache)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings=findings, waivers=waivers, files=nfiles)

@@ -1,0 +1,361 @@
+"""``cross-await-race``: shared state read-modify-written across an
+``await`` boundary.
+
+The bug class: a coroutine reads ``self.x`` (directly or into a local),
+suspends at an ``await``, and later writes ``self.x`` (or mutates the
+object the stale local still names) from the pre-suspension value.
+Another coroutine interleaving at the await clobbers or is clobbered —
+exactly the class PR 7's supersession guards fixed four times in
+review.
+
+Detection is a per-coroutine linear event walk (source order
+approximates execution order; loop back-edges are ignored):
+
+* ``load self.X`` events taint locals assigned from them;
+* ``store self.X`` events carry the attrs whose loads taint the stored
+  value (mutating method calls — append/pop/update/… — on ``self.X``
+  or on a tainted alias count as stores of X);
+* ``await`` events come from Await / async for / async with.
+
+A load→await→store of the same attribute is a finding UNLESS the code
+shows one of the recognized safe idioms between the LAST await and the
+store:
+
+* a fresh re-read of the attribute (the supersession-guard shape:
+  ``if self.owner is not me: return`` — any post-await load counts);
+* a guard branch — an ``if``/``while`` test reading any ``self.*``
+  attribute whose body bails (return/raise/continue/break);
+* load and store sharing an enclosing ``async with <lock-ish>`` block
+  (context expression mentioning lock/mutex/sem) — the await between
+  them cannot interleave with a peer holding the same lock.
+
+Deliberately single-assignment-safe patterns that remain flagged carry
+a ``# lint: waive(cross-await-race): <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from lizardfs_tpu.tools.lint.engine import Finding, SourceFile
+
+RULE = "cross-await-race"
+
+_LOCKISH = re.compile(r"lock|mutex|sem", re.IGNORECASE)
+
+# method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "add", "pop", "remove", "discard", "clear", "update",
+    "extend", "insert", "setdefault", "popitem", "appendleft", "popleft",
+}
+
+
+class _Ev:
+    __slots__ = ("kind", "attr", "line", "locks", "deps")
+
+    def __init__(self, kind, attr=None, line=0, locks=frozenset(), deps=()):
+        self.kind = kind  # "load" | "store" | "await" | "guard"
+        self.attr = attr
+        self.line = line
+        self.locks = locks
+        self.deps = deps  # store only: tuple[(attr, load_event_idx)]
+
+
+def _is_self_attr(node) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _base_self_attr(node) -> str | None:
+    """self.X, self.X[...], self.X.y, self.X[...].y → "X"."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        a = _is_self_attr(node)
+        if a is not None:
+            return a
+        node = node.value
+    return None
+
+
+class _CoroScan:
+    """Event walk over one coroutine body."""
+
+    def __init__(self):
+        self.events: list[_Ev] = []
+        self.env: dict[str, frozenset] = {}  # local -> {(attr, load_idx)}
+        self.locks: list[int] = []
+        self._lock_seq = 0
+        # store-target reads (the `self.d` in `self.d[k] = v`) must not
+        # count as fresh re-reads — they are part of the store itself
+        self._quiet = False
+
+    # -- expression walk: emits load/await events, returns taint set ------
+    def expr(self, node) -> frozenset:
+        taint: set = set()
+        self._expr(node, taint)
+        return frozenset(taint)
+
+    def _emit(self, kind, attr=None, line=0, deps=()):
+        if self._quiet and kind == "load":
+            # pseudo-index at "now": a dep on it can never straddle an
+            # await, and no event is recorded to suppress others
+            return len(self.events)
+        self.events.append(
+            _Ev(kind, attr, line, frozenset(self.locks), tuple(deps))
+        )
+        return len(self.events) - 1
+
+    def _expr(self, node, taint: set) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Await):
+            self._expr(node.value, taint)
+            self._emit("await", line=node.lineno)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # separate scope; scanned on its own
+        a = _is_self_attr(node)
+        if a is not None and isinstance(node.ctx, ast.Load):
+            idx = self._emit("load", a, node.lineno)
+            taint.add((a, idx))
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            taint.update(self.env.get(node.id, ()))
+            return
+        if isinstance(node, ast.Call):
+            # mutator method call: receiver is written, not just read
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                base = func.value
+                base_taint: set = set()
+                self._expr(base, base_taint)
+                arg_taint: set = set()
+                for arg in node.args:
+                    self._expr(arg, arg_taint)
+                for kw in node.keywords:
+                    self._expr(kw.value, arg_taint)
+                attr = _base_self_attr(base)
+                deps = set(base_taint)
+                if attr is not None:
+                    # direct self.X.append(...): load+store same statement
+                    # — only an await inside the args makes it cross-await
+                    deps = {d for d in base_taint if d[0] == attr} or base_taint
+                for dattr in {d[0] for d in deps}:
+                    self._emit(
+                        "store",
+                        dattr,
+                        node.lineno,
+                        deps=[d for d in deps if d[0] == dattr],
+                    )
+                taint.update(base_taint)
+                taint.update(arg_taint)
+                return
+            # a plain call: the RECEIVER taints the result (`v =
+            # self.d.get(k)` derives v from d's contents — the classic
+            # cache-RMW read), but a bound self-METHOD does not
+            # (`session = self._lookup(k)`: stores to `self._lookup`
+            # never happen; tainting through the bound-method read only
+            # manufactures false positives on every helper call)
+            if isinstance(func, ast.Attribute):
+                self._expr(func.value, taint)
+            else:
+                discard: set = set()
+                self._expr(func, discard)
+            for arg in node.args:
+                self._expr(arg, taint)
+            for kw in node.keywords:
+                self._expr(kw.value, taint)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, taint)
+
+    # -- statement walk ---------------------------------------------------
+    def _assign_target(self, target, taint: frozenset, line: int) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_target(elt, taint, line)
+            return
+        if isinstance(target, ast.Starred):
+            self._assign_target(target.value, taint, line)
+            return
+        attr = _base_self_attr(target)
+        if attr is not None:
+            # index/attr path expressions are loads too
+            if _is_self_attr(target) is None:
+                sub_taint: set = set()
+                self._quiet = True
+                try:
+                    for child in ast.iter_child_nodes(target):
+                        if isinstance(child, (ast.Load, ast.Store)):
+                            continue
+                        self._expr(child, sub_taint)
+                finally:
+                    self._quiet = False
+                taint = taint | frozenset(sub_taint)
+            self._emit(
+                "store", attr, line,
+                deps=[d for d in taint if d[0] == attr],
+            )
+
+    def stmts(self, body) -> None:
+        for st in body:
+            self.stmt(st)
+
+    def stmt(self, st) -> None:
+        line = getattr(st, "lineno", 0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            taint = self.expr(st.value)
+            for t in st.targets:
+                self._assign_target(t, taint, line)
+            return
+        if isinstance(st, ast.AnnAssign):
+            taint = self.expr(st.value) if st.value else frozenset()
+            self._assign_target(st.target, taint, line)
+            return
+        if isinstance(st, ast.AugAssign):
+            attr = _base_self_attr(st.target)
+            taint: set = set()
+            if attr is not None:
+                idx = self._emit("load", attr, line)
+                taint.add((attr, idx))
+            elif isinstance(st.target, ast.Name):
+                taint.update(self.env.get(st.target.id, ()))
+            self._expr(st.value, taint)
+            if attr is not None:
+                self._assign_target(st.target, frozenset(taint), line)
+            elif isinstance(st.target, ast.Name):
+                self.env[st.target.id] = frozenset(taint)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            test_taint: set = set()
+            self._expr(st.test, test_taint)
+            reads_self = any(True for _ in test_taint)
+            bails = any(
+                isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+                for s in st.body
+            )
+            if reads_self and bails:
+                self._emit("guard", line=line)
+            self.stmts(st.body)
+            self.stmts(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            taint = self.expr(st.iter)
+            if isinstance(st, ast.AsyncFor):
+                self._emit("await", line=line)
+            self._assign_target(st.target, taint, line)
+            self.stmts(st.body)
+            self.stmts(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            lock_ids = []
+            for item in st.items:
+                self.expr(item.context_expr)
+                try:
+                    text = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover - unparse is total
+                    text = ""
+                if _LOCKISH.search(text):
+                    self._lock_seq += 1
+                    lock_ids.append(self._lock_seq)
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars, frozenset(), line)
+            if isinstance(st, ast.AsyncWith):
+                self._emit("await", line=line)
+            self.locks.extend(lock_ids)
+            self.stmts(st.body)
+            for _ in lock_ids:
+                self.locks.pop()
+            if isinstance(st, ast.AsyncWith):
+                self._emit("await", line=line)
+            return
+        if isinstance(st, ast.Try):
+            self.stmts(st.body)
+            for h in st.handlers:
+                self.stmts(h.body)
+            self.stmts(st.orelse)
+            self.stmts(st.finalbody)
+            return
+        if isinstance(st, (ast.Return, ast.Expr, ast.Raise, ast.Assert,
+                           ast.Delete)):
+            for child in ast.iter_child_nodes(st):
+                self.expr(child)
+            return
+        # fallback: walk any embedded expressions generically
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.expr(child)
+            elif isinstance(child, ast.stmt):
+                self.stmt(child)
+
+
+def _analyze(events: list[_Ev]) -> list[tuple[str, int, int]]:
+    """Return (attr, load_line, store_line) for each cross-await RMW."""
+    out = []
+    for s_idx, ev in enumerate(events):
+        if ev.kind != "store" or not ev.deps:
+            continue
+        for (attr, i) in ev.deps:
+            if attr != ev.attr:
+                continue
+            awaits = [
+                j for j in range(i + 1, s_idx)
+                if events[j].kind == "await"
+            ]
+            if not awaits:
+                continue
+            last_await = awaits[-1]
+            # fresh re-read or guard between the last await and the store
+            window = events[last_await + 1 : s_idx]
+            if any(
+                e.kind == "guard"
+                or (e.kind == "load" and e.attr == attr)
+                for e in window
+            ):
+                continue
+            # load and store under one shared lock block
+            if events[i].locks & ev.locks:
+                continue
+            out.append((attr, events[i].line, ev.line))
+            break  # one finding per store
+    return out
+
+
+def check_file(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[int, str]] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        args = node.args.posonlyargs + node.args.args
+        if not args or args[0].arg != "self":
+            continue
+        scan = _CoroScan()
+        scan.stmts(node.body)
+        for attr, load_line, store_line in _analyze(scan.events):
+            key = (store_line, attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(
+                Finding(
+                    RULE,
+                    src.rel,
+                    store_line,
+                    f"self.{attr} read at line {load_line} is written back "
+                    f"here across an await with no lock, supersession "
+                    f"guard, or fresh re-read — interleaving coroutines "
+                    f"can clobber it (coroutine {node.name!r})",
+                )
+            )
+    return findings

@@ -1,0 +1,227 @@
+"""``wire-skew``: the trailing-field version-skew contract, statically.
+
+Every additive wire evolution in this tree (trace_id, meta_version,
+health_json, replica_ok, mirror) rides the same convention: optional
+fields are a TRAILING suffix declared by ``SKEW_TOLERANT_FROM``, the
+codec constructor-defaults them (old call sites keep working) and the
+decoder default-fills them (old senders keep parsing). The codec
+enforces the mechanics at class-definition time; this checker pins the
+*conventions* before the code ever runs, by parsing the message catalog
+(``proto/messages.py``) without importing it:
+
+* ``SKEW_TOLERANT_FROM`` must be a literal int with ``1 <= v <
+  len(FIELDS)`` — ``0`` would make every field optional (fail-open
+  decode: a truncated status reply would parse as OK), ``>= len``
+  is a dead marker;
+* the conventionally-optional field names (trace_id, meta_version,
+  health_json, replica_ok, mirror) must sit AT OR PAST the skew index —
+  never required mid-message, where an old peer's encoding would
+  misalign every following field;
+* a skew-variable message (own optional tail, or transitively via its
+  terminal nested message) may be nested only as the FINAL field of a
+  container and never inside a ``list:`` — its encoding has no fixed
+  length;
+* ``MSG_TYPE`` ids are unique; field types must be valid codec grammar;
+* message classes must not override ``__init__``/``pack_body``/
+  ``unpack_body``/``_field_is_default`` — an override silently breaks
+  the constructor-default/decode-fill halves of the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from lizardfs_tpu.tools.lint.engine import Finding, SourceFile
+
+RULE = "wire-skew"
+
+# field names that by repo convention ONLY ever ride as skew-tolerant
+# trailing fields (eattr is excluded: Seteattr carries it as required
+# request payload)
+OPTIONAL_BY_CONVENTION = {
+    "trace_id",
+    "meta_version",
+    "health_json",
+    "replica_ok",
+    "mirror",
+}
+
+_SCALARS = {"u8", "u16", "u32", "u64", "i32", "i64", "bool"}
+_CONTRACT_METHODS = {
+    "__init__",
+    "pack_body",
+    "unpack_body",
+    "_field_is_default",
+}
+
+
+def _valid_ftype(ftype: str, classes: dict) -> bool:
+    if ftype in _SCALARS or ftype in ("bytes", "str"):
+        return True
+    if ftype.startswith("list:"):
+        return _valid_ftype(ftype[5:], classes)
+    if ftype.startswith("msg:"):
+        return ftype[4:] in classes
+    return False
+
+
+class _Msg:
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.fields: list[tuple[str, str]] | None = None
+        self.skew: int | None = None
+        self.msg_type: int | None = None
+        self.overrides: list[tuple[str, int]] = []
+        self.fields_literal = True
+
+
+def _literal(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _parse_catalog(tree: ast.Module) -> dict[str, _Msg]:
+    out: dict[str, _Msg] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        msg = _Msg(node.name, node.lineno)
+        for st in node.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and (
+                isinstance(st.targets[0], ast.Name)
+            ):
+                tname = st.targets[0].id
+                if tname == "FIELDS":
+                    val = _literal(st.value)
+                    if isinstance(val, (tuple, list)):
+                        msg.fields = list(val)
+                    else:
+                        msg.fields = []
+                        msg.fields_literal = False
+                elif tname == "SKEW_TOLERANT_FROM":
+                    msg.skew = _literal(st.value)
+                elif tname == "MSG_TYPE":
+                    msg.msg_type = _literal(st.value)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if st.name in _CONTRACT_METHODS:
+                    msg.overrides.append((st.name, st.lineno))
+        if msg.fields is not None:
+            out[msg.name] = msg
+    return out
+
+
+def _tail_elides(name: str, classes: dict[str, _Msg], seen=()) -> bool:
+    msg = classes.get(name)
+    if msg is None or name in seen:
+        return False
+    if msg.skew is not None:
+        return True
+    if msg.fields:
+        _, ftype = msg.fields[-1]
+        if isinstance(ftype, str) and ftype.startswith("msg:"):
+            return _tail_elides(ftype[4:], classes, seen + (name,))
+    return False
+
+
+def check_global(cfg, collections: dict) -> list[Finding]:
+    # parses its one target itself (a single file) — the engine's
+    # per-file cache can then skip parsing everything else on warm runs
+    path = cfg.messages_path
+    if not path or not os.path.exists(path):
+        return []
+    rel = os.path.relpath(path, cfg.root)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = SourceFile(path, rel, fh.read())
+    except (OSError, SyntaxError) as e:
+        return [Finding(RULE, rel, 0, f"cannot parse catalog: {e}")]
+    classes = _parse_catalog(src.tree)
+    findings: list[Finding] = []
+
+    def f(msg: _Msg, text: str, line: int | None = None):
+        findings.append(Finding(RULE, rel, line or msg.line, text))
+
+    by_type: dict[int, str] = {}
+    for msg in classes.values():
+        fields = msg.fields or []
+        if not msg.fields_literal:
+            f(msg, f"{msg.name}: FIELDS is not a literal tuple — the "
+                   "checker (and any reader) must be able to see the wire "
+                   "schema without executing code")
+        for entry in fields:
+            if (
+                not isinstance(entry, tuple)
+                or len(entry) != 2
+                or not all(isinstance(x, str) for x in entry)
+            ):
+                f(msg, f"{msg.name}: FIELDS entry {entry!r} is not a "
+                       "(name, type) pair of string literals")
+                continue
+            fname, ftype = entry
+            if not _valid_ftype(ftype, classes):
+                f(msg, f"{msg.name}.{fname}: unknown codec field type "
+                       f"{ftype!r}")
+        # MSG_TYPE uniqueness
+        if msg.msg_type is not None:
+            prev = by_type.get(msg.msg_type)
+            if prev is not None:
+                f(msg, f"{msg.name}: MSG_TYPE {msg.msg_type} already "
+                       f"used by {prev}")
+            else:
+                by_type[msg.msg_type] = msg.name
+        # skew index shape
+        if msg.skew is not None:
+            if not isinstance(msg.skew, int) or isinstance(msg.skew, bool):
+                f(msg, f"{msg.name}: SKEW_TOLERANT_FROM must be a literal "
+                       "int")
+            elif msg.skew < 1:
+                f(msg, f"{msg.name}: SKEW_TOLERANT_FROM={msg.skew} makes "
+                       "required fields optional — a truncated reply would "
+                       "fail OPEN (decode defaults instead of a parse "
+                       "error); the optional suffix must start at >= 1")
+            elif msg.skew >= len(fields):
+                f(msg, f"{msg.name}: SKEW_TOLERANT_FROM={msg.skew} covers "
+                       f"no field (only {len(fields)} declared) — dead "
+                       "marker, drop it or add the optional suffix")
+        # conventionally-optional names must be in the optional suffix
+        for i, entry in enumerate(fields):
+            if not (isinstance(entry, tuple) and len(entry) == 2):
+                continue
+            fname = entry[0]
+            if fname in OPTIONAL_BY_CONVENTION:
+                if msg.skew is None or i < msg.skew:
+                    f(msg, f"{msg.name}.{fname}: {fname!r} is an additive "
+                           "convention field — it must sit at or past "
+                           "SKEW_TOLERANT_FROM (trailing, constructor-"
+                           "defaulted, decode default-filled), or an old "
+                           "peer's shorter encoding misaligns every "
+                           "following field")
+        # skew-variable nesting: terminal msg: only, never in lists
+        for i, entry in enumerate(fields):
+            if not (isinstance(entry, tuple) and len(entry) == 2):
+                continue
+            fname, ftype = entry
+            if not isinstance(ftype, str):
+                continue
+            if ftype.startswith("list:msg:"):
+                inner = ftype[9:]
+                if _tail_elides(inner, classes):
+                    f(msg, f"{msg.name}.{fname}: skew-tolerant {inner} "
+                           "inside a list — elements have no fixed length, "
+                           "the decode misaligns")
+            elif ftype.startswith("msg:"):
+                inner = ftype[4:]
+                if _tail_elides(inner, classes) and i != len(fields) - 1:
+                    f(msg, f"{msg.name}.{fname}: skew-tolerant {inner} "
+                           "nested non-terminally — its optional tail "
+                           "elides, misaligning every following field")
+        # contract-method overrides
+        for mname, line in msg.overrides:
+            f(msg, f"{msg.name}.{mname}: overriding {mname} breaks the "
+                   "codec's constructor-default/decode-fill contract — "
+                   "extend FIELDS + SKEW_TOLERANT_FROM instead", line)
+    return findings

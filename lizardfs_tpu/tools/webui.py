@@ -99,7 +99,9 @@ def sparkline(points, width=480, height=60, color="#8ab4f8"):
 
 
 async def _admin(addr, msg):
-    reader, writer = await asyncio.open_connection(*addr)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(*addr), 5.0
+    )
     try:
         await framing.send_message(writer, msg)
         return await framing.read_message(reader)

@@ -360,11 +360,19 @@ struct Server {
     // owned by the Server would be destroyed under that live thread
     // (TSAN: cond_destroy/delete vs notify/unlock, r07). Shared
     // ownership keeps them alive until the last toucher drops out.
+    // `active` is an ATOMIC the stop path polls, not a condvar count:
+    // libstdc++ timed condvar waits go through pthread_cond_clockwait,
+    // which gcc-10's TSan does not intercept — the invisible unlock
+    // inside wait_for corrupted the mutex's happens-before state and
+    // the full-matrix TSan leg reported bogus double-locks plus
+    // derivative races on everything mu guards (ISSUE-11 sweep). The
+    // release-decrement / acquire-load pair carries the same ordering
+    // the condvar did, and stop is a rare path where a 1 ms poll is
+    // free.
     struct ConnSync {
-        std::mutex mu;
-        std::condition_variable cv;
+        std::mutex mu;  // guards fds
         std::vector<int> fds;
-        size_t active = 0;
+        std::atomic<size_t> active{0};
     };
     std::shared_ptr<ConnSync> conns = std::make_shared<ConnSync>();
     std::atomic<uint64_t> bytes_read{0}, bytes_written{0};
@@ -2067,16 +2075,11 @@ void connection_loop(Server& srv, std::shared_ptr<Server::ConnSync> sync,
         if (it != sync->fds.end()) sync->fds.erase(it);
     }
     if (!adopted) ::close(cfd);
-    {
-        // notify UNDER the mutex (TSAN, r05) — and everything in this
-        // epilogue goes through the shared `sync`, never `srv`: the
-        // stop path deletes the Server as soon as it observes
-        // active == 0, and only the shared_ptr keeps these primitives
-        // alive through this thread's final unlock
-        std::lock_guard<std::mutex> g(sync->mu);
-        --sync->active;
-        sync->cv.notify_all();
-    }
+    // the release-decrement is this thread's LAST touch, and it goes
+    // through the shared `sync`, never `srv`: the stop path deletes
+    // the Server as soon as its acquire-load observes active == 0,
+    // and only the shared_ptr keeps the counter alive through here
+    sync->active.fetch_sub(1, std::memory_order_release);
 }
 
 void accept_loop(Server* srv, int lfd) {
@@ -2094,8 +2097,8 @@ void accept_loop(Server* srv, int lfd) {
         {
             std::lock_guard<std::mutex> g(sync->mu);
             sync->fds.push_back(cfd);
-            ++sync->active;
         }
+        sync->active.fetch_add(1, std::memory_order_relaxed);
         std::thread([srv, sync, cfd] {
             connection_loop(*srv, sync, cfd);
         }).detach();
@@ -2212,18 +2215,28 @@ void lz_serve_stop(int handle) {
     }
     if (srv->accept_thread.joinable()) srv->accept_thread.join();
     if (srv->uds_thread.joinable()) srv->uds_thread.join();
-    bool drained;
     // hold our own reference to the sync block: a straggler thread's
-    // final notify/unlock may still be in flight after we observe
+    // final decrement may still be in flight after we observe
     // active == 0, and `delete srv` below must not destroy the
-    // primitives under it — the last shared_ptr holder frees them
+    // counter under it — the last shared_ptr holder frees it
     std::shared_ptr<Server::ConnSync> sync = srv->conns;
     {
         std::unique_lock<std::mutex> g(sync->mu);
         for (int cfd : sync->fds) ::shutdown(cfd, SHUT_RDWR);
-        drained = sync->cv.wait_for(
-            g, std::chrono::seconds(10),
-            [&sync] { return sync->active == 0; });
+    }
+    // poll the atomic drain counter (10 s budget, 1 ms steps) instead
+    // of a timed condvar wait — see the ConnSync comment for why the
+    // condvar had to go (uninstrumented pthread_cond_clockwait under
+    // TSan). The acquire-load pairs with each connection thread's
+    // release-decrement, ordering every epilogue effect before the
+    // proactor_stop/delete below.
+    bool drained = false;
+    for (int i = 0; i < 10 * 1000; ++i) {
+        if (sync->active.load(std::memory_order_acquire) == 0) {
+            drained = true;
+            break;
+        }
+        ::usleep(1000);
     }
     // a straggler thread past the timeout still references srv: leak it
     // rather than free memory under a live thread. The proactor stops

@@ -11,6 +11,7 @@
 // The python codec mirrors this as a SKEW_TOLERANT trailing field.
 #pragma once
 
+#include <cctype>
 #include <cerrno>
 #include <cstddef>
 #include <cstdint>
@@ -124,7 +125,21 @@ inline int connect_tcp(const std::string& host, uint16_t port) {
 // connect_tcp — only the data plane binds a unix listener.
 
 inline bool uds_disabled() {
-    static const bool off = std::getenv("LZ_NO_UDS") != nullptr;
+    // Four-spelling parity with native_io.uds_disabled(): LZ_NO_UDS
+    // set to 0/off/false/no means NOT disabled — the old presence
+    // check treated "0" as set-and-therefore-kill, inverting the
+    // documented contract (kill-switch lint class). Cached once: the
+    // gate sits on every data dial.
+    static const bool off = [] {
+        const char* v = std::getenv("LZ_NO_UDS");
+        if (v == nullptr) return false;
+        char low[8] = {};
+        for (size_t i = 0; i < sizeof(low) - 1 && v[i] != '\0'; ++i)
+            low[i] = static_cast<char>(
+                std::tolower(static_cast<unsigned char>(v[i])));
+        return std::strcmp(low, "0") != 0 && std::strcmp(low, "off") != 0 &&
+               std::strcmp(low, "false") != 0 && std::strcmp(low, "no") != 0;
+    }();
     return off;
 }
 

@@ -1,0 +1,31 @@
+"""Known-bad unbounded-await fixtures (seeded, waived): every risky
+primitive the checker must catch when awaited bare."""
+
+import asyncio
+
+
+async def bad_dial(host, port):
+    # lint: waive(unbounded-await): seeded known-bad fixture
+    r, w = await asyncio.open_connection(host, port)
+    return r, w
+
+
+async def bad_read(reader):
+    # lint: waive(unbounded-await): seeded known-bad fixture
+    hdr = await reader.readexactly(8)
+    return hdr
+
+
+async def bad_drain(writer):
+    # lint: waive(unbounded-await): seeded known-bad fixture
+    await writer.drain()
+
+
+async def bad_queue_get(q):
+    # lint: waive(unbounded-await): seeded known-bad fixture
+    return await q.get()
+
+
+async def bad_event_wait(ev):
+    # lint: waive(unbounded-await): seeded known-bad fixture
+    await ev.wait()

@@ -1,0 +1,33 @@
+"""Known-good bounded idioms the await checker must NOT flag."""
+
+import asyncio
+
+from lizardfs_tpu.runtime.retry import bounded_wait
+from lizardfs_tpu.runtime.rpc import RpcConnection
+
+
+async def good_bounded(reader):
+    return await bounded_wait(reader.readexactly(8), 5.0)
+
+
+async def good_wait_for(writer):
+    await asyncio.wait_for(writer.drain(), 5.0)
+
+
+async def good_timeout_kwarg(tasks):
+    done, pending = await asyncio.wait(tasks, timeout=10.0)
+    return done, pending
+
+
+async def good_delegate(host, port):
+    # RpcConnection.connect is the audited bounded dial accessor
+    return await RpcConnection.connect(host, port)
+
+
+async def good_dict_get_is_not_queue_get(d, key):
+    # .get with arguments is a lookup, not a queue park
+    return await noop(d.get(key))
+
+
+async def noop(x):
+    return x

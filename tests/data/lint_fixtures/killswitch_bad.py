@@ -1,0 +1,43 @@
+"""Known-bad kill-switch fixtures (seeded, waived): direct switch
+reads, unregistered vars, computed names, and a split accessor."""
+
+import os
+from os import environ, getenv
+
+from lizardfs_tpu.constants import env_flag
+
+
+def direct_switch_read():
+    # boolean switch read outside constants.env_flag
+    # lint: waive(kill-switch): seeded known-bad fixture
+    return os.environ.get("LZ_SHM_RING", "1") == "1"
+
+
+def unregistered_var():
+    # lint: waive(kill-switch): seeded known-bad fixture
+    return os.environ.get("LZ_TOTALLY_NEW_KNOB", "")
+
+
+def computed_name(which):
+    # lint: waive(kill-switch): seeded known-bad fixture
+    return os.environ.get(f"LZ_{which}_MODE")
+
+
+def accessor_one():
+    # lint: waive(kill-switch): seeded known-bad fixture
+    return env_flag("LZ_TRACE")
+
+
+def accessor_two():
+    # second env_flag call site for the same switch: accessor drift
+    # lint: waive(kill-switch): seeded known-bad fixture
+    return env_flag("LZ_TRACE")
+
+
+def from_import_bypass():
+    # bare-name forms must not slip past the gate
+    # lint: waive(kill-switch): seeded known-bad fixture
+    if getenv("LZ_SLO"):
+        # lint: waive(kill-switch): seeded known-bad fixture
+        return environ.get("LZ_ANOTHER_UNREGISTERED")
+    return None

@@ -1,0 +1,24 @@
+"""Known-good kill-switch idioms: the one env_flag accessor shape and
+a single-site value-var read."""
+
+import os
+
+
+def env_flag(name, default=True):
+    # the accessor itself may read the environment directly
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() not in ("0", "off", "false", "no")
+
+
+def shm_ring_enabled():
+    return env_flag("LZ_SHM_RING")
+
+
+def shm_seg_mb():
+    # value var: direct read allowed, single accessor function
+    try:
+        return float(os.environ.get("LZ_SHM_RING_MB", "16"))
+    except ValueError:
+        return 16.0

@@ -1,0 +1,38 @@
+"""Known-bad cross-await-race fixtures (seeded, waived).
+
+Each pattern is the interleaving bug class PR 7 fixed four times: state
+read before an await, written after it from the stale value. The
+waivers keep the fixture at zero UNWAIVED findings; the gate self-test
+strips them and asserts the checker fires.
+"""
+
+
+class BadDaemon:
+    def __init__(self):
+        self.position = 0
+        self.sessions = {}
+        self.pending = []
+
+    async def bump_position(self, step):
+        v = self.position
+        await self._io()
+        # lint: waive(cross-await-race): seeded known-bad fixture
+        self.position = v + step
+
+    async def refresh(self, key):
+        # single-expression RMW: read, suspend, write — still a race
+        # lint: waive(cross-await-race): seeded known-bad fixture
+        self.sessions = await self._merge(self.sessions)
+
+    async def queue_alias(self, item):
+        items = self.pending
+        await self._io()
+        # mutating a stale alias: the object may have been superseded
+        # lint: waive(cross-await-race): seeded known-bad fixture
+        items.append(item)
+
+    async def _io(self):
+        pass
+
+    async def _merge(self, d):
+        return d

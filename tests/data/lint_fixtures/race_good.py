@@ -1,0 +1,49 @@
+"""Known-good idioms the race checker must NOT flag: lock-spanned
+read-modify-write, the supersession-guard shape, fresh re-reads, and
+plain awaited stores with no stale input."""
+
+import asyncio
+
+
+class GoodDaemon:
+    def __init__(self):
+        self.position = 0
+        self.owner = None
+        self.sessions = {}
+        self._lock = asyncio.Lock()
+
+    async def locked_bump(self, step):
+        # load and store share the lock: an interleaving peer holds it
+        async with self._lock:
+            v = self.position
+            await self._io()
+            self.position = v + step
+
+    async def guarded_write(self, me):
+        v = self.position
+        await self._io()
+        if self.owner is not me:
+            return  # supersession guard: state was re-validated
+        self.position = v + 1
+
+    async def fresh_reread(self, step):
+        v = self.position
+        await self._io()
+        if self.position != v:
+            v = self.position  # fresh read after the await
+        self.position = v + step
+
+    async def fresh_store(self):
+        # the stored value derives only from the awaited result
+        self.sessions = dict(await self._fetch())
+
+    async def same_side_rmw(self):
+        await self._io()
+        # read and write on the SAME side of the await: no interleaving
+        self.position = self.position + 1
+
+    async def _io(self):
+        pass
+
+    async def _fetch(self):
+        return {}

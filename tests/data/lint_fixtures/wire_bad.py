@@ -1,0 +1,84 @@
+"""Known-bad message catalog for the wire-skew checker. Every class
+here violates the trailing-field skew contract in a distinct way."""
+
+
+class Message:  # stand-in base so the fixture parses standalone
+    pass
+
+
+class MidMessageTraceId(Message):
+    # trace_id is a convention-optional field but sits mid-message
+    # with no SKEW_TOLERANT_FROM: an old peer's encoding misaligns
+    MSG_TYPE = 9001
+    FIELDS = (
+        ("req_id", "u32"),
+        ("trace_id", "u64"),
+        ("status", "u8"),
+    )
+
+
+class FailOpenSkew(Message):
+    # SKEW_TOLERANT_FROM = 0 makes the verdict-bearing status optional:
+    # a truncated reply decodes as status=0 == OK
+    MSG_TYPE = 9002
+    SKEW_TOLERANT_FROM = 0
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+    )
+
+
+class DeadSkewMarker(Message):
+    MSG_TYPE = 9003
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+    )
+
+
+class SkewTolerantTail(Message):
+    MSG_TYPE = 9004
+    SKEW_TOLERANT_FROM = 1
+    FIELDS = (
+        ("inode", "u32"),
+        ("meta_version", "u64"),
+    )
+
+
+class NestsSkewNonTerminally(Message):
+    # SkewTolerantTail's encoding has no fixed length: nesting it
+    # before another field misaligns everything after it
+    MSG_TYPE = 9005
+    FIELDS = (
+        ("req_id", "u32"),
+        ("attr", "msg:SkewTolerantTail"),
+        ("status", "u8"),
+    )
+
+
+class ListOfSkewTolerant(Message):
+    MSG_TYPE = 9006
+    FIELDS = (
+        ("req_id", "u32"),
+        ("attrs", "list:msg:SkewTolerantTail"),
+    )
+
+
+class DuplicateType(Message):
+    MSG_TYPE = 9001  # collides with MidMessageTraceId
+    FIELDS = (("req_id", "u32"),)
+
+
+class BadFieldType(Message):
+    MSG_TYPE = 9007
+    FIELDS = (("req_id", "u128"),)
+
+
+class OverridesInit(Message):
+    MSG_TYPE = 9008
+    SKEW_TOLERANT_FROM = 1
+    FIELDS = (("req_id", "u32"), ("trace_id", "u64"))
+
+    def __init__(self, **kw):  # breaks constructor-defaulting
+        pass

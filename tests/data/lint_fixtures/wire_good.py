@@ -1,0 +1,40 @@
+"""Known-good message catalog: the conventions messages.py rides on,
+which the wire-skew checker must pass untouched."""
+
+
+class Message:  # stand-in base so the fixture parses standalone
+    pass
+
+
+class Addr(Message):
+    FIELDS = (("host", "str"), ("port", "u16"))
+
+
+class PlainRequest(Message):
+    MSG_TYPE = 9101
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("names", "list:str"),
+        ("where", "msg:Addr"),
+    )
+
+
+class TokenedReply(Message):
+    MSG_TYPE = 9102
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("meta_version", "u64"),
+        ("trace_id", "u64"),
+    )
+
+
+class CarriesTokenedTailTerminally(Message):
+    # a skew-variable message may ride as the FINAL field
+    MSG_TYPE = 9103
+    FIELDS = (
+        ("req_id", "u32"),
+        ("reply", "msg:TokenedReply"),
+    )

@@ -179,6 +179,23 @@ def test_summary_budget_guard_drops_not_truncates():
     assert "kernel_ladder" in s.get("dropped", []) or "kernel_ladder" in s
 
 
+def test_summary_immune_to_unknown_row_keys():
+    """Subsystems that add FILES but no fiducials (e.g. the invariant
+    lint engine) must not be able to regress the tail summary: the
+    summary is allowlist-built, so arbitrary new row keys — however
+    many, however fat — change NOTHING about the emitted line. This
+    pins that property structurally instead of hoping each new
+    subsystem remembers it."""
+    base = bench._summary_row(_fat_row())
+    row = _fat_row()
+    for i in range(50):
+        row[f"lint_findings_shard_{i}"] = {"rule": "x" * 120, "n": i}
+    row["lint_waivers"] = ["cross-await-race"] * 100
+    polluted = bench._summary_row(row)
+    assert polluted == base  # byte-identical: unknown keys never ride
+    assert len(json.dumps(polluted)) <= bench.SUMMARY_BUDGET_BYTES
+
+
 def test_summary_keeps_targets_under_any_drop():
     row = _fat_row()
     row["kernel_ladder"] = {f"c{i}": "e" * 200 for i in range(20)}

@@ -1,0 +1,371 @@
+"""Invariant lint engine: the tier-1 gate and its self-tests.
+
+Three layers:
+
+* **the gate** — the whole tree lints at ZERO unwaived findings (any
+  new cross-await race, unbounded await, wire-skew break, or stray
+  LZ_* read fails tier-1 here);
+* **fixture tests** — per checker, known-bad snippets must flag and
+  known-good idioms (bounded_wait, supersession guards, env_flag,
+  skew-tolerant tails) must not; the seeded known-bad fixtures carry
+  waivers, and stripping them must re-arm the findings (self-test that
+  the gate actually bites);
+* **waiver accounting** — a waiver that matches nothing is itself a
+  finding, and a reasonless waiver is not a waiver, so suppressions
+  cannot silently accumulate.
+
+Plus the kill-switch off-spelling equivalence pins (LZ_TRACE,
+LZ_NO_UDS, LZ_TPU_ALLOW_CPU, LZ_SHADOW_READS) the kill-switch checker
+requires to exist.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lizardfs_tpu.constants import env_flag, shadow_reads_enabled  # noqa: E402
+from lizardfs_tpu.tools.lint import cli as lint_cli  # noqa: E402
+from lizardfs_tpu.tools.lint.engine import (  # noqa: E402
+    LintConfig,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _cfg(paths, rules=None, **kw):
+    kw.setdefault("use_cache", False)
+    return LintConfig(root=REPO, paths=paths, rules=rules, **kw)
+
+
+def _strip_waivers(tmp_path, src_path):
+    """Copy a fixture with every waiver comment removed."""
+    out = tmp_path / os.path.basename(src_path)
+    with open(src_path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    kept = [ln for ln in lines if "lint: waive" not in ln]
+    out.write_text("\n".join(kept) + "\n", encoding="utf-8")
+    return str(out)
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+
+
+def test_tree_zero_unwaived_findings():
+    cfg = LintConfig.for_tree(REPO)
+    cfg.use_cache = False
+    result = run_lint(cfg)
+    assert not result.unwaived, "\n" + "\n".join(
+        f.render() for f in result.unwaived
+    )
+    # the burn-down's deliberate exceptions are visible, not silent
+    assert len(result.waived) >= 10
+    assert all(f.waive_reason for f in result.waived)
+
+
+# --------------------------------------------------------------------------
+# cross-await-race
+# --------------------------------------------------------------------------
+
+
+def test_race_bad_fixture_is_waived_clean():
+    result = run_lint(_cfg([_fx("race_bad.py")], ["cross-await-race"]))
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+    assert result.by_rule(waived=True)["cross-await-race"] == 3
+
+
+def test_race_bad_fires_without_waivers(tmp_path):
+    stripped = _strip_waivers(tmp_path, _fx("race_bad.py"))
+    result = run_lint(_cfg([stripped], ["cross-await-race"]))
+    found = [f for f in result.findings if f.rule == "cross-await-race"]
+    assert len(found) == 3, [f.render() for f in result.findings]
+    attrs = {f.message.split()[0] for f in found}
+    assert attrs == {"self.position", "self.sessions", "self.pending"}
+
+
+def test_race_good_idioms_do_not_flag():
+    result = run_lint(_cfg([_fx("race_good.py")], ["cross-await-race"]))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# --------------------------------------------------------------------------
+# unbounded-await
+# --------------------------------------------------------------------------
+
+
+def test_await_bad_fixture_is_waived_clean():
+    result = run_lint(_cfg([_fx("await_bad.py")], ["unbounded-await"]))
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+    assert result.by_rule(waived=True)["unbounded-await"] == 5
+
+
+def test_await_bad_fires_without_waivers(tmp_path):
+    stripped = _strip_waivers(tmp_path, _fx("await_bad.py"))
+    result = run_lint(_cfg([stripped], ["unbounded-await"]))
+    found = [f for f in result.findings if f.rule == "unbounded-await"]
+    assert len(found) == 5, [f.render() for f in result.findings]
+    prims = {f.message.split("`")[1] for f in found}
+    assert prims == {
+        "await ....open_connection(...)", "await ....readexactly(...)",
+        "await ....drain(...)", "await ....get(...)", "await ....wait(...)",
+    }
+
+
+def test_await_good_idioms_do_not_flag():
+    result = run_lint(_cfg([_fx("await_good.py")], ["unbounded-await"]))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# --------------------------------------------------------------------------
+# wire-skew
+# --------------------------------------------------------------------------
+
+
+def test_wire_bad_catalog_flags_every_violation():
+    result = run_lint(_cfg(
+        [_fx("wire_bad.py")], ["wire-skew"],
+        messages_path=_fx("wire_bad.py"),
+    ))
+    msgs = "\n".join(f.message for f in result.unwaived)
+    for expected in (
+        "MidMessageTraceId.trace_id",       # required mid-message
+        "FailOpenSkew: SKEW_TOLERANT_FROM=0",
+        "DeadSkewMarker: SKEW_TOLERANT_FROM=2 covers no field",
+        "NestsSkewNonTerminally.attr",      # non-terminal skew nesting
+        "ListOfSkewTolerant.attrs",         # skew class inside a list
+        "DuplicateType: MSG_TYPE 9001 already used",
+        "BadFieldType.req_id: unknown codec field type",
+        "OverridesInit.__init__",
+    ):
+        assert expected in msgs, f"missing: {expected}\ngot:\n{msgs}"
+
+
+def test_wire_good_catalog_is_clean():
+    result = run_lint(_cfg(
+        [_fx("wire_good.py")], ["wire-skew"],
+        messages_path=_fx("wire_good.py"),
+    ))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_wire_real_catalog_is_clean():
+    # the live proto/messages.py passes its own contract
+    result = run_lint(_cfg(
+        [os.path.join(REPO, "lizardfs_tpu", "proto", "messages.py")],
+        ["wire-skew"],
+    ))
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+
+
+# --------------------------------------------------------------------------
+# kill-switch
+# --------------------------------------------------------------------------
+
+
+def test_killswitch_bad_fixture_is_waived_clean():
+    result = run_lint(_cfg([_fx("killswitch_bad.py")], ["kill-switch"]))
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+    assert result.by_rule(waived=True)["kill-switch"] == 7
+
+
+def test_killswitch_bad_fires_without_waivers(tmp_path):
+    stripped = _strip_waivers(tmp_path, _fx("killswitch_bad.py"))
+    result = run_lint(_cfg([stripped], ["kill-switch"]))
+    msgs = "\n".join(f.message for f in result.findings)
+    assert "LZ_SHM_RING: boolean kill switch read directly" in msgs
+    assert "LZ_TOTALLY_NEW_KNOB: unregistered" in msgs
+    assert "computed name" in msgs
+    assert "LZ_TRACE: env_flag called from 2 places" in msgs
+    # bare-name forms (`from os import getenv/environ`) are caught too
+    assert "LZ_SLO: boolean kill switch read directly" in msgs
+    assert "LZ_ANOTHER_UNREGISTERED: unregistered" in msgs
+    assert len(result.findings) == 7, [f.render() for f in result.findings]
+
+
+def test_killswitch_good_idioms_do_not_flag():
+    cfg = _cfg([_fx("killswitch_good.py")], ["kill-switch"])
+    # the fixture hosts its own accessor; the real tree pins
+    # lizardfs_tpu/constants.py as THE env_flag home
+    cfg.ks_accessor_files = (
+        os.path.relpath(_fx("killswitch_good.py"), REPO),
+    )
+    result = run_lint(cfg)
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_killswitch_env_flag_elsewhere_is_not_the_accessor(tmp_path):
+    """A function merely NAMED env_flag outside constants.py is a
+    re-implementation (its own spelling set), not the accessor — a
+    literal switch read inside it must still flag."""
+    p = tmp_path / "fake_accessor.py"
+    p.write_text(
+        "import os\n\n\n"
+        "def env_flag(default=True):\n"
+        "    return os.environ.get('LZ_SHM_RING', '1') != '0'\n",
+        encoding="utf-8",
+    )
+    result = run_lint(_cfg([str(p)], ["kill-switch"]))
+    msgs = [f.message for f in result.unwaived]
+    assert any(
+        "LZ_SHM_RING: boolean kill switch read directly" in m for m in msgs
+    ), msgs
+
+
+# --------------------------------------------------------------------------
+# waiver accounting — suppressions cannot accumulate silently
+# --------------------------------------------------------------------------
+
+
+def test_stale_waiver_is_a_finding(tmp_path):
+    p = tmp_path / "stale.py"
+    p.write_text(
+        "# lint: waive(unbounded-await): nothing here needs this\n"
+        "X = 1\n",
+        encoding="utf-8",
+    )
+    result = run_lint(_cfg([str(p)], ["unbounded-await"]))
+    assert [f.rule for f in result.unwaived] == ["stale-waiver"]
+    assert "matches no finding" in result.unwaived[0].message
+
+
+def test_reasonless_waiver_is_not_a_waiver(tmp_path):
+    p = tmp_path / "reasonless.py"
+    p.write_text(
+        "async def f(reader):\n"
+        "    # lint: waive(unbounded-await):\n"
+        "    return await reader.readexactly(4)\n",
+        encoding="utf-8",
+    )
+    result = run_lint(_cfg([str(p)], ["unbounded-await"]))
+    assert [f.rule for f in result.unwaived] == ["unbounded-await"]
+
+
+def test_waiver_in_docstring_is_ignored(tmp_path):
+    p = tmp_path / "doc.py"
+    p.write_text(
+        '"""docs may quote `# lint: waive(unbounded-await): like so`"""\n'
+        "X = 1\n",
+        encoding="utf-8",
+    )
+    result = run_lint(_cfg([str(p)], ["unbounded-await"]))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+# --------------------------------------------------------------------------
+# engine: cache + CLI
+# --------------------------------------------------------------------------
+
+
+def test_per_file_cache_roundtrip(tmp_path):
+    import shutil
+
+    src = tmp_path / "cached.py"
+    shutil.copy(_fx("race_bad.py"), src)
+    cache = tmp_path / "cache.json"
+    cfg = _cfg([str(src)], ["cross-await-race"],
+               use_cache=True, cache_path=str(cache))
+    first = run_lint(cfg)
+    assert cache.exists()
+    second = run_lint(cfg)  # served from cache
+    assert [f.render() for f in first.findings] == [
+        f.render() for f in second.findings
+    ]
+    # editing the file invalidates its entry
+    src.write_text(src.read_text() + "\nY = 2\n", encoding="utf-8")
+    third = run_lint(cfg)
+    assert len(third.waived) == len(first.waived)
+
+
+def test_targeted_run_does_not_clobber_full_cache(tmp_path):
+    """A single-file or --rule invocation must merge into the cache,
+    not overwrite it — otherwise every targeted run puts the next
+    `make lint` back on a cold parse of the whole tree."""
+    import json
+    import shutil
+
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    shutil.copy(_fx("race_good.py"), a)
+    shutil.copy(_fx("await_good.py"), b)
+    cache = tmp_path / "cache.json"
+
+    def cfg(paths, rules=None):
+        return _cfg(paths, rules, use_cache=True, cache_path=str(cache))
+
+    run_lint(cfg([str(a), str(b)]))  # full run: both files cached
+    full_fp = next(iter(json.loads(cache.read_text())["entries"]))
+    run_lint(cfg([str(a)]))  # targeted run, same rules fingerprint
+    entries = json.loads(cache.read_text())["entries"]
+    assert set(entries[full_fp]) == {
+        os.path.relpath(str(a), REPO), os.path.relpath(str(b), REPO)
+    }
+    run_lint(cfg([str(a)], ["cross-await-race"]))  # different fingerprint
+    entries = json.loads(cache.read_text())["entries"]
+    assert len(entries[full_fp]) == 2  # full-tree slice survived
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert lint_cli.main([_fx("race_good.py")]) == 0
+    stripped = _strip_waivers(tmp_path, _fx("race_bad.py"))
+    assert lint_cli.main(["--no-cache", stripped]) == 1
+    out = capsys.readouterr().out
+    assert "cross-await-race" in out
+
+
+# --------------------------------------------------------------------------
+# kill-switch off-spelling equivalence (the tests the checker demands)
+# --------------------------------------------------------------------------
+
+
+def test_env_flag_four_spelling_parity_lz_trace(monkeypatch):
+    for off in ("0", "off", "false", "no", "OFF", "No", "FALSE"):
+        monkeypatch.setenv("LZ_TRACE", off)
+        assert env_flag("LZ_TRACE") is False, off
+    for on in ("1", "on", "true", "yes", "anything"):
+        monkeypatch.setenv("LZ_TRACE", on)
+        assert env_flag("LZ_TRACE") is True, on
+    monkeypatch.delenv("LZ_TRACE", raising=False)
+    assert env_flag("LZ_TRACE") is True  # default on
+
+
+def test_lz_no_uds_spelling_inversion_fixed(monkeypatch):
+    """LZ_NO_UDS=0 used to DISABLE the UDS fast path (bare truthiness:
+    set therefore kill). Four-spelling parity means 0/off/false/no ==
+    'not disabled', matching wire.h uds_disabled() C-side."""
+    from lizardfs_tpu.core.native_io import uds_disabled
+
+    monkeypatch.delenv("LZ_NO_UDS", raising=False)
+    assert uds_disabled() is False
+    for off in ("0", "off", "false", "no"):
+        monkeypatch.setenv("LZ_NO_UDS", off)
+        assert uds_disabled() is False, off
+    monkeypatch.setenv("LZ_NO_UDS", "1")
+    assert uds_disabled() is True
+
+
+def test_lz_tpu_allow_cpu_spelling_inversion_fixed(monkeypatch):
+    """LZ_TPU_ALLOW_CPU=0 used to ENABLE the escape hatch (truthy
+    string). It must read as OFF now."""
+    from lizardfs_tpu.core.encoder import _tpu_allow_cpu
+
+    monkeypatch.delenv("LZ_TPU_ALLOW_CPU", raising=False)
+    assert _tpu_allow_cpu() is False
+    monkeypatch.setenv("LZ_TPU_ALLOW_CPU", "0")
+    assert _tpu_allow_cpu() is False
+    monkeypatch.setenv("LZ_TPU_ALLOW_CPU", "1")
+    assert _tpu_allow_cpu() is True
+
+
+def test_shadow_reads_switch_rides_env_flag(monkeypatch):
+    monkeypatch.setenv("LZ_SHADOW_READS", "off")
+    assert shadow_reads_enabled() is False
+    monkeypatch.delenv("LZ_SHADOW_READS", raising=False)
+    assert shadow_reads_enabled() is True
