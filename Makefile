@@ -52,7 +52,14 @@ chaos-slow:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q \
 	  -p no:cacheprovider
 
+# s3-smoke: boot master + chunkservers + S3 gateway in-process and run
+# the PUT/GET/List/multipart round trip (the `smoke`-named subset of
+# tests/test_s3.py; the whole non-slow file rides tier-1 too)
+s3-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_s3.py -q -k smoke \
+	  -p no:cacheprovider
+
 native:
 	$(MAKE) -C native
 
-.PHONY: test lint sanitize chaos chaos-slow native
+.PHONY: test lint sanitize chaos chaos-slow s3-smoke native

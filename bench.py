@@ -441,6 +441,20 @@ def cluster_throughput() -> dict:
                 for extra in ("MBps_reps", "ops_reps"):
                     if extra in r:
                         out[f"cluster_{key}_{extra}"] = r[extra]
+            elif "put_MBps" in r:
+                # S3 gateway row (ROADMAP 3): object PUT/GET MB/s plus
+                # the ListObjectsV2 ops rate over a populated bucket
+                out["cluster_s3_put_MBps"] = r["put_MBps"]
+                out["cluster_s3_get_MBps"] = r["get_MBps"]
+                out["cluster_s3_list_ops"] = r["list_ops"]
+                out["cluster_s3_spread_pct"] = max(
+                    r.get("put_spread_pct", 0), r.get("get_spread_pct", 0),
+                    r.get("list_spread_pct", 0),
+                )
+                for extra in ("put_reps_MBps", "get_reps_MBps",
+                              "list_ops_reps"):
+                    if extra in r:
+                        out[f"cluster_s3_{extra}"] = r[extra]
             elif "rebuild_MBps" in r:
                 # RebuildEngine convergence after a chunkserver loss
                 out["cluster_rebuild_MBps"] = r["rebuild_MBps"]
@@ -719,6 +733,10 @@ def _summary_row(row: dict) -> dict:
         # parts came back through the RebuildEngine (part count lives
         # in BENCH_FULL.json)
         "cluster_rebuild_MBps", "cluster_rebuild_s",
+        # s3 gateway row (ROADMAP 3): the third front door's object
+        # PUT/GET MB/s + listing ops rate (reps in BENCH_FULL.json)
+        "cluster_s3_put_MBps", "cluster_s3_get_MBps",
+        "cluster_s3_list_ops",
     ):
         if key in row:
             s[key] = row[key]
@@ -805,6 +823,12 @@ _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "cluster_locate_p99_ms",
     "kernel_ladder",
     "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",
+    # spreads are noise CONTEXT for the target verdicts, not verdicts:
+    # the whole suffix family drops as one recorded unit
+    "*_spread_pct",
+    # the s3 row drops as ONE unit (prefix entry, one drop record)
+    # before the ec(8,4) instruments the standing write target depends on
+    "cluster_s3_*",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
     "cluster_ec8_4_write_shm", "cluster_locate_qps",
     "cluster_ec8_4_write_phases",
@@ -816,10 +840,24 @@ def _fit_summary(s: dict) -> dict:
     for key in _SUMMARY_DROP_ORDER:
         if len(json.dumps(s)) <= SUMMARY_BUDGET_BYTES:
             break
-        if key in s:
+        if key.endswith("*") or key.startswith("*"):
+            # prefix/suffix entry: a whole key family drops as one unit
+            # with ONE drop record (per-key records would eat the
+            # savings)
+            if key.endswith("*"):
+                family = [k for k in s if k.startswith(key[:-1])]
+            else:
+                family = [k for k in s if k.endswith(key[1:])]
+            if not family:
+                continue
+            for k in family:
+                del s[k]
+        elif key in s:
             del s[key]
-            dropped.append(key)
-            s["dropped"] = dropped  # idempotent re-assign, stays last
+        else:
+            continue
+        dropped.append(key)
+        s["dropped"] = dropped  # idempotent re-assign, stays last
     return s
 
 

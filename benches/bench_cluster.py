@@ -613,6 +613,74 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
 
             logging.getLogger("bench").exception("nfs bench row failed")
 
+        # S3 gateway throughput: the third protocol front door
+        # (ROADMAP 3) measured wire-level like the NFS rows — PUT/GET
+        # of whole objects through the HTTP gateway plus a
+        # ListObjectsV2 ops rate over a populated bucket. One gateway
+        # process is the scale-out unit, same as NFS.
+        try:
+            from lizardfs_tpu.s3.client import S3Client
+            from lizardfs_tpu.s3.server import S3Gateway
+
+            s3gw = S3Gateway("127.0.0.1", master.port)
+            await s3gw.start()
+            try:
+                s3_mb = min(size_mb, 32)
+                blob = payload[: s3_mb * 2**20]
+                wts, rts, lops = [], [], []
+                async with S3Client("127.0.0.1", s3gw.port) as s3c:
+                    await s3c.create_bucket("bench")
+                    # a populated key space for the listing rate
+                    for i in range(64):
+                        await s3c.put_object(
+                            "bench", f"small/{i:04d}", b"x" * 1024
+                        )
+                    for rep in range(REPS):
+                        key = f"obj_{rep}.bin"
+                        t0 = time.perf_counter()
+                        await s3c.put_object("bench", key, blob)
+                        wts.append(time.perf_counter() - t0)
+                        t0 = time.perf_counter()
+                        got = await s3c.get_object("bench", key)
+                        rts.append(time.perf_counter() - t0)
+                        assert got.body == blob, "s3 read mismatch"
+                        n_lists = 0
+                        t0 = time.perf_counter()
+                        while time.perf_counter() - t0 < 1.0:
+                            await s3c.list_objects(
+                                "bench", prefix="small/", max_keys=100
+                            )
+                            n_lists += 1
+                        lops.append(
+                            round(n_lists / (time.perf_counter() - t0), 1)
+                        )
+                        await s3c.delete_object("bench", key)
+                w_reps = [round(s3_mb / t, 1) for t in wts]
+                r_reps = [round(s3_mb / t, 1) for t in rts]
+                w_med, w_spread = _median_spread(w_reps)
+                r_med, r_spread = _median_spread(r_reps)
+                l_med, l_spread = _median_spread(lops)
+                rows.append({
+                    "goal": "s3 gateway",
+                    "put_MBps": w_med,
+                    "get_MBps": r_med,
+                    "list_ops": l_med,
+                    "put_spread_pct": w_spread,
+                    "get_spread_pct": r_spread,
+                    "list_spread_pct": l_spread,
+                    "put_reps_MBps": w_reps,
+                    "get_reps_MBps": r_reps,
+                    "list_ops_reps": lops,
+                })
+            finally:
+                await s3gw.stop()
+        except AssertionError:
+            raise  # data corruption must fail the bench
+        except Exception:  # noqa: BLE001 — infra failure must not kill it
+            import logging
+
+            logging.getLogger("bench").exception("s3 bench row failed")
+
         # small-read latency: the FUSE-path comparison — direct C call
         # (liz_read on the caller thread) vs asyncio planner path
         from lizardfs_tpu.client import native_client
@@ -785,6 +853,10 @@ def main(argv=None) -> int:
                   f"   ({r.get('locate_qps_x', 0)}x, "
                   f"p99 {a['locate_p99_ms']}/"
                   f"{b.get('locate_p99_ms', 0)} ms)")
+        elif "put_MBps" in r:
+            print(f"{r['goal']:>18s}:  put {r['put_MBps']:8.1f} MB/s"
+                  f"   get {r['get_MBps']:8.1f} MB/s"
+                  f"   list {r['list_ops']:6.1f} ops/s")
         elif "native_read_us" in r:
             print(f"{r['goal']:>18s}:  native {r['native_read_us']:7.1f} us"
                   f"   loop {r['loop_read_us']:7.1f} us")

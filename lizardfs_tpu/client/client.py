@@ -799,11 +799,31 @@ class Client:
         return r.attr
 
     async def tape_info(self, inode: int) -> dict:
-        """Tape-copy state: {"wanted", "pending", "copies", "fresh"}."""
+        """Tape-copy state: {"wanted", "pending", "copies", "fresh",
+        "demoted", "recalling", "forced"}."""
         import json as _json
 
         r = await self._call(m.CltomaTapeInfo, inode=inode)
         return _json.loads(r.json)
+
+    async def tape_demote(self, inode: int, uid: int | None = None,
+                          gids: list[int] | None = None) -> None:
+        """Demote a file to the tape tier (frees its chunk data once a
+        fresh archival copy exists). CHUNK_BUSY means the master queued
+        a forced archive — retry after it lands."""
+        await self._call(
+            m.CltomaTapeDemote, inode=inode, **self._ident(uid, gids)
+        )
+        self._drop_locates(inode)
+        self.cache.invalidate(inode)
+
+    async def tape_recall(self, inode: int) -> None:
+        """Recall a demoted file from the tape tier; returns once the
+        master restored the bytes (no-op for a live file). Callers that
+        hit TAPE_RECALL on a read retry it after this resolves."""
+        await self._call(m.CltomaTapeRecall, inode=inode)
+        self._drop_locates(inode)
+        self.cache.invalidate(inode)
 
     async def statfs(self) -> tuple[int, int]:
         """Cluster (total_bytes, available_bytes) across chunkservers."""
@@ -1347,10 +1367,18 @@ class Client:
                 # some not, parity stale); each retry takes a FRESH grant
                 # — the version bump drops unreachable holders and the
                 # full region rewrite restores stripe consistency on the
-                # survivors
+                # survivors. The RMW read-back happens ONCE and is
+                # reused across retries (rmw_cache): a retry that
+                # re-read the region would decode a MIX of first-attempt
+                # and stale parts — torn state — and write the garbage
+                # back over the preserved bytes (caught by the
+                # s3-multipart chaos schedule: SIGKILL mid-RMW)
+                rmw_cache: dict = {}
+
                 async def attempt():
                     await self._pwrite_chunk_locked(
-                        inode, ci, coff, piece, old_length, new_length
+                        inode, ci, coff, piece, old_length, new_length,
+                        rmw_cache,
                     )
 
                 await self._retry_transient(f"pwrite chunk {ci}", attempt)
@@ -1362,6 +1390,7 @@ class Client:
     async def _pwrite_chunk_locked(
         self, inode: int, ci: int, coff: int, piece: np.ndarray,
         old_length: int, new_length: int,
+        rmw_cache: dict | None = None,
     ) -> None:
         grant = await self._call(
             m.CltomaWriteChunk, inode=inode, chunk_index=ci,
@@ -1388,7 +1417,7 @@ class Client:
                 # use the grant's file length, not the caller's snapshot:
                 # concurrent writers may have extended the file since
                 await self._rmw_striped(grant, slice_type, copies, ci, coff,
-                                        piece, grant.file_length)
+                                        piece, grant.file_length, rmw_cache)
             status_code = st.OK
         finally:
             await self._call(
@@ -1404,6 +1433,7 @@ class Client:
     async def _rmw_striped(
         self, grant, slice_type, copies, ci: int, coff: int,
         piece: np.ndarray, old_length: int,
+        rmw_cache: dict | None = None,
     ) -> None:
         d = slice_type.data_parts
         first_data = 1 if slice_type.is_xor else 0
@@ -1412,6 +1442,15 @@ class Client:
         hi_s = (coff + len(piece) - 1) // stripe_bytes
         nstripes = hi_s - lo_s + 1
         region_start = lo_s * stripe_bytes
+        if rmw_cache is not None and "region" in rmw_cache:
+            # retry after a torn first attempt: re-reading the stripes
+            # now would decode a mix of already-rewritten and stale
+            # parts — reuse the region assembled BEFORE any of our
+            # writes touched the wire, making retries write-only
+            region = rmw_cache["region"]
+            await self._rmw_send(grant, slice_type, copies, lo_s,
+                                 nstripes, region)
+            return
         region = np.zeros(nstripes * stripe_bytes, dtype=np.uint8)
 
         chunk_len_old = min(max(old_length - ci * MFSCHUNKSIZE, 0), MFSCHUNKSIZE)
@@ -1460,8 +1499,18 @@ class Client:
                 data_parts, slice_type, len(region)
             )
         region[coff - region_start : coff - region_start + len(piece)] = piece
+        if rmw_cache is not None:
+            # stash the patched region BEFORE any write hits the wire:
+            # this is the one pre-torn snapshot a retry may trust
+            rmw_cache["region"] = region
+        await self._rmw_send(grant, slice_type, copies, lo_s, nstripes,
+                             region)
 
-        # recompute the affected stripes' parity and rewrite all parts
+    async def _rmw_send(self, grant, slice_type, copies, lo_s: int,
+                        nstripes: int, region: np.ndarray) -> None:
+        """Encode + rewrite the RMW region's parts (the write half of
+        _rmw_striped, shared by first attempts and torn-state
+        retries)."""
         t0 = self._t0()
         parts = await asyncio.to_thread(
             striping.split_chunk, region, slice_type, self.encoder

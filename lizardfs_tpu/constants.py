@@ -72,6 +72,22 @@ def shadow_reads_enabled() -> bool:
     return env_flag("LZ_SHADOW_READS")
 
 
+def s3_enabled() -> bool:
+    """LZ_S3 kill switch (default ON) for the S3 object gateway: off,
+    the gateway refuses to start (a booted gateway keeps serving —
+    operators drain by restarting, like any protocol front door)."""
+    return env_flag("LZ_S3")
+
+
+def s3_lifecycle_enabled() -> bool:
+    """LZ_S3_LIFECYCLE kill switch (default ON) for the master's
+    lifecycle tiering scanner (age-based demote of cold objects to the
+    tape tier). Off stops NEW demotions and forced archive queueing;
+    recall of already-demoted files always works — data access must
+    never be behind a kill switch."""
+    return env_flag("LZ_S3_LIFECYCLE")
+
+
 # Per-inode extra-attribute flags (reference: MFSCommunication.h EATTR_*
 # subset; `lizardfs geteattr`/`seteattr`): NOOWNER makes every uid act
 # as the owner for permission checks; NOCACHE forbids client-side data
@@ -82,9 +98,23 @@ def shadow_reads_enabled() -> bool:
 EATTR_NOOWNER = 0x01
 EATTR_NOCACHE = 0x02
 EATTR_NOENTRYCACHE = 0x04
+# Directory carries S3 lifecycle rules (the parameters live in the
+# S3_LIFECYCLE_XATTR JSON on the same directory): the marker bit rides
+# every Attr reply, so the master's lifecycle scanner and the S3
+# gateway can test "has rules?" without an xattr round trip.
+EATTR_LIFECYCLE = 0x08
 
 EATTR_NAMES = {
     "noowner": EATTR_NOOWNER,
     "nocache": EATTR_NOCACHE,
     "noentrycache": EATTR_NOENTRYCACHE,
+    "lifecycle": EATTR_LIFECYCLE,
 }
+
+# Bucket-directory xattr holding the lifecycle rule parameters as JSON
+# ({"demote_after_s": seconds}); the EATTR_LIFECYCLE bit marks the
+# directory so scanners index it cheaply.
+S3_LIFECYCLE_XATTR = "lizardfs.s3.lifecycle"
+# Object-file xattr holding the S3 ETag the gateway computed at PUT /
+# CompleteMultipartUpload time (served back on GET/HEAD/List).
+S3_ETAG_XATTR = "lizardfs.s3.etag"

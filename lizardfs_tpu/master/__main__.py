@@ -4,7 +4,8 @@ Config keys (KEY = VALUE, mfsmaster.cfg analog): DATA_PATH, LISTEN_HOST,
 LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), IO_LIMIT_BPS
 (global bytes/s budget), IO_LIMITS_CFG (mfsiolimits.cfg-style per-cgroup
 budgets: `subsystem X` + `limit <group> <bps>` lines), LOG_LEVEL,
-HEALTH_INTERVAL, IMAGE_INTERVAL, PERSONALITY (master|shadow),
+HEALTH_INTERVAL, IMAGE_INTERVAL, LIFECYCLE_INTERVAL (s3 lifecycle
+tiering scan period), PERSONALITY (master|shadow),
 ACTIVE_MASTER (host:port, required for shadow), and optional election:
 ELECTION_ID, ELECTION_LISTEN (host:port), ELECTION_PEERS
 (id=host:port,id=host:port,...), PROMOTE_EXEC / DEMOTE_EXEC (shell
@@ -48,6 +49,9 @@ async def _run(cfg: Config) -> None:
         admin_password=cfg.get_str("ADMIN_PASSWORD", "") or None,
         lock_grace_seconds=cfg.get_float("LOCK_GRACE", 30.0, min_value=0.0),
         config_paths=config_paths,
+        lifecycle_interval=cfg.get_float(
+            "LIFECYCLE_INTERVAL", 30.0, min_value=0.1
+        ),
     )
     # initial load runs the SAME code as SIGHUP reload, strictly: boot
     # fails loudly on a bad file instead of serving half a config

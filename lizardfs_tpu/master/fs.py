@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
-from lizardfs_tpu.constants import MFSCHUNKSIZE
+from lizardfs_tpu.constants import EATTR_LIFECYCLE, MFSCHUNKSIZE
 from lizardfs_tpu.proto import status as st
 
 ROOT_INODE = 1
@@ -153,6 +153,11 @@ class FsTree:
         # freed at the last release. Replicated via acquire/release ops.
         self.open_refs: dict[int, dict[int, int]] = {}
         self.sustained: set[int] = set()
+        # directories carrying the EATTR_LIFECYCLE marker bit (S3
+        # lifecycle rules): maintained by apply_seteattr / apply_rmdir
+        # and rebuilt on load, so the master's lifecycle scanner never
+        # walks the whole namespace just to find its roots
+        self.lifecycle_dirs: set[int] = set()
         root = Node(inode=ROOT_INODE, ftype=TYPE_DIR, mode=0o755, nlink=1)
         self.nodes[ROOT_INODE] = root
 
@@ -337,6 +342,7 @@ class FsTree:
             raise FsError(st.ENOTEMPTY, name)
         del p.children[name]
         del self.nodes[inode]
+        self.lifecycle_dirs.discard(inode)
         p.mtime = p.ctime = ts
         self._add_stats(parent, -1, 0)
 
@@ -434,6 +440,11 @@ class FsTree:
         n = self.node(inode)
         n.eattr = eattr & 0xFF
         n.ctime = ts
+        if n.ftype == TYPE_DIR:
+            if n.eattr & EATTR_LIFECYCLE:
+                self.lifecycle_dirs.add(inode)
+            else:
+                self.lifecycle_dirs.discard(inode)
         return n
 
     def apply_set_chunk(self, inode: int, chunk_index: int, chunk_id: int) -> Node:
@@ -587,6 +598,19 @@ class FsTree:
             self._add_stats(parent, 0, delta)
         return [c for c in shared if c]
 
+    def apply_demote(self, inode: int, ts: int) -> list[int]:
+        """Tape-tier demote: drop the file's chunk list (the caller
+        releases the ids in the registry) while KEEPING length and
+        mtime — the content still exists on tape, stamped by exactly
+        those fields, and stat must keep telling the truth about the
+        object's size. Only ctime moves (a demote is a metadata
+        event)."""
+        n = self.file_node(inode)
+        removed = [c for c in n.chunks if c]
+        n.chunks = []
+        n.ctime = ts
+        return removed
+
     def apply_repair_zero_chunk(
         self, inode: int, chunk_index: int, ts: int
     ) -> int:
@@ -679,9 +703,12 @@ class FsTree:
             for i, refs in d.get("open", {}).items()
         }
         fs.sustained = set(d.get("sustained", ()))
+        fs.lifecycle_dirs = set()
         for nd in d["nodes"]:
             node = Node.from_dict(nd)
             fs.nodes[node.inode] = node
+            if node.ftype == TYPE_DIR and node.eattr & EATTR_LIFECYCLE:
+                fs.lifecycle_dirs.add(node.inode)
         if ROOT_INODE not in fs.nodes:
             raise ValueError("image missing root inode")
         return fs

@@ -38,6 +38,13 @@ class MetadataStore:
         # reads as stale. Deterministic from the op stream (shadows
         # converge), so excluded from the digest like next_inode.
         self.content_gen: dict[int, int] = {}
+        # lifecycle-demoted (tape-only) inodes: inode -> {"length",
+        # "mtime", "gen"} content stamp at demote time. A demoted file
+        # keeps its length/mtime but holds no chunks — reads/writes are
+        # refused with TAPE_RECALL until a recall restores the bytes.
+        # Replicated through the changelog (demote frees chunk refs, so
+        # shadows must apply it identically) and persisted in the image.
+        self.demoted: dict[int, dict] = {}
         # incremental metadata digest (see checksum())
         self._digest = 0
         self.reset_digest()
@@ -175,6 +182,7 @@ class MetadataStore:
         self.fs.apply_purge_trash(op["inode"])
         if op["inode"] not in self.fs.nodes:
             self.content_gen.pop(op["inode"], None)
+            self.demoted.pop(op["inode"], None)
 
     def _op_undelete(self, op):
         self.fs.apply_undelete(op["inode"], op["ts"])
@@ -265,6 +273,7 @@ class MetadataStore:
                     self.registry.release_chunk(cid)
             self.fs.nodes.pop(inode, None)
             self.content_gen.pop(inode, None)
+            self.demoted.pop(inode, None)
 
     def _op_release(self, op):
         self._release_one(op["inode"], op["sid"])
@@ -310,6 +319,7 @@ class MetadataStore:
             "next_session": self.next_session,
             "tape": {str(i): c for i, c in self.tape_copies.items() if c},
             "tape_gen": {str(i): g for i, g in self.content_gen.items()},
+            "demoted": {str(i): d for i, d in self.demoted.items()},
             "locks": {
                 kind: {
                     str(inode): [
@@ -346,6 +356,9 @@ class MetadataStore:
         }
         self.content_gen = {
             int(i): int(g) for i, g in doc.get("tape_gen", {}).items()
+        }
+        self.demoted = {
+            int(i): dict(d) for i, d in doc.get("demoted", {}).items()
         }
         from lizardfs_tpu.master.locks import FileLocks, Owner, Range
 
@@ -448,6 +461,13 @@ class MetadataStore:
                  c["ts"])
                 for c in copies
             ])
+        if kind == "demoted":
+            d = self.demoted.get(key[1])
+            if d is None:
+                return 0
+            return self._h(
+                "demoted", key[1], d["length"], d["mtime"], d.get("gen", 0)
+            )
         if kind == "open":
             refs = self.fs.open_refs.get(key[1])
             if not refs:
@@ -536,6 +556,49 @@ class MetadataStore:
     def _op_tape_drop(self, op):
         self.tape_copies.pop(op["inode"], None)
         self.content_gen.pop(op["inode"], None)
+        self.demoted.pop(op["inode"], None)
+
+    def _op_tape_demote(self, op):
+        """Demote to the tape tier: free the file's chunk data, record
+        the content stamp the archival copy must match for recall. The
+        live master only commits this with a fresh tape copy on hand;
+        apply is unconditional (replay must not re-validate against
+        volatile link state)."""
+        inode = op["inode"]
+        node = self.fs.file_node(inode)
+        removed = self.fs.apply_demote(inode, op["ts"])
+        for cid in removed:
+            self.registry.release_chunk(cid)
+        self.demoted[inode] = {
+            "length": node.length, "mtime": node.mtime,
+            "gen": self.content_gen.get(inode, 0),
+        }
+
+    def _op_tape_recall_done(self, op):
+        """Recall finished: the archived bytes were written back. The
+        restore writes bumped mtime/content_gen; put the original mtime
+        back (a recall is not a modification) and re-stamp the tape
+        copies that matched the demoted stamp to the CURRENT generation
+        so the recall does not read as staleness (which would trigger a
+        pointless re-archive of identical bytes)."""
+        inode = op["inode"]
+        stamp = self.demoted.pop(inode, None)
+        node = self.fs.nodes.get(inode)
+        if stamp is None or node is None:
+            return
+        if not op.get("restore", True):
+            # a write raced the restore: the content is live again but
+            # it is NOT the archived version — no mtime/stamp rewrite
+            node.ctime = op["ts"]
+            return
+        node.mtime = stamp["mtime"]
+        node.ctime = op["ts"]
+        gen = self.content_gen.get(inode, 0)
+        for c in self.tape_copies.get(inode, []):
+            if (c["length"], c["mtime"], c.get("gen", 0)) == (
+                stamp["length"], stamp["mtime"], stamp["gen"]
+            ):
+                c["gen"] = gen
 
     def _touched(self, op: dict) -> set[tuple]:
         """Entities whose state the op may change — evaluated against
@@ -599,19 +662,19 @@ class MetadataStore:
             out.add(("chunk", op["chunk_id"]))
         elif t in ("acquire", "release"):
             out |= {("open", op["inode"]), ("sustained", op["inode"]),
-                    ("node", op["inode"])}
+                    ("node", op["inode"]), ("demoted", op["inode"])}
             node_quota(op["inode"])
             node_chunks(op["inode"])
         elif t == "release_session_opens":
             for inode, refs in self.fs.open_refs.items():
                 if op["sid"] in refs:
                     out |= {("open", inode), ("sustained", inode),
-                            ("node", inode)}
+                            ("node", inode), ("demoted", inode)}
                     node_quota(inode)
                     node_chunks(inode)
         elif t in ("purge_trash", "undelete"):
             out |= {("node", op["inode"]), ("trash", op["inode"]),
-                    ("sustained", op["inode"])}
+                    ("sustained", op["inode"]), ("demoted", op["inode"])}
             node_quota(op["inode"])
             node_chunks(op["inode"])
             entry = fs.trash.get(op["inode"])
@@ -631,6 +694,12 @@ class MetadataStore:
                                 out.add(("edge", p, name))
         elif t in ("tape_copy", "tape_drop"):
             out.add(("tape", op["inode"]))
+            if t == "tape_drop":
+                out.add(("demoted", op["inode"]))
+        elif t in ("tape_demote", "tape_recall_done"):
+            out |= {("node", op["inode"]), ("demoted", op["inode"]),
+                    ("tape", op["inode"])}
+            node_chunks(op["inode"])
         elif t == "set_quota":
             out.add(("quota", op["kind"], op["owner_id"]))
         elif t == "snapshot":
@@ -695,6 +764,8 @@ class MetadataStore:
                 d ^= self._entity_hash(("locks", lkind, inode))
         for inode in self.tape_copies:
             d ^= self._entity_hash(("tape", inode))
+        for inode in self.demoted:
+            d ^= self._entity_hash(("demoted", inode))
         return d
 
     def checksum(self, cache_key: int | None = None) -> str:

@@ -33,6 +33,7 @@ from lizardfs_tpu.master.chunks import ChunkServerInfo
 from lizardfs_tpu.master.locks import LOCK_UNLOCK, MAX_OFFSET
 from lizardfs_tpu.master.metadata import MetadataStore
 from lizardfs_tpu.master.quotas import KIND_DIR, KIND_GROUP, KIND_USER
+from lizardfs_tpu import constants as constants_mod
 from lizardfs_tpu.constants import MFSBLOCKSIZE, MFSCHUNKSIZE
 from lizardfs_tpu.master import rebuild as rebuild_mod
 from lizardfs_tpu.proto import framing
@@ -134,6 +135,7 @@ class MasterServer(Daemon):
         admin_password: str | None = None,
         lock_grace_seconds: float = 30.0,
         config_paths: dict[str, str] | None = None,
+        lifecycle_interval: float = 30.0,
     ):
         super().__init__(host, port)
         self.admin_password = admin_password
@@ -160,6 +162,18 @@ class MasterServer(Daemon):
         # (rebuilt by a scan when a tape server registers)
         self.tape_pending: dict[int, tuple[int, int, int]] = {}
         self._tape_inflight: set[int] = set()
+        # lifecycle tiering (S3 gateway / ROADMAP 3): inodes the
+        # lifecycle scanner wants archived even without a $tape goal —
+        # _tape_missing_labels treats membership as one wildcard copy.
+        # Derived state (the scanner re-queues each pass), not persisted.
+        self.tape_force: set[int] = set()
+        # demoted inodes mid-recall: inode -> Future resolving to a
+        # status code. While an inode is here the demoted write guard
+        # stands down FOR THE RECALLING TAPE SERVER'S SESSION only
+        # (_recall_sids; 0 = legacy peer without a session id =
+        # permissive); reads stay refused until recall completes.
+        self._recall_inflight: dict[int, asyncio.Future] = {}
+        self._recall_sids: dict[int, int] = {}
         self.shadow_writers: list[asyncio.StreamWriter] = []
         self.sessions: dict[int, dict] = {}
         # orphaned lock owners (no live connection) first seen at ts;
@@ -185,6 +199,13 @@ class MasterServer(Daemon):
         self.topology = topology if topology is not None else Topology()
         self.health_interval = health_interval
         self.image_interval = image_interval
+        self.lifecycle_interval = lifecycle_interval
+        # lifecycle scan work caps: nodes visited / demotes committed
+        # per tick — the scan must never own the loop. Oversized
+        # buckets resume across ticks via the saved walk stacks.
+        self.lifecycle_scan_budget = 10_000
+        self.lifecycle_demote_budget = 256
+        self._lifecycle_stacks: dict[int, list[int]] = {}
         # explicit rebuild scheduler (priority classes, token-bucket
         # throttle, progress/ETA) — the endangered FIFO feeds it, the
         # health tick launches what it admits (master/rebuild.py)
@@ -322,6 +343,11 @@ class MasterServer(Daemon):
         self.add_timer(1.0, self._lock_grace_sweep)
         self.add_timer(30.0, self._read_watcher_sweep)
         self.add_timer(1.0, self._tape_drain)
+        # S3 lifecycle tiering scan (age-based demote to tape); the
+        # kill switch is re-read per tick, so LZ_S3_LIFECYCLE=0 stops
+        # new demotions without a restart
+        self.add_timer(max(self.lifecycle_interval, 0.1),
+                       self._lifecycle_tick)
 
     async def _task_tick(self) -> None:
         """Run a batch of background metadata jobs (TaskManager analog:
@@ -1065,7 +1091,7 @@ class MasterServer(Daemon):
         "CltomaSnapshot", "CltomaSetXattr",
         "CltomaSetQuota", "CltomaUndelete", "CltomaSetAcl",
         "CltomaSetRichAcl", "CltomaSetEattr", "CltomaFileRepair",
-        "CltomaAppendChunks",
+        "CltomaAppendChunks", "CltomaTapeDemote",
     )
 
     _INODE_FIELDS = ("parent", "inode", "parent_src", "parent_dst",
@@ -1171,10 +1197,32 @@ class MasterServer(Daemon):
                 "pending": msg.inode in self.tape_pending,
                 "copies": self.meta.tape_copies.get(msg.inode, []),
                 "fresh": len(stamp_fresh),
+                # lifecycle tiering state: tape-only / restore running /
+                # archive forced by the scanner without a $tape goal
+                "demoted": msg.inode in self.meta.demoted,
+                "recalling": msg.inode in self._recall_inflight,
+                "forced": msg.inode in self.tape_force,
             }
             return m.MatoclTapeInfoReply(
                 req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
+        if isinstance(msg, m.CltomaTapeDemote):
+            node = fs.file_node(msg.inode)
+            self._check_perm(node, msg.uid, list(msg.gids), 2)
+            return m.MatoclStatusReply(
+                req_id=msg.req_id, status=self._try_demote(msg.inode, now)
+            )
+        if isinstance(msg, m.CltomaTapeRecall):
+            fs.file_node(msg.inode)  # must exist and be a file
+            if msg.inode not in self.meta.demoted:
+                return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+            try:
+                code = await retrymod.bounded_wait(
+                    asyncio.shield(self._ensure_recall(msg.inode)), 120.0
+                )
+            except asyncio.TimeoutError:
+                code = st.TIMEOUT  # the recall task itself keeps going
+            return m.MatoclStatusReply(req_id=msg.req_id, status=code)
         if isinstance(msg, m.CltomaStatFs):
             # the space sum is O(servers) — memoize briefly so a statfs
             # storm against a 10k-chunkserver master stays O(1) per call
@@ -1342,6 +1390,10 @@ class MasterServer(Daemon):
             return self._attr_reply(msg.req_id, fs.node(msg.inode))
         if isinstance(msg, m.CltomaTruncate):
             self._check_perm(fs.file_node(msg.inode), msg.uid, list(msg.gids), 2)
+            if (msg.inode in self.meta.demoted
+                    and not self._recall_writer_ok(msg.inode, session_id)):
+                # tape-only content must be recalled before reshaping it
+                return self._error_reply(msg, st.TAPE_RECALL)
             self.commit({"op": "set_length", "inode": msg.inode,
                          "length": msg.length, "ts": now})
             self._invalidate_client_caches(msg.inode, exclude_sid=session_id)
@@ -1382,7 +1434,7 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaReadChunk):
             return await self._read_chunk(msg, session.get("ip"), session_id)
         if isinstance(msg, m.CltomaWriteChunk):
-            return await self._write_chunk(msg)
+            return await self._write_chunk(msg, session_id)
         if isinstance(msg, m.CltomaWriteChunkEnd):
             # invalidate FIRST and unconditionally: even a failed write
             # (non-OK status, or quota raise below) may have overwritten
@@ -1806,6 +1858,11 @@ class MasterServer(Daemon):
         ident = (msg.uid, list(msg.gids))
         self._check_perm(src, *ident, 4)
         self._check_perm(dst, *ident, 2)
+        if (msg.inode_src in self.meta.demoted
+                or msg.inode_dst in self.meta.demoted):
+            # a demoted side holds no chunks to share: concat would
+            # fabricate holes where tape-only bytes belong
+            return self._error_reply(msg, st.TAPE_RECALL)
         padded = (
             (dst.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE * MFSCHUNKSIZE
         )
@@ -1836,6 +1893,17 @@ class MasterServer(Daemon):
         src = fs.node(msg.src_inode)
         ident = (getattr(msg, "uid", 0), list(getattr(msg, "gids", [0])))
         self._check_perm(src, *ident, 4)
+        if self.meta.demoted:
+            # a demoted file in the subtree holds no chunks to share —
+            # its clone would silently read zeros; recall first
+            stack = [src.inode]
+            while stack:
+                cur = stack.pop()
+                if cur in self.meta.demoted:
+                    return self._error_reply(msg, st.TAPE_RECALL)
+                n = fs.nodes.get(cur)
+                if n is not None and n.ftype == fsmod.TYPE_DIR:
+                    stack.extend(n.children.values())
         self._check_perm(fs.dir_node(msg.dst_parent), *ident, 2 | 1)
         wi, wb = fs._node_weight(src)
         self._check_quota(msg.dst_parent, src.uid, src.gid, wi, wb)
@@ -1961,6 +2029,16 @@ class MasterServer(Daemon):
     ):
         node = self.meta.fs.file_node(msg.inode)
         self._check_perm(node, msg.uid, list(msg.gids), 4)
+        if msg.inode in self.meta.demoted:
+            # tape-only data: kick the recall (idempotent single-flight)
+            # and refuse with the transient status — a reader that
+            # waits (CltomaTapeRecall) or simply retries later succeeds
+            # once the archive streamed back
+            self._ensure_recall(msg.inode)
+            return m.MatoclReadChunk(
+                req_id=msg.req_id, status=st.TAPE_RECALL, chunk_id=0,
+                version=0, file_length=node.length, locations=[],
+            )
         self._note_watcher(msg.inode, session_id)
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
@@ -1978,9 +2056,18 @@ class MasterServer(Daemon):
             locations=self._locations_of(chunk, client_ip),
         )
 
-    async def _write_chunk(self, msg: m.CltomaWriteChunk):
+    async def _write_chunk(self, msg: m.CltomaWriteChunk,
+                           session_id: int = 0):
         node = self.meta.fs.file_node(msg.inode)
         self._check_perm(node, msg.uid, list(msg.gids), 2)
+        if (msg.inode in self.meta.demoted
+                and not self._recall_writer_ok(msg.inode, session_id)):
+            # tape-only file: recall before mutating (only the
+            # recalling tape server's session may write mid-restore)
+            return m.MatoclWriteChunk(
+                req_id=msg.req_id, status=st.TAPE_RECALL, chunk_id=0,
+                version=0, file_length=0, locations=[],
+            )
         chunk_id = (
             node.chunks[msg.chunk_index] if msg.chunk_index < len(node.chunks) else 0
         )
@@ -2541,7 +2628,12 @@ class MasterServer(Daemon):
         ts_id = self._next_ts_id
         self._next_ts_id += 1
         label = first.label or "_"
-        self.ts_links[ts_id] = {"link": link, "label": label}
+        self.ts_links[ts_id] = {
+            "link": link, "label": label,
+            # the tape server's own client session (0 = old peer):
+            # recalls scope the demoted-file write guard to exactly it
+            "sid": getattr(first, "session_id", 0),
+        }
         await framing.send_message(
             writer, m.MatotsRegisterReply(
                 req_id=first.req_id, status=st.OK, ts_id=ts_id
@@ -2555,7 +2647,7 @@ class MasterServer(Daemon):
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
-                if isinstance(msg, m.TstomaPutDone):
+                if isinstance(msg, (m.TstomaPutDone, m.TstomaRecallDone)):
                     link.dispatch_ack(msg)
         finally:
             self.ts_links.pop(ts_id, None)
@@ -2573,9 +2665,13 @@ class MasterServer(Daemon):
     def _tape_missing_labels(self, inode: int, node) -> list[str]:
         """Goal tape labels not yet covered by a fresh copy. A named
         label needs a server with that label; a wildcard is satisfied by
-        any fresh copy not already claimed by a named label."""
+        any fresh copy not already claimed by a named label. A
+        lifecycle-forced inode (``tape_force``) wants one wildcard copy
+        even when its goal carries no $tape slice."""
         goal = self.goals.get(node.goal)
         labels = goal.tape_labels() if goal is not None else []
+        if not labels and inode in self.tape_force:
+            labels = [geometry.WILDCARD_LABEL]
         if not labels:
             return []
         stamp = self._content_stamp(inode, node)
@@ -2623,6 +2719,7 @@ class MasterServer(Daemon):
         elif t == "purge_trash":
             inode = op["inode"]
             self.tape_pending.pop(inode, None)
+            self.tape_force.discard(inode)
             if (inode not in self.meta.fs.nodes
                     and inode in self.meta.tape_copies):
                 self.commit({"op": "tape_drop", "inode": inode})
@@ -2647,6 +2744,9 @@ class MasterServer(Daemon):
             if self._goal_tape_copies(node.goal) > 0:
                 self.tape_pending[inode] = self._content_stamp(inode, node)
             else:
+                # a content mutation resets a lifecycle-forced archive
+                # too: the file is hot again, the scanner re-decides
+                self.tape_force.discard(inode)
                 self.tape_pending.pop(inode, None)
 
     async def _tape_drain(self) -> None:
@@ -2718,6 +2818,211 @@ class MasterServer(Daemon):
             pass  # stays pending; next drain retries
         finally:
             self._tape_inflight.discard(inode)
+
+    # --- lifecycle tiering: demote to tape, recall on access ---------------------------
+
+    def _tape_fresh_labels(self, inode: int, stamp) -> set[str]:
+        """Labels holding an archival copy at exactly this content
+        stamp."""
+        return {
+            c["label"] for c in self.meta.tape_copies.get(inode, [])
+            if (c["length"], c["mtime"], c.get("gen", 0)) == tuple(stamp)
+        }
+
+    def _try_demote(self, inode: int, now: int) -> int:
+        """Demote one file to the tape tier. OK = demoted (or nothing
+        to do), CHUNK_BUSY = archive queued / file busy, retry later."""
+        node = self.meta.fs.nodes.get(inode)
+        if node is None or node.ftype != fsmod.TYPE_FILE:
+            return st.ENOENT
+        if inode in self.meta.demoted:
+            return st.OK  # already tape-only
+        if node.length == 0 or not node.chunks:
+            return st.OK  # nothing to free; GET serves zeros already
+        if self.meta.fs.open_refs.get(inode) or inode in self._recall_inflight:
+            return st.CHUNK_BUSY  # never demote under an open handle
+        for cid in node.chunks:
+            chunk = self.meta.registry.chunks.get(cid) if cid else None
+            if chunk is not None and chunk.locked_until > time.monotonic():
+                return st.CHUNK_BUSY  # write in flight
+        stamp = self._content_stamp(inode, node)
+        if self._tape_fresh_labels(inode, stamp):
+            self.commit({"op": "tape_demote", "inode": inode, "ts": now})
+            self.tape_force.discard(inode)
+            self.tape_pending.pop(inode, None)
+            self._invalidate_client_caches(inode)
+            self.metrics.counter(
+                "tape_demoted",
+                help="files demoted to the tape tier (chunk data freed)",
+            ).inc()
+            return st.OK
+        # no fresh archival copy yet: force-queue one (wildcard label,
+        # goal-independent) and report busy so the caller retries
+        self.tape_force.add(inode)
+        self.tape_pending.setdefault(inode, stamp)
+        return st.CHUNK_BUSY
+
+    def _recall_writer_ok(self, inode: int, session_id: int) -> bool:
+        """May this session write a demoted inode right now? Only the
+        recalling tape server's session, and only once the recall task
+        dispatched the restore (sid recorded). A legacy tape server
+        that registered without a session id (sid 0) gets the old
+        permissive standdown — the recall-done length check is then
+        the only concurrent-write defense."""
+        if inode not in self._recall_inflight:
+            return False
+        sid = self._recall_sids.get(inode)
+        if sid is None:
+            return False  # restore not dispatched yet: nobody writes
+        return sid == 0 or sid == session_id
+
+    def _ensure_recall(self, inode: int) -> asyncio.Future:
+        """The single-flight recall future for an inode: every GET that
+        trips over a demoted file awaits the same restore."""
+        fut = self._recall_inflight.get(inode)
+        if fut is None or fut.done():
+            fut = asyncio.get_running_loop().create_future()
+            self._recall_inflight[inode] = fut
+            self.spawn(self._tape_recall_task(inode, fut))
+        return fut
+
+    async def _tape_recall_task(self, inode: int, fut: asyncio.Future) -> None:
+        status = st.EIO
+        try:
+            doc = self.meta.demoted.get(inode)
+            if doc is None:
+                status = st.OK
+                return
+            want = (doc["length"], doc["mtime"], doc.get("gen", 0))
+            labels = self._tape_fresh_labels(inode, want)
+            entry = next(
+                (e for e in self.ts_links.values() if e["label"] in labels),
+                None,
+            )
+            if entry is None:
+                # no connected tape server holds the archived version
+                status = st.NOT_POSSIBLE
+                return
+            # scope the write-guard standdown to the restoring session
+            # (0 = legacy tape server: permissive, length check below
+            # is then the only concurrent-write defense)
+            self._recall_sids[inode] = entry.get("sid", 0)
+            done = await entry["link"].command(
+                m.MatotsRecallFile, inode=inode,
+                path=self.meta.fs.path_of(inode),
+                length=doc["length"], mtime=doc["mtime"], timeout=120.0,
+            )
+            if done.status != st.OK:
+                status = done.status
+                return
+            node = self.meta.fs.nodes.get(inode)
+            if node is None or inode not in self.meta.demoted:
+                status = st.OK if node is not None else st.ENOENT
+                return
+            # a write that raced the restore makes the content live
+            # again but NOT the archived version: clear the demoted
+            # state without the mtime/stamp restore, and let _tape_mark
+            # (which already saw the write) drive any re-archive. With
+            # a session-scoped guard (sid > 0) concurrent writes were
+            # refused outright, so the length check is pure defense;
+            # for a legacy tape server (sid == 0) it is the only
+            # concurrent-write tell we have (a same-length race slips
+            # through — upgrade the tape server to close it).
+            clean = (
+                (done.length, done.mtime) == want[:2]
+                and node.length == doc["length"]
+            )
+            self.commit({
+                "op": "tape_recall_done", "inode": inode,
+                "ts": int(time.time()), "restore": clean,
+            })
+            self.tape_pending.pop(inode, None)
+            self._invalidate_client_caches(inode)
+            self.metrics.counter(
+                "tape_recalled",
+                help="files recalled from the tape tier on access",
+            ).inc()
+            status = st.OK
+        except (ConnectionError, asyncio.TimeoutError):
+            status = st.TIMEOUT
+        finally:
+            self._recall_inflight.pop(inode, None)
+            self._recall_sids.pop(inode, None)
+            if not fut.done():
+                fut.set_result(status)
+
+    def _lifecycle_rule_of(self, node) -> float | None:
+        """demote_after_s from a lifecycle directory's rule xattr, or
+        None when the rule is absent/offline/unparseable."""
+        raw = node.xattrs.get(constants_mod.S3_LIFECYCLE_XATTR)
+        if not raw:
+            return None
+        try:
+            rule = json.loads(raw.decode("utf-8"))
+            if not rule.get("enabled", True):
+                return None
+            return max(float(rule["demote_after_s"]), 0.0)
+        except (ValueError, KeyError, UnicodeDecodeError):
+            return None
+
+    async def _lifecycle_tick(self) -> None:
+        """Age-based demote scan over lifecycle-marked directories
+        (S3 buckets with rules): files colder than the rule's
+        demote_after_s push through the existing tape archive flow and
+        demote once a fresh copy lands. Budgeted per tick with a
+        RESUMABLE cursor (the saved walk stack): a bucket larger than
+        one tick's budget makes progress every tick instead of
+        rescanning the same prefix forever."""
+        if not (self.is_active and self.meta.fs.lifecycle_dirs):
+            return
+        if not constants_mod.s3_lifecycle_enabled():
+            return
+        fs = self.meta.fs
+        now = int(time.time())
+        scanned = demoted = 0
+        # drop cursors of roots that lost their rule/marker
+        for root in [r for r in self._lifecycle_stacks
+                     if r not in fs.lifecycle_dirs]:
+            del self._lifecycle_stacks[root]
+        for root in list(fs.lifecycle_dirs):
+            dnode = fs.nodes.get(root)
+            if dnode is None or dnode.ftype != fsmod.TYPE_DIR:
+                fs.lifecycle_dirs.discard(root)
+                self._lifecycle_stacks.pop(root, None)
+                continue
+            after_s = self._lifecycle_rule_of(dnode)
+            if after_s is None:
+                self._lifecycle_stacks.pop(root, None)
+                continue
+            # resume where the last tick stopped; a fresh (or finished)
+            # walk restarts at the root. Stale inodes saved in a cursor
+            # are skipped via nodes.get below.
+            stack = self._lifecycle_stacks.pop(root, None) or [root]
+            while stack:
+                if scanned >= self.lifecycle_scan_budget:
+                    self._lifecycle_stacks[root] = stack  # resume here
+                    return
+                scanned += 1
+                if scanned % 2048 == 0:
+                    await asyncio.sleep(0)  # stay off the hot loop
+                # lint: waive(cross-await-race): _run_timer awaits each tick to completion — lifecycle ticks never overlap, so the cursor stack and fs alias can't be clobbered by a concurrent scan
+                node = fs.nodes.get(stack.pop())
+                if node is None:
+                    continue
+                if node.ftype == fsmod.TYPE_DIR:
+                    stack.extend(node.children.values())
+                    continue
+                if node.ftype != fsmod.TYPE_FILE:
+                    continue
+                if node.inode in self.meta.demoted:
+                    continue
+                if now - node.mtime <= after_s:
+                    continue
+                if self._try_demote(node.inode, now) == st.OK:
+                    demoted += 1
+                    if demoted >= self.lifecycle_demote_budget:
+                        self._lifecycle_stacks[root] = stack
+                        return
 
     # --- health loop (ChunkWorker analog) ----------------------------------------------
 
@@ -3465,11 +3770,31 @@ class MasterServer(Daemon):
             }
             for snap in self.shadow_status.values()
         ]
+        # protocol gateways, by role, from the session registry: the
+        # rollup names every front door (fuse clients register as
+        # pyclient/fuse, gateways as nfs-gateway / s3-gateway), so "is
+        # the s3 tier up" is answerable from `lizardfs-admin health`
+        gateways: dict[str, int] = {"nfs": 0, "s3": 0}
+        for sess in self.sessions.values():
+            if not sess.get("connected"):
+                continue
+            info = str(sess.get("info", ""))
+            if info.startswith("nfs-gateway"):
+                gateways["nfs"] += 1
+            elif info.startswith("s3-gateway"):
+                gateways["s3"] += 1
         return {
             "status": status,
             "master": master_snap,
             "chunkservers": servers,
             "shadows": shadows,
+            "gateways": gateways,
+            "tape": {
+                "servers": len(self.ts_links),
+                "pending": len(self.tape_pending),
+                "demoted": len(self.meta.demoted),
+                "recalling": len(self._recall_inflight),
+            },
             "summary": {
                 "endangered": endangered,
                 "lost": lost,

@@ -565,6 +565,34 @@ class MatoclTapeInfoReply(Message):
     FIELDS = (("req_id", "u32"), ("status", "u8"), ("json", "str"))
 
 
+class CltomaTapeDemote(Message):
+    """Demote a file to the tape tier: with a fresh archival copy the
+    master frees its chunk data and marks the inode tape-only;
+    otherwise it force-queues an archive (even without a $tape goal)
+    and replies CHUNK_BUSY so the caller retries after the copy
+    lands. Driven by the master's own lifecycle scanner and by the S3
+    gateway / admin tooling."""
+
+    MSG_TYPE = 1077
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("uid", "u32"),
+        ("gids", "list:u32"),
+    )
+
+
+class CltomaTapeRecall(Message):
+    """Recall a demoted file from the tape tier: the master streams the
+    archived content back through a registered tape server and replies
+    once the file is readable again (OK immediately when the inode is
+    not demoted). Bounded server-side; callers put it under their own
+    deadline too."""
+
+    MSG_TYPE = 1078
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
 class CltomaGetQuota(Message):
     MSG_TYPE = 1046
     FIELDS = (("req_id", "u32"), ("uid", "u32"), ("gids", "list:u32"))
@@ -1282,8 +1310,19 @@ class AdminReply(Message):
 
 
 class TstomaRegister(Message):
+    """``session_id`` (trailing, skew-tolerant; 0 = unknown) names the
+    tape server's own cluster-client session, so the master can scope
+    the demoted-file write guard to exactly the recalling session
+    instead of standing it down for everyone mid-recall."""
+
     MSG_TYPE = 1500
-    FIELDS = (("req_id", "u32"), ("label", "str"), ("capacity", "u64"))
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (
+        ("req_id", "u32"),
+        ("label", "str"),
+        ("capacity", "u64"),
+        ("session_id", "u32"),
+    )
 
 
 class MatotsRegisterReply(Message):
@@ -1328,4 +1367,36 @@ class MatotsDeleteFile(Message):
         ("inode", "u32"),
         ("keep_mtime", "u32"),
         ("keep_length", "u64"),
+    )
+
+
+class MatotsRecallFile(Message):
+    """Master -> tape server: write the archived content version
+    (``length``/``mtime`` pick the exact archive file) back into the
+    live file through the tape server's cluster client session. Sent
+    only while the master has the inode in recall-inflight state, so
+    the write guard on demoted files stands down for it."""
+
+    MSG_TYPE = 1505
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("path", "str"),
+        ("length", "u64"),
+        ("mtime", "u32"),
+    )
+
+
+class TstomaRecallDone(Message):
+    """Tape server -> master: recall finished; ``length``/``mtime``
+    echo the archive stamp actually restored (the master refuses a
+    stamp it did not ask for)."""
+
+    MSG_TYPE = 1506
+    FIELDS = (
+        ("req_id", "u32"),
+        ("inode", "u32"),
+        ("status", "u8"),
+        ("length", "u64"),
+        ("mtime", "u32"),
     )

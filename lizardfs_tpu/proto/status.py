@@ -34,6 +34,10 @@ EROFS = 26
 ENODATA = 27
 BAD_SESSION = 28
 NOT_POSSIBLE = 29
+# data lives only on the tape tier (lifecycle-demoted inode): reads and
+# writes must recall it first (CltomaTapeRecall); transient by design —
+# a client that waits out the recall and retries succeeds
+TAPE_RECALL = 30
 
 _NAMES = {v: k for k, v in list(globals().items()) if isinstance(v, int)}
 

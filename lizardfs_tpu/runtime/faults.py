@@ -18,6 +18,8 @@ consulted at a handful of natural choke points:
                                    (op = "rpc"|"cs"|..., peer = host:port)
   ``serve_read``                   chunkserver asyncio read path (the
                                    ``debug_read_delay_ms`` alias site)
+  ``http_recv`` / ``http_send``    S3 gateway HTTP framing boundaries
+                                   (op = method on recv, S3 op on send)
 
 Spec grammar (whitespace-tolerant)::
 
@@ -83,7 +85,7 @@ import time
 # site names wired in the tree (kept here so tools/tests can enumerate)
 SITES = (
     "frame_send", "frame_recv", "disk_pread", "disk_pwrite", "dial",
-    "serve_read",
+    "serve_read", "http_recv", "http_send",
 )
 
 ACTIONS = ("delay", "drop", "error", "flip", "short")

@@ -59,7 +59,7 @@ def set_enabled(on: bool) -> None:
     _ENABLED = bool(on)
 
 
-OP_CLASSES = ("read", "write", "locate", "replicate", "nfs")
+OP_CLASSES = ("read", "write", "locate", "replicate", "nfs", "s3")
 
 # objective defaults: threshold_ms is the per-op latency bound, target
 # the fraction of ops that must meet it. Deliberately loose for
@@ -71,6 +71,9 @@ DEFAULT_OBJECTIVES = {
     "locate": (500.0, 0.999),
     "replicate": (30000.0, 0.99),
     "nfs": (1000.0, 0.999),
+    # object ops span one HTTP request end-to-end (a multi-MB PUT or a
+    # recall-triggering GET is one op), so the bound is looser than nfs
+    "s3": (2000.0, 0.999),
 }
 
 # burn-rate windows (seconds): fast catches acute pain, slow provides

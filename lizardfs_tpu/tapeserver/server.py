@@ -44,6 +44,11 @@ class TapeServer(Daemon):
         self.master: RpcConnection | None = None
         self.client: Client | None = None
         self.ts_id = 0
+        # test hook: when set, _cmd_put parks here AFTER reading the
+        # file and BEFORE archiving/acking — the window the master's
+        # stamp-mismatch defense exists for (a concurrent write must
+        # not be recorded as archived)
+        self.put_barrier: asyncio.Event | None = None
         os.makedirs(archive_dir, exist_ok=True)
 
     async def setup(self) -> None:
@@ -60,8 +65,10 @@ class TapeServer(Daemon):
         self.master = await RpcConnection.connect(*self.master_addr)
         self.master.on_push(m.MatotsPutFile, self._cmd_put)
         self.master.on_push(m.MatotsDeleteFile, self._cmd_delete)
+        self.master.on_push(m.MatotsRecallFile, self._cmd_recall)
         reply = await self.master.call_ok(
             m.TstomaRegister, label=self.label, capacity=0,
+            session_id=self.client.session_id,
         )
         self.ts_id = reply.ts_id
         self.log.info("registered with master as tape server %d", self.ts_id)
@@ -89,6 +96,10 @@ class TapeServer(Daemon):
             attr = await self.client.getattr(msg.inode)
             length, mtime = attr.length, attr.mtime
             data = await self.client.read_file(msg.inode, 0, attr.length)
+            if self.put_barrier is not None:
+                # test hook: hold the read-to-ack window open so a
+                # concurrent mutation can race the archive
+                await asyncio.wait_for(self.put_barrier.wait(), 30.0)
             dest = self._archive_path(msg.inode, mtime, length)
             tmp = dest + ".tmp"
             await asyncio.to_thread(self._write_archive, tmp, dest, data, {
@@ -106,6 +117,41 @@ class TapeServer(Daemon):
             req_id=msg.req_id, inode=msg.inode, status=code,
             length=length, mtime=mtime,
         ))
+
+    async def _cmd_recall(self, msg: m.MatotsRecallFile) -> None:
+        """Restore a demoted file from the archive: stream the exact
+        stamped version back through the cluster client session. The
+        master only sends this while it holds the inode in
+        recall-inflight state (writes allowed, reads still fenced)."""
+        code = st.OK
+        try:
+            path = self._archive_path(msg.inode, msg.mtime, msg.length)
+            data = await asyncio.to_thread(self._read_archive, path)
+            if data is None:
+                code = st.ENOENT
+            else:
+                await self.client.write_file(msg.inode, data)
+                self.metrics.counter("tape_recalled_bytes").inc(
+                    float(len(data))
+                )
+                self.metrics.counter("tape_recalls").inc()
+        except st.StatusError as e:
+            code = e.code
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            self.log.exception("recalling inode %d failed", msg.inode)
+            code = st.EIO
+        await self.master.send(m.TstomaRecallDone(
+            req_id=msg.req_id, inode=msg.inode, status=code,
+            length=msg.length, mtime=msg.mtime,
+        ))
+
+    @staticmethod
+    def _read_archive(path: str) -> bytes | None:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     async def _cmd_delete(self, msg: m.MatotsDeleteFile) -> None:
         """Reclaim archives: keep only the (keep_mtime, keep_length)

@@ -30,6 +30,9 @@ Schedules:
   shadow-stale   partition the chunkserver->shadow mirror plane so the
                  shadow serves stale locates; clients recover through
                  the primary
+  s3-multipart   SIGKILL a chunkserver mid-multipart-upload; the S3
+                 gateway completes byte-identically or fails cleanly
+                 (no torn object visible to GET)
 """
 
 from __future__ import annotations
@@ -406,11 +409,82 @@ async def run_shadow_stale(cluster: ChaosCluster, rng: random.Random,
         await c.close()
 
 
+async def run_s3_multipart(cluster: ChaosCluster, rng: random.Random,
+                           log) -> None:
+    """SIGKILL a chunkserver mid-multipart-upload: the S3 gateway's
+    CompleteMultipartUpload either yields the byte-identical object
+    (appendchunks assembly over the survivors) or fails cleanly — a
+    GET must never observe a torn object."""
+    from lizardfs_tpu.s3.client import S3Client, S3Error
+    from lizardfs_tpu.s3.server import S3Gateway
+
+    c = await _client(cluster)
+    gw = S3Gateway("127.0.0.1", cluster.master_port)
+    await gw.start()
+    s3 = S3Client("127.0.0.1", gw.port)
+    try:
+        await s3.create_bucket("chaos")
+        # force ec(3,2) on both the bucket AND the gateway's staging
+        # area (part/assembly files live there): every object byte must
+        # survive one chunkserver loss
+        await s3.put_object("chaos", "warmup", b"x")
+        for path in ("/chaos", "/.s3mpu"):
+            node = await c.resolve(path)
+            await c.setgoal(node.inode, 5)
+        parts = [
+            _payload(rng.randrange(1 << 20), 2 * 2**20 + rng.randrange(999))
+            for _ in range(3)
+        ]
+        upload = await s3.create_multipart("chaos", "obj")
+        victim = rng.randrange(cluster.n_cs)
+        delay = rng.uniform(0.02, 0.4)
+
+        async def killer():
+            await asyncio.sleep(delay)
+            log(f"  SIGKILL cs{victim} after {delay * 1e3:.0f} ms")
+            cluster.kill9(f"cs{victim}")
+
+        kill_task = asyncio.ensure_future(killer())
+        etags: list[tuple[int, str]] = []
+        completed = False
+        try:
+            for i, p in enumerate(parts):
+                etags.append(
+                    (i + 1,
+                     await s3.upload_part("chaos", "obj", upload, i + 1, p))
+                )
+            await s3.complete_multipart("chaos", "obj", upload, etags)
+            completed = True
+        except S3Error as e:
+            log(f"  upload failed cleanly: HTTP {e.status} {e.code}")
+        await kill_task
+        if completed:
+            got = await s3.get_object("chaos", "obj")
+            assert got.body == b"".join(parts), \
+                "multipart byte identity after SIGKILL"
+            log("  completed; object byte-identical through the loss")
+        else:
+            # clean failure: the key must not exist at all — a torn
+            # object visible to GET is the invariant violation
+            try:
+                await s3.get_object("chaos", "obj")
+                raise AssertionError(
+                    "torn object visible after failed complete"
+                )
+            except S3Error as e:
+                assert e.status == 404, f"torn object state: {e}"
+    finally:
+        await s3.close()
+        await gw.stop()
+        await c.close()
+
+
 SCHEDULES = {
     "kill-write": (run_kill_write, dict(n_cs=4)),
     "bitflip-read": (run_bitflip_read, dict(n_cs=3)),
     "stall-acks": (run_stall_acks, dict(n_cs=3)),
     "shadow-stale": (run_shadow_stale, dict(n_cs=3, shadow=True)),
+    "s3-multipart": (run_s3_multipart, dict(n_cs=4)),
 }
 
 

@@ -241,7 +241,10 @@ async def cmd_fileinfo(c: Client, args) -> int:
     nchunks = (a.length + MFSCHUNKSIZE - 1) // MFSCHUNKSIZE
     print(f"{args.path}: {a.length} bytes, {nchunks} chunk(s)")
     tape = await c.tape_info(a.inode)
-    if tape["wanted"] or tape["copies"]:
+    if tape.get("demoted"):
+        state = "recalling" if tape.get("recalling") else "tape-only"
+        print(f"  tier: demoted ({state}) — GET/read triggers recall")
+    if tape["wanted"] or tape["copies"] or tape.get("forced"):
         state = "pending" if tape["pending"] else "in sync"
         print(
             f"  tape: {tape['fresh']}/{tape['wanted']} fresh copies"
@@ -331,6 +334,31 @@ async def _walk_size(c: Client, inode: int) -> tuple[int, int, int]:
             files += 1
             total += (await c.getattr(e.inode)).length
     return files, dirs, total
+
+
+async def cmd_tape_demote(c: Client, args) -> int:
+    """Demote a file to the tape tier (frees chunk data once a fresh
+    archival copy exists; CHUNK_BUSY = archive queued, retry)."""
+    a = await c.resolve(args.path)
+    try:
+        await c.tape_demote(a.inode)
+    except st.StatusError as e:
+        if e.code != st.CHUNK_BUSY:
+            raise
+        print(f"{args.path}: archive queued — not yet demoted, retry "
+              "after the tape copy lands")
+        return 1
+    print(f"{args.path}: demoted to the tape tier")
+    return 0
+
+
+async def cmd_tape_recall(c: Client, args) -> int:
+    """Recall a demoted file from the tape tier (blocks until the
+    bytes are live again)."""
+    a = await c.resolve(args.path)
+    await c.tape_recall(a.inode)
+    print(f"{args.path}: recalled")
+    return 0
 
 
 async def cmd_dirinfo(c: Client, args) -> int:
@@ -525,6 +553,8 @@ COMMANDS = {
     "appendchunks": (cmd_appendchunks, [
         ("dst", {}), ("srcs", {"nargs": "+"}),
     ]),
+    "tape-demote": (cmd_tape_demote, [("path", {})]),
+    "tape-recall": (cmd_tape_recall, [("path", {})]),
     "dirinfo": (cmd_dirinfo, [("path", {})]),
     "rremove": (cmd_rremove, [("path", {})]),
     "snapshot": (cmd_snapshot, [("src", {}), ("dst", {})]),

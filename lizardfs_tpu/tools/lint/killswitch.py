@@ -52,6 +52,8 @@ SWITCHES = {
     "LZ_WRITE_PIPELINE",   # double-buffered stripe pipeline (on)
     "LZ_TPU_ALLOW_CPU",    # encoder escape hatch (default OFF)
     "LZ_NO_UDS",           # disable same-host UDS fast path (default OFF)
+    "LZ_S3",               # S3 object gateway (on; off refuses start)
+    "LZ_S3_LIFECYCLE",     # master lifecycle tiering scanner (on)
 }
 
 # Value vars: one read site each; documented; spelling rules N/A.

@@ -96,6 +96,14 @@ def _fat_row() -> dict:
     row["cluster_rebuild_MBps"] = 1234.5
     row["cluster_rebuild_s"] = 12.34
     row["cluster_rebuild_parts"] = 48
+    # s3 gateway fiducials (this round: the third protocol front door)
+    row["cluster_s3_put_MBps"] = 123.4
+    row["cluster_s3_get_MBps"] = 234.5
+    row["cluster_s3_list_ops"] = 45.6
+    row["cluster_s3_spread_pct"] = 33.3
+    row["cluster_s3_put_reps_MBps"] = [120.1, 123.4, 130.9]
+    row["cluster_s3_get_reps_MBps"] = [230.0, 234.5, 240.1]
+    row["cluster_s3_list_ops_reps"] = [44.1, 45.6, 47.0]
     # locate storm fiducials (round 7: shadow read replicas — the
     # metadata-plane A/B with its 1.8x aggregate-QPS target verdict)
     row["cluster_locate_qps"] = {
@@ -153,6 +161,15 @@ def test_summary_line_fits_driver_tail():
     # the rebuild row survives compaction (RebuildEngine fiducials)
     assert parsed["cluster_rebuild_MBps"] == 1234.5
     assert parsed["cluster_rebuild_s"] == 12.34
+    # the s3 gateway row rides the tail (this round's new front door);
+    # on a worst-case round it may drop — recorded, never silent — and
+    # per-rep arrays stay in BENCH_FULL.json either way
+    for skey, sval in (("cluster_s3_put_MBps", 123.4),
+                       ("cluster_s3_get_MBps", 234.5),
+                       ("cluster_s3_list_ops", 45.6)):
+        assert (parsed.get(skey) == sval
+                or "cluster_s3_*" in parsed.get("dropped", []))
+    assert "cluster_s3_put_reps_MBps" not in parsed
     # the locate-storm A/B verdict rides the tail (or its drop is
     # recorded); the detail dict is full-file-only
     assert (

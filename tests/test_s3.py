@@ -1,0 +1,616 @@
+"""S3 object gateway end-to-end tests: REST subset over a real
+in-process cluster, multipart-via-appendchunks, lifecycle tiering to
+tape with recall on GET, kill switches, and the satellite regressions
+(appendchunks under concurrent COW writes; tape stamp-mismatch
+re-queue).
+
+`make s3-smoke` runs the `smoke`-named subset (tier-1 rides the whole
+non-slow file).
+"""
+
+import asyncio
+import hashlib
+import os
+
+import pytest
+
+from lizardfs_tpu.chunkserver.server import ChunkServer
+from lizardfs_tpu.master.server import MasterServer
+from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.s3.client import S3Client, S3Error
+from lizardfs_tpu.s3.server import S3Gateway
+from lizardfs_tpu.tapeserver.server import TapeServer
+from lizardfs_tpu.utils import data_generator
+
+from tests.test_cluster import make_goals
+
+pytestmark = pytest.mark.asyncio
+
+
+def _payload(seed: int, n: int) -> bytes:
+    return data_generator.generate(seed, n).tobytes()
+
+
+async def _wait_for(cond, timeout=15.0, interval=0.1):
+    for _ in range(int(timeout / interval)):
+        if await cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+class S3Cluster:
+    """Master + chunkservers + S3 gateway, all in-process."""
+
+    def __init__(self, tmp_path, n_cs=3, lifecycle_interval=0.2):
+        self.tmp_path = tmp_path
+        self.n_cs = n_cs
+        self.lifecycle_interval = lifecycle_interval
+        self.master = None
+        self.chunkservers = []
+        self.gw = None
+        self.clients = []
+
+    async def start(self):
+        self.master = MasterServer(
+            str(self.tmp_path / "master"), goals=make_goals(),
+            health_interval=0.2,
+            lifecycle_interval=self.lifecycle_interval,
+        )
+        await self.master.start()
+        for i in range(self.n_cs):
+            cs = ChunkServer(
+                str(self.tmp_path / f"cs{i}"),
+                master_addr=("127.0.0.1", self.master.port),
+                wave_timeout=0.2,
+            )
+            await cs.start()
+            self.chunkservers.append(cs)
+        self.gw = S3Gateway("127.0.0.1", self.master.port)
+        await self.gw.start()
+
+    async def client(self):
+        from lizardfs_tpu.client.client import Client
+
+        c = Client("127.0.0.1", self.master.port, wave_timeout=0.2)
+        await c.connect()
+        self.clients.append(c)
+        return c
+
+    def s3(self) -> S3Client:
+        return S3Client("127.0.0.1", self.gw.port)
+
+    async def stop(self):
+        for c in self.clients:
+            await c.close()
+        if self.gw is not None:
+            await self.gw.stop()
+        for cs in self.chunkservers:
+            await cs.stop()
+        if self.master is not None:
+            await self.master.stop()
+
+
+async def test_s3_smoke(tmp_path):
+    """The `make s3-smoke` round trip: buckets, PUT/GET/HEAD/DELETE,
+    ListObjectsV2, and a multipart upload assembled via appendchunks,
+    byte-identical on GET."""
+    cluster = S3Cluster(tmp_path)
+    await cluster.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("demo")
+            assert "demo" in await s3.list_buckets()
+            # simple object round trip (+ nested key creating real dirs)
+            blob = _payload(7, 300_000)
+            put = await s3.put_object("demo", "a/b/hello.bin", blob)
+            assert put.etag == hashlib.md5(blob).hexdigest()
+            got = await s3.get_object("demo", "a/b/hello.bin")
+            assert got.body == blob
+            assert got.etag == put.etag
+            head = await s3.head_object("demo", "a/b/hello.bin")
+            assert int(head.headers["content-length"]) == len(blob)
+            # ranged GET
+            r = await s3.get_object("demo", "a/b/hello.bin",
+                                    range_="bytes=100-199")
+            assert r.status == 206 and r.body == blob[100:200]
+            # overwrite is atomic + replaces content
+            blob2 = _payload(8, 120_000)
+            await s3.put_object("demo", "a/b/hello.bin", blob2)
+            assert (await s3.get_object("demo", "a/b/hello.bin")).body == blob2
+
+            # multipart upload: part 1 lands on a chunk-aligned tail
+            # (empty object) and is assembled via the O(1) appendchunks
+            # share; the non-aligned follow-up part takes the copy path
+            p1 = _payload(9, 1_000_000)
+            p2 = _payload(10, 700_001)
+            upload = await s3.create_multipart("demo", "mpu/big.bin")
+            e1 = await s3.upload_part("demo", "mpu/big.bin", upload, 1, p1)
+            e2 = await s3.upload_part("demo", "mpu/big.bin", upload, 2, p2)
+            await s3.complete_multipart(
+                "demo", "mpu/big.bin", upload, [(1, e1), (2, e2)]
+            )
+            got = await s3.get_object("demo", "mpu/big.bin")
+            assert got.body == p1 + p2, "multipart byte identity"
+            assert got.etag.endswith("-2")
+            gwm = cluster.gw.metrics
+            assert gwm.counter("s3_mpu_parts_shared").total >= 1
+            # upload staging is cleaned up after complete
+            listing = await s3.list_objects("demo")
+            assert sorted(k["key"] for k in listing["keys"]) == [
+                "a/b/hello.bin", "mpu/big.bin",
+            ]
+
+            await s3.delete_object("demo", "mpu/big.bin")
+            with pytest.raises(S3Error) as e:
+                await s3.get_object("demo", "mpu/big.bin")
+            assert e.value.status == 404
+            # DELETE is idempotent
+            await s3.delete_object("demo", "mpu/big.bin")
+    finally:
+        await cluster.stop()
+
+
+async def test_s3_list_objects_v2_semantics(tmp_path):
+    """prefix/delimiter/continuation-token semantics over readdir."""
+    cluster = S3Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("lst")
+            keys = ["a.txt", "dir/one", "dir/two", "dir/sub/three",
+                    "dirx", "z.txt"]
+            for k in keys:
+                await s3.put_object("lst", k, k.encode())
+            full = await s3.list_objects("lst")
+            assert [k["key"] for k in full["keys"]] == sorted(keys)
+            # delimiter groups
+            top = await s3.list_objects("lst", delimiter="/")
+            assert [k["key"] for k in top["keys"]] == ["a.txt", "dirx",
+                                                      "z.txt"]
+            assert top["prefixes"] == ["dir/"]
+            sub = await s3.list_objects("lst", prefix="dir/", delimiter="/")
+            assert [k["key"] for k in sub["keys"]] == ["dir/one", "dir/two"]
+            assert sub["prefixes"] == ["dir/sub/"]
+            # pagination walks the whole set without dupes or holes
+            walked, token = [], ""
+            while True:
+                page = await s3.list_objects("lst", max_keys=2, token=token)
+                walked += [k["key"] for k in page["keys"]]
+                if not page["truncated"]:
+                    break
+                token = page["token"]
+                assert token
+            assert walked == sorted(keys)
+    finally:
+        await cluster.stop()
+
+
+async def test_s3_bucket_errors(tmp_path):
+    cluster = S3Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        async with cluster.s3() as s3:
+            with pytest.raises(S3Error) as e:
+                await s3.get_object("nosuch", "k")
+            assert e.value.status == 404
+            with pytest.raises(S3Error) as e:
+                await s3.create_bucket("Bad_Bucket")
+            assert e.value.status == 400
+            # reserved names can't become buckets
+            with pytest.raises(S3Error):
+                await s3.create_bucket("metrics")
+            await s3.create_bucket("full")
+            await s3.put_object("full", "x", b"1")
+            with pytest.raises(S3Error) as e:
+                await s3.delete_bucket("full")
+            assert e.value.status == 409  # BucketNotEmpty
+            await s3.delete_object("full", "x")
+            await s3.delete_bucket("full")
+            # keys that would escape the bucket are refused
+            with pytest.raises(S3Error) as e:
+                await s3.put_object("nosuch2", "k", b"")
+            assert e.value.status == 404
+            # DELETE is idempotent at ANY key depth (missing
+            # intermediate prefixes included)
+            await s3.create_bucket("deep")
+            r = await s3.request("DELETE", "/deep/never/made/key")
+            assert r.status == 204
+            # negative max-keys is a 400, not a silent truncation
+            with pytest.raises(S3Error) as e:
+                await s3.request("GET", "/deep",
+                                 query={"list-type": "2", "max-keys": "-1"})
+            assert e.value.status == 400
+            # an uploadId is bound to its bucket/key: a mismatched
+            # complete/part must not touch another key's staging
+            up = await s3.create_multipart("deep", "real/key")
+            with pytest.raises(S3Error) as e:
+                await s3.upload_part("deep", "other/key", up, 1, b"x")
+            assert e.value.status == 404  # NoSuchUpload
+            with pytest.raises(S3Error) as e:
+                await s3.complete_multipart("deep", "other/key", up,
+                                            [(1, "0" * 32)])
+            assert e.value.status == 404
+            await s3.abort_multipart("deep", "real/key", up)
+    finally:
+        await cluster.stop()
+
+
+async def test_recall_write_guard_scoped_to_tape_session(tmp_path):
+    """Satellite-hardening regression: during a recall only the
+    recalling tape server's session may write the demoted inode — a
+    concurrent client write (even same-length) is refused with
+    TAPE_RECALL instead of silently merging into the restore."""
+    cluster = S3Cluster(tmp_path, lifecycle_interval=3600.0)
+    await cluster.start()
+    ts = TapeServer(
+        str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port)
+    )
+    await ts.start()
+    try:
+        c = await cluster.client()
+        blob = _payload(40, 200_000)
+        f = await c.create(1, "cold.bin")
+        await c.write_file(f.inode, blob)
+        master = cluster.master
+        # demote via the RPC (forced archive first)
+        deadline = 100
+        while deadline:
+            try:
+                await c.tape_demote(f.inode)
+                break
+            except st.StatusError as e:
+                assert e.code == st.CHUNK_BUSY
+                deadline -= 1
+                await asyncio.sleep(0.2)
+        assert f.inode in master.meta.demoted
+        # freeze the restore mid-flight via the put/recall barrier:
+        # reuse the tapeserver test hook by delaying its archive read —
+        # simplest deterministic hold is a paused recall dispatch: mark
+        # the inflight state by hand and assert the guard refuses a
+        # foreign session while the (fake) tape session may pass
+        import asyncio as _a
+
+        master._recall_inflight[f.inode] = _a.get_running_loop(
+        ).create_future()
+        master._recall_sids[f.inode] = 424242
+        assert master._recall_writer_ok(f.inode, 424242)
+        assert not master._recall_writer_ok(f.inode, c.session_id)
+        with pytest.raises(st.StatusError) as e:
+            await c.pwrite(f.inode, 0, b"z" * len(blob))
+        assert e.value.code == st.TAPE_RECALL
+        # restore not dispatched yet -> nobody writes
+        master._recall_sids.pop(f.inode)
+        assert not master._recall_writer_ok(f.inode, 424242)
+        master._recall_inflight.pop(f.inode).cancel()
+        # the real recall still restores the original bytes
+        await c.tape_recall(f.inode)
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode, 0, len(blob)) == blob
+    finally:
+        await ts.stop()
+        await cluster.stop()
+
+
+async def test_s3_lifecycle_demote_and_recall_on_get(tmp_path):
+    """The hot/cold hierarchy end-to-end: a bucket lifecycle rule
+    demotes a cold object through the tapeserver flow (chunk data
+    freed, stat unchanged), and GET triggers recall and serves the
+    original bytes."""
+    cluster = S3Cluster(tmp_path, lifecycle_interval=0.2)
+    await cluster.start()
+    ts = TapeServer(
+        str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port),
+        label="vault",
+    )
+    await ts.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("cold")
+            blob = _payload(11, 400_000)
+            await s3.put_object("cold", "archive/me.bin", blob)
+            head = await s3.head_object("cold", "archive/me.bin")
+            # demote immediately once a tape copy lands
+            await s3.put_lifecycle("cold", demote_after_s=0.0)
+            assert b"TAPE" in await s3.get_lifecycle("cold")
+
+            master = cluster.master
+            c = await cluster.client()
+            attr = await c.resolve("/cold/archive/me.bin")
+            inode = attr.inode
+
+            async def demoted():
+                return inode in master.meta.demoted
+
+            assert await _wait_for(demoted, timeout=20.0), \
+                master.meta.demoted
+            # demote freed the chunk data but kept the object's stat
+            node = master.meta.fs.nodes[inode]
+            assert node.chunks == [] and node.length == len(blob)
+            info = await c.tape_info(inode)
+            assert info["demoted"] and info["fresh"] >= 1
+            # the tape_demote op maintained the incremental metadata
+            # digest exactly (shadow divergence detection depends on it)
+            assert master.meta._digest == master.meta.full_digest()
+
+            # GET recalls from tape and serves the original bytes
+            got = await s3.get_object("cold", "archive/me.bin")
+            assert got.body == blob, "recall byte identity"
+            assert inode not in master.meta.demoted
+            assert master.meta._digest == master.meta.full_digest()
+            assert cluster.gw.metrics.counter("s3_recalls").total >= 1
+            # a recall is not a modification: Last-Modified is stable
+            head2 = await s3.head_object("cold", "archive/me.bin")
+            assert (head2.headers["last-modified"]
+                    == head.headers["last-modified"])
+            # ... and the tape copy still reads as fresh (no re-archive
+            # storm after recall)
+            info = await c.tape_info(inode)
+            assert info["fresh"] >= 1 and not info["demoted"]
+            # the scanner demotes it again (still cold, copy fresh)
+            assert await _wait_for(demoted, timeout=20.0)
+
+            # direct POSIX read of a demoted file recalls too (the
+            # locate error is transient by contract)
+            c.cache.invalidate(inode)
+            try:
+                data = await c.read_file(inode, 0, len(blob))
+            except st.StatusError as e:
+                assert e.code == st.TAPE_RECALL
+                await c.tape_recall(inode)
+                data = await c.read_file(inode, 0, len(blob))
+            assert bytes(data) == blob
+    finally:
+        await ts.stop()
+        await cluster.stop()
+
+
+async def test_s3_kill_switch_off(tmp_path, monkeypatch):
+    """LZ_S3=0 (any documented off spelling) refuses to start the
+    gateway; the rest of the cluster is untouched."""
+    monkeypatch.setenv("LZ_S3", "0")
+    gw = S3Gateway("127.0.0.1", 1)  # never dialed: the switch trips first
+    with pytest.raises(RuntimeError, match="LZ_S3"):
+        await gw.start()
+    monkeypatch.setenv("LZ_S3", "off")
+    with pytest.raises(RuntimeError, match="LZ_S3"):
+        await gw.start()
+
+
+async def test_s3_lifecycle_kill_switch_off(tmp_path, monkeypatch):
+    """LZ_S3_LIFECYCLE=0 stops the master's demote scanner; flipping it
+    back on resumes demotion without a restart."""
+    cluster = S3Cluster(tmp_path, lifecycle_interval=0.1)
+    await cluster.start()
+    ts = TapeServer(
+        str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port)
+    )
+    await ts.start()
+    try:
+        monkeypatch.setenv("LZ_S3_LIFECYCLE", "0")
+        async with cluster.s3() as s3:
+            await s3.create_bucket("gated")
+            await s3.put_object("gated", "obj", b"y" * 50_000)
+            await s3.put_lifecycle("gated", demote_after_s=0.0)
+            await asyncio.sleep(1.0)
+            assert not cluster.master.meta.demoted, \
+                "scanner demoted with LZ_S3_LIFECYCLE=0"
+            monkeypatch.delenv("LZ_S3_LIFECYCLE")
+
+            async def demoted():
+                return bool(cluster.master.meta.demoted)
+
+            assert await _wait_for(demoted, timeout=20.0)
+    finally:
+        await ts.stop()
+        await cluster.stop()
+
+
+async def test_s3_metrics_lint_and_health_rollup(tmp_path):
+    """The gateway's /metrics page is metrics-lint clean and the master
+    health rollup names the s3 role."""
+    from tests.test_metrics_lint import lint_prometheus
+
+    cluster = S3Cluster(tmp_path, n_cs=1)
+    await cluster.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("obs")
+            await s3.put_object("obs", "k", b"123")
+            await s3.get_object("obs", "k")
+            with pytest.raises(S3Error):
+                await s3.get_object("obs", "missing")
+            typed = lint_prometheus(await s3.metrics())
+            assert typed["lizardfs_s3_requests_total"] == "counter"
+            assert typed["lizardfs_s3_bytes_out_total"] == "counter"
+            assert "lizardfs_slo_s3_burn_fast" in typed
+            health = cluster.master.cluster_health()
+            assert health["gateways"]["s3"] >= 1
+            assert "tape" in health
+            # healthz names the role
+            r = await s3.request("GET", "/healthz")
+            assert b'"role": "s3"' in r.body
+    finally:
+        await cluster.stop()
+
+
+async def test_appendchunks_concurrent_cow_writes(tmp_path):
+    """Satellite: appendchunks under concurrent COW writes to the
+    shared source chunk (the multipart-complete hot path). Byte
+    identity on both sides + refcount convergence in the registry."""
+    cluster = S3Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        src_blob = _payload(20, 900_000)
+        src = await c.create(1, "src.bin")
+        await c.write_file(src.inode, src_blob)
+        dst = await c.create(1, "dst.bin")
+        await c.append_chunks(dst.inode, src.inode)
+        chunk_id = cluster.master.meta.fs.nodes[dst.inode].chunks[0]
+        assert cluster.master.meta.registry.chunks[chunk_id].refcount == 2
+
+        # concurrent COW writes to the SHARED chunk from both sides
+        patch_a = _payload(21, 64 * 1024)
+        patch_b = _payload(22, 64 * 1024)
+        await asyncio.gather(
+            c.pwrite(src.inode, 128 * 1024, patch_a),
+            c.pwrite(dst.inode, 256 * 1024, patch_b),
+        )
+        want_src = bytearray(src_blob)
+        want_src[128 * 1024:128 * 1024 + len(patch_a)] = patch_a
+        want_dst = bytearray(src_blob)
+        want_dst[256 * 1024:256 * 1024 + len(patch_b)] = patch_b
+        c.cache.invalidate(src.inode)
+        c.cache.invalidate(dst.inode)
+        assert await c.read_file(src.inode, 0, len(want_src)) == bytes(
+            want_src
+        ), "src bytes after COW"
+        assert await c.read_file(dst.inode, 0, len(want_dst)) == bytes(
+            want_dst
+        ), "dst bytes diverged independently"
+        # refcount convergence: every live chunk's refcount equals the
+        # number of file slots referencing it
+        refs: dict[int, int] = {}
+        for node in cluster.master.meta.fs.nodes.values():
+            for cid in getattr(node, "chunks", ()):
+                if cid:
+                    refs[cid] = refs.get(cid, 0) + 1
+        for cid, chunk in cluster.master.meta.registry.chunks.items():
+            assert chunk.refcount == refs.get(cid, 0), (
+                f"chunk {cid}: refcount {chunk.refcount} vs "
+                f"{refs.get(cid, 0)} referencing slots"
+            )
+    finally:
+        await cluster.stop()
+
+
+async def test_tape_stamp_mismatch_not_recorded_and_requeued(tmp_path):
+    """Satellite: a file mutated between MatotsPutFile and
+    TstomaPutDone must NOT be recorded as archived, and the lifecycle
+    scanner re-queues the (forced) archive until a clean copy lands."""
+    cluster = S3Cluster(tmp_path, lifecycle_interval=0.2)
+    await cluster.start()
+    ts = TapeServer(
+        str(tmp_path / "tape"), ("127.0.0.1", cluster.master.port)
+    )
+    await ts.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("racy")
+            await s3.put_object("racy", "obj", b"OLDCONTENT" * 1000)
+            await s3.put_lifecycle("racy", demote_after_s=0.0)
+        c = await cluster.client()
+        attr = await c.resolve("/racy/obj")
+        inode = attr.inode
+
+        # hold the tapeserver's read->ack window open and mutate the
+        # file inside it
+        ts.put_barrier = asyncio.Event()
+
+        async def put_started():
+            # the tapeserver read the file and is parked on the barrier
+            return bool(
+                inode in cluster.master._tape_inflight
+            )
+
+        assert await _wait_for(put_started, timeout=20.0)
+        await asyncio.sleep(0.3)  # let the read finish into the window
+        new_blob = b"NEWCONTENT" * 1500
+        await c.write_file(inode, new_blob)
+        ts.put_barrier.set()
+        ts.put_barrier = None
+
+        # the stale archive must never be recorded as fresh, and the
+        # scanner re-queues until the new content is archived + demoted
+        async def settled():
+            info = await c.tape_info(inode)
+            return info["fresh"] >= 1 or info["demoted"]
+
+        assert await _wait_for(settled, timeout=30.0)
+        info = await c.tape_info(inode)
+        copies = info["copies"]
+        node = cluster.master.meta.fs.nodes[inode]
+        stamp_now = cluster.master._content_stamp(inode, node)
+        for cp in copies:
+            assert (cp["length"], cp["mtime"], cp.get("gen", 0)) == tuple(
+                stamp_now
+            ) or cp["length"] != len(b"OLDCONTENT" * 1000), (
+                f"stale archive recorded as a copy: {cp}"
+            )
+        # and the content that finally lands on tape is the NEW one
+        async with cluster.s3() as s3:
+            got = await s3.get_object("racy", "obj")
+            assert got.body == new_blob
+    finally:
+        await ts.stop()
+        await cluster.stop()
+
+
+async def test_demoted_state_replays_and_persists():
+    """The tape_demote / tape_recall_done changelog ops replay
+    identically on a second store (shadow path) and the demoted map
+    survives an image round trip."""
+    from lizardfs_tpu.master.metadata import MetadataStore
+
+    ops = [
+        {"op": "mknode", "parent": 1, "name": "f", "inode": 7, "ftype": 1,
+         "mode": 0o644, "uid": 0, "gid": 0, "ts": 100, "goal": 1,
+         "trash_time": 0},
+        {"op": "create_chunk", "slice_type": 0, "chunk_id": 5,
+         "version": 1, "copies": 1},
+        {"op": "set_chunk", "inode": 7, "chunk_index": 0, "chunk_id": 5},
+        {"op": "set_length", "inode": 7, "length": 1234, "ts": 101},
+        {"op": "tape_copy", "inode": 7, "label": "_", "length": 1234,
+         "mtime": 101, "gen": 2, "ts": 102},
+        {"op": "tape_demote", "inode": 7, "ts": 103},
+    ]
+    live, shadow = MetadataStore(), MetadataStore()
+    for op in ops:
+        live.apply(op)
+        shadow.apply(dict(op))
+    assert live.demoted[7]["length"] == 1234
+    assert live.fs.nodes[7].chunks == [] and live.fs.nodes[7].length == 1234
+    assert 5 not in live.registry.chunks  # refcount hit zero on demote
+    assert live.checksum() == shadow.checksum()
+    assert live._digest == live.full_digest()
+    # image round trip keeps the demoted map
+    restored = MetadataStore()
+    restored.load_sections(live.to_sections())
+    assert restored.demoted == live.demoted
+    assert restored.checksum() == live.checksum()
+    # recall-done (restore=True) clears it and re-stamps the copy
+    for store in (live, shadow):
+        store.apply({"op": "tape_recall_done", "inode": 7, "ts": 104,
+                     "restore": True})
+    assert 7 not in live.demoted
+    assert live.fs.nodes[7].mtime == 101  # recall is not a modification
+    assert live.checksum() == shadow.checksum()
+    assert live._digest == live.full_digest()
+
+
+@pytest.mark.slow
+async def test_multipart_fully_chunk_aligned_is_zero_copy(tmp_path):
+    """A 64 MiB (chunk-aligned) part followed by a tail part assembles
+    entirely through appendchunks — zero re-copied bytes."""
+    from lizardfs_tpu.constants import MFSCHUNKSIZE
+
+    cluster = S3Cluster(tmp_path, n_cs=3)
+    await cluster.start()
+    try:
+        async with cluster.s3() as s3:
+            await s3.create_bucket("aligned")
+            p1 = _payload(30, MFSCHUNKSIZE)
+            p2 = _payload(31, 300_000)
+            up = await s3.create_multipart("aligned", "big")
+            e1 = await s3.upload_part("aligned", "big", up, 1, p1)
+            e2 = await s3.upload_part("aligned", "big", up, 2, p2)
+            await s3.complete_multipart("aligned", "big", up,
+                                        [(1, e1), (2, e2)])
+            gwm = cluster.gw.metrics
+            assert gwm.counter("s3_mpu_parts_shared").total == 2
+            assert gwm.counter("s3_mpu_copied_bytes").total == 0
+            got = await s3.get_object("aligned", "big")
+            assert got.body == p1 + p2
+    finally:
+        await cluster.stop()
