@@ -11,13 +11,35 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 	  --continue-on-collection-errors -p no:cacheprovider
 
-# invariant lint engine (lizardfs_tpu/tools/lint): the four repo
-# checkers — cross-await-race, unbounded-await, wire-skew, kill-switch.
+# invariant lint engine (lizardfs_tpu/tools/lint): the seven repo
+# checkers — cross-await-race, unbounded-await, wire-skew, kill-switch,
+# changelog-durability, native-wire, telemetry-coverage.
 # Exit 0 == zero unwaived findings. Stamps .lint-stamp so `make chaos`
 # can tell when the tree changed since the last lint run.
 lint:
 	$(PY) -m lizardfs_tpu.tools.lint
 	@touch .lint-stamp
+
+# metrics-lint: the Prometheus-exposition structural gate alone (the
+# whole file also rides tier-1)
+metrics-lint:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics_lint.py -q \
+	  -p no:cacheprovider
+
+# racehunt: replay the async smoke set across deterministic-scheduler
+# seeds (runtime/detsched.py); failures print LZ_DETSCHED=<seed> replay
+# commands that re-execute the schedule byte-identically:
+#   make racehunt RACEHUNT_SEEDS=10 RACEHUNT_TARGETS=tests/test_shadow_reads.py
+RACEHUNT_SEEDS ?= 3
+RACEHUNT_TARGETS ?=
+racehunt:
+	JAX_PLATFORMS=cpu $(PY) -m lizardfs_tpu.tools.racehunt \
+	  --seeds $(RACEHUNT_SEEDS) $(RACEHUNT_TARGETS)
+
+# check: the one-command gate — invariant lint, metrics exposition
+# lint, tier-1, then a racehunt smoke (seeds printed for replay)
+check: lint metrics-lint test racehunt
+	@echo "check: lint + metrics-lint + tier-1 + racehunt all green"
 
 # sanitizer matrix over the FULL native surface (native/Makefile
 # `sanitize`: ASan+UBSan and TSan over ec/io/serve + the shm plane),
@@ -37,10 +59,15 @@ sanitize:
 # $(SEEDS); on failure the driver prints the exact seed + replay
 # command, so a red run reproduces deterministically:
 #   make chaos SEEDS=1,2,3,4,5
+# the nag watches every lint INPUT: package sources (incl. the checker
+# modules themselves under lizardfs_tpu/tools/lint/), tests, docs,
+# native C sources, and this Makefile — the new checkers read all of
+# them, so any edit there can change the lint verdict
 chaos:
 	@if [ ! -f .lint-stamp ] || [ -n "$$(find lizardfs_tpu tests doc \
-	  native \( -name '*.py' -o -name '*.h' -o -name '*.cpp' \
-	  -o -name '*.md' \) -newer .lint-stamp -print -quit)" ]; then \
+	  native Makefile \( -name '*.py' -o -name '*.h' -o -name '*.cpp' \
+	  -o -name '*.md' -o -name Makefile \) -newer .lint-stamp \
+	  -print -quit)" ]; then \
 	  echo "note: invariant lint has not run on this tree state —" \
 	       "run 'make lint' before trusting a chaos verdict"; fi
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) -m lizardfs_tpu.tools.chaos \
@@ -62,4 +89,5 @@ s3-smoke:
 native:
 	$(MAKE) -C native
 
-.PHONY: test lint sanitize chaos chaos-slow s3-smoke native
+.PHONY: test lint metrics-lint racehunt check sanitize chaos chaos-slow \
+	s3-smoke native

@@ -1,7 +1,7 @@
 """Invariant lint engine — machine-checks the correctness conventions
 the last several PRs policed by hand.
 
-Four repo-specific rules ride a shared AST visitor framework
+Seven repo-specific rules ride a shared AST visitor framework
 (:mod:`engine`), each one born from a bug class this tree has already
 paid for at review time:
 
@@ -20,6 +20,23 @@ paid for at review time:
     routes through one accessor, boolean switches honor the four
     documented off spellings, and each var is inventoried, documented,
     and test-referenced.
+``changelog-durability`` (:mod:`changelog`) every metadata-store op is
+    digest-covered, replay-deterministic, image-persisted, and named
+    by a test — the checklist PRs 4/7/10 ran by hand; committed op
+    literals must name real ``_op_`` methods.
+``native-wire``        (:mod:`native_wire`) the Python<->C++ wire
+    contract without compiling: message-type constants, layout
+    declarations, status codes, proto version, and off-spelling parity
+    at native ``getenv`` sites all cross-checked against the catalog.
+``telemetry-coverage`` (:mod:`telemetry`)  every client-facing verb
+    maps to an SLO class (or a reasoned waiver), a live fault choke
+    point, and the per-surface span/metric instruments — the PR
+    2/3/8/10 conventions as a standing gate.
+
+Dynamic companions: ``tests/test_changelog_durability.py`` replays
+every op against a shadow + image round trip, and ``tools/racehunt.py``
+(with ``runtime/detsched.py``) explores cross-await-race windows under
+seeded deterministic schedules.
 
 Run as ``lizardfs-lint`` / ``python -m lizardfs_tpu.tools.lint`` /
 ``make lint``; the tier-1 gate is ``tests/test_invariant_lint.py``

@@ -1,0 +1,338 @@
+"""``changelog-durability``: every changelog op is digest-covered,
+replay-deterministic, image-persisted, and test-named.
+
+PRs 4, 7 and 10 each added changelog ops (``repair_zero_chunk``,
+``synth_populate``, ``tape_demote``/``tape_recall_done``) and each ran
+the same four-point checklist by hand before review would pass them:
+
+1. **digest coverage** — ``MetadataStore.apply`` maintains the
+   incremental divergence digest from ``_touched(op)``; an op the
+   dispatch doesn't name XORs nothing in/out, so a shadow that applies
+   it still "matches" the primary while its state silently drifts. An
+   op must either appear in ``_touched``'s literal dispatch or maintain
+   ``self._digest`` itself (the ``synth_populate`` pattern).
+2. **replay determinism** — shadows and crash recovery re-apply the
+   same records through the same ``_op_*`` methods. A method that reads
+   the clock, RNG, environment, or does IO converges only by luck; all
+   inputs must ride the op record. (Async op methods are flagged too:
+   ``apply`` is synchronous by contract.)
+3. **image persistence** — every ``self.<store>`` an op method touches
+   must round-trip through ``to_sections``/``load_sections``, or a
+   restart loses what replay rebuilt (the PR-10 ``demoted`` map
+   checklist item).
+4. **a test naming it** — at least one file under ``tests/`` must
+   mention the op name as a string literal; an op nobody replays in a
+   test has no pinned shadow/restore story.
+
+Plus dispatch integrity: every ``{"op": "<name>", ...}`` literal built
+anywhere in the package must name a real ``_op_<name>`` method — a
+typo'd commit site otherwise fails at runtime, on the live master,
+mid-mutation.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from lizardfs_tpu.tools.lint.engine import Finding, SourceFile
+
+RULE = "changelog-durability"
+
+# digest-excluded private attrs + derived plumbing an op may touch
+# without persistence implications
+_NON_STORES = {"_digest"}
+
+# nondeterminism sources an op method must not call: dotted-path
+# prefixes whose every call is volatile (matched against the full
+# attribute chain, so os.environ.get and datetime.datetime.now both
+# hit), plus bare names the from-import spellings land on
+_NONDET_PREFIXES = (
+    ("time",), ("random",), ("uuid",), ("secrets",), ("datetime",),
+    ("os", "environ"), ("os", "urandom"), ("os", "getenv"),
+)
+_NONDET_BARE = {
+    "open", "input", "print",        # IO
+    "getenv", "urandom",             # from os import ...
+    "time", "monotonic", "perf_counter", "time_ns",  # from time import ...
+    "uuid4", "token_bytes",
+}
+
+
+def extra_inputs(cfg) -> list[str]:
+    """Non-scanned files whose content this checker's verdict depends
+    on: the metadata store itself plus every test file (the test-naming
+    leg). The engine folds their hashes into the global-results cache
+    key, so editing any of them re-runs this pass."""
+    out = []
+    if cfg.metadata_path:
+        out.append(cfg.metadata_path)
+    if cfg.tests_dir and os.path.isdir(cfg.tests_dir):
+        out.extend(sorted(glob.glob(os.path.join(cfg.tests_dir, "*.py"))))
+    return out
+
+
+def collect_file(src: SourceFile) -> list:
+    """Cacheable per-file summary: every ``{"op": "<name>"}`` dict
+    literal (a changelog commit/apply site) with its line."""
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Dict) or not node.keys:
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (
+                isinstance(k, ast.Constant) and k.value == "op"
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)
+            ):
+                out.append([v.value, node.lineno])
+    return out
+
+
+class _Method:
+    def __init__(self, node: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.node = node
+        self.name = node.name
+        self.line = node.lineno
+        self.attrs: set[str] = set()       # self.<attr> roots touched
+        self.self_calls: set[str] = set()  # self.<method>() called
+        self.nondet: list[tuple[int, str]] = []
+        self.uses_digest = False
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self._walk()
+
+    @staticmethod
+    def _dotted(node) -> tuple[str, ...] | None:
+        """('os', 'environ', 'get') for os.environ.get — None when any
+        link is not a plain name/attribute chain."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return tuple(reversed(parts))
+
+    def _walk(self):
+        for node in ast.walk(self.node):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == "self":
+                if node.attr == "_digest":
+                    self.uses_digest = True
+                elif not node.attr.startswith("__"):
+                    self.attrs.add(node.attr)
+            if isinstance(node, ast.Call):
+                f = node.func
+                chain = self._dotted(f)
+                if chain and chain[0] == "self":
+                    if len(chain) == 2:
+                        self.self_calls.add(chain[1])
+                elif chain and any(
+                    chain[:len(p)] == p for p in _NONDET_PREFIXES
+                ):
+                    self.nondet.append((node.lineno, ".".join(chain) + "()"))
+                elif isinstance(f, ast.Name) and f.id in _NONDET_BARE:
+                    self.nondet.append((node.lineno, f"{f.id}()"))
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                # os.environ[...] / environ[...] reads
+                chain = self._dotted(node.value)
+                if chain and (
+                    chain == ("os", "environ") or chain == ("environ",)
+                ):
+                    self.nondet.append(
+                        (node.lineno, ".".join(chain) + "[...]")
+                    )
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                self.nondet.append(
+                    (node.lineno, "await (apply() is synchronous)")
+                )
+
+
+def _touched_ops(methods: dict[str, _Method]) -> set[str]:
+    """Op names the ``_touched`` dispatch mentions as string literals
+    (``t == "x"`` / ``t in ("x", "y")`` comparisons)."""
+    m = methods.get("_touched")
+    if m is None:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(m.node):
+        if not isinstance(node, ast.Compare) or not isinstance(
+            node.left, ast.Name
+        ):
+            continue
+        for comp in node.comparators:
+            if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+                out.add(comp.value)
+            elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for el in comp.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str
+                    ):
+                        out.add(el.value)
+    return out
+
+
+def _closure(
+    name: str, methods: dict[str, _Method], seen: set[str] | None = None
+) -> tuple[set[str], list[tuple[int, str]], bool]:
+    """(attrs, nondet sites, uses_digest) for a method plus every
+    ``self._helper()`` it calls, transitively (the ``_release_one``
+    pattern: ops share mutation helpers)."""
+    seen = seen if seen is not None else set()
+    if name in seen or name not in methods:
+        return set(), [], False
+    seen.add(name)
+    m = methods[name]
+    attrs = set(m.attrs)
+    nondet = list(m.nondet)
+    digest = m.uses_digest
+    for callee in m.self_calls:
+        a, n, d = _closure(callee, methods, seen)
+        attrs |= a
+        nondet.extend(n)
+        digest = digest or d
+    return attrs, nondet, digest
+
+
+def check_global(cfg, collections: dict) -> list[Finding]:
+    path = getattr(cfg, "metadata_path", None)
+    if not path or not os.path.exists(path):
+        return []
+    rel = os.path.relpath(path, cfg.root)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = SourceFile(path, rel, fh.read())
+    except (OSError, SyntaxError) as e:
+        return [Finding(RULE, rel, 0, f"cannot parse metadata store: {e}")]
+
+    store = next(
+        (
+            n for n in src.tree.body
+            if isinstance(n, ast.ClassDef) and any(
+                isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and st.name.startswith("_op_")
+                for st in n.body
+            )
+        ),
+        None,
+    )
+    if store is None:
+        return [Finding(
+            RULE, rel, 0,
+            "no class with _op_* methods found — the apply dispatch moved; "
+            "update cfg.metadata_path",
+        )]
+    methods = {
+        st.name: _Method(st)
+        for st in store.body
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    ops = {n[4:]: m for n, m in methods.items() if n.startswith("_op_")}
+    findings: list[Finding] = []
+
+    # section attrs named by the persistence pair: an op-store must
+    # appear in BOTH (write half alone loses it at load, read half
+    # alone never saves it)
+    def _attrs_of(name: str) -> set[str]:
+        m = methods.get(name)
+        if m is None:
+            return set()
+        return m.attrs
+
+    saved = _attrs_of("to_sections")
+    loaded = _attrs_of("load_sections")
+    if not saved or not loaded:
+        findings.append(Finding(
+            RULE, rel, store.lineno,
+            "to_sections/load_sections not found on the op-dispatch class "
+            "— image persistence cannot be verified",
+        ))
+
+    touched = _touched_ops(methods)
+    tests_text = ""
+    if cfg.tests_dir and os.path.isdir(cfg.tests_dir):
+        for tp in sorted(glob.glob(os.path.join(cfg.tests_dir, "*.py"))):
+            try:
+                with open(tp, encoding="utf-8") as fh:
+                    tests_text += fh.read()
+            except OSError:
+                continue
+
+    for op, m in sorted(ops.items()):
+        attrs, nondet, self_digest = _closure(m.name, methods)
+        if m.is_async:
+            findings.append(Finding(
+                RULE, rel, m.line,
+                f"op {op!r}: async op method — apply() is synchronous by "
+                "contract (an awaiting op would let another op interleave "
+                "mid-mutation on the live master while shadows replay it "
+                "atomically)",
+            ))
+        # 1. digest coverage
+        if op not in touched and not self_digest:
+            findings.append(Finding(
+                RULE, rel, m.line,
+                f"op {op!r}: no incremental-digest coverage — name it in "
+                "_touched()'s dispatch (or maintain self._digest in the "
+                "method, the synth_populate pattern); without it a shadow "
+                "drifts while its checksum still matches",
+            ))
+        # 2. replay determinism
+        for line, what in nondet:
+            findings.append(Finding(
+                RULE, rel, line,
+                f"op {op!r}: calls {what} — op application must be a pure "
+                "function of (state, op record) or shadow replay and crash "
+                "recovery diverge; move the volatile read to the commit "
+                "site and ride it on the record",
+            ))
+        # 3. image persistence: every store the op touches must
+        # round-trip. Method names (helpers) and the persistence pair's
+        # own plumbing are not stores.
+        stores = {
+            a for a in attrs
+            if a not in _NON_STORES and a not in methods
+        }
+        if saved and loaded:
+            for a in sorted(stores):
+                if a not in saved or a not in loaded:
+                    half = (
+                        "load_sections" if a in saved else
+                        "to_sections" if a in loaded else
+                        "to_sections/load_sections"
+                    )
+                    findings.append(Finding(
+                        RULE, rel, m.line,
+                        f"op {op!r}: touches self.{a} which {half} does not "
+                        "carry — a restart loses state that replay already "
+                        "rebuilt (add it to the image, or route the op "
+                        "through a persisted store)",
+                    ))
+        # 4. a test naming it
+        if tests_text and (
+            f'"{op}"' not in tests_text and f"'{op}'" not in tests_text
+        ):
+            findings.append(Finding(
+                RULE, rel, m.line,
+                f"op {op!r}: no test under tests/ names it — add one that "
+                "replays it (two stores + checksum compare) and round-trips "
+                "the image, the PR-10 test_demoted_state pattern",
+            ))
+
+    # 5. dispatch integrity: committed op literals must have methods.
+    # The metadata file's own record-shape literals (none today) and
+    # test fixtures are out of scope — collections cover cfg.paths.
+    for file_rel, sites in sorted(collections.items()):
+        for op, line in sites:
+            if op not in ops:
+                findings.append(Finding(
+                    RULE, file_rel, line,
+                    f"op literal {op!r} has no _op_{op} method on the "
+                    "metadata store — this commit site raises on the live "
+                    "master mid-mutation",
+                ))
+    return findings

@@ -15,10 +15,16 @@ this parsed file (or the whole repo context) and yield findings":
   editing a source file invalidates that file only. A warm hit skips
   the parse AND the tokenize: findings, waivers, and the global
   checkers' per-file summaries (``collect_file``) all ride the cache
-  entry. Global passes (wire-skew parses its one catalog file itself;
-  kill-switch aggregates the cached summaries) re-run every time —
-  their verdicts depend on cross-file state no single-file key can
-  capture, but they cost no re-parsing.
+  entry.
+* Global-results caching — each ``check_global`` pass's findings are
+  cached under a key closing over every input it can read: the scanned
+  files' sha1s, the config, and the checker's declared non-Python
+  inputs (``extra_inputs(cfg) -> list[str]``). A global checker that
+  reads files outside the scanned Python set (native C sources, docs,
+  ``tests/``) MUST list them in ``extra_inputs`` or its cached verdict
+  goes stale when they change; with them declared, an untouched tree
+  skips even the global passes, and an edit to e.g. ``native/wire.h``
+  re-runs exactly the passes that read it.
 """
 
 from __future__ import annotations
@@ -97,10 +103,13 @@ class LintConfig:
     root: str
     paths: list[str] = field(default_factory=list)
     rules: list[str] | None = None  # None = every registered rule
-    messages_path: str | None = None  # wire-skew target
+    messages_path: str | None = None  # wire-skew / native-wire catalog
     doc_paths: list[str] = field(default_factory=list)  # kill-switch docs
     tests_dir: str | None = None  # kill-switch equivalence tests
-    native_dir: str | None = None  # kill-switch C++ getenv sweep
+    native_dir: str | None = None  # kill-switch + native-wire C sweep
+    metadata_path: str | None = None  # changelog-durability op dispatch
+    status_path: str | None = None  # native-wire status codes
+    framing_path: str | None = None  # native-wire proto version
     use_cache: bool = True
     cache_path: str | None = None
 
@@ -118,6 +127,9 @@ class LintConfig:
             doc_paths=[os.path.join(root, "doc", "operations.md")],
             tests_dir=os.path.join(root, "tests"),
             native_dir=os.path.join(root, "native"),
+            metadata_path=os.path.join(pkg, "master", "metadata.py"),
+            status_path=os.path.join(pkg, "proto", "status.py"),
+            framing_path=os.path.join(pkg, "proto", "framing.py"),
             cache_path=os.path.join(root, ".lint-cache.json"),
         )
         for k, v in kw.items():
@@ -164,13 +176,24 @@ class LintResult:
 
 def _registry():
     # imported lazily: checker modules import Finding from here
-    from lizardfs_tpu.tools.lint import awaits, killswitch, races, wire
+    from lizardfs_tpu.tools.lint import (
+        awaits,
+        changelog,
+        killswitch,
+        native_wire,
+        races,
+        telemetry,
+        wire,
+    )
 
     return {
         races.RULE: races,
         awaits.RULE: awaits,
         wire.RULE: wire,
         killswitch.RULE: killswitch,
+        changelog.RULE: changelog,
+        native_wire.RULE: native_wire,
+        telemetry.RULE: telemetry,
     }
 
 
@@ -189,6 +212,21 @@ def _engine_fingerprint() -> str:
                 h.update(name.encode())
                 h.update(fh.read())
     return h.hexdigest()
+
+
+def native_sources(native_dir: str | None) -> list[str]:
+    """The native C surface the cross-language checkers read — ONE
+    definition so a checker's sweep and its ``extra_inputs`` cache key
+    can never drift apart (a file the sweep reads but the key does not
+    hash would serve stale cached verdicts)."""
+    import glob
+
+    if not native_dir or not os.path.isdir(native_dir):
+        return []
+    return sorted(
+        glob.glob(os.path.join(native_dir, "*.h"))
+        + glob.glob(os.path.join(native_dir, "*.cpp"))
+    )
 
 
 def iter_py_files(paths: list[str]) -> list[str]:
@@ -310,12 +348,65 @@ def run_lint(cfg: LintConfig) -> LintResult:
             "collected": collected,
         }
 
+    # ---- global passes ---------------------------------------------------
+    # Cached per rule under a key closing over EVERY input the pass can
+    # read: the scanned files (per-file sha1s — collections are derived
+    # from them), the config (anchor paths + test overrides), and the
+    # checker's declared non-Python inputs (``extra_inputs(cfg)``:
+    # native C sources, the ops doc, tests/). Editing native/wire.h
+    # therefore invalidates the native-wire entries even though the
+    # per-file half of the cache only keys Python content — the
+    # staleness class this key exists to kill.
+    scan_h = hashlib.sha1()
+    for rel in sorted(new_cache):
+        scan_h.update(rel.encode())
+        scan_h.update(new_cache[rel]["sha1"].encode())
+    scan_digest = scan_h.hexdigest()
+    cfg_digest = hashlib.sha1(repr(sorted(
+        (k, repr(v)) for k, v in vars(cfg).items()
+        if k not in ("use_cache", "cache_path")
+    )).encode()).hexdigest()
+    _ext_memo: dict[str, str] = {}
+
+    def _ext_sha(path: str) -> str:
+        h = _ext_memo.get(path)
+        if h is None:
+            try:
+                with open(path, "rb") as fh:
+                    h = hashlib.sha1(fh.read()).hexdigest()
+            except OSError:
+                h = "<missing>"
+            _ext_memo[path] = h
+        return h
+
     for rule in rules:
         checker = registry[rule]
-        if hasattr(checker, "check_global"):
-            findings.extend(
-                checker.check_global(cfg, collections.get(rule, {}))
-            )
+        if not hasattr(checker, "check_global"):
+            continue
+        ext_h = hashlib.sha1()
+        for p in (
+            checker.extra_inputs(cfg)
+            if hasattr(checker, "extra_inputs") else ()
+        ):
+            ext_h.update(p.encode())
+            ext_h.update(_ext_sha(p).encode())
+        gkey = "//global/" + rule  # no real rel starts with //
+        key = f"{scan_digest}:{cfg_digest}:{ext_h.hexdigest()}"
+        cached = cache.get(gkey) if cfg.use_cache else None
+        if cached is not None and cached.get("key") == key:
+            gf = [
+                Finding(r, path, line, message)
+                for r, path, line, message in cached["findings"]
+            ]
+        else:
+            gf = checker.check_global(cfg, collections.get(rule, {}))
+        findings.extend(gf)
+        new_cache[gkey] = {
+            "key": key,
+            "findings": [
+                [f.rule, f.path, f.line, f.message] for f in gf
+            ],
+        }
 
     # ---- waiver matching -------------------------------------------------
     # a waiver covers findings of its rule on its own line or the line

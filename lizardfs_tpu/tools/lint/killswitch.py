@@ -36,6 +36,7 @@ import glob
 import os
 import re
 
+from lizardfs_tpu.tools.lint import engine
 from lizardfs_tpu.tools.lint.engine import Finding
 
 RULE = "kill-switch"
@@ -67,6 +68,7 @@ VALUES = {
     "LZ_WRITE_CS_CREDITS",        # per-chunkserver credit override
     "LZ_WRITE_WINDOW_BYTES_MB",   # staging-byte budget
     "LZ_WRITE_PIPELINE_SEGMENTS", # pipeline depth
+    "LZ_DETSCHED",                # deterministic-scheduler seed (tests)
 }
 
 # Wildcard families: literal prefix of an f-string read.
@@ -156,6 +158,18 @@ def _collect(src):
 def _match_wildcard(read, wildcards):
     probe = read.var or read.prefix or ""
     return next((w for w in wildcards if probe.startswith(w)), None)
+
+
+def extra_inputs(cfg) -> list[str]:
+    """Non-scanned inputs the global pass reads: the ops doc, every
+    test file (switch-reference leg), and the native sources (getenv
+    sweep). Folded into the engine's global-results cache key so a
+    native/doc/tests edit re-runs this pass."""
+    out = list(cfg.doc_paths or [])
+    if cfg.tests_dir and os.path.isdir(cfg.tests_dir):
+        out.extend(sorted(glob.glob(os.path.join(cfg.tests_dir, "*.py"))))
+    out.extend(engine.native_sources(cfg.native_dir))
+    return out
 
 
 def collect_file(src) -> dict:
@@ -271,26 +285,21 @@ def check_global(cfg, collections: dict) -> list[Finding]:
                 ))
 
     # ---- native/ getenv sweep --------------------------------------------
-    native_dir = cfg.native_dir
-    if native_dir and os.path.isdir(native_dir):
-        for path in sorted(
-            glob.glob(os.path.join(native_dir, "*.h"))
-            + glob.glob(os.path.join(native_dir, "*.cpp"))
-        ):
-            rel = os.path.relpath(path, cfg.root)
-            try:
-                with open(path, encoding="utf-8", errors="replace") as fh:
-                    for i, line in enumerate(fh, start=1):
-                        for m in _NATIVE_GETENV.finditer(line):
-                            var = m.group(1)
-                            if var not in switches and var not in values:
-                                findings.append(Finding(
-                                    RULE, rel, i,
-                                    f"{var}: native getenv of an "
-                                    "uninventoried LZ_* var",
-                                ))
-            except OSError:
-                continue
+    for path in engine.native_sources(cfg.native_dir):
+        rel = os.path.relpath(path, cfg.root)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                for i, line in enumerate(fh, start=1):
+                    for m in _NATIVE_GETENV.finditer(line):
+                        var = m.group(1)
+                        if var not in switches and var not in values:
+                            findings.append(Finding(
+                                RULE, rel, i,
+                                f"{var}: native getenv of an "
+                                "uninventoried LZ_* var",
+                            ))
+        except OSError:
+            continue
 
     # ---- doc + test inventory --------------------------------------------
     doc_text = ""

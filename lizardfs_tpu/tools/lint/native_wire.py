@@ -1,0 +1,397 @@
+"""``native-wire``: the Python<->C++ wire contract, cross-checked
+without compiling anything.
+
+The native sources speak the same frames as ``proto/messages.py`` but
+declare their half of the contract as hand-written constants, layout
+comments, and byte offsets. Drift is silent until a mixed deployment
+corrupts a decode — and the LZ_NO_UDS spelling-parity inversion (PR 9)
+showed even the env-gate half can invert between languages. This
+checker parses the C sources textually and pins four contracts:
+
+* **message-type constants** — every ``kType<Suffix> = N`` /
+  ``k<ClassName> = N`` in ``native/`` must name a catalog ``MSG_TYPE``
+  (value match), the named Python class must match the constant's
+  spelling (``kTypeWriteBulkPart`` -> a class ending ``WriteBulkPart``,
+  ``kCltomaRegister`` -> exactly ``CltomaRegister``), and the same
+  constant name must agree across native files;
+* **frame layouts** — every message a native file speaks (defines a
+  type constant for) must carry a machine-readable layout declaration
+  ``//   <ClassName>(<type>): field[:ty] field[:ty] ...`` (continuation
+  comment lines allowed), and the declaration must match the catalog:
+  right MSG_TYPE, field names a prefix of FIELDS in order (trailing
+  skew-tolerant fields may be omitted — old native peers legally elide
+  them), scalar type annotations exact;
+* **status codes** — ``st<NAME> = N`` / ``kStatus<CamelName> = N``
+  must match ``proto/status.py`` (name + value);
+* **proto version + kill-switch spelling parity** — ``kProtoVersion``
+  equals ``framing.PROTO_VERSION``, and any ``getenv("LZ_<switch>")``
+  of an inventoried boolean switch must spell out all four documented
+  off values (0/off/false/no) in the enclosing function — the standing
+  gate generalizing the LZ_NO_UDS fix.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from lizardfs_tpu.tools.lint.engine import Finding, SourceFile, native_sources
+from lizardfs_tpu.tools.lint import killswitch
+from lizardfs_tpu.tools.lint.wire import _parse_catalog
+
+RULE = "native-wire"
+
+_SCALAR_SIZES = {"u8": 1, "u16": 2, "u32": 4, "u64": 8, "bool": 1}
+
+_CONST_RE = re.compile(
+    r"^\s*(?:constexpr\s+)?(?:uint(?:8|16|32|64)_t|int|unsigned)?\s*"
+    r"(k[A-Z]\w+|st[A-Z_]\w*)\s*=\s*(\d+)\s*[,;]"
+)
+_LAYOUT_HEAD_RE = re.compile(
+    r"^\s*//\s{0,3}([A-Z]\w+)\s*\((\d+)\):\s*(.*)$"
+)
+_LAYOUT_CONT_RE = re.compile(r"^\s*//\s{2,}(\S.*)$")
+_FIELD_TOKEN_RE = re.compile(r"^([a-z_][a-z0-9_]*)(?::([a-zA-Z0-9:]+))?$")
+# role prefixes that make a bare k<ClassName> constant a wire constant
+# even when the catalog no longer has the class (that is the drift the
+# rule exists to catch, not a reason to skip the check)
+_ROLE_PREFIX_RE = re.compile(
+    r"^k(?:Cltoma|Matocl|Cltocs|Cstocl|Cstoma|Matocs|Mltoma|Matoml|"
+    r"Tstoma|Matots)[A-Z]"
+)
+_GETENV_RE = re.compile(r'getenv\(\s*"(LZ_[A-Z0-9_]*)"')
+_OFF_SPELLINGS = ('"0"', '"off"', '"false"', '"no"')
+
+
+def extra_inputs(cfg) -> list[str]:
+    out = native_sources(cfg.native_dir)
+    for p in (cfg.messages_path, getattr(cfg, "status_path", None),
+              getattr(cfg, "framing_path", None)):
+        if p:
+            out.append(p)
+    return out
+
+
+class _NativeFile:
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.lines = text.splitlines()
+        # constant name -> (value, line)
+        self.consts: dict[str, tuple[int, int]] = {}
+        # catalog-class layout declarations:
+        # name -> (declared type, [(field, ty|None)], line)
+        self.layouts: dict[str, tuple[int, list, int]] = {}
+        self.getenvs: list[tuple[int, str]] = []
+        self._parse()
+
+    def _parse(self):
+        cur: list | None = None  # tokens of the open layout declaration
+        for i, line in enumerate(self.lines, start=1):
+            m = _LAYOUT_HEAD_RE.match(line)
+            if m:
+                name, mtype, rest = m.group(1), int(m.group(2)), m.group(3)
+                tokens: list = []
+                cur = tokens
+                self.layouts[name] = (mtype, tokens, i)
+                self._eat_tokens(rest, tokens)
+            elif cur is not None:
+                mc = _LAYOUT_CONT_RE.match(line)
+                if mc and all(
+                    _FIELD_TOKEN_RE.match(t) for t in mc.group(1).split()
+                ):
+                    self._eat_tokens(mc.group(1), cur)
+                else:
+                    cur = None
+            mconst = _CONST_RE.match(line)
+            if mconst:
+                self.consts[mconst.group(1)] = (int(mconst.group(2)), i)
+            for mg in _GETENV_RE.finditer(line):
+                self.getenvs.append((i, mg.group(1)))
+
+    @staticmethod
+    def _eat_tokens(text: str, tokens: list) -> None:
+        for tok in text.split():
+            m = _FIELD_TOKEN_RE.match(tok)
+            if m is None:
+                tokens.append((None, tok))  # opaque token: ends checking
+                return
+            tokens.append((m.group(1), m.group(2)))
+
+
+def _enclosing_block(lines: list[str], idx: int, cap: int = 400) -> str:
+    """Text of the brace-delimited block enclosing ``lines[idx]`` — the
+    C function body the getenv sits in (approximate: brace counting,
+    good enough for the tree's formatting; capped so a pathological
+    file cannot make this quadratic). Falls back to a +/-12-line window
+    when no enclosing brace is found."""
+    depth = 0
+    start = None
+    for i in range(idx, max(-1, idx - cap), -1):
+        # walk each line right-to-left so a '{' closed on the same line
+        # doesn't count as the opener
+        for ch in reversed(lines[i]):
+            if ch == "}":
+                depth += 1
+            elif ch == "{":
+                if depth == 0:
+                    start = i
+                    break
+                depth -= 1
+        if start is not None:
+            break
+    if start is None:
+        lo, hi = max(0, idx - 12), min(len(lines), idx + 13)
+        return "\n".join(lines[lo:hi])
+    depth = 0
+    end = min(len(lines), start + cap)
+    for i in range(start, end):
+        for ch in lines[i]:
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    return "\n".join(lines[start:i + 1])
+    return "\n".join(lines[start:end])
+
+
+def _camel_to_upper_snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).upper()
+
+
+def _parse_int_consts(path: str) -> dict[str, int]:
+    """Module-level ``NAME = <int>`` assignments, without importing."""
+    out: dict[str, int] = {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return out
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+            and not isinstance(node.value.value, bool)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def check_global(cfg, collections: dict) -> list[Finding]:
+    native_dir = cfg.native_dir
+    if not native_dir or not os.path.isdir(native_dir):
+        return []
+    findings: list[Finding] = []
+
+    # ---- the Python half --------------------------------------------------
+    classes = {}
+    if cfg.messages_path and os.path.exists(cfg.messages_path):
+        try:
+            with open(cfg.messages_path, encoding="utf-8") as fh:
+                src = SourceFile(
+                    cfg.messages_path,
+                    os.path.relpath(cfg.messages_path, cfg.root),
+                    fh.read(),
+                )
+            classes = _parse_catalog(src.tree)
+        except (OSError, SyntaxError) as e:
+            return [Finding(RULE, "proto/messages.py", 0,
+                            f"cannot parse catalog: {e}")]
+    by_type = {
+        msg.msg_type: msg for msg in classes.values()
+        if msg.msg_type is not None
+    }
+    status_codes = _parse_int_consts(getattr(cfg, "status_path", "") or "")
+    framing_consts = _parse_int_consts(getattr(cfg, "framing_path", "") or "")
+    switches = getattr(cfg, "ks_switches", killswitch.SWITCHES)
+
+    # ---- the C half -------------------------------------------------------
+    nfiles: list[_NativeFile] = []
+    for path in native_sources(native_dir):
+        rel = os.path.relpath(path, cfg.root)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                nfiles.append(_NativeFile(rel, fh.read()))
+        except OSError:
+            continue
+
+    # message-type constants: value + spelling + cross-file agreement
+    seen_consts: dict[str, tuple[int, str, int]] = {}
+    spoken: dict[str, dict[int, int]] = {}  # rel -> {msg type: line}
+    for nf in nfiles:
+        for cname, (value, line) in nf.consts.items():
+            if cname.startswith("st") or cname.startswith("kStatus"):
+                continue
+            if not (
+                cname.startswith("kType")
+                or _ROLE_PREFIX_RE.match(cname)
+                or (cname.startswith("k") and cname[1:] in classes)
+            ):
+                # kBlockSize/kChunkSize and friends: not wire types
+                continue
+            prev = seen_consts.get(cname)
+            if prev is not None and prev[0] != value:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"{cname} = {value} disagrees with {prev[1]}:{prev[2]} "
+                    f"({cname} = {prev[0]}) — one of them frames garbage",
+                ))
+            seen_consts.setdefault(cname, (value, nf.rel, line))
+            msg = by_type.get(value)
+            if msg is None:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"{cname} = {value}: no catalog message declares "
+                    f"MSG_TYPE {value} — the native side speaks a frame "
+                    "Python cannot parse",
+                ))
+                continue
+            suffix = cname[5:] if cname.startswith("kType") else cname[1:]
+            if not (msg.name == suffix or (
+                cname.startswith("kType") and msg.name.endswith(suffix)
+            )):
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"{cname} = {value} but MSG_TYPE {value} belongs to "
+                    f"{msg.name} — constant name and catalog class "
+                    "disagree; rename one",
+                ))
+            spoken.setdefault(nf.rel, {}).setdefault(value, line)
+
+    # layout declarations: well-formed, catalog-true, and present for
+    # every message a file defines a type constant for
+    declared: dict[str, set[int]] = {}  # rel -> types with a declaration
+    for nf in nfiles:
+        for name, (mtype, tokens, line) in nf.layouts.items():
+            msg = classes.get(name)
+            if msg is None:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"layout comment for {name} ({mtype}): no such class "
+                    "in the catalog",
+                ))
+                continue
+            declared.setdefault(nf.rel, set()).add(mtype)
+            if msg.msg_type != mtype:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"layout comment says {name} ({mtype}) but the catalog "
+                    f"declares MSG_TYPE {msg.msg_type}",
+                ))
+            fields = [
+                e for e in (msg.fields or [])
+                if isinstance(e, tuple) and len(e) == 2
+            ]
+            for i, (fname, fty) in enumerate(tokens):
+                if fname is None:
+                    break  # opaque token: prefix checked up to here
+                if i >= len(fields):
+                    findings.append(Finding(
+                        RULE, nf.rel, line,
+                        f"layout {name}: declares field {fname!r} past the "
+                        f"catalog's {len(fields)} fields",
+                    ))
+                    break
+                cat_name, cat_ty = fields[i]
+                if fname != cat_name:
+                    findings.append(Finding(
+                        RULE, nf.rel, line,
+                        f"layout {name}: field {i} is {fname!r}, catalog "
+                        f"says {cat_name!r} — the byte offsets that follow "
+                        "are wrong on one side",
+                    ))
+                    break
+                if fty is not None and fty != cat_ty:
+                    findings.append(Finding(
+                        RULE, nf.rel, line,
+                        f"layout {name}.{fname}: declared :{fty}, catalog "
+                        f"says :{cat_ty}",
+                    ))
+            # every NON-skew field must be covered (a declaration may
+            # stop at an opaque token or the skew boundary, not before)
+            ncovered = next(
+                (i for i, t in enumerate(tokens) if t[0] is None),
+                len(tokens),
+            )
+            required = min(
+                msg.skew if isinstance(msg.skew, int) else len(fields),
+                len(fields),
+            )
+            if ncovered < required:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"layout {name}: declares only {ncovered} of "
+                    f"{required} required fields — partial declarations "
+                    "hide drift in the undeclared tail",
+                ))
+    all_declared: set[int] = set()
+    for types in declared.values():
+        all_declared |= types
+    for nf in nfiles:
+        for t, line in sorted(spoken.get(nf.rel, {}).items()):
+            if t not in all_declared and t in by_type:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"message type {t} ({by_type[t].name}) is spoken here "
+                    "but no native file declares its layout — add the "
+                    "machine-checkable `//   Name(type): field:ty ...` "
+                    "comment next to the framing code",
+                ))
+
+    # status constants
+    for nf in nfiles:
+        for cname, (value, line) in nf.consts.items():
+            if cname.startswith("st"):
+                pyname = cname[2:]
+            elif cname.startswith("kStatus"):
+                pyname = _camel_to_upper_snake(cname[7:])
+            else:
+                continue
+            if not status_codes:
+                continue
+            expect = status_codes.get(pyname)
+            if expect is None:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"{cname}: no status named {pyname} in proto/status.py",
+                ))
+            elif expect != value:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f"{cname} = {value} but proto/status.py says "
+                    f"{pyname} = {expect}",
+                ))
+
+    # proto version
+    py_ver = framing_consts.get("PROTO_VERSION")
+    for nf in nfiles:
+        kv = nf.consts.get("kProtoVersion")
+        if kv is not None and py_ver is not None and kv[0] != py_ver:
+            findings.append(Finding(
+                RULE, nf.rel, kv[1],
+                f"kProtoVersion = {kv[0]} but framing.PROTO_VERSION = "
+                f"{py_ver}",
+            ))
+
+    # kill-switch spelling parity at native getenv sites
+    for nf in nfiles:
+        for line, var in nf.getenvs:
+            if var not in switches:
+                continue  # inventory membership is the kill-switch rule
+            window = _enclosing_block(nf.lines, line - 1)
+            missing = [s for s in _OFF_SPELLINGS if s not in window]
+            if missing:
+                findings.append(Finding(
+                    RULE, nf.rel, line,
+                    f'getenv("{var}"): boolean switch read without the '
+                    f"full off-spelling set nearby (missing "
+                    f"{', '.join(missing)}) — C side must honor the same "
+                    "0/off/false/no contract as constants.env_flag or the "
+                    "two languages invert on the same deployment",
+                ))
+    return findings

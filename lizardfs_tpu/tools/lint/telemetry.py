@@ -1,0 +1,374 @@
+"""``telemetry-coverage``: every client-facing verb maps to a trace
+span, an SLO class (or a reasoned waiver), a fault choke point, and
+metrics — statically.
+
+PRs 2/3/8/10 built the conventions one at a time: the master RPC loop
+traces + times every dispatched op, the chunkserver data plane charges
+read/write spans and objectives, the NFS and S3 gateways begin a span
+and observe their own SLO class at ONE dispatch boundary, and the fault
+engine's frame choke points cover every proto message generically. Each
+new verb since then was hand-audited against that matrix at review
+time. This checker turns the audit into a standing gate:
+
+* **the verb inventory is total** — every client-facing catalog class
+  (``Cltoma*`` master RPCs, ``Cltocs*`` data-plane requests) must have
+  an inventory entry below, and every entry must still name a catalog
+  class. Adding a verb without deciding its telemetry story fails lint.
+* **SLO mapping is real** — an entry either names a class from
+  ``runtime/slo.py``'s ``OP_CLASSES`` (and the verb's handler file must
+  actually ``observe`` that class) or carries a waiver REASON saying
+  why the verb has no latency objective.
+* **the fault path exists** — each verb's choke point must be an
+  inventoried ``runtime/faults.py`` site whose implementing file really
+  consults it (a renamed site string otherwise leaves the verb
+  undrillable while the inventory still claims coverage).
+* **the generic instruments stand** — the per-surface span/metric
+  anchors (master per-op timing + span record, chunkserver op spans,
+  gateway boundary spans) must exist in the handler sources; deleting
+  or renaming one fails here, not in a post-incident review.
+* **no dead objectives** — every ``OP_CLASSES`` entry must be observed
+  by at least one surface (a class nobody feeds burns no rate yet
+  still reads "healthy" on dashboards).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from lizardfs_tpu.tools.lint.engine import Finding
+
+RULE = "telemetry-coverage"
+
+# ---- surfaces --------------------------------------------------------------
+MASTER = "lizardfs_tpu/master/server.py"
+CS = "lizardfs_tpu/chunkserver/server.py"
+NFS = "lizardfs_tpu/nfs/server.py"
+S3 = "lizardfs_tpu/s3/server.py"
+FRAMING = "lizardfs_tpu/proto/framing.py"
+
+# fault site -> the file that consults it (runtime/faults.py names the
+# site; the implementing file must pass the literal to the engine)
+SITE_IMPL = {
+    "frame_send": FRAMING,
+    "frame_recv": FRAMING,
+    "disk_pread": "lizardfs_tpu/chunkserver/chunk_store.py",
+    "disk_pwrite": "lizardfs_tpu/chunkserver/chunk_store.py",
+    # every dialer (pool, RPC links, client data plane) funnels through
+    # faults.dial_point — the literal lives with the choke point
+    "dial": "lizardfs_tpu/runtime/faults.py",
+    "serve_read": CS,
+    "http_recv": S3,
+    "http_send": S3,
+}
+
+# ---- the verb inventory ----------------------------------------------------
+# verb -> SLO class its handler surface must observe
+SLO_CLASSES = {
+    # chunk grant / commit RPCs are the master's latency-critical class
+    "CltomaReadChunk": "locate",
+    "CltomaWriteChunk": "locate",
+    "CltomaWriteChunkEnd": "locate",
+    "CltomaWriteChunkEndBatch": "locate",
+    # data plane: the chunkserver charges read/write objectives
+    "CltocsRead": "read",
+    "CltocsReadBulk": "read",
+    "CltocsWriteData": "write",
+    "CltocsWriteBulk": "write",
+    "CltocsWriteBulkPart": "write",
+    "CltocsShmWritePart": "write",
+    "CltocsWriteEnd": "write",
+    "CltocsWriteInit": "write",
+}
+
+_META = (
+    "namespace metadata RPC — per-op latency histogram + master span "
+    "cover it; the latency objective rides the locate class (chunk "
+    "grants) by design, metadata breaches surface via the per-op "
+    "timings and the health rollup"
+)
+_SESSION = (
+    "session/control RPC — fires once per mount or failover, not on "
+    "the request path; per-op timing + trace span only"
+)
+_ADMIN = (
+    "operator/introspection verb — human-paced, budget-bounded "
+    "server-side; per-op timing + trace span only"
+)
+_TAPE = (
+    "tape-tier verb — latency is dominated by the archival backend and "
+    "bounded by the caller's deadline; recall progress is tracked via "
+    "tape_* health counts, not a latency objective"
+)
+
+# verb -> why it carries NO latency objective (the reason is the
+# waiver; an empty reason fails lint)
+SLO_WAIVERS = {
+    **{v: _META for v in (
+        "CltomaLookup", "CltomaGetattr", "CltomaMkdir", "CltomaCreate",
+        "CltomaReaddir", "CltomaUnlink", "CltomaRmdir", "CltomaRename",
+        "CltomaSetGoal", "CltomaSetEattr", "CltomaTruncate",
+        "CltomaSetattr", "CltomaSymlink", "CltomaReadlink", "CltomaLink",
+        "CltomaSnapshot", "CltomaSetXattr", "CltomaGetXattr",
+        "CltomaListXattr", "CltomaStatFs", "CltomaAccess",
+        "CltomaSetAcl", "CltomaGetAcl", "CltomaSetRichAcl",
+        "CltomaGetRichAcl", "CltomaLockOp", "CltomaOpen", "CltomaRelease",
+        "CltomaSetQuota", "CltomaGetQuota", "CltomaAppendChunks",
+    )},
+    **{v: _SESSION for v in (
+        "CltomaRegister", "CltomaGoodbye", "CltomaIoLimitRequest",
+    )},
+    **{v: _ADMIN for v in (
+        "CltomaTrashList", "CltomaUndelete", "CltomaFileRepair",
+        "CltomaChunkDamaged",
+    )},
+    **{v: _TAPE for v in (
+        "CltomaTapeInfo", "CltomaTapeDemote", "CltomaTapeRecall",
+    )},
+    "CltocsPrefetch": (
+        "fire-and-forget page-cache hint with no reply frame — there "
+        "is no completion to time"
+    ),
+    "CltocsShmInit": (
+        "one-shot ring negotiation per (client, chunkserver) pair, "
+        "acked via CstoclWriteStatus; not a data op"
+    ),
+}
+
+# per-verb fault choke point (default: the frame plane covers every
+# proto message at recv time)
+VERB_SITES = {
+    "CltocsRead": "serve_read",
+    "CltocsReadBulk": "serve_read",
+    "CltocsWriteData": "disk_pwrite",
+    "CltocsWriteBulk": "disk_pwrite",
+    "CltocsWriteBulkPart": "disk_pwrite",
+    "CltocsShmWritePart": "disk_pwrite",
+}
+DEFAULT_SITE = "frame_recv"
+
+# generic per-surface instruments: (file, regex, what broke if absent)
+ANCHORS = (
+    (MASTER, r"metrics\.timing\(type\(msg\)\.__name__\)",
+     "master per-op latency histograms (request_log analog)"),
+    (MASTER, r"trace_ring\.record\(", "master RPC span recording"),
+    (CS, r"trace_ring\.record\(", "chunkserver op span recording"),
+    (CS, r"slo\.observe\(", "chunkserver data-plane SLO accounting"),
+    (NFS, r"tracing\.begin\(\)", "NFS gateway boundary span"),
+    (NFS, r"observe\(\s*\n?\s*[\"']nfs[\"']", "NFS SLO class accounting"),
+    (S3, r"tracing\.begin\(\)", "S3 gateway boundary span"),
+    (S3, r"observe\(\s*\n?\s*[\"']s3[\"']", "S3 SLO class accounting"),
+)
+
+# files searched for OP_CLASSES coverage (who feeds each objective)
+SLO_SURFACES = (MASTER, CS, NFS, S3)
+
+
+def extra_inputs(cfg) -> list[str]:
+    root = cfg.root
+    paths = {os.path.join(root, p) for p in SITE_IMPL.values()}
+    paths.update(os.path.join(root, p) for p in SLO_SURFACES)
+    paths.add(os.path.join(root, "lizardfs_tpu/runtime/slo.py"))
+    paths.add(os.path.join(root, "lizardfs_tpu/runtime/faults.py"))
+    if cfg.messages_path:
+        paths.add(cfg.messages_path)
+    return sorted(p for p in paths if os.path.exists(p))
+
+
+def _tuple_of_strs(path: str, var: str) -> list[str]:
+    """Module-level ``VAR = ("a", "b", ...)`` literal, without import."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return []
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == var
+        ):
+            try:
+                val = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                return []
+            if isinstance(val, (tuple, list)):
+                return [v for v in val if isinstance(v, str)]
+    return []
+
+
+def _observes(text: str, cls: str) -> bool:
+    return re.search(
+        r"observe\(\s*\n?\s*[\"']" + re.escape(cls) + r"[\"']", text
+    ) is not None
+
+
+def check_global(cfg, collections: dict) -> list[Finding]:
+    root = cfg.root
+    findings: list[Finding] = []
+    missing: set[str] = set()
+
+    def read(rel: str) -> str:
+        """Text of an inventoried surface file. An unreadable surface
+        is a FINDING (reported once), never a silent skip — otherwise a
+        renamed master/server.py would vacuously pass every check this
+        rule makes about it."""
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            if rel not in missing:
+                missing.add(rel)
+                findings.append(Finding(
+                    RULE, rel, 0,
+                    "telemetry surface file is missing/unreadable — the "
+                    "inventory in tools/lint/telemetry.py names it; update "
+                    "the inventory to the file's new home (every check "
+                    "against it would otherwise pass vacuously)",
+                ))
+            return ""
+
+    # inventory anchors are configurable so fixtures can exercise the
+    # rule without a full tree
+    slo_classes = getattr(cfg, "tc_slo_classes", SLO_CLASSES)
+    slo_waivers = getattr(cfg, "tc_slo_waivers", SLO_WAIVERS)
+    verb_sites = getattr(cfg, "tc_verb_sites", VERB_SITES)
+    anchors = getattr(cfg, "tc_anchors", ANCHORS)
+    site_impl = getattr(cfg, "tc_site_impl", SITE_IMPL)
+    slo_path = getattr(
+        cfg, "slo_path", os.path.join(root, "lizardfs_tpu/runtime/slo.py")
+    )
+    faults_path = getattr(
+        cfg, "faults_path",
+        os.path.join(root, "lizardfs_tpu/runtime/faults.py"),
+    )
+
+    # ---- catalog <-> inventory bijection ---------------------------------
+    verbs: dict[str, int] = {}
+    cat_rel = ""
+    if cfg.messages_path and os.path.exists(cfg.messages_path):
+        cat_rel = os.path.relpath(cfg.messages_path, root)
+        try:
+            with open(cfg.messages_path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            return [Finding(RULE, cat_rel, 0, f"cannot parse catalog: {e}")]
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name.startswith(
+                ("Cltoma", "Cltocs")
+            ):
+                verbs[node.name] = node.lineno
+    if not verbs:
+        return findings
+
+    op_classes = _tuple_of_strs(slo_path, "OP_CLASSES")
+    fault_sites = _tuple_of_strs(faults_path, "SITES")
+    master_text = read(MASTER)
+    cs_text = read(CS)
+
+    for verb, line in sorted(verbs.items()):
+        handler_rel = MASTER if verb.startswith("Cltoma") else CS
+        handler_text = master_text if handler_rel == MASTER else cs_text
+        in_slo = verb in slo_classes
+        in_waiver = verb in slo_waivers
+        if not in_slo and not in_waiver:
+            findings.append(Finding(
+                RULE, cat_rel, line,
+                f"{verb}: client-facing verb with no telemetry inventory "
+                "entry — map it to an SLO class in tools/lint/telemetry.py "
+                "(or add a waiver REASON there saying why it carries no "
+                "latency objective)",
+            ))
+            continue
+        if in_slo and in_waiver:
+            findings.append(Finding(
+                RULE, cat_rel, line,
+                f"{verb}: both an SLO class and a waiver — pick one",
+            ))
+        if in_slo:
+            cls = slo_classes[verb]
+            if op_classes and cls not in op_classes:
+                findings.append(Finding(
+                    RULE, cat_rel, line,
+                    f"{verb}: inventory maps it to SLO class {cls!r} which "
+                    "runtime/slo.py OP_CLASSES does not define",
+                ))
+            elif handler_text and not _observes(handler_text, cls):
+                findings.append(Finding(
+                    RULE, cat_rel, line,
+                    f"{verb}: inventory claims SLO class {cls!r} but "
+                    f"{handler_rel} never observes it — the objective is "
+                    "a dead letter for this verb",
+                ))
+        elif not str(slo_waivers[verb]).strip():
+            findings.append(Finding(
+                RULE, cat_rel, line,
+                f"{verb}: SLO waiver with no reason — a reasonless waiver "
+                "is not a waiver",
+            ))
+        # word-boundary match: CltomaWriteChunkEnd must not pass on the
+        # strength of CltomaWriteChunkEndBatch still being handled
+        if handler_text and not re.search(
+            r"\b" + re.escape(verb) + r"\b", handler_text
+        ):
+            findings.append(Finding(
+                RULE, cat_rel, line,
+                f"{verb}: not referenced by its handler surface "
+                f"({handler_rel}) — either a dead verb or a dispatch gap; "
+                "remove it from the catalog or handle it",
+            ))
+        site = verb_sites.get(verb, DEFAULT_SITE)
+        if fault_sites and site not in fault_sites:
+            findings.append(Finding(
+                RULE, cat_rel, line,
+                f"{verb}: fault choke point {site!r} is not in "
+                "runtime/faults.py SITES — the verb cannot be drilled",
+            ))
+
+    # ---- fault sites really consulted ------------------------------------
+    # verb-mapped sites need a SITE_IMPL row; every SITE_IMPL row (not
+    # just the ones a verb maps to today) must really pass its literal
+    # to the fault engine, or a renamed "http_recv"/"disk_pread" string
+    # leaves the site undrillable while the inventory still claims it
+    checked_sites = {verb_sites.get(v, DEFAULT_SITE) for v in verbs}
+    for site in sorted(checked_sites - set(site_impl)):
+        findings.append(Finding(
+            RULE, "lizardfs_tpu/tools/lint/telemetry.py", 0,
+            f"fault site {site!r} has no SITE_IMPL mapping — name the "
+            "file that consults it",
+        ))
+    for site, impl in sorted(site_impl.items()):
+        text = read(impl)
+        if text and f'"{site}"' not in text and f"'{site}'" not in text:
+            findings.append(Finding(
+                RULE, impl, 0,
+                f"fault site {site!r} is claimed by the inventory but this "
+                "file never passes the literal to the fault engine — the "
+                "choke point is gone",
+            ))
+
+    # ---- generic instruments ---------------------------------------------
+    for rel, pattern, what in anchors:
+        text = read(rel)
+        if text and re.search(pattern, text) is None:
+            findings.append(Finding(
+                RULE, rel, 0,
+                f"missing instrument: {what} (expected /{pattern}/) — "
+                "restore it or update the telemetry inventory with the "
+                "new spelling",
+            ))
+
+    # ---- no dead objectives ----------------------------------------------
+    if op_classes:
+        surface_texts = [read(p) for p in SLO_SURFACES]
+        for cls in op_classes:
+            if not any(_observes(t, cls) for t in surface_texts if t):
+                findings.append(Finding(
+                    RULE, os.path.relpath(slo_path, root), 0,
+                    f"SLO class {cls!r} is defined but no surface observes "
+                    "it — dashboards read it as forever-healthy; feed it "
+                    "or retire it",
+                ))
+    return findings

@@ -47,6 +47,18 @@ OPTIONAL_BY_CONVENTION = {
     "mirror",
 }
 
+# (message, field) pairs that are additive-convention fields WITHIN one
+# message even though the same name is required payload elsewhere — the
+# PR-10 wire surface: the tape server's own cluster-client session id
+# rides TstomaRegister's optional tail (legacy sid-0 peers keep the
+# permissive demoted-write standdown), while session_id stays a
+# required field of CltomaRegister/MatoclRegister. Same pattern for any
+# future S3/tape-era trailing field whose name is taken: scope it here
+# instead of widening the global set.
+OPTIONAL_BY_CONVENTION_SCOPED = {
+    ("TstomaRegister", "session_id"),
+}
+
 _SCALARS = {"u8", "u16", "u32", "u64", "i32", "i64", "bool"}
 _CONTRACT_METHODS = {
     "__init__",
@@ -82,6 +94,12 @@ def _literal(node):
         return ast.literal_eval(node)
     except (ValueError, SyntaxError):
         return None
+
+
+def extra_inputs(cfg) -> list[str]:
+    """The one catalog file this global pass reads (feeds the engine's
+    global-results cache key)."""
+    return [cfg.messages_path] if cfg.messages_path else []
 
 
 def _parse_catalog(tree: ast.Module) -> dict[str, _Msg]:
@@ -192,7 +210,9 @@ def check_global(cfg, collections: dict) -> list[Finding]:
             if not (isinstance(entry, tuple) and len(entry) == 2):
                 continue
             fname = entry[0]
-            if fname in OPTIONAL_BY_CONVENTION:
+            if fname in OPTIONAL_BY_CONVENTION or (
+                (msg.name, fname) in OPTIONAL_BY_CONVENTION_SCOPED
+            ):
                 if msg.skew is None or i < msg.skew:
                     f(msg, f"{msg.name}.{fname}: {fname!r} is an additive "
                            "convention field — it must sit at or past "

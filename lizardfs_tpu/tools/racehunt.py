@@ -1,0 +1,143 @@
+"""``racehunt`` — replay async tests (and chaos schedules) across K
+deterministic-scheduler seeds.
+
+The cross-await-race checker names *candidate* interleavings; this tool
+hunts them dynamically: each seed runs the target pytest selection
+under ``runtime/detsched.py``'s seeded event loop (``LZ_DETSCHED=<n>``
+— tests/conftest.py routes every async test through ``detsched.run``
+when the var is set), so each seed executes a DIFFERENT but fully
+reproducible interleaving of every awaited race window. A failure
+prints the exact replay command; re-running it executes a
+byte-identical schedule (pinned by tests/test_detsched.py's digest
+tests).
+
+    python -m lizardfs_tpu.tools.racehunt                 # smoke set, seeds 1..3
+    python -m lizardfs_tpu.tools.racehunt --seeds 10 tests/test_shadow_reads.py
+    python -m lizardfs_tpu.tools.racehunt --seed 7 tests/test_detsched.py -- -k reconnect
+    python -m lizardfs_tpu.tools.racehunt --chaos kill-write --seeds 5
+
+``--chaos`` delegates a schedule to ``tools/chaos.py`` per seed (chaos
+drives REAL process clusters — its determinism comes from the seeded
+fault engine, not detsched; both hunts share the seed discipline and
+the replay-command contract).
+
+Exit status: 0 = every seed green, 1 = at least one failing seed (the
+summary lists each with its replay command), 2 = bad invocation.
+``make racehunt`` wraps the default hunt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+# the default smoke selection: fast, pure-asyncio, detsched-sensitive
+# (the seeded race fixtures + the single-flight regression pins)
+SMOKE_TARGETS = ("tests/test_detsched.py",)
+
+
+def _pytest_cmd(targets: list[str], extra: list[str]) -> list[str]:
+    return [
+        sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+        *targets, *extra,
+    ]
+
+
+def _chaos_cmd(schedule: str, seed: int) -> list[str]:
+    return [
+        sys.executable, "-m", "lizardfs_tpu.tools.chaos",
+        "--schedule", schedule, "--seed", str(seed),
+    ]
+
+
+def _shell(env_prefix: str, cmd: list[str]) -> str:
+    return (env_prefix + " " + " ".join(cmd)).strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="racehunt",
+        description="seeded deterministic-interleaving hunt "
+                    "(see doc/operations.md)",
+    )
+    ap.add_argument(
+        "targets", nargs="*",
+        help="pytest files/nodeids (default: the detsched smoke set); "
+        "args after `--` pass through to pytest",
+    )
+    ap.add_argument(
+        "--seeds", type=int, default=3, metavar="K",
+        help="hunt seeds 1..K (default 3)",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="replay exactly this one seed",
+    )
+    ap.add_argument(
+        "--chaos", metavar="SCHEDULE", default=None,
+        help="hunt a chaos schedule instead of a pytest selection",
+    )
+    if argv is None:
+        argv = sys.argv[1:]
+    # everything after `--` rides through to pytest untouched
+    extra: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, extra = argv[:split], argv[split + 1:]
+    args = ap.parse_args(argv)
+
+    if args.seed is None and args.seeds < 1:
+        # a hunt over zero seeds would exit 0 with nothing hunted — a
+        # misconfigured CI variable must fail loudly, not pass the gate
+        ap.error(f"--seeds {args.seeds}: need at least 1 seed")
+    if args.chaos and (args.targets or extra):
+        # silently dropping a pytest selection would report the hunt
+        # green without anything having hunted it
+        ap.error("--chaos runs a chaos schedule; pytest targets/args "
+                 "don't apply — drop them or drop --chaos")
+    seeds = [args.seed] if args.seed is not None else list(
+        range(1, args.seeds + 1)
+    )
+    targets = list(args.targets) or list(SMOKE_TARGETS)
+
+    failures: list[tuple[int, str]] = []
+    for seed in seeds:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the replay prefix names the platform actually used — a
+        # pre-exported JAX_PLATFORMS must replay on ITSELF
+        jax = f"JAX_PLATFORMS={env['JAX_PLATFORMS']}"
+        if args.chaos:
+            cmd = _chaos_cmd(args.chaos, seed)
+            replay = _shell(jax, cmd)
+        else:
+            env["LZ_DETSCHED"] = str(seed)
+            cmd = _pytest_cmd(targets, extra)
+            replay = _shell(f"LZ_DETSCHED={seed} {jax}", cmd)
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True
+        )
+        dt = time.monotonic() - t0
+        status = "ok" if proc.returncode == 0 else "FAIL"
+        print(f"racehunt seed={seed} {status} ({dt:.1f}s)", flush=True)
+        if proc.returncode != 0:
+            failures.append((seed, replay))
+            tail = (proc.stdout + proc.stderr).splitlines()[-25:]
+            for line in tail:
+                print(f"  | {line}")
+            print(f"  REPLAY: {replay}")
+    if failures:
+        print(f"racehunt: {len(failures)}/{len(seeds)} seeds failed")
+        for seed, replay in failures:
+            print(f"  seed {seed}: {replay}")
+        return 1
+    print(f"racehunt: all {len(seeds)} seeds green")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

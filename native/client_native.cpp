@@ -9,6 +9,56 @@
 //
 // Threading: one mutex per handle; operations serialize. Data-plane
 // sockets are pooled per address inside the handle.
+//
+// Master-RPC wire layouts (keep in sync with proto/messages.py — the
+// `lizardfs-lint` native-wire checker cross-checks every declaration
+// against the catalog; str/list fields are u32-length/count-prefixed,
+// trailing skew-tolerant fields — replica_ok, meta_version, trace_id —
+// may be elided on the wire and are default-filled by the receiver):
+//   CltomaRegister(1000): req_id:u32 session_id:u64 info:str password:str
+//                         replica_ok:u8
+//   MatoclRegister(1001): req_id:u32 status:u8 session_id:u64
+//                         meta_version:u64
+//   CltomaLookup(1002): req_id:u32 parent:u32 name:str uid:u32 gids:list:u32
+//   MatoclAttrReply(1003): req_id:u32 status:u8 attr:msg:Attr
+//   CltomaGetattr(1004): req_id:u32 inode:u32
+//   CltomaMkdir(1006): req_id:u32 parent:u32 name:str mode:u16 uid:u32
+//                      gid:u32
+//   CltomaCreate(1008): req_id:u32 parent:u32 name:str mode:u16 uid:u32
+//                       gid:u32
+//   CltomaReaddir(1010): req_id:u32 inode:u32 uid:u32 gids:list:u32
+//   MatoclReaddir(1011): req_id:u32 status:u8 entries:list:msg:DirEntry
+//                        meta_version:u64
+//   CltomaUnlink(1012): req_id:u32 parent:u32 name:str uid:u32 gids:list:u32
+//   MatoclStatusReply(1013): req_id:u32 status:u8 meta_version:u64
+//   CltomaRmdir(1014): req_id:u32 parent:u32 name:str uid:u32 gids:list:u32
+//   CltomaRename(1016): req_id:u32 parent_src:u32 name_src:str
+//                       parent_dst:u32 name_dst:str uid:u32 gids:list:u32
+//   CltomaReadChunk(1020): req_id:u32 inode:u32 chunk_index:u32 uid:u32
+//                          gids:list:u32 trace_id:u64
+//   MatoclReadChunk(1021): req_id:u32 status:u8 chunk_id:u64 version:u32
+//                          file_length:u64 locations:list:msg:PartLocation
+//                          meta_version:u64
+//   CltomaWriteChunk(1022): req_id:u32 inode:u32 chunk_index:u32 uid:u32
+//                           gids:list:u32 trace_id:u64
+//   MatoclWriteChunk(1023): req_id:u32 status:u8 chunk_id:u64 version:u32
+//                           file_length:u64 locations:list:msg:PartLocation
+//   CltomaWriteChunkEnd(1024): req_id:u32 chunk_id:u64 inode:u32
+//                              chunk_index:u32 file_length:u64 status:u8
+//                              trace_id:u64
+//   CltomaTruncate(1026): req_id:u32 inode:u32 length:u64 uid:u32
+//                         gids:list:u32
+//   CltomaSetattr(1028): req_id:u32 inode:u32 set_mask:u8 mode:u16 uid:u32
+//                        gid:u32 atime:u32 mtime:u32 trash_time:u32
+//                        caller_uid:u32 caller_gids:list:u32
+//   CltomaSymlink(1030): req_id:u32 parent:u32 name:str target:str uid:u32
+//                        gid:u32
+//   CltomaReadlink(1032): req_id:u32 inode:u32
+//   MatoclReadlink(1033): req_id:u32 status:u8 target:str meta_version:u64
+//   CltomaLink(1034): req_id:u32 inode:u32 parent:u32 name:str uid:u32
+//                     gids:list:u32
+//   CltomaAccess(1060): req_id:u32 inode:u32 uid:u32 gids:list:u32 mask:u8
+//   CltomaGoodbye(1066): req_id:u32
 
 #include "lizardfs_client.h"
 

@@ -7,18 +7,29 @@
 // generation, buffer scatter — and are called from worker threads with
 // the GIL released (ctypes does this automatically for plain C calls).
 //
-// Wire format (keep in sync with lizardfs_tpu/proto):
-//   frame   = header(type:u32 BE, length:u32 BE) + version:u8 + body
-//   CltocsRead       (1200): req_id:u32 chunk_id:u64 version:u32
-//                            part_id:u32 offset:u32 size:u32
-//   CstoclReadData   (1201): req_id:u32 chunk_id:u64 offset:u32 crc:u32
-//                            data(u32 len + bytes)
-//   CstoclReadStatus (1202): req_id:u32 chunk_id:u64 status:u8
-//   CltocsWriteData  (1211): req_id:u32 chunk_id:u64 write_id:u32
-//                            block:u32 offset:u32 crc:u32
-//                            data(u32 len + bytes)
-//   CstoclWriteStatus(1212): req_id:u32 chunk_id:u64 write_id:u32
-//                            status:u8
+// Wire format (keep in sync with lizardfs_tpu/proto — the
+// `lizardfs-lint` native-wire checker cross-checks these declarations
+// against the catalog; bytes/str/list fields are u32-length/count-
+// prefixed per proto/codec.py, trailing skew-tolerant fields like
+// trace_id may be elided on the wire):
+//   frame   = header type:u32 BE + length:u32 BE + version:u8 + body
+//   CltocsRead(1200): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                     offset:u32 size:u32 trace_id:u64
+//   CstoclReadData(1201): req_id:u32 chunk_id:u64 offset:u32 crc:u32
+//                         data:bytes
+//   CstoclReadStatus(1202): req_id:u32 chunk_id:u64 status:u8
+//   CltocsReadBulk(1206): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                         offset:u32 size:u32 trace_id:u64
+//   CstoclReadBulkData(1207): req_id:u32 chunk_id:u64 status:u8 offset:u32
+//                             crcs:list:u32 data:bytes
+//   CltocsWriteData(1211): req_id:u32 chunk_id:u64 write_id:u32 block:u32
+//                          offset:u32 crc:u32 data:bytes
+//   CstoclWriteStatus(1212): req_id:u32 chunk_id:u64 write_id:u32 status:u8
+//   CltocsWriteBulk(1214): req_id:u32 chunk_id:u64 write_id:u32
+//                          part_offset:u32 crcs:list:u32 data:bytes
+//   CltocsWriteBulkPart(1215): req_id:u32 chunk_id:u64 write_id:u32
+//                              part_id:u32 part_offset:u32 crcs:list:u32
+//                              data:bytes
 //
 // Return codes: 0 = OK; >0 = protocol status byte from the peer;
 // -1 = socket error; -2 = protocol violation; -3 = CRC mismatch.

@@ -10,20 +10,36 @@
 // data path.  The asyncio server remains the control plane (admin,
 // replication commands) and the portable fallback.
 //
-// Wire format (keep in sync with lizardfs_tpu/proto/messages.py):
-//   frame = header(type:u32 BE, length:u32 BE) + version:u8 + body
-//   CltocsRead       (1200): req_id:u32 chunk_id:u64 version:u32
-//                            part_id:u32 offset:u32 size:u32
-//   CstoclReadData   (1201): req_id chunk_id offset:u32 crc:u32 data
-//   CstoclReadStatus (1202): req_id chunk_id status:u8
-//   CltocsPrefetch   (1205): like Read, no reply
-//   CltocsWriteInit  (1210): req_id chunk_id version part_id
-//                            chain(list of {host:str port:u16 part:u32})
-//                            create:bool
-//   CltocsWriteData  (1211): req_id chunk_id write_id:u32 block:u32
-//                            offset:u32 crc:u32 data
-//   CstoclWriteStatus(1212): req_id chunk_id write_id status:u8
-//   CltocsWriteEnd   (1213): req_id chunk_id
+// Wire format (keep in sync with lizardfs_tpu/proto/messages.py —
+// the `lizardfs-lint` native-wire checker parses these declarations
+// and cross-checks every field against the catalog, so keep the
+// `Name(type): field:ty ...` grammar intact; trailing skew-tolerant
+// fields like trace_id are legal to omit on the wire, but declared
+// here so the full layout is visible in one place):
+//   frame = header type:u32 BE + length:u32 BE + version:u8 + body
+//   CltocsRead(1200): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                     offset:u32 size:u32 trace_id:u64
+//   CstoclReadData(1201): req_id:u32 chunk_id:u64 offset:u32 crc:u32
+//                         data:bytes
+//   CstoclReadStatus(1202): req_id:u32 chunk_id:u64 status:u8
+//   CltocsPrefetch(1205): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                         offset:u32 size:u32
+//   CltocsReadBulk(1206): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                         offset:u32 size:u32 trace_id:u64
+//   CstoclReadBulkData(1207): req_id:u32 chunk_id:u64 status:u8 offset:u32
+//                             crcs:list:u32 data:bytes
+//   CltocsWriteInit(1210): req_id:u32 chunk_id:u64 version:u32 part_id:u32
+//                          chain:list:msg:PartLocation create:bool
+//                          trace_id:u64
+//   CltocsWriteData(1211): req_id:u32 chunk_id:u64 write_id:u32 block:u32
+//                          offset:u32 crc:u32 data:bytes
+//   CstoclWriteStatus(1212): req_id:u32 chunk_id:u64 write_id:u32 status:u8
+//   CltocsWriteEnd(1213): req_id:u32 chunk_id:u64
+//   CltocsWriteBulk(1214): req_id:u32 chunk_id:u64 write_id:u32
+//                          part_offset:u32 crcs:list:u32 data:bytes
+//   CltocsWriteBulkPart(1215): req_id:u32 chunk_id:u64 write_id:u32
+//                              part_id:u32 part_offset:u32 crcs:list:u32
+//                              data:bytes
 //
 // On-disk chunk format (chunk_store.py, reference chunk.h:154-176):
 //   chunk_<id:016X>_<version:08X>.liz inside <id&0xFF:02X>/ subfolders:

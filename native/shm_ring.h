@@ -29,7 +29,7 @@
 //                             enforces the same same-uid gate]
 //   CltocsShmWritePart(1217): req_id:u32 chunk_id:u64 write_id:u32
 //                             part_id:u32 part_offset:u32 ring_off:u64
-//                             length:u32 crcs(u32 count + u32 each)
+//                             length:u32 crcs:list:u32
 //   ack = CstoclWriteStatus  (1212), exactly as for 1214/1215 frames.
 //
 // Kill switch: LZ_SHM_RING=0 disables both the client attempt and the

@@ -37,7 +37,16 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        # racehunt mode (tools/racehunt.py): LZ_DETSCHED=<seed> runs
+        # every async test under the seeded deterministic event loop so
+        # each seed explores one reproducible interleaving
+        from lizardfs_tpu.runtime import detsched
+
+        seed = detsched.detsched_seed()
+        if seed is not None:
+            detsched.run(fn(**kwargs), seed=seed)
+        else:
+            asyncio.run(fn(**kwargs))
         return True
     return None
 

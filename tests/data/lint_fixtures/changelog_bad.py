@@ -1,0 +1,52 @@
+"""Known-bad metadata store for the changelog-durability checker: each
+op violates one leg of the durability checklist."""
+
+import os
+import time
+
+
+class BadStore:
+    def __init__(self):
+        self.fs = {}
+        self.ephemeral = {}  # never persisted
+        self._digest = 0
+
+    def apply(self, op):
+        getattr(self, "_op_" + op["op"])(op)
+
+    # compliant baseline: digest-named, persisted, deterministic
+    def _op_covered(self, op):
+        self.fs[op["k"]] = op["v"]
+
+    # not in _touched and no self._digest maintenance
+    def _op_uncovered(self, op):
+        self.fs[op["k"]] = op["v"]
+
+    # reads the wall clock: shadow replay diverges
+    def _op_wallclock(self, op):
+        self.fs[op["k"]] = time.time()
+
+    # reads the environment through the attribute-chain spelling the
+    # bare `os.getenv` rule used to miss
+    def _op_envy(self, op):
+        self.fs[op["k"]] = os.environ.get("HOSTNAME", "")
+
+    # mutates a store to_sections/load_sections never carry
+    def _op_leaky(self, op):
+        self.ephemeral[op["k"]] = 1
+
+    # async op: apply() is synchronous by contract
+    async def _op_sleepy(self, op):
+        self.fs[op["k"]] = 1
+
+    def to_sections(self):
+        return {"fs": dict(self.fs)}
+
+    def load_sections(self, doc):
+        self.fs = dict(doc["fs"])
+
+    def _touched(self, op):
+        t = op["op"]
+        if t in ("covered", "wallclock", "leaky", "sleepy", "envy"):
+            return {("fs", op["k"])}
+        return set()

@@ -1,0 +1,11 @@
+"""Commit site with a typo'd op literal: no _op_ method matches, so the
+live master would raise mid-mutation."""
+
+
+class Server:
+    def commit(self, op):
+        raise NotImplementedError
+
+    def handle(self):
+        self.commit({"op": "putt", "k": 1, "v": 2})  # typo: no _op_putt
+        self.commit({"op": "put", "k": 1, "v": 2})   # fine

@@ -1,0 +1,44 @@
+"""Known-good metadata store for the changelog-durability checker: the
+compliant idioms — _touched dispatch coverage, a self-maintained-digest
+bulk op, shared mutation helpers, full persistence."""
+
+
+class GoodStore:
+    def __init__(self):
+        self.fs = {}
+        self.tape = {}
+        self._digest = 0
+
+    def apply(self, op):
+        getattr(self, "_op_" + op["op"])(op)
+
+    def _op_put(self, op):
+        self.fs[op["k"]] = op["v"]
+
+    def _op_drop(self, op):
+        # mutation via a shared helper (the _release_one pattern)
+        self._forget(op["k"])
+
+    def _op_bulk(self, op):
+        # synth_populate pattern: maintains the digest itself
+        for i in range(op["count"]):
+            self.fs[i] = 0
+            self._digest ^= i
+        self.tape[op["count"]] = 1
+
+    def _forget(self, k):
+        self.fs.pop(k, None)
+        self.tape.pop(k, None)
+
+    def to_sections(self):
+        return {"fs": dict(self.fs), "tape": dict(self.tape)}
+
+    def load_sections(self, doc):
+        self.fs = dict(doc["fs"])
+        self.tape = dict(doc["tape"])
+
+    def _touched(self, op):
+        t = op["op"]
+        if t in ("put", "drop"):
+            return {("fs", op["k"])}
+        return set()

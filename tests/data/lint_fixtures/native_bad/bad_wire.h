@@ -1,0 +1,22 @@
+// Known-bad native wire half for the native-wire checker fixtures.
+// Each block drifts from native_wire_msgs.py in a distinct way.
+#pragma once
+
+// value names no catalog message (catalog says CltocsPing = 9301)
+constexpr uint32_t kTypePing = 9309;
+
+// value exists but belongs to CltocsPing, not anything named *Quack
+constexpr uint32_t kTypeQuack = 9301;
+
+// spoken (constant above) with a layout whose field name drifted:
+//   CstoclPong(9302): req_id:u32 code:u8
+constexpr uint32_t kTypePong = 9302;
+
+// status constant disagrees with proto/status.py (OK = 0)
+constexpr uint8_t stOK = 1;
+
+// boolean switch read without the four off spellings nearby
+inline bool uds_off_bad() {
+    const char* v = getenv("LZ_NO_UDS");
+    return v != nullptr;
+}

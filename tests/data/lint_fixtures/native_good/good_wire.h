@@ -1,0 +1,21 @@
+// Known-good native wire half: constants, layouts, status codes, and
+// switch spelling parity all agree with native_wire_msgs.py.
+#pragma once
+
+// Wire layouts (checked against the fixture catalog; the optional
+// skew tail may be omitted — it is declared here for completeness):
+//   CltocsPing(9301): req_id:u32 payload:bytes
+//   CstoclPong(9302): req_id:u32 status:u8 trace_id:u64
+constexpr uint32_t kTypePing = 9301;
+constexpr uint32_t kTypePong = 9302;
+
+constexpr uint8_t stOK = 0;
+constexpr uint8_t stCRC_ERROR = 20;
+
+// four-spelling parity, the env_flag contract mirrored C-side
+inline bool uds_off_good() {
+    const char* v = getenv("LZ_NO_UDS");
+    if (v == nullptr) return false;
+    return strcmp(v, "0") != 0 && strcmp(v, "off") != 0 &&
+           strcmp(v, "false") != 0 && strcmp(v, "no") != 0;
+}

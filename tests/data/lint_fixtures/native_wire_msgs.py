@@ -1,0 +1,20 @@
+"""Mini message catalog for the native-wire fixture pair."""
+
+
+class Message:  # stand-in base so the fixture parses standalone
+    pass
+
+
+class CltocsPing(Message):
+    MSG_TYPE = 9301
+    FIELDS = (("req_id", "u32"), ("payload", "bytes"))
+
+
+class CstoclPong(Message):
+    MSG_TYPE = 9302
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("trace_id", "u64"),
+    )

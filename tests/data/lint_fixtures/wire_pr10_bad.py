@@ -1,0 +1,44 @@
+"""Known-bad PR-10-era wire surface: tape/S3 convention fields placed
+as required payload. Each class violates the extended (scoped)
+convention inventory in a distinct way."""
+
+
+class Message:  # stand-in base so the fixture parses standalone
+    pass
+
+
+class TstomaRegister(Message):
+    # session_id is a SCOPED convention field on this message (the
+    # tape server's cluster-client session, added in PR 10): required
+    # mid-message, a legacy tape server's shorter register frame
+    # misaligns capacity
+    MSG_TYPE = 9201
+    FIELDS = (
+        ("req_id", "u32"),
+        ("session_id", "u32"),
+        ("label", "str"),
+        ("capacity", "u64"),
+    )
+
+
+class CltomaTapeRecall(Message):
+    # meta_version is globally convention-optional: riding it required
+    # mid-request breaks every pre-PR-7 client
+    MSG_TYPE = 9202
+    FIELDS = (
+        ("req_id", "u32"),
+        ("meta_version", "u64"),
+        ("inode", "u32"),
+    )
+
+
+class MatoclTapeStatusReply(Message):
+    # S3-era reply grew its consistency token without a skew marker:
+    # old masters' shorter encoding fails the decode instead of
+    # default-filling
+    MSG_TYPE = 9203
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("meta_version", "u64"),
+    )

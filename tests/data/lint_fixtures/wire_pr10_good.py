@@ -1,0 +1,35 @@
+"""Known-good PR-10-era wire surface: the tape/S3 messages exactly as
+the live catalog rides them — scoped and global convention fields in
+skew-tolerant trailing position."""
+
+
+class Message:  # stand-in base so the fixture parses standalone
+    pass
+
+
+class TstomaRegister(Message):
+    # session_id trailing + skew-covered: legacy sid-0 tape servers
+    # keep working (the scoped-inventory compliant shape)
+    MSG_TYPE = 9211
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (
+        ("req_id", "u32"),
+        ("label", "str"),
+        ("capacity", "u64"),
+        ("session_id", "u32"),
+    )
+
+
+class CltomaTapeRecall(Message):
+    MSG_TYPE = 9212
+    FIELDS = (("req_id", "u32"), ("inode", "u32"))
+
+
+class MatoclTapeStatusReply(Message):
+    MSG_TYPE = 9213
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("status", "u8"),
+        ("meta_version", "u64"),
+    )

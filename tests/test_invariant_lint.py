@@ -300,16 +300,21 @@ def test_targeted_run_does_not_clobber_full_cache(tmp_path):
     def cfg(paths, rules=None):
         return _cfg(paths, rules, use_cache=True, cache_path=str(cache))
 
+    def file_keys(entries, fp):
+        # global-pass results (//global/<rule>) share the dict; the
+        # per-FILE slice is what must survive targeted runs
+        return {k for k in entries[fp] if not k.startswith("//global/")}
+
     run_lint(cfg([str(a), str(b)]))  # full run: both files cached
     full_fp = next(iter(json.loads(cache.read_text())["entries"]))
     run_lint(cfg([str(a)]))  # targeted run, same rules fingerprint
     entries = json.loads(cache.read_text())["entries"]
-    assert set(entries[full_fp]) == {
+    assert file_keys(entries, full_fp) == {
         os.path.relpath(str(a), REPO), os.path.relpath(str(b), REPO)
     }
     run_lint(cfg([str(a)], ["cross-await-race"]))  # different fingerprint
     entries = json.loads(cache.read_text())["entries"]
-    assert len(entries[full_fp]) == 2  # full-tree slice survived
+    assert len(file_keys(entries, full_fp)) == 2  # full-tree slice survived
 
 
 def test_cli_exit_codes(tmp_path, capsys):
@@ -369,3 +374,327 @@ def test_shadow_reads_switch_rides_env_flag(monkeypatch):
     assert shadow_reads_enabled() is False
     monkeypatch.delenv("LZ_SHADOW_READS", raising=False)
     assert shadow_reads_enabled() is True
+
+
+# --------------------------------------------------------------------------
+# wire-skew: PR-10 scoped convention fields
+# --------------------------------------------------------------------------
+
+
+def test_wire_pr10_bad_catalog_flags_tape_era_fields():
+    result = run_lint(_cfg(
+        [_fx("wire_pr10_bad.py")], ["wire-skew"],
+        messages_path=_fx("wire_pr10_bad.py"),
+    ))
+    msgs = "\n".join(f.message for f in result.unwaived)
+    for expected in (
+        "TstomaRegister.session_id",       # scoped convention pair
+        "CltomaTapeRecall.meta_version",   # global convention name
+        "MatoclTapeStatusReply.meta_version",
+    ):
+        assert expected in msgs, f"missing: {expected}\ngot:\n{msgs}"
+
+
+def test_wire_pr10_good_catalog_is_clean():
+    result = run_lint(_cfg(
+        [_fx("wire_pr10_good.py")], ["wire-skew"],
+        messages_path=_fx("wire_pr10_good.py"),
+    ))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_scoped_convention_does_not_leak_to_other_messages():
+    """session_id stays required payload in CltomaRegister and friends:
+    the scoped pair must not flag the live catalog."""
+    result = run_lint(_cfg(
+        [os.path.join(REPO, "lizardfs_tpu", "proto", "messages.py")],
+        ["wire-skew"],
+        messages_path=os.path.join(
+            REPO, "lizardfs_tpu", "proto", "messages.py"
+        ),
+    ))
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+
+
+# --------------------------------------------------------------------------
+# changelog-durability
+# --------------------------------------------------------------------------
+
+
+def _cl_cfg(paths, store, **kw):
+    kw.setdefault("use_cache", False)
+    return LintConfig(
+        root=REPO, paths=paths, rules=["changelog-durability"],
+        metadata_path=store, **kw,
+    )
+
+
+def test_changelog_bad_store_flags_every_leg():
+    result = run_lint(_cl_cfg([], _fx("changelog_bad.py")))
+    msgs = "\n".join(f.message for f in result.unwaived)
+    for expected in (
+        "op 'uncovered': no incremental-digest coverage",
+        "op 'wallclock': calls time.time()",
+        "op 'envy': calls os.environ.get()",
+        "op 'leaky': touches self.ephemeral",
+        "op 'sleepy': async op method",
+    ):
+        assert expected in msgs, f"missing: {expected}\ngot:\n{msgs}"
+    # the compliant baseline op contributes no findings
+    assert "op 'covered'" not in msgs
+
+
+def test_changelog_commit_typo_flags():
+    result = run_lint(
+        _cl_cfg([_fx("changelog_commit_bad.py")], _fx("changelog_good.py"))
+    )
+    msgs = [f.message for f in result.unwaived]
+    assert any("op literal 'putt' has no _op_putt" in m for m in msgs), msgs
+    assert not any("'put'" in m and "putt" not in m for m in msgs)
+
+
+def test_changelog_good_store_is_clean():
+    result = run_lint(_cl_cfg([], _fx("changelog_good.py")))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_changelog_test_naming_leg(tmp_path):
+    """An op no test file names is a finding; naming it clears it."""
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_ops.py").write_text(
+        'OPS = ["put", "bulk"]\n', encoding="utf-8"
+    )
+    result = run_lint(
+        _cl_cfg([], _fx("changelog_good.py"), tests_dir=str(tdir))
+    )
+    msgs = [f.message for f in result.unwaived]
+    assert any("op 'drop': no test under tests/ names it" in m
+               for m in msgs), msgs
+    (tdir / "test_ops.py").write_text(
+        'OPS = ["put", "bulk", "drop"]\n', encoding="utf-8"
+    )
+    result = run_lint(
+        _cl_cfg([], _fx("changelog_good.py"), tests_dir=str(tdir))
+    )
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+
+
+# --------------------------------------------------------------------------
+# native-wire
+# --------------------------------------------------------------------------
+
+
+def _nw_cfg(native_dir, **kw):
+    kw.setdefault("use_cache", False)
+    kw.setdefault("messages_path", _fx("native_wire_msgs.py"))
+    kw.setdefault(
+        "status_path",
+        os.path.join(REPO, "lizardfs_tpu", "proto", "status.py"),
+    )
+    kw.setdefault(
+        "framing_path",
+        os.path.join(REPO, "lizardfs_tpu", "proto", "framing.py"),
+    )
+    return LintConfig(
+        root=REPO, paths=[], rules=["native-wire"],
+        native_dir=native_dir, **kw,
+    )
+
+
+def test_native_wire_bad_flags_every_drift_class():
+    result = run_lint(_nw_cfg(_fx("native_bad")))
+    msgs = "\n".join(f.message for f in result.unwaived)
+    for expected in (
+        "kTypePing = 9309: no catalog message declares MSG_TYPE 9309",
+        "kTypeQuack = 9301 but MSG_TYPE 9301 belongs to CltocsPing",
+        "layout CstoclPong: field 1 is 'code', catalog says 'status'",
+        "stOK = 1 but proto/status.py says OK = 0",
+        'getenv("LZ_NO_UDS"): boolean switch read without the full '
+        "off-spelling set",
+    ):
+        assert expected in msgs, f"missing: {expected}\ngot:\n{msgs}"
+
+
+def test_native_wire_good_is_clean():
+    result = run_lint(_nw_cfg(_fx("native_good")))
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_native_wire_real_tree_is_clean():
+    cfg = LintConfig.for_tree(REPO, rules=["native-wire"], use_cache=False)
+    result = run_lint(cfg)
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+
+
+# --------------------------------------------------------------------------
+# telemetry-coverage
+# --------------------------------------------------------------------------
+
+
+def _tc_cfg(**kw):
+    cfg = LintConfig.for_tree(REPO, rules=["telemetry-coverage"],
+                              use_cache=False)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_telemetry_real_tree_is_clean():
+    result = run_lint(_tc_cfg())
+    assert not result.unwaived, [f.render() for f in result.unwaived]
+
+
+def test_telemetry_new_verb_without_entry_flags():
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    waivers = dict(tc.SLO_WAIVERS)
+    del waivers["CltomaLookup"]
+    result = run_lint(_tc_cfg(tc_slo_waivers=waivers))
+    msgs = [f.message for f in result.unwaived]
+    assert any(
+        "CltomaLookup: client-facing verb with no telemetry inventory"
+        in m for m in msgs
+    ), msgs
+
+
+def test_telemetry_reasonless_waiver_flags():
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    waivers = dict(tc.SLO_WAIVERS)
+    waivers["CltomaLookup"] = "  "
+    result = run_lint(_tc_cfg(tc_slo_waivers=waivers))
+    msgs = [f.message for f in result.unwaived]
+    assert any("SLO waiver with no reason" in m for m in msgs), msgs
+
+
+def test_telemetry_unknown_slo_class_flags():
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    classes = dict(tc.SLO_CLASSES)
+    classes["CltomaReadChunk"] = "warp-speed"
+    result = run_lint(_tc_cfg(tc_slo_classes=classes))
+    msgs = [f.message for f in result.unwaived]
+    assert any("'warp-speed' which runtime/slo.py OP_CLASSES" in m
+               for m in msgs), msgs
+
+
+def test_telemetry_unclaimed_fault_site_flags():
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    sites = dict(tc.VERB_SITES)
+    sites["CltomaReadChunk"] = "bogus_site"
+    result = run_lint(_tc_cfg(tc_verb_sites=sites))
+    msgs = [f.message for f in result.unwaived]
+    assert any("'bogus_site' is not in" in m for m in msgs), msgs
+
+
+def test_telemetry_missing_surface_file_is_a_finding():
+    """A renamed/deleted surface file must fail lint, not vacuously
+    pass every check the inventory makes about it."""
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    anchors = tc.ANCHORS + (
+        ("lizardfs_tpu/master/server_moved_away.py", r"x",
+         "instrument on a moved surface"),
+    )
+    result = run_lint(_tc_cfg(tc_anchors=anchors))
+    msgs = [f.message for f in result.unwaived]
+    assert any("surface file is missing/unreadable" in m
+               for m in msgs), msgs
+
+
+def test_telemetry_deleted_instrument_flags():
+    from lizardfs_tpu.tools.lint import telemetry as tc
+
+    anchors = tc.ANCHORS + (
+        (tc.MASTER, r"this_instrument_does_not_exist\(",
+         "a hypothetical removed instrument"),
+    )
+    result = run_lint(_tc_cfg(tc_anchors=anchors))
+    msgs = [f.message for f in result.unwaived]
+    assert any("missing instrument: a hypothetical removed instrument"
+               in m for m in msgs), msgs
+
+
+# --------------------------------------------------------------------------
+# engine: global-results cache + non-Python input staleness
+# --------------------------------------------------------------------------
+
+
+def test_native_edit_invalidates_global_cache(tmp_path, monkeypatch):
+    """The satellite regression: per-file cache keys are Python content
+    hashes, so the native-wire pass caches its results under a key that
+    fingerprints the C sources too — editing native/wire.h must re-run
+    it, while an untouched tree serves the cached verdict."""
+    import shutil
+
+    from lizardfs_tpu.tools.lint import native_wire
+
+    native = tmp_path / "native"
+    native.mkdir()
+    shutil.copy(_fx("native_good") + "/good_wire.h", native / "w.h")
+    cfg = _nw_cfg(str(native), use_cache=True,
+                  cache_path=str(tmp_path / "cache.json"))
+    assert not run_lint(cfg).unwaived
+
+    real_check = native_wire.check_global
+    calls = []
+
+    def counting_check(cfg_, collections):
+        calls.append(1)
+        return real_check(cfg_, collections)
+
+    monkeypatch.setattr(native_wire, "check_global", counting_check)
+    assert not run_lint(cfg).unwaived
+    assert calls == []  # warm verdict served from the cache
+
+    # drift the C half: the cached entry must NOT survive
+    text = (native / "w.h").read_text().replace(
+        "kTypePing = 9301", "kTypePing = 9309"
+    )
+    (native / "w.h").write_text(text)
+    result = run_lint(cfg)
+    assert calls == [1]  # the pass really re-ran
+    assert any("9309" in f.message for f in result.unwaived)
+
+
+def test_global_cache_still_applies_waivers(tmp_path):
+    """Cached global findings re-enter waiver matching each run: a
+    waiver added AFTER the cache was written must still suppress."""
+    store = tmp_path / "store.py"
+    import shutil
+
+    shutil.copy(_fx("changelog_bad.py"), store)
+    # the store rides cfg.paths too (as metadata.py does in the real
+    # tree) so its waiver comments are collected
+    cfg = _cl_cfg([str(store)], str(store), use_cache=True,
+                  cache_path=str(tmp_path / "cache.json"))
+    first = run_lint(cfg)
+    assert first.unwaived
+    # waive the async-op finding on its line
+    lines = store.read_text().splitlines()
+    idx = next(i for i, ln in enumerate(lines)
+               if "async def _op_sleepy" in ln)
+    lines[idx] += ("  # lint: waive(changelog-durability): "
+                   "fixture pins the async-op finding")
+    store.write_text("\n".join(lines) + "\n")
+    second = run_lint(cfg)
+    assert len(second.unwaived) == len(first.unwaived) - 1
+    assert any(f.waived and "sleepy" in f.message for f in second.findings)
+
+
+def test_warm_lint_under_200ms():
+    """The warm-cache budget the lint gate promises: a second run over
+    an unchanged tree (per-file AND global results cached) finishes in
+    <= 0.2 s in-process."""
+    import time as _time
+
+    cfg = LintConfig.for_tree(REPO)
+    cfg.cache_path = os.path.join(REPO, ".lint-cache.json")
+    run_lint(cfg)  # prime
+    t0 = _time.perf_counter()
+    result = run_lint(cfg)
+    dt = _time.perf_counter() - t0
+    assert result.files > 50  # really the whole tree
+    assert dt <= 0.2, f"warm lint took {dt:.3f}s"
