@@ -86,8 +86,16 @@ s3-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_s3.py -q -k smoke \
 	  -p no:cacheprovider
 
+# top-smoke: boot a full observatory cluster (master + CS + both
+# gateways) in-process, drive traffic, and pin that `lizardfs-admin
+# top` attributes it to the right sessions (the `smoke`-named subset
+# of tests/test_top.py; the whole non-slow file rides tier-1 too)
+top-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_top.py -q -k smoke \
+	  -p no:cacheprovider
+
 native:
 	$(MAKE) -C native
 
 .PHONY: test lint metrics-lint racehunt check sanitize chaos chaos-slow \
-	s3-smoke native
+	s3-smoke top-smoke native

@@ -629,6 +629,142 @@ def box_health() -> dict:
     }
 
 
+# --- bench trajectory: round files + regression guard ----------------------
+#
+# Every run self-records its full row as BENCH_r<NN>.json (numbered past
+# the highest existing round file, parseable or not) and compares its
+# fiducials against the newest loadable previous round — the recorded
+# trajectory was empty before this because the driver-captured files
+# hold only a truncated stdout tail (r05's is cut mid-JSON).
+
+# round-over-round comparable fiducials by suffix; "value" compares only
+# when the metric row names the same kernel (tpu vs CPU-fallback rounds
+# are different experiments)
+_HIGHER_BETTER = ("_MBps", "_GBps", "_ops_per_s", "_list_ops")
+_LOWER_BETTER = ("_ms", "_us")
+
+# default tolerance before a delta flags as a regression: these boxes
+# are noisy (see box_health — the r02-r04 "drift" was hypervisor state),
+# so the guard flags order-of-magnitude story changes, not run jitter
+BENCH_DELTA_TOL = 0.25
+
+
+def _round_files(bench_dir):
+    """[(round number, path)] of every BENCH_r*.json, sorted."""
+    import glob
+    import os
+    import re
+
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        mt = re.search(r"BENCH_r(\d+)\.json$", path)
+        if mt:
+            out.append((int(mt.group(1)), path))
+    return sorted(out)
+
+
+def _row_from_tail(tail: str):
+    """Best-effort fiducial row from a driver-captured stdout tail:
+    the LAST parseable JSON object line wins (the summary line prints
+    last by design). A tail cut mid-JSON yields nothing."""
+    best = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            best = doc
+    return best
+
+
+def _load_prev_round(bench_dir):
+    """(round number, fiducial row) of the newest loadable previous
+    round, or None. Self-recorded files carry the full row under
+    "row"; driver-captured files are mined from their stdout tail."""
+    for n, path in reversed(_round_files(bench_dir)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        row = doc.get("row") if isinstance(doc.get("row"), dict) else None
+        if row is None and isinstance(doc.get("tail"), str):
+            row = _row_from_tail(doc["tail"])
+        if row:
+            return n, row
+    return None
+
+
+def bench_deltas(row: dict, prev: dict, tol: float = BENCH_DELTA_TOL):
+    """(per-fiducial delta %, regressed keys) vs a previous round.
+    Only direction-known scalar fiducials compare; a regression is a
+    move past ``tol`` in the bad direction."""
+    deltas: dict[str, float] = {}
+    regressions: list[str] = []
+    for key, new in row.items():
+        if isinstance(new, bool) or not isinstance(new, (int, float)):
+            continue
+        old = prev.get(key)
+        if isinstance(old, bool) or not isinstance(old, (int, float)):
+            continue
+        if old == 0:
+            continue
+        if key == "value":
+            if prev.get("metric") != row.get("metric"):
+                continue
+            higher, lower = True, False
+        else:
+            higher = key.endswith(_HIGHER_BETTER)
+            lower = key.endswith(_LOWER_BETTER)
+        if not higher and not lower:
+            continue
+        deltas[key] = round((new - old) / old * 100.0, 1)
+        if (higher and new < old * (1 - tol)) or (
+            lower and new > old * (1 + tol)
+        ):
+            regressions.append(key)
+    return deltas, sorted(regressions)
+
+
+def _bench_guard(row: dict, bench_dir: str) -> None:
+    """Compare against the newest loadable round, fold the verdict
+    into the row (summary carries ``bench_regressions``), print human
+    delta lines, and self-record this round's full row. Never fatal —
+    a broken trajectory must not kill the bench line."""
+    import os
+
+    try:
+        prev = _load_prev_round(bench_dir)
+        if prev is not None:
+            prev_n, prev_row = prev
+            deltas, regs = bench_deltas(row, prev_row)
+            row["bench_prev_round"] = prev_n
+            row["bench_deltas_pct"] = deltas
+            if regs:
+                row["bench_regressions"] = regs
+            for key in sorted(deltas):
+                flag = "  REGRESSION" if key in regs else ""
+                print(
+                    f"DELTA vs r{prev_n:02d}: {key} "
+                    f"{deltas[key]:+.1f}%{flag}"
+                )
+        files = _round_files(bench_dir)
+        n_next = (files[-1][0] + 1) if files else 1
+        path = os.path.join(bench_dir, f"BENCH_r{n_next:02d}.json")
+        with open(path, "w") as f:
+            json.dump({"n": n_next, "self_recorded": True, "row": row}, f,
+                      indent=1)
+            f.write("\n")
+    except Exception as e:  # noqa: BLE001
+        row["bench_guard_error"] = str(e)[:160]
+
+
 def main():
     tpu_rows, tpu_err, attempts = _tpu_throughput_guarded()
     value = tpu_rows.get("ok")
@@ -688,6 +824,11 @@ def main():
     except Exception as e:  # noqa: BLE001 — fiducials must not kill the line
         row["box_health_error"] = str(e)[:120]
     row.update(cluster_throughput())
+    # regression guard + round self-record (delta lines print before
+    # the JSON so the tail-surviving summary still lands last)
+    import os
+
+    _bench_guard(row, os.path.dirname(os.path.abspath(__file__)))
     # full row set first (humans, driver logs), then the durable copy on
     # disk, then the COMPACT summary as the very last stdout line: the
     # driver records only a ~2000-byte stdout tail, and r05's artifact
@@ -737,6 +878,10 @@ def _summary_row(row: dict) -> dict:
         # PUT/GET MB/s + listing ops rate (reps in BENCH_FULL.json)
         "cluster_s3_put_MBps", "cluster_s3_get_MBps",
         "cluster_s3_list_ops",
+        # bench-trajectory regression guard: which fiducials moved past
+        # tolerance vs the previous recorded round (full per-key delta
+        # map lives in BENCH_FULL.json / this round's BENCH_r file)
+        "bench_prev_round", "bench_regressions", "bench_guard_error",
     ):
         if key in row:
             s[key] = row[key]
@@ -821,6 +966,7 @@ SUMMARY_BUDGET_BYTES = 1900
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "cluster_locate_p99_ms",
+    "bench_regressions",
     "kernel_ladder",
     "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",
     # spreads are noise CONTEXT for the target verdicts, not verdicts:

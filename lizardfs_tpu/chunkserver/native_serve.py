@@ -50,6 +50,13 @@ if _lib is not None:
         except AttributeError:
             pass  # stale .so: per-op timing/trace channel stays off
         try:
+            _lib.lz_serve_trace2.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
+            ]
+            _lib.lz_serve_trace2.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: session attribution rides trace as 0
+        try:
             _lib.lz_serve_shm_stats.argtypes = [
                 ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
             ]
@@ -60,9 +67,10 @@ if _lib is not None:
         _lib = None
 
 
-# lz_serve_trace flattens one op to 8 u64 slots — keep in sync with
+# lz_serve_trace2 flattens one op to 9 u64 slots (the legacy
+# lz_serve_trace serves 8, eliding session_id) — keep in sync with
 # serve_native.cpp TraceOp
-TRACE_OP_SLOTS = 8
+TRACE_OP_SLOTS = 9
 _TRACE_KINDS = {1: "cs_read", 2: "cs_read_bulk", 4: "cs_write_bulk",
                 5: "cs_write_shm"}
 
@@ -128,15 +136,24 @@ class DataPlaneServer:
 
     def trace_ops(self, max_ops: int = 1024) -> list[dict]:
         """Drain the native per-op trace ring: one dict per traced op
-        with CLOCK_REALTIME second bounds (t0/t1) and accumulated
-        disk/net microseconds, ready to fold into a SpanRing."""
-        if not hasattr(_lib, "lz_serve_trace") or self._handle < 0:
+        with CLOCK_REALTIME second bounds (t0/t1), accumulated disk/net
+        microseconds, and (new .so) the originating session id, ready
+        to fold into a SpanRing + per-session accounting."""
+        if self._handle < 0:
             return []
-        out = (ctypes.c_uint64 * (TRACE_OP_SLOTS * max_ops))()
-        n = _lib.lz_serve_trace(self._handle, out, max_ops)
+        # version-skew tolerant drain: prefer the 9-slot channel (adds
+        # session_id), fall back to the legacy 8-slot one on a stale .so
+        if hasattr(_lib, "lz_serve_trace2"):
+            slots, fn = TRACE_OP_SLOTS, _lib.lz_serve_trace2
+        elif hasattr(_lib, "lz_serve_trace"):
+            slots, fn = 8, _lib.lz_serve_trace
+        else:
+            return []
+        out = (ctypes.c_uint64 * (slots * max_ops))()
+        n = fn(self._handle, out, max_ops)
         ops = []
         for i in range(n):
-            s = out[TRACE_OP_SLOTS * i : TRACE_OP_SLOTS * (i + 1)]
+            s = out[slots * i : slots * (i + 1)]
             ops.append({
                 "name": _TRACE_KINDS.get(int(s[0]), f"cs_op_{int(s[0])}"),
                 "trace_id": int(s[1]),
@@ -146,6 +163,7 @@ class DataPlaneServer:
                 "t1": s[5] / 1e6,
                 "disk_us": int(s[6]),
                 "net_us": int(s[7]),
+                "session_id": int(s[8]) if slots > 8 else 0,
             })
         return ops
 

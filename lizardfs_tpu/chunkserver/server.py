@@ -43,6 +43,7 @@ from lizardfs_tpu.core.encoder import get_encoder
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as faultsmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
@@ -58,11 +59,12 @@ class _WriteSession:
     """
 
     def __init__(self, chunk_id: int, version: int, part_id: int,
-                 trace_id: int = 0):
+                 trace_id: int = 0, session_id: int = 0):
         self.chunk_id = chunk_id
         self.version = version
         self.part_id = part_id
         self.trace_id = trace_id  # request trace from WriteInit
+        self.session_id = session_id  # originating client session
         self.downstream: tuple[asyncio.StreamReader, asyncio.StreamWriter] | None = None
         self.down_status: dict[int, int] = {}  # write_id -> status
         self.down_event: dict[int, asyncio.Event] = {}
@@ -107,6 +109,15 @@ class ChunkServer(Daemon):
         # its re-detection — persists)
         self.chunks_damaged = 0
         self._damaged_seen: set[tuple[int, int]] = set()
+        # per-session data-plane accounting (runtime/accounting.py):
+        # reads/writes charge the originating session carried by the
+        # request's trailing session_id; native-plane ops (no session
+        # on their frames) aggregate under the "native" row. The top-K
+        # summary folds into heartbeat health_json for the master's
+        # cluster-wide `top` view.
+        self.session_ops = accounting.SessionOps(
+            self.metrics, "chunkserver", max_sessions=16
+        )
         # (total, used) from the last heartbeat's store.space() so the
         # health snapshot doesn't re-stat the folders
         self._last_space: tuple[int, int] | None = None
@@ -508,6 +519,15 @@ class ChunkServer(Daemon):
                 op_class, max(op["t1"] - op["t0"], 0.0),
                 trace_id=op["trace_id"], name=op["name"],
             )
+            # the C plane parses the same trailing session_id the
+            # asyncio plane reads (wire.h additive-tail convention;
+            # lz_serve_trace2) — ops from legacy peers/stale .so land
+            # on the "native" aggregate row so totals stay truthful
+            self.session_ops.record(
+                op.get("session_id") or "native", op_class,
+                max(op["t1"] - op["t0"], 0.0),
+                nbytes=op["bytes"], trace_id=op["trace_id"],
+            )
 
     def trace_spans(self, trace_id: int | None = None) -> list[dict]:
         # pull whatever the native plane recorded since the last
@@ -525,8 +545,16 @@ class ChunkServer(Daemon):
         # of re-statting every data folder (snapshot and heartbeat run
         # back to back; the fallback covers ad-hoc admin `health`)
         total, used = self._last_space or self.store.space()
-        return {"cs_id": self.cs_id, "used_space": used,
-                "total_space": total}
+        extra = {"cs_id": self.cs_id, "used_space": used,
+                 "total_space": total}
+        # per-session data-plane top-K rides the heartbeat health_json
+        # (skew-tolerant: old masters ignore the key) so the master's
+        # `top` rollup owns the cluster-wide byte attribution; empty
+        # under LZ_TOP=0 — the heartbeat stays byte-identical
+        sessions = self.session_ops.top(8)
+        if sessions:
+            extra["sessions"] = sessions
+        return extra
 
     async def _test_chunks(self) -> None:
         """Chunk tester (hdd_test_chunk analog): rotate through every
@@ -841,6 +869,10 @@ class ChunkServer(Daemon):
                     self.slo.observe(
                         "read", dt, trace_id=msg.trace_id, name="cs_read"
                     )
+                    self.session_ops.record(
+                        msg.session_id or "unattributed", "read", dt,
+                        nbytes=msg.size, trace_id=msg.trace_id,
+                    )
                 elif isinstance(msg, m.CltocsReadBulk):
                     t0 = time.perf_counter()
                     tw0 = time.time()
@@ -855,6 +887,10 @@ class ChunkServer(Daemon):
                     self.slo.observe(
                         "read", dt, trace_id=msg.trace_id,
                         name="cs_read_bulk",
+                    )
+                    self.session_ops.record(
+                        msg.session_id or "unattributed", "read", dt,
+                        nbytes=msg.size, trace_id=msg.trace_id,
                     )
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
@@ -1033,9 +1069,13 @@ class ChunkServer(Daemon):
             session.trace_id, "cs_write_shm", tw0, time.time(),
             role="chunkserver", bytes=msg.length,
         )
+        dt = time.perf_counter() - t0
         self.slo.observe(
-            "write", time.perf_counter() - t0, trace_id=session.trace_id,
-            name="cs_write_shm",
+            "write", dt, trace_id=session.trace_id, name="cs_write_shm"
+        )
+        self.session_ops.record(
+            session.session_id or "unattributed", "write", dt,
+            nbytes=msg.length, trace_id=session.trace_id,
         )
         await ack(code)
 
@@ -1322,7 +1362,8 @@ class ChunkServer(Daemon):
 
     async def _serve_write_init(self, writer, msg: m.CltocsWriteInit, sessions):
         session = _WriteSession(
-            msg.chunk_id, msg.version, msg.part_id, trace_id=msg.trace_id
+            msg.chunk_id, msg.version, msg.part_id, trace_id=msg.trace_id,
+            session_id=msg.session_id,
         )
         code = st.OK
         try:
@@ -1357,6 +1398,7 @@ class ChunkServer(Daemon):
                         chain=msg.chain[1:],
                         create=msg.create,
                         trace_id=msg.trace_id,
+                        session_id=msg.session_id,
                     ),
                 )
                 reply = await retrymod.bounded_wait(
@@ -1559,9 +1601,13 @@ class ChunkServer(Daemon):
             session.trace_id, "cs_write_bulk", tw0, time.time(),
             role="chunkserver", bytes=len(msg.data),
         )
+        dt = time.perf_counter() - t0
         self.slo.observe(
-            "write", time.perf_counter() - t0, trace_id=session.trace_id,
-            name="cs_write_bulk",
+            "write", dt, trace_id=session.trace_id, name="cs_write_bulk"
+        )
+        self.session_ops.record(
+            session.session_id or "unattributed", "write", dt,
+            nbytes=len(msg.data), trace_id=session.trace_id,
         )
         await ack(code)
 

@@ -41,6 +41,7 @@ from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
+from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as _faults
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
@@ -290,6 +291,16 @@ class Client:
         # fault-injection fires attributed to the client role land in
         # this registry (faults_injected{site,action})
         _faults.attach_metrics("client", self.metrics)
+        # per-session op accounting (runtime/accounting.py): LOGICAL
+        # reads/writes charge exactly once at the public-API boundary —
+        # replica fallbacks, transient retries, and RMW retry loops are
+        # implementation detail below this line (the PR-7 double-count
+        # class, pinned across detsched seeds in test_op_accounting).
+        # Gateways share this registry, so their per-session view rides
+        # whatever exporter embeds the client.
+        self.session_ops = accounting.SessionOps(
+            self.metrics, "client", max_sessions=8
+        )
         self._replica: RpcConnection | None = None
         self._replica_addr: tuple[str, int] | None = None
         self._replica_retry_at = 0.0
@@ -442,6 +453,10 @@ class Client:
                 self.current_master_addr = addr  # failover moves this
                 # lint: waive(cross-await-race): every caller holds _conn_lock (connect/_reconnect) — the handshake is single-flight and adopts the server-issued id
                 self.session_id = reply.session_id
+                # the identity this process's data-plane requests carry
+                # (CltocsRead/WriteInit trailing session_id): module-
+                # global because read_executor is module functions
+                accounting.set_process_session(self.session_id)
                 # the primary's position at registration seeds the
                 # monotonic-reads floor: a replica must be at least
                 # this caught up before any of its replies are accepted
@@ -1240,6 +1255,10 @@ class Client:
         tid, fresh_trace = tracing.begin()
         tw0 = _time.time()
         try:
+            # every chunk task spawned below copies this context — the
+            # native scatter path reads the session from it in-task
+            session_ctx = accounting.task_session(self.session_id)
+            session_ctx.__enter__()
             old_length = (await self.getattr(inode)).length
             self.trace_ring.record(
                 tid, "getattr", tw0, _time.time(), role="client"
@@ -1307,7 +1326,18 @@ class Client:
                 tid, "write_file", tw0, _time.time(), role="client",
                 bytes=total,
             )
+            # ONE logical write == ONE accounting record, regardless of
+            # how many transient retries the chunks above burned
+            self.session_ops.record(
+                self.session_id, "write",
+                _time.perf_counter() - wall_t0, nbytes=total, trace_id=tid,
+            )
         finally:
+            # manual __enter__/__exit__ pair: the session scope must
+            # cover the whole body without re-indenting it under a
+            # second with-block (tokens reset in reverse order, same
+            # task, so pairing across the try/finally is sound)
+            session_ctx.__exit__(None, None, None)
             tracing.end(fresh_trace)
 
     async def pwrite(self, inode: int, offset: int, data: bytes | np.ndarray) -> None:
@@ -1325,6 +1355,10 @@ class Client:
         tid, fresh_trace = tracing.begin()
         tw0 = _time.time()
         try:
+            # session scope for the RMW read-backs + native write path
+            # (paired __exit__ in the finally, as in write_file)
+            session_ctx = accounting.task_session(self.session_id)
+            session_ctx.__enter__()
             old_length = (await self.getattr(inode)).length
             end = offset + len(data)
             pos = offset
@@ -1346,7 +1380,15 @@ class Client:
                 tid, "pwrite", tw0, _time.time(), role="client",
                 bytes=len(data),
             )
+            # one logical pwrite counts once — RMW retries inside
+            # _pwrite_chunk are implementation detail
+            self.session_ops.record(
+                self.session_id, "write",
+                _time.perf_counter() - wall_t0, nbytes=len(data),
+                trace_id=tid,
+            )
         finally:
+            session_ctx.__exit__(None, None, None)
             tracing.end(fresh_trace)
 
     async def _pwrite_chunk(
@@ -2313,6 +2355,7 @@ class Client:
                     part_id=head.part_id,
                     chain=chain,
                     create=False,
+                    session_id=self.session_id,
                 ),
             )
             # every reply wait is deadline-bounded (unbounded-await
@@ -2376,6 +2419,24 @@ class Client:
     # --- read path ---------------------------------------------------------------------
 
     async def read_file(self, inode: int, offset: int = 0, size: int | None = None) -> bytes:
+        t0 = _time.perf_counter()
+        tid, fresh_trace = tracing.begin()
+        try:
+            with accounting.task_session(self.session_id):
+                data = await self._read_file_inner(inode, offset, size)
+        finally:
+            tracing.end(fresh_trace)
+        # ONE logical read == ONE accounting record: replica fallbacks
+        # and dead-holder retries below this line never double-count
+        self.session_ops.record(
+            self.session_id, "read", _time.perf_counter() - t0,
+            nbytes=len(data), trace_id=tid,
+        )
+        return data
+
+    async def _read_file_inner(
+        self, inode: int, offset: int, size: int | None
+    ) -> bytes:
         if size is not None and size > 0:
             ci = offset // MFSCHUNKSIZE
             if (offset + size - 1) // MFSCHUNKSIZE == ci:
@@ -2415,9 +2476,14 @@ class Client:
             if end <= offset:
                 return 0
             n = end - offset
-            await self._read_into(inode, offset, out[:n], length)
+            with accounting.task_session(self.session_id):
+                await self._read_into(inode, offset, out[:n], length)
             self.trace_ring.record(
                 tid, "read_file", tw0, _time.time(), role="client", bytes=n
+            )
+            self.session_ops.record(
+                self.session_id, "read", _time.time() - tw0, nbytes=n,
+                trace_id=tid,
             )
             return n
         finally:

@@ -27,6 +27,7 @@ from lizardfs_tpu.core import native as _native_lib
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
 
 # exchanges smaller than this stay on the asyncio path
 NATIVE_READ_THRESHOLD = 128 * 1024
@@ -90,6 +91,11 @@ if _lib is not None:
             _lib.lz_trace_set.restype = None
         except AttributeError:
             pass  # stale .so: native requests stay untraced
+        try:
+            _lib.lz_session_set.argtypes = [ctypes.c_uint64]
+            _lib.lz_session_set.restype = None
+        except AttributeError:
+            pass  # stale .so: native requests stay session-less
         try:
             _lib.lz_write_parts_scatterv.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint32,
@@ -522,14 +528,19 @@ async def run(fn, *args):
 
 
 def partial_with_trace(fn, *args):
-    """``functools.partial`` carrying the caller's trace id into the
-    executor thread — for call sites that need raw run_in_executor
-    (shield/abort-cell patterns) instead of :func:`run`."""
+    """``functools.partial`` carrying the caller's trace id AND wire
+    session into the executor thread — for call sites that need raw
+    run_in_executor (shield/abort-cell patterns) instead of
+    :func:`run`. Both are captured HERE, in the calling task, because
+    neither contextvars nor the task's session scope reach an executor
+    thread."""
     from lizardfs_tpu.runtime import tracing
 
     trace_id = tracing.current_trace_id()
     if trace_id:
-        return functools.partial(_traced_call, trace_id, fn, *args)
+        return functools.partial(
+            _traced_call, trace_id, accounting.wire_session(), fn, *args
+        )
     return functools.partial(fn, *args)
 
 
@@ -542,11 +553,17 @@ def _thread_trace_id() -> int:
     return getattr(_TRACE_TL, "trace_id", 0)
 
 
-def _traced_call(trace_id, fn, *args):
+def _traced_call(trace_id, session_id, fn, *args):
     _TRACE_TL.trace_id = trace_id
     has_c = _lib is not None and hasattr(_lib, "lz_trace_set")
+    # the caller's session rides next to the trace (per-session op
+    # accounting on the chunkserver); a stale .so simply lacks the
+    # setter and frames stay session-less
+    has_sess = _lib is not None and hasattr(_lib, "lz_session_set")
     if has_c:
         _lib.lz_trace_set(trace_id)
+    if has_sess:
+        _lib.lz_session_set(session_id)
     try:
         return fn(*args)
     finally:
@@ -555,6 +572,8 @@ def _traced_call(trace_id, fn, *args):
         _TRACE_TL.trace_id = 0
         if has_c:
             _lib.lz_trace_set(0)
+        if has_sess:
+            _lib.lz_session_set(0)
 
 
 async def run_serve(fn, *args):
@@ -734,6 +753,7 @@ def write_part_blocking(
                     req_id=1, chunk_id=chunk_id, version=version,
                     part_id=part_id, chain=chain, create=False,
                     trace_id=_thread_trace_id(),
+                    session_id=accounting.wire_session(),
                 )
             )
         )
@@ -960,6 +980,7 @@ def _send_write_init(sock: socket.socket, chunk_id: int, version: int,
         req_id=1, chunk_id=chunk_id, version=version,
         part_id=part_id, chain=[], create=False,
         trace_id=_thread_trace_id(),
+        session_id=accounting.wire_session(),
     )))
 
 

@@ -20,6 +20,7 @@ from lizardfs_tpu.ops import crc32 as crc_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as _faults
 from lizardfs_tpu.runtime import tracing
 
@@ -138,6 +139,10 @@ async def read_part_range(
                 offset=offset,
                 size=size,
                 trace_id=tracing.current_trace_id(),
+                # per-session attribution on the chunkserver: the
+                # process-wide session identity (accounting.py), the
+                # module-function analog of the thread-local trace id
+                session_id=accounting.wire_session(),
             ),
         )
         received = 0

@@ -39,9 +39,41 @@ from lizardfs_tpu.master import rebuild as rebuild_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
+
+
+# client RPC -> op class for the per-session accounting the `top` view
+# aggregates: chunk-grant RPCs split read/write (the latency-critical
+# classes), namespace traffic splits by mutation, session/control
+# chatter stays out of the hot classes
+_OP_CLASS_READ = frozenset({
+    "CltomaLookup", "CltomaGetattr", "CltomaReaddir", "CltomaReadlink",
+    "CltomaAccess", "CltomaStatFs", "CltomaGetXattr", "CltomaListXattr",
+    "CltomaGetQuota", "CltomaGetAcl", "CltomaGetRichAcl",
+    "CltomaTrashList", "CltomaTapeInfo",
+})
+_OP_CLASS_SESSION = frozenset({
+    "CltomaRegister", "CltomaGoodbye", "CltomaIoLimitRequest",
+    "CltomaSessionStats", "CltomaOpen", "CltomaRelease",
+})
+
+
+def _op_class_of(msg) -> str:
+    name = type(msg).__name__
+    if name == "CltomaReadChunk":
+        return "read"
+    if name in (
+        "CltomaWriteChunk", "CltomaWriteChunkEnd", "CltomaWriteChunkEndBatch",
+    ):
+        return "write"
+    if name in _OP_CLASS_READ:
+        return "meta_read"
+    if name in _OP_CLASS_SESSION:
+        return "session"
+    return "meta_write"
 
 
 def _fork_safe() -> bool:
@@ -176,6 +208,13 @@ class MasterServer(Daemon):
         self._recall_sids: dict[int, int] = {}
         self.shadow_writers: list[asyncio.StreamWriter] = []
         self.sessions: dict[int, dict] = {}
+        # per-session op accounting (runtime/accounting.py): every
+        # client RPC charges its originating session's labeled
+        # latency/byte cells; `lizardfs-admin top` renders the rollup
+        self.session_ops = accounting.SessionOps(self.metrics, "master")
+        # gateway-pushed workload summaries (CltomaSessionStats):
+        # sid -> {"ts": epoch, ...gateway stats doc}
+        self.session_stats: dict[int, dict] = {}
         # orphaned lock owners (no live connection) first seen at ts;
         # released after _ORPHAN_LOCK_TIMEOUT (promotion leaves locks of
         # sessions that never reconnect)
@@ -555,6 +594,11 @@ class MasterServer(Daemon):
         ]
         for sid in dead:
             del self.sessions[sid]
+            # per-session accounting follows the session registry's
+            # lifetime: rate windows + pushed gateway stats retire with
+            # the session (labeled counters keep their totals)
+            self.session_ops.retire(sid)
+            self.session_stats.pop(sid, None)
         # release locks AND open handles whose owning session has no
         # live connection and never reconnected (orphans from a
         # promotion or client crash)
@@ -726,6 +770,11 @@ class MasterServer(Daemon):
                 tid = getattr(msg, "trace_id", 0)
                 self.trace_ring.record(
                     tid, type(msg).__name__, tw0, time.time(), role="master",
+                )
+                # per-session accounting: the same op charged to its
+                # originating session (the `top` rollup's master leg)
+                self.session_ops.record(
+                    session_id, _op_class_of(msg), dt, trace_id=tid,
                 )
                 # SLO accounting: chunk grant/locate RPCs are the
                 # master's latency-critical class — a slow one breaches
@@ -909,8 +958,15 @@ class MasterServer(Daemon):
                             "replica op %s failed", type(msg).__name__
                         )
                         reply = self._error_reply(msg, st.EIO)
-                    self.metrics.timing(type(msg).__name__).record(
-                        time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    self.metrics.timing(type(msg).__name__).record(dt)
+                    # replica-served reads charge the same session the
+                    # primary would (the shadow's own registry; the
+                    # client never double-counts — fallbacks re-enter
+                    # the primary loop which records there instead)
+                    self.session_ops.record(
+                        session_id, _op_class_of(msg), dt,
+                        trace_id=getattr(msg, "trace_id", 0),
                     )
                 if reply is not None:
                     self._stamp_token(reply)
@@ -1165,6 +1221,21 @@ class MasterServer(Daemon):
         if isinstance(msg, m.CltomaGoodbye):
             if session:
                 session["clean_close"] = True
+            return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
+        if isinstance(msg, m.CltomaSessionStats):
+            # gateway workload summary push: folded into the `top`
+            # rollup under this session (bounded: one doc per live
+            # session, swept with the session registry)
+            try:
+                doc = json.loads(msg.stats_json) if msg.stats_json else {}
+                if not isinstance(doc, dict):
+                    raise ValueError("stats doc must be an object")
+            except ValueError:
+                return m.MatoclStatusReply(
+                    req_id=msg.req_id, status=st.EINVAL
+                )
+            doc["ts"] = time.time()
+            self.session_stats[session_id] = doc
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaLookup):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 1)
@@ -3035,6 +3106,20 @@ class MasterServer(Daemon):
         )
         self.metrics.gauge("chunkservers_connected").set(len(self.cs_links))
         self.metrics.gauge("inodes").set(len(self.meta.fs.nodes))
+        # metrics-history inputs for the `top` trends: aggregate
+        # per-session op rate + live session population ride the
+        # retention rings like any other gauge
+        self.metrics.gauge(
+            "session_ops_rate",
+            help="aggregate client-RPC rate across tracked sessions "
+                 "(ops/s over the accounting window)",
+        ).set(self.session_ops.total_rate())
+        self.metrics.gauge(
+            "sessions_active",
+            help="client sessions with a live connection",
+        ).set(sum(
+            1 for s in self.sessions.values() if s.get("connected")
+        ))
         self.metrics.gauge("open_files").set(len(self.meta.fs.open_refs))
         self.metrics.gauge("sustained_files").set(
             len(self.meta.fs.sustained)
@@ -3808,7 +3893,85 @@ class MasterServer(Daemon):
             },
         }
 
+    def top_report(self, k: int = 16, resolution: str = "sec") -> dict:
+        """The cluster-wide workload rollup `lizardfs-admin top` and
+        the webui ``/api/top`` render: per-session op rates / bytes /
+        p99 / exemplars from this master's own accounting, decorated
+        with session identity, merged with every chunkserver's
+        heartbeat-folded top-K (data-plane bytes) and every gateway's
+        pushed protocol-op summary, plus short metrics-history rings so
+        the view shows trends, not just instants."""
+        now = time.time()
+        sessions_doc: dict[str, dict] = {}
+        for row in self.session_ops.top(k):
+            sessions_doc[row["session"]] = {"master": row}
+        # decorate with the session registry's identity; sessions only
+        # known through a gateway push still get a row
+        for sid, sess in self.sessions.items():
+            label = f"s{sid}"
+            if label not in sessions_doc and sid not in self.session_stats:
+                continue
+            entry = sessions_doc.setdefault(label, {})
+            entry["info"] = str(sess.get("info", ""))
+            entry["ip"] = sess.get("ip", "")
+            entry["connected"] = bool(sess.get("connected"))
+            stats = self.session_stats.get(sid)
+            if stats is not None:
+                entry["gateway"] = dict(stats)
+                entry["gateway"]["age_s"] = round(
+                    now - stats.get("ts", now), 1
+                )
+        # chunkserver legs: per-session data-plane summaries folded
+        # into heartbeats (health_json "sessions"); merged per session
+        chunkservers: dict[str, list] = {}
+        for cs_id, snap in self.cs_health.items():
+            rows = snap.get("sessions") or []
+            if not rows:
+                continue
+            chunkservers[str(cs_id)] = rows
+            for row in rows:
+                entry = sessions_doc.setdefault(row["session"], {})
+                entry.setdefault("chunkservers", {})[str(cs_id)] = row
+        history = {
+            name: self.metrics.history(name, resolution)
+            for name in (
+                "session_ops_rate", "sessions_active",
+                "cluster_health_status", "cluster_slo_breaches",
+                "endangered_queue",
+                "slo_locate_burn_fast",
+            )
+        }
+        return {
+            "ts": now,
+            "enabled": accounting.enabled(),
+            "resolution": resolution,
+            "sessions": sessions_doc,
+            "chunkservers": chunkservers,
+            "totals": {
+                "rate_ops": self.session_ops.total_rate(),
+                "sessions_tracked": self.session_ops.active_sessions(),
+                "sessions_connected": sum(
+                    1 for s in self.sessions.values() if s.get("connected")
+                ),
+            },
+            "slo": self.slo.snapshot(),
+            "history": history,
+        }
+
     async def _admin_command(self, msg: m.AdminCommand) -> m.AdminReply:
+        if msg.command == "top":
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+                k = int(payload.get("k", 16))
+                resolution = str(payload.get("resolution", "sec"))
+            except (ValueError, TypeError):
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL, json="{}"
+                )
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self.top_report(k, resolution)),
+            )
         if msg.command == "health":
             # cluster-wide rollup (overrides the base daemon's
             # single-process snapshot): one command answers "is the

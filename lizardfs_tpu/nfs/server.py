@@ -30,6 +30,8 @@ from lizardfs_tpu.nfs import rpc
 from lizardfs_tpu.nfs.xdr import Packer, Unpacker
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
+from lizardfs_tpu.runtime import profiler as profmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
@@ -337,6 +339,31 @@ class NfsGateway:
             self.metrics, role="nfs",
             span_source=self.client.trace_ring.dump,
         )
+        # per-session protocol-op accounting (runtime/accounting.py):
+        # every NFS proc charges the gateway's cluster session under an
+        # "nfs_<proc>" class; the top-K summary is pushed to the master
+        # (CltomaSessionStats) so `lizardfs-admin top` names what this
+        # front door is doing. The embedded Client's own session_ops
+        # (logical read/write) share the same registry.
+        self.session_ops = accounting.SessionOps(
+            self.metrics, "nfs", max_sessions=8
+        )
+        self.stats_push_interval_s = 5.0
+        self._stats_task: asyncio.Task | None = None
+        # always-on sampling profiler (runtime/profiler.py; the
+        # process-wide shared instance), dumped at GET /profile on the
+        # HTTP observability listener
+        self.profiler = profmod.process_profiler(role="nfs")
+        self.slo.profiler = self.profiler
+        self.slo.recorder.profile_source = self.profiler.collapsed
+        # HTTP observability endpoint (the S3 gateway serves /metrics +
+        # /healthz on its protocol port; NFS can't — the wire speaks
+        # ONC-RPC — so a sibling listener owns them). http_port=0
+        # binds an ephemeral port (read it back after start()); None
+        # disables the listener.
+        self.http_host = host
+        self.http_port: int | None = 0
+        self._http_server: asyncio.Server | None = None
 
     @property
     def port(self) -> int:
@@ -454,14 +481,30 @@ class NfsGateway:
         self.rpc.register(PROG_NFS, 3, self._nfs_dispatch)
         self.rpc.register(PROG_PORTMAP, 2, self._portmap_dispatch)
         await self.rpc.start()
+        if self.http_port is not None:
+            self._http_server = await asyncio.start_server(
+                self._http_conn, self.http_host, self.http_port
+            )
+            self.http_port = self._http_server.sockets[0].getsockname()[1]
+            log.info("nfs observability endpoint on port %d", self.http_port)
+        self.profiler.start()  # no-op under LZ_PROF=0
+        self._stats_task = asyncio.ensure_future(self._stats_push_loop())
         log.info("nfs gateway on port %d", self.port)
 
     async def stop(self) -> None:
-        if self._gather_task is not None:
-            self._gather_task.cancel()
+        for task in (self._gather_task, self._stats_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self.profiler.stop()
+        if self._http_server is not None:
+            self._http_server.close()
             try:
-                await self._gather_task
-            except asyncio.CancelledError:
+                await asyncio.wait_for(self._http_server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
                 pass
         try:
             await self._flush_all()
@@ -469,6 +512,94 @@ class NfsGateway:
             log.exception("final gather flush failed")
         await self.rpc.stop()
         await self.client.close()
+
+    # --- HTTP observability endpoint (/metrics, /healthz, /profile) ------
+
+    def _stats_doc(self) -> dict:
+        """The workload summary pushed to the master and served at
+        /top: protocol-op mix (this gateway's SessionOps) + the logical
+        data-op view the embedded Client accounts."""
+        return {
+            "role": "nfs",
+            "endpoint": f"{self.rpc.host}:{self.port}",
+            "http_port": self.http_port,
+            "protocol": self.session_ops.top(8),
+            "data": self.client.session_ops.top(8),
+        }
+
+    def _healthz_doc(self) -> dict:
+        return {
+            "role": "nfs",
+            "status": self.slo.status() if slomod.enabled() else "ok",
+            "slo": self.slo.snapshot() if slomod.enabled() else {},
+            "slow_ops": len(self.slo.recorder.slowops()),
+            "session": self.client.session_id,
+            "mounts": len(self._mounts),
+        }
+
+    async def _http_conn(self, reader, writer) -> None:
+        """Minimal one-shot HTTP/1.0-style server: GET /metrics (the
+        Prometheus scrape surface the S3 gateway already has),
+        /healthz (probe JSON), /profile (collapsed flamegraph stacks),
+        /top (this gateway's per-session summary)."""
+        import json as _json
+
+        try:
+            line = await retrymod.bounded_wait(reader.readline(), 10.0)
+            try:
+                method, target, _ = line.decode("ascii").split(" ", 2)
+            except (UnicodeDecodeError, ValueError):
+                return
+            while True:  # drain headers
+                hl = await retrymod.bounded_wait(reader.readline(), 10.0)
+                if hl in (b"\r\n", b"\n", b""):
+                    break
+            path = target.split("?", 1)[0]
+            code, ctype, body = 404, "text/plain", b"not found\n"
+            if method == "GET" and path == "/metrics":
+                code, ctype, body = (
+                    200, "text/plain; version=0.0.4; charset=utf-8",
+                    self.metrics.to_prometheus().encode(),
+                )
+            elif method == "GET" and path == "/healthz":
+                code, ctype, body = (
+                    200, "application/json",
+                    _json.dumps(self._healthz_doc()).encode(),
+                )
+            elif method == "GET" and path == "/profile":
+                doc = self.profiler.snapshot()
+                doc["role"] = "nfs"  # process-wide sampler, this surface
+                doc["collapsed"] = self.profiler.collapsed()
+                code, ctype, body = (
+                    200, "application/json", _json.dumps(doc).encode(),
+                )
+            elif method == "GET" and path == "/top":
+                code, ctype, body = (
+                    200, "application/json",
+                    _json.dumps(self._stats_doc()).encode(),
+                )
+            writer.write(
+                (
+                    f"HTTP/1.1 {code} {'OK' if code == 200 else 'NF'}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode("latin-1") + body
+            )
+            await asyncio.wait_for(writer.drain(), 10.0)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        finally:
+            await retrymod.close_writer(writer, swallow_cancel=True)
+
+    def _stats_push_loop(self):
+        """The shared gateway push contract (CltomaSessionStats every
+        few seconds — runtime/accounting.py owns the loop so the NFS
+        and S3 gateways cannot drift apart on it)."""
+        return accounting.gateway_stats_push_loop(
+            self.client, self._stats_doc, self.stats_push_interval_s, log
+        )
 
     # --- portmapper (RFC 1833 v2): just enough for clients probing us ----
 
@@ -545,11 +676,15 @@ class NfsGateway:
         except st.StatusError as e:
             return self._plain_error(proc, _nfs_code(e))
         finally:
+            dt = time.perf_counter() - t0
             self.client.trace_ring.record(
                 tid, name, tw0, time.time(), role="nfs"
             )
-            self.slo.observe(
-                "nfs", time.perf_counter() - t0, trace_id=tid, name=name
+            self.slo.observe("nfs", dt, trace_id=tid, name=name)
+            # per-session protocol accounting: the proc charged to this
+            # gateway's cluster session, pushed to the master's `top`
+            self.session_ops.record(
+                self.client.session_id, name, dt, trace_id=tid
             )
             tracing.end(fresh)
 
@@ -1194,6 +1329,9 @@ async def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=2049)
     ap.add_argument("--export", action="append", default=None,
                     help="EXPORT=CLUSTERPATH (repeatable; default /=/)")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="observability endpoint (/metrics /healthz "
+                         "/profile /top); 0 = ephemeral, -1 = disabled")
     args = ap.parse_args(argv)
     mhost, mport = args.master.rsplit(":", 1)
     exports = {"/": "/"}
@@ -1201,6 +1339,7 @@ async def main(argv: list[str] | None = None) -> None:
         exports = dict(e.split("=", 1) for e in args.export)
     gw = NfsGateway(mhost, int(mport), host=args.host, port=args.port,
                     exports=exports)
+    gw.http_port = None if args.http_port < 0 else args.http_port
     await gw.start()
     try:
         # lint: waive(unbounded-await): the gateway process parks here until killed by design

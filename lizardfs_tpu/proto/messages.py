@@ -730,6 +730,30 @@ class CltomaGoodbye(Message):
     FIELDS = (("req_id", "u32"),)
 
 
+class CltomaSessionStats(Message):
+    """Periodic per-session workload summary push (gateway -> master).
+
+    Protocol gateways (NFS/S3) serve MANY protocol clients through ONE
+    cluster session; the master sees that session's RPC stream but not
+    the protocol-level op mix behind it. Every few seconds the gateway
+    pushes its local :class:`~lizardfs_tpu.runtime.accounting.SessionOps`
+    top-K summary (plus role/endpoint info) as ``stats_json`` so the
+    master's cluster-wide ``top`` rollup names what each front door is
+    actually doing — the cluster analog of the per-mount ``.stats``
+    magic file. Fire-and-forget semantics at the caller (a missed push
+    costs one refresh interval); answered with MatoclStatusReply. Old
+    masters never see the verb (new type id); the trailing ``trace_id``
+    follows the tracing convention."""
+
+    MSG_TYPE = 1079
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (
+        ("req_id", "u32"),
+        ("stats_json", "str"),
+        ("trace_id", "u64"),
+    )
+
+
 class CltomaAccess(Message):
     """Permission probe: can (uid, gid) access inode with mask r4/w2/x1?
     Evaluated against the inode's RichACL when one is set, else mode
@@ -1020,7 +1044,12 @@ class CltocsRead(Message):
     # trailing ``trace_id`` (optional, skew-tolerant): the native C
     # data plane reads it as an optional trailing u64 past the fixed
     # 28-byte body (native/wire.h trace contract); peers predating it
-    # decode/serve as trace 0
+    # decode/serve as trace 0.
+    # trailing ``session_id`` (optional, skew-tolerant): the master-
+    # issued session of the originating client, feeding the
+    # chunkserver's per-session op accounting (runtime/accounting.py);
+    # the native server reads fixed offsets and ignores the longer
+    # body, old peers send/serve 0 = unattributed
     MSG_TYPE = 1200
     SKEW_TOLERANT_FROM = 6
     FIELDS = (
@@ -1031,6 +1060,7 @@ class CltocsRead(Message):
         ("offset", "u32"),
         ("size", "u32"),
         ("trace_id", "u64"),
+        ("session_id", "u64"),
     )
 
 
@@ -1055,7 +1085,7 @@ class CltocsReadBulk(Message):
     and the receiver can land bytes directly in the destination buffer.
     ``offset`` must be 64 KiB-block-aligned."""
 
-    # trailing ``trace_id``: see CltocsRead
+    # trailing ``trace_id`` + ``session_id``: see CltocsRead
     MSG_TYPE = 1206
     SKEW_TOLERANT_FROM = 6
     FIELDS = (
@@ -1066,6 +1096,7 @@ class CltocsReadBulk(Message):
         ("offset", "u32"),
         ("size", "u32"),
         ("trace_id", "u64"),
+        ("session_id", "u64"),
     )
 
 
@@ -1111,7 +1142,10 @@ class CltocsWriteInit(Message):
 
     # trailing ``trace_id``: carries the request trace into the data
     # plane for the whole write session (both the asyncio server and
-    # serve_native.cpp read it; peers predating it serve as trace 0)
+    # serve_native.cpp read it; peers predating it serve as trace 0).
+    # trailing ``session_id``: attributes the whole write session to
+    # its originating client session (per-session op accounting);
+    # relayed down the chain, 0 = unattributed legacy peer
     MSG_TYPE = 1210
     SKEW_TOLERANT_FROM = 6
     FIELDS = (
@@ -1122,6 +1156,7 @@ class CltocsWriteInit(Message):
         ("chain", "list:msg:PartLocation"),  # remaining chain after this CS
         ("create", "bool"),  # create part if absent (first write)
         ("trace_id", "u64"),
+        ("session_id", "u64"),
     )
 
 

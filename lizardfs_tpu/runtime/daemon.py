@@ -14,6 +14,7 @@ import signal
 import sys
 
 from lizardfs_tpu.runtime import faults as faultsmod
+from lizardfs_tpu.runtime import profiler as profmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
@@ -67,6 +68,19 @@ class Daemon:
         self.slo = slomod.SloEngine(
             self.metrics, role=self.name, span_source=self.trace_spans
         )
+        # always-on sampling profiler (runtime/profiler.py): adaptive
+        # interval under a <2% overhead budget, dumped as collapsed
+        # stacks via `lizardfs-admin <addr> profile`; an SLO breach
+        # arms its incident boost and incident files embed the profile.
+        # LZ_PROF=0 = the thread is never started (no hot-path hooks).
+        # PROCESS-wide shared instance: a profile is per-process, and
+        # in-process test clusters host many daemons — N private
+        # samplers would contend on one GIL for N copies of the same
+        # stacks (measured ~7% on the ec(8,4) row at 13 daemons; the
+        # shared sampler costs <0.5%)
+        self.profiler = profmod.process_profiler(role=self.name)
+        self.slo.profiler = self.profiler
+        self.slo.recorder.profile_source = self.profiler.collapsed
         # challenge-response admin password (None = open admin port)
         self.admin_password: str | None = None
         self.add_timer(1.0, self._sample_metrics)
@@ -244,6 +258,41 @@ class Daemon:
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps({"spans": spans}),
+            )
+        if command == "profile":
+            # collapsed-stack flamegraph dump of the always-on sampling
+            # profiler (runtime/profiler.py); `lizardfs-admin <addr>
+            # profile` prints the text ready for flamegraph.pl
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+            except ValueError:
+                payload = {}
+            top = payload.get("top")
+            doc = self.profiler.snapshot()
+            # the sampler is process-wide; the dump names the surface
+            # it was asked through (in-process clusters share one)
+            doc["role"] = self.name
+            doc["collapsed"] = self.profiler.collapsed(
+                int(top) if top else None
+            )
+            if payload.get("reset"):
+                self.profiler.reset()
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
+            )
+        if command == "top-sessions":
+            # this daemon's own per-session accounting summary (the
+            # master's `top` aggregates these cluster-wide)
+            from lizardfs_tpu.runtime import accounting
+
+            ops = getattr(self, "session_ops", None)
+            doc = {
+                "role": self.name,
+                "enabled": accounting.enabled(),
+                "sessions": ops.top(16) if ops is not None else [],
+            }
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if command == "slowops":
             # in-memory top-N slowest ops (flight recorder); each entry
@@ -510,10 +559,13 @@ class Daemon:
             target=self._wd_sampler, name=self.name + "-watchdog", daemon=True
         )
         self._wd_sampler_thread.start()
+        # no-op under LZ_PROF=0 (the switch is the start gate)
+        self.profiler.start()
         self.log.info("%s listening on %s:%d", self.name, self.host, self.port)
 
     async def stop(self) -> None:
         self._stopping.set()
+        self.profiler.stop()
         if self._wd_sampler_stop is not None:
             self._wd_sampler_stop.set()
             self._wd_sampler_thread.join(timeout=1.0)

@@ -97,12 +97,21 @@ class Series:
 class Timing:
     """Latency histogram with log2 buckets (request_log.h scope-timing
     analog): record() costs one int_log2 + two adds; export gives
-    count/sum/max plus per-bucket counts for percentile estimates."""
+    count/sum/max plus per-bucket counts for percentile estimates.
+
+    A nonzero ``trace_id`` passed to :meth:`record` becomes the
+    histogram's EXEMPLAR — the trace of the slowest recent op — so a
+    hot cell on the metrics page links straight to a ``trace-dump``
+    timeline. The exemplar decays: a newer op replaces it when it is at
+    least as slow, or when the stored one is older than a minute (a
+    one-off spike must not pin a stale id forever)."""
 
     # bucket i covers [2^i, 2^(i+1)) microseconds; 20 buckets = 1us..1s+
     NBUCKETS = 20
+    EXEMPLAR_TTL_S = 60.0
 
-    __slots__ = ("name", "count", "total_us", "max_us", "buckets")
+    __slots__ = ("name", "count", "total_us", "max_us", "buckets",
+                 "exemplar_trace_id", "exemplar_us", "exemplar_ts")
 
     def __init__(self, name: str):
         self.name = name
@@ -110,8 +119,11 @@ class Timing:
         self.total_us = 0.0
         self.max_us = 0.0
         self.buckets = [0] * self.NBUCKETS
+        self.exemplar_trace_id = 0
+        self.exemplar_us = 0.0
+        self.exemplar_ts = 0.0
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float, trace_id: int = 0) -> None:
         us = seconds * 1e6
         self.count += 1
         self.total_us += us
@@ -119,15 +131,43 @@ class Timing:
             self.max_us = us
         b = max(int(us), 1).bit_length() - 1
         self.buckets[min(b, self.NBUCKETS - 1)] += 1
+        if trace_id:
+            now = time.monotonic()
+            if (
+                us >= self.exemplar_us
+                or now - self.exemplar_ts > self.EXEMPLAR_TTL_S
+            ):
+                self.exemplar_trace_id = trace_id
+                self.exemplar_us = us
+                self.exemplar_ts = now
+
+    def quantile_us(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile latency from the log2
+        buckets (the p99 the `top` view renders). Exact to within one
+        bucket (a factor of 2), which is the honest resolution a
+        20-bucket histogram has."""
+        if not self.count:
+            return 0.0
+        want = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            cum += n
+            if cum >= want:
+                return float(2 ** (i + 1))
+        return self.max_us
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name, "kind": "timing", "count": self.count,
             "avg_us": round(self.total_us / self.count, 1) if self.count
             else 0.0,
             "max_us": round(self.max_us, 1),
             "buckets_us_log2": list(self.buckets),
         }
+        if self.exemplar_trace_id:
+            out["exemplar_trace_id"] = f"0x{self.exemplar_trace_id:x}"
+            out["exemplar_us"] = round(self.exemplar_us, 1)
+        return out
 
 
 class PhaseBreakdown:
@@ -183,6 +223,14 @@ def _label_value(v) -> str:
     )
 
 
+# Per-family cap on distinct label combinations: a label value drawn
+# from an unbounded domain (session ids, file names) must not grow the
+# registry — and the scrape page — without bound. Past the cap, new
+# combinations fold into the same label NAMES with every value
+# "other", so totals stay truthful while cardinality stays fixed.
+LABEL_VARIANT_CAP = 256
+
+
 class Metrics:
     def __init__(self):
         self.series: dict[str, Series] = {}
@@ -193,6 +241,10 @@ class Metrics:
         # HELP/TYPE block per family on the Prometheus page, one sample
         # line per label combination.
         self.labeled: dict[str, dict[tuple, Series]] = {}
+        # labeled Timing families (session_ops{session,op} style): one
+        # HELP/TYPE histogram block per family, per-combination
+        # bucket/_sum/_count samples, trace-id exemplars on +Inf
+        self.labeled_timings: dict[str, dict[tuple, Timing]] = {}
         # per-series HELP text (Prometheus exposition); series without
         # an explicit entry export an auto-generated line so every
         # scraped metric carries help (the metrics-lint contract)
@@ -226,15 +278,25 @@ class Metrics:
         self.describe(name, help)
         return s
 
+    @staticmethod
+    def _label_key(variants: dict, labels: dict) -> tuple:
+        """Sorted, sanitized (label, value) key for one combination,
+        folding NEW combinations past LABEL_VARIANT_CAP into the
+        all-"other" overflow bucket (same label names, bounded page)."""
+        key = tuple(sorted(
+            (str(k), _label_value(v)) for k, v in labels.items()
+        ))
+        if key not in variants and len(variants) >= LABEL_VARIANT_CAP:
+            key = tuple((k, "other") for k, _ in key)
+        return key
+
     def labeled_counter(
         self, family: str, labels: dict, help: str | None = None
     ) -> Series:
         """One Series per (family, label-set) combination, exported as a
         single Prometheus counter family with per-combination samples."""
         variants = self.labeled.setdefault(family, {})
-        key = tuple(sorted(
-            (str(k), _label_value(v)) for k, v in labels.items()
-        ))
+        key = self._label_key(variants, labels)
         s = variants.get(key)
         if s is None:
             decorated = family + "{" + ",".join(
@@ -243,6 +305,29 @@ class Metrics:
             s = variants[key] = Series(decorated, "counter")
         self.describe(family, help)
         return s
+
+    def labeled_timing(
+        self, family: str, labels: dict, help: str | None = None
+    ) -> Timing:
+        """One :class:`Timing` per (family, label-set) combination —
+        the labeled-histogram family behind per-session op accounting.
+        Exports as ONE Prometheus histogram family whose per-
+        combination ``_bucket``/``_sum``/``_count`` samples carry the
+        labels, with the slowest recent op's trace id as an OpenMetrics
+        exemplar on the ``+Inf`` bucket (so a hot cell links straight
+        to ``trace-dump``). Cardinality is bounded by
+        ``LABEL_VARIANT_CAP`` — overflow combinations fold into the
+        all-"other" bucket."""
+        variants = self.labeled_timings.setdefault(family, {})
+        key = self._label_key(variants, labels)
+        t = variants.get(key)
+        if t is None:
+            decorated = family + "{" + ",".join(
+                f'{k}="{v}"' for k, v in key
+            ) + "}"
+            t = variants[key] = Timing(decorated)
+        self.describe(family, help)
+        return t
 
     def define(self, name: str, expr: str, help: str | None = None) -> None:
         """Register a derived series: RPN over series names/constants,
@@ -253,6 +338,32 @@ class Metrics:
         self.eval_rpn(expr)  # raises ValueError on malformed exprs
         self.derived[name] = expr
         self.describe(name, help)
+
+    def drop_labeled(self, family: str, label: str, value) -> None:
+        """Retire every variant of ``family`` (counter or timing) whose
+        label set carries ``label="value"``. Departed-session cleanup:
+        a long-lived master with session churn would otherwise fill the
+        LABEL_VARIANT_CAP with dead variants and fold every NEW
+        session into "other" — losing exactly the p99/exemplar cells
+        the `top` view exists for. Prometheus handles series
+        disappearing (same as a process restart)."""
+        pair = (str(label), _label_value(value))
+        for table in (self.labeled, self.labeled_timings):
+            variants = table.get(family)
+            if not variants:
+                continue
+            for key in [k for k in variants if pair in k]:
+                del variants[key]
+
+    def history(self, name: str, resolution: str = "sec") -> list[float]:
+        """One series' retained ring at a resolution (the metrics-
+        history view `top`/`health` trends render; [] for unknown
+        names). Counters yield per-tick rates, gauges sampled values —
+        exactly what the rings hold."""
+        s = self.series.get(name)
+        if s is None:
+            return []
+        return [float(v) for v in s._rings.get(resolution, ())]
 
     def sample_all(self, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -390,6 +501,34 @@ class Metrics:
             lines.append(f'{pname}_bucket{{le="+Inf"}} {t.count}')
             lines.append(f"{pname}_sum {_prom_value(t.total_us)}")
             lines.append(f"{pname}_count {t.count}")
+        for family, variants in sorted(self.labeled_timings.items()):
+            pname = f"{prefix}_{_prom_name(family)}_us"
+            lines.append(
+                f"# HELP {pname} "
+                f"{_prom_help(self.help_for(family, 'latency histogram'))}"
+            )
+            lines.append(f"# TYPE {pname} histogram")
+            for key, t in sorted(variants.items()):
+                lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                cum = 0
+                for i, n in enumerate(t.buckets):
+                    cum += n
+                    lines.append(
+                        f'{pname}_bucket{{{lbl},le="{2 ** (i + 1)}"}} {cum}'
+                    )
+                inf = f'{pname}_bucket{{{lbl},le="+Inf"}} {t.count}'
+                if t.exemplar_trace_id:
+                    # OpenMetrics exemplar: the slowest recent op's
+                    # trace id + its latency, the hot-cell -> trace-dump
+                    # link (0.0.4-only scrapers may drop the suffix;
+                    # metrics-lint validates the syntax)
+                    inf += (
+                        f' # {{trace_id="0x{t.exemplar_trace_id:x}"}} '
+                        f"{_prom_value(round(t.exemplar_us, 1))}"
+                    )
+                lines.append(inf)
+                lines.append(f"{pname}_sum{{{lbl}}} {_prom_value(t.total_us)}")
+                lines.append(f"{pname}_count{{{lbl}}} {t.count}")
         return "\n".join(lines) + "\n"
 
     def to_dict(self, resolution: str = "sec") -> dict:
@@ -416,4 +555,7 @@ class Metrics:
                 out[name]["error"] = err
         for name, t in sorted(self.timings.items()):
             out[f"timing.{name}"] = t.to_dict()
+        for variants in self.labeled_timings.values():
+            for t in variants.values():
+                out[f"timing.{t.name}"] = t.to_dict()
         return out

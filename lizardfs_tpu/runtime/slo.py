@@ -199,6 +199,10 @@ class FlightRecorder:
         self.incident_dir = incident_dir
         self.top_n = top_n
         self.max_incidents = max_incidents
+        # optional () -> str of collapsed stacks (runtime/profiler.py):
+        # incident files then carry WHERE the process was spending its
+        # time while the breach happened, not just the trace spans
+        self.profile_source = None
         # slowest ops seen, sorted slowest-first, bounded to top_n
         self._slow: list[dict] = []
         # disk-write rate limit: capture runs synchronously on the
@@ -242,8 +246,16 @@ class FlightRecorder:
         path = os.path.join(
             self.incident_dir, f"inc_{entry['trace_id']:016x}.json"
         )
+        doc = {**entry, "spans": spans}
+        if self.profile_source is not None:
+            # bounded: the heaviest stacks only — an incident file is a
+            # ring slot, not an archive
+            try:
+                doc["profile"] = self.profile_source(32)
+            except Exception:  # noqa: BLE001 — capture is best effort
+                pass
         with open(path, "w") as f:
-            json.dump({**entry, "spans": spans}, f)
+            json.dump(doc, f)
         self._rotate()
 
     def _rotate(self) -> None:
@@ -293,6 +305,10 @@ class SloEngine:
         self.role = role
         self.span_source = span_source
         self.recorder = FlightRecorder(incident_dir)
+        # optional SamplingProfiler (runtime/profiler.py): a breach
+        # arms its incident boost window so slowops captures come with
+        # stacks, and incident files embed the collapsed profile
+        self.profiler = None
         self.objectives: dict[str, Objective] = {}
         for op_class, (thresh_ms, target) in {
             **DEFAULT_OBJECTIVES, **(objectives or {})
@@ -366,6 +382,11 @@ class SloEngine:
             if breached:
                 self.metrics.counter(f"slo_{op_class}_breaches").inc()
         if breached:
+            if self.profiler is not None:
+                # incident auto-arm: the profiler holds its boosted
+                # sample rate for the capture window so the incident's
+                # collapsed stacks have useful resolution
+                self.profiler.arm_incident()
             spans: list[dict] = []
             if self.span_source is not None and trace_id:
                 try:

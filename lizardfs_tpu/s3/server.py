@@ -55,7 +55,9 @@ from lizardfs_tpu.client.client import Client
 from lizardfs_tpu.constants import EATTR_LIFECYCLE, MFSCHUNKSIZE
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as faultsmod
+from lizardfs_tpu.runtime import profiler as profmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import slo as slomod
 from lizardfs_tpu.runtime import tracing
@@ -122,7 +124,9 @@ def _status_error(e: st.StatusError, resource: str) -> _HttpError:
 
 
 def _valid_bucket(name: str) -> bool:
-    if not (3 <= len(name) <= 63) or name in ("metrics", "healthz"):
+    if not (3 <= len(name) <= 63) or name in (
+        "metrics", "healthz", "profile", "top"
+    ):
         return False
     if name[0] in ".-" or name[-1] in ".-":
         return False
@@ -202,6 +206,18 @@ class S3Gateway:
             self.metrics, role="s3",
             span_source=self.client.trace_ring.dump,
         )
+        # per-session protocol-op accounting, pushed to the master's
+        # `top` rollup (CltomaSessionStats) — the NFS gateway pattern
+        self.session_ops = accounting.SessionOps(
+            self.metrics, "s3", max_sessions=8
+        )
+        self.stats_push_interval_s = 5.0
+        self._stats_task: asyncio.Task | None = None
+        # always-on sampling profiler (process-wide shared instance),
+        # served at GET /profile
+        self.profiler = profmod.process_profiler(role="s3")
+        self.slo.profiler = self.profiler
+        self.slo.recorder.profile_source = self.profiler.collapsed
         self.metrics.counter(
             "s3_bytes_in", help="object bytes received in PUT/UploadPart"
         )
@@ -248,9 +264,18 @@ class S3Gateway:
             self._serve_conn, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        self.profiler.start()  # no-op under LZ_PROF=0
+        self._stats_task = asyncio.ensure_future(self._stats_push_loop())
         log.info("s3 gateway on port %d (root %s)", self.port, self.root)
 
     async def stop(self) -> None:
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+            try:
+                await self._stats_task
+            except asyncio.CancelledError:
+                pass
+        self.profiler.stop()
         if self._server is not None:
             self._server.close()
             try:
@@ -258,6 +283,25 @@ class S3Gateway:
             except asyncio.TimeoutError:
                 pass
         await self.client.close()
+
+    def _stats_doc(self) -> dict:
+        """Workload summary pushed to the master (`top` rollup) and
+        mirrored at GET /top: protocol-op mix + the embedded Client's
+        logical data-op accounting."""
+        return {
+            "role": "s3",
+            "endpoint": f"{self.host}:{self.port}",
+            "protocol": self.session_ops.top(8),
+            "data": self.client.session_ops.top(8),
+        }
+
+    def _stats_push_loop(self):
+        """The shared gateway push contract (CltomaSessionStats every
+        few seconds — runtime/accounting.py owns the loop so the NFS
+        and S3 gateways cannot drift apart on it)."""
+        return accounting.gateway_stats_push_loop(
+            self.client, self._stats_doc, self.stats_push_interval_s, log
+        )
 
     # --- HTTP framing ------------------------------------------------------
 
@@ -373,6 +417,10 @@ class S3Gateway:
             return "Metrics", self._op_metrics, ()
         if req.method == "GET" and path == "healthz":
             return "Healthz", self._op_healthz, ()
+        if req.method == "GET" and path == "profile":
+            return "Profile", self._op_profile, ()
+        if req.method == "GET" and path == "top":
+            return "Top", self._op_top, ()
         if not path:
             if req.method == "GET":
                 return "ListBuckets", self._op_list_buckets, ()
@@ -462,6 +510,7 @@ class S3Gateway:
             )
             return req.headers.get("connection", "").lower() != "close"
         finally:
+            dt = time.perf_counter() - t0
             self.metrics.labeled_counter(
                 "s3_requests", {"op": opname, "code": str(code)},
                 help="S3 gateway requests by operation and HTTP status",
@@ -469,9 +518,12 @@ class S3Gateway:
             self.client.trace_ring.record(
                 tid, f"s3_{opname}", tw0, time.time(), role="s3"
             )
-            self.slo.observe(
-                "s3", time.perf_counter() - t0, trace_id=tid,
-                name=f"s3_{opname}",
+            self.slo.observe("s3", dt, trace_id=tid, name=f"s3_{opname}")
+            # per-session protocol accounting: the op charged to this
+            # gateway's cluster session for the master's `top` rollup
+            self.session_ops.record(
+                self.client.session_id, f"s3_{opname}", dt,
+                nbytes=len(req.body), trace_id=tid,
             )
             tracing.end(fresh)
 
@@ -586,6 +638,17 @@ class S3Gateway:
             "slow_ops": len(self.slo.recorder.slowops()),
         }
         return (200, json.dumps(doc).encode(),
+                {"Content-Type": "application/json"}, False)
+
+    async def _op_profile(self, req: _Request):
+        doc = self.profiler.snapshot()
+        doc["role"] = "s3"  # process-wide sampler, this surface's dump
+        doc["collapsed"] = self.profiler.collapsed()
+        return (200, json.dumps(doc).encode(),
+                {"Content-Type": "application/json"}, False)
+
+    async def _op_top(self, req: _Request):
+        return (200, json.dumps(self._stats_doc()).encode(),
                 {"Content-Type": "application/json"}, False)
 
     async def _op_list_buckets(self, req: _Request):

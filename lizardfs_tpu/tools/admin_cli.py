@@ -87,12 +87,14 @@ async def _amain(argv) -> int:
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
             "trace-dump", "health", "slowops", "rebuild-status", "faults",
+            "top", "profile",
         ],
     )
     p.add_argument("extra", nargs="*",
                    help="tweaks-set: NAME VALUE; metrics: [resolution]; "
                         "trace-dump: [trace_id]; "
-                        "faults: [arm RULE | clear]")
+                        "faults: [arm RULE | clear]; "
+                        "top: [watch]; profile: [top_n]")
     p.add_argument("--password", default=None,
                    help="admin password (challenge-response)")
     args = p.parse_args(argv)
@@ -155,6 +157,44 @@ async def _amain(argv) -> int:
         if getattr(reply, "status", 1) == st.OK:
             _print_faults(json.loads(reply.json))
             return 0
+    elif cmd == "top":
+        # live cluster workload view (the cluster analog of the
+        # reference's per-mount .oplog): `top watch` refreshes until ^C
+        watch = bool(args.extra) and args.extra[0] == "watch"
+        while True:
+            reply = await _admin(addr, "top", password=args.password)
+            if getattr(reply, "status", 1) != st.OK:
+                break
+            if watch:
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            _print_top(json.loads(reply.json))
+            if not watch:
+                return 0
+            await asyncio.sleep(2.0)
+    elif cmd == "profile":
+        top_n = int(args.extra[0]) if args.extra else 0
+        reply = await _admin(
+            addr, "profile",
+            json.dumps({"top": top_n} if top_n else {}),
+            password=args.password,
+        )
+        if getattr(reply, "status", 1) == st.OK:
+            doc = json.loads(reply.json)
+            print(
+                f"# profiler role={doc.get('role', '?')} "
+                f"enabled={doc.get('enabled')} "
+                f"samples={doc.get('samples', 0)} "
+                f"stacks={doc.get('stacks', 0)} "
+                f"interval={doc.get('interval_ms', 0)}ms "
+                f"cost={doc.get('sample_cost_us', 0)}us "
+                f"budget={doc.get('overhead_budget_pct', 0)}%",
+                file=sys.stderr,
+            )
+            # stdout carries pure collapsed-stack text, ready to pipe
+            # into flamegraph.pl
+            if doc.get("collapsed"):
+                print(doc["collapsed"])
+            return 0
     elif cmd == "tweaks-set":
         if len(args.extra) != 2:
             print("usage: tweaks-set NAME VALUE", file=sys.stderr)
@@ -199,6 +239,91 @@ async def _amain(argv) -> int:
     else:
         print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
+
+
+def _spark(points: list, width: int = 24) -> str:
+    """ASCII sparkline of a metrics-history ring (trend rendering for
+    the `top` view; empty ring -> empty string)."""
+    pts = [max(float(p), 0.0) for p in points][-width:]
+    if not pts:
+        return ""
+    peak = max(pts) or 1.0
+    marks = " .:-=+*#%@"
+    return "".join(
+        marks[min(int(v / peak * (len(marks) - 1)), len(marks) - 1)]
+        for v in pts
+    )
+
+
+def _print_top(doc: dict) -> None:
+    """Render the master's cluster-wide `top` rollup: per-session op
+    rates / bytes / p99 / exemplars, gateway protocol mixes, and the
+    metrics-history trends."""
+    totals = doc.get("totals", {})
+    if not doc.get("enabled", True):
+        print("per-session accounting is DISABLED (LZ_TOP=0)")
+    print(
+        f"cluster top — {totals.get('rate_ops', 0):.1f} ops/s across "
+        f"{totals.get('sessions_tracked', 0)} tracked sessions "
+        f"({totals.get('sessions_connected', 0)} connected)"
+    )
+    history = doc.get("history", {})
+    for name in ("session_ops_rate", "cluster_slo_breaches",
+                 "endangered_queue"):
+        pts = history.get(name) or []
+        if pts:
+            print(f"  {name:<22s} [{_spark(pts):<24s}] now "
+                  f"{pts[-1]:.1f}")
+    rows = sorted(
+        doc.get("sessions", {}).items(),
+        key=lambda kv: -kv[1].get("master", {}).get("rate_ops", 0.0),
+    )
+    print(
+        f"  {'session':<10s} {'who':<22s} {'ops/s':>8s} {'MB/s':>8s} "
+        f"{'p99 ms':>8s}  hot (class: ops/s, p99) / exemplar"
+    )
+    for label, entry in rows:
+        mrow = entry.get("master", {})
+        # bytes move on the data plane: sum this session's chunkserver
+        # legs (the master leg has no payload bytes)
+        cs_bytes = sum(
+            r.get("rate_bytes", 0.0)
+            for r in entry.get("chunkservers", {}).values()
+        )
+        who = (entry.get("info", "") or "?")[:22]
+        classes = mrow.get("classes", {})
+        hot = sorted(
+            classes.items(), key=lambda kv: -kv[1].get("ops", 0)
+        )[:2]
+        hot_s = " ".join(
+            f"{cls}:{v.get('ops', 0)}op/{v.get('p99_ms', 0):.0f}ms"
+            for cls, v in hot
+        )
+        exemplar = mrow.get("exemplar", entry.get("exemplar", ""))
+        print(
+            f"  {label:<10s} {who:<22s} "
+            f"{mrow.get('rate_ops', 0.0):>8.1f} "
+            f"{cs_bytes / 1e6:>8.2f} "
+            f"{mrow.get('p99_ms', 0.0):>8.1f}  "
+            f"{hot_s}{('  trace ' + exemplar) if exemplar else ''}"
+        )
+        gw = entry.get("gateway")
+        if gw:
+            proto = gw.get("protocol") or []
+            mix = proto[0].get("classes", {}) if proto else {}
+            top3 = sorted(
+                mix.items(), key=lambda kv: -kv[1].get("ops", 0)
+            )[:3]
+            mix_s = " ".join(
+                f"{cls}={v.get('ops', 0)}" for cls, v in top3
+            )
+            print(
+                f"             `- {gw.get('role', '?')} gateway "
+                f"{gw.get('endpoint', '')}  {mix_s}  "
+                f"(pushed {gw.get('age_s', 0)}s ago)"
+            )
+    if not rows:
+        print("  (no sessions tracked yet)")
 
 
 def _print_faults(doc: dict) -> None:
@@ -310,6 +435,8 @@ def _print_health(doc: dict) -> None:
 def main(argv=None) -> int:
     try:
         return asyncio.run(_amain(argv if argv is not None else sys.argv[1:]))
+    except KeyboardInterrupt:
+        return 0  # `top watch` exits via ^C by design
     except (ConnectionError, OSError, asyncio.TimeoutError) as e:
         # TimeoutError: the bounded 5 s dial — on 3.10 it is not an
         # OSError subclass, and a blackholed daemon must print the

@@ -55,6 +55,8 @@ SWITCHES = {
     "LZ_NO_UDS",           # disable same-host UDS fast path (default OFF)
     "LZ_S3",               # S3 object gateway (on; off refuses start)
     "LZ_S3_LIFECYCLE",     # master lifecycle tiering scanner (on)
+    "LZ_TOP",              # per-session op accounting / `top` view (on)
+    "LZ_PROF",             # always-on sampling profiler (on)
 }
 
 # Value vars: one read site each; documented; spelling rules N/A.

@@ -119,6 +119,11 @@ SLO_WAIVERS = {
     **{v: _SESSION for v in (
         "CltomaRegister", "CltomaGoodbye", "CltomaIoLimitRequest",
     )},
+    "CltomaSessionStats": (
+        "periodic best-effort workload-summary push (gateway -> "
+        "master, ~1/5s) feeding the `top` rollup — telemetry about "
+        "telemetry; per-op timing + master span cover it"
+    ),
     **{v: _ADMIN for v in (
         "CltomaTrashList", "CltomaUndelete", "CltomaFileRepair",
         "CltomaChunkDamaged",
@@ -149,6 +154,7 @@ VERB_SITES = {
 DEFAULT_SITE = "frame_recv"
 
 # generic per-surface instruments: (file, regex, what broke if absent)
+DAEMON = "lizardfs_tpu/runtime/daemon.py"
 ANCHORS = (
     (MASTER, r"metrics\.timing\(type\(msg\)\.__name__\)",
      "master per-op latency histograms (request_log analog)"),
@@ -159,6 +165,28 @@ ANCHORS = (
     (NFS, r"observe\(\s*\n?\s*[\"']nfs[\"']", "NFS SLO class accounting"),
     (S3, r"tracing\.begin\(\)", "S3 gateway boundary span"),
     (S3, r"observe\(\s*\n?\s*[\"']s3[\"']", "S3 SLO class accounting"),
+    # per-session op accounting (ISSUE 14): the master RPC loop and
+    # the chunkserver data plane must keep charging the originating
+    # session, or `top` silently reads empty
+    (MASTER, r"session_ops\.record\(",
+     "master per-session op accounting (`top` rollup input)"),
+    (CS, r"session_ops\.record\(",
+     "chunkserver per-session data-plane accounting"),
+    (MASTER, r"def top_report\(", "master cluster-wide `top` rollup"),
+    # gateway observability surfaces: both front doors must keep their
+    # /metrics + /healthz HTTP endpoints AND their master stats push —
+    # a deleted endpoint is a lint failure, not a dashboard mystery
+    (NFS, r"[\"']/metrics[\"']", "NFS gateway /metrics endpoint"),
+    (NFS, r"[\"']/healthz[\"']", "NFS gateway /healthz endpoint"),
+    (NFS, r"gateway_stats_push_loop\(",
+     "NFS gateway workload-summary push (CltomaSessionStats)"),
+    (S3, r"_op_metrics", "S3 gateway /metrics endpoint"),
+    (S3, r"_op_healthz", "S3 gateway /healthz endpoint"),
+    (S3, r"gateway_stats_push_loop\(",
+     "S3 gateway workload-summary push (CltomaSessionStats)"),
+    # the always-on sampling profiler's dump path (admin `profile`)
+    (DAEMON, r"profiler\.collapsed\(",
+     "daemon profiler collapsed-stack dump (admin `profile`)"),
 )
 
 # files searched for OP_CLASSES coverage (who feeds each objective)
@@ -169,6 +197,7 @@ def extra_inputs(cfg) -> list[str]:
     root = cfg.root
     paths = {os.path.join(root, p) for p in SITE_IMPL.values()}
     paths.update(os.path.join(root, p) for p in SLO_SURFACES)
+    paths.update(os.path.join(root, rel) for rel, _, _ in ANCHORS)
     paths.add(os.path.join(root, "lizardfs_tpu/runtime/slo.py"))
     paths.add(os.path.join(root, "lizardfs_tpu/runtime/faults.py"))
     if cfg.messages_path:

@@ -57,6 +57,13 @@ OPTIONAL_BY_CONVENTION = {
 # instead of widening the global set.
 OPTIONAL_BY_CONVENTION_SCOPED = {
     ("TstomaRegister", "session_id"),
+    # per-session op accounting (ISSUE 14): the originating session
+    # rides the data-plane requests as an additive tail (old peers
+    # send/serve 0 = unattributed) while session_id stays required
+    # payload in the Register messages
+    ("CltocsRead", "session_id"),
+    ("CltocsReadBulk", "session_id"),
+    ("CltocsWriteInit", "session_id"),
 }
 
 _SCALARS = {"u8", "u16", "u32", "u64", "i32", "i64", "bool"}

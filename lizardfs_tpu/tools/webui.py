@@ -8,6 +8,7 @@ live HTML dashboard plus raw JSON endpoints.
     python -m lizardfs_tpu.tools.webui --master 127.0.0.1:9420 --port 9425
 
 Endpoints: /  (dashboard), /api/info, /api/health, /api/metrics,
+/api/top (cluster-wide per-session workload rollup),
 /api/rebuild (RebuildEngine progress/ETA JSON),
 /metrics (Prometheus text exposition of the master's registry),
 /health (cluster health rollup JSON — SLO burn, per-CS snapshots)
@@ -60,6 +61,9 @@ PAGE = """<!doctype html>
 <tr><th>completed / failed</th><td>{rb_completed} / {rb_failed}</td></tr>
 <tr><th>rate / ETA</th><td>{rb_rate} MB/s &mdash; {rb_eta}</td></tr>
 </table>
+<h2>workload top &mdash; per-session (ops/s over the accounting window)</h2>
+<table><tr><th>session</th><th>who</th><th>ops/s</th><th>p99 ms</th>
+<th>hot classes</th><th>exemplar trace</th></tr>{top_rows}</table>
 <h2>metadata ops (last 120 s)</h2>
 <pre>{ops}</pre>
 <h2>charts &mdash; range: {range_links} (showing {span})</h2>
@@ -148,6 +152,15 @@ class Dashboard:
             ).json
         )
 
+    def top(self) -> dict:
+        """The master's cluster-wide per-session workload rollup
+        (`lizardfs-admin top` over the admin link)."""
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="top", json="{}")
+            ).json
+        )
+
     def metrics(self, resolution: str = "sec") -> dict:
         return json.loads(
             self._call(
@@ -198,6 +211,42 @@ class Dashboard:
             rb = self.rebuild_status()
         except Exception:  # noqa: BLE001 — older master: no verb
             rb = {}
+        try:
+            top = self.top()
+        except Exception:  # noqa: BLE001 — older master: no verb
+            top = {}
+        top_rows = []
+        sessions = sorted(
+            top.get("sessions", {}).items(),
+            key=lambda kv: -kv[1].get("master", {}).get("rate_ops", 0.0),
+        )
+        from html import escape as _esc
+
+        for label, entry in sessions[:12]:
+            mrow = entry.get("master", {})
+            classes = mrow.get("classes", {})
+            hot = " ".join(
+                f"{cls}:{v.get('ops', 0)}"
+                for cls, v in sorted(
+                    classes.items(), key=lambda kv: -kv[1].get("ops", 0)
+                )[:3]
+            )
+            # session info and gateway-pushed fields are CLIENT-supplied
+            # strings (CltomaRegister.info / CltomaSessionStats JSON) —
+            # escape everything interpolated, or a hostile client's
+            # registration string runs as script in the operator's
+            # browser
+            who = entry.get("info", "") or "?"
+            gw = entry.get("gateway")
+            if gw:
+                who += f" ({gw.get('role', '?')} gateway)"
+            exemplar = str(mrow.get("exemplar", entry.get("exemplar", "")))
+            top_rows.append(
+                f"<tr><td>{_esc(str(label))}</td><td>{_esc(who)}</td>"
+                f"<td>{mrow.get('rate_ops', 0.0):.1f}</td>"
+                f"<td>{mrow.get('p99_ms', 0.0):.1f}</td>"
+                f"<td>{_esc(hot)}</td><td>{_esc(exemplar)}</td></tr>"
+            )
         rows = []
         for s in info.get("chunkservers", []):
             state = (
@@ -287,6 +336,8 @@ class Dashboard:
             lost=health.get("lost", 0),
             endangered_cls="bad" if health.get("endangered") else "ok",
             lost_cls="bad" if health.get("lost") else "ok",
+            top_rows="".join(top_rows)
+            or "<tr><td colspan=6>no sessions tracked</td></tr>",
             servers="".join(rows) or "<tr><td colspan=5>none</td></tr>",
             ops="\n".join(sorted(ops_lines)) or "(no ops yet)",
             charts="".join(charts_html) or "(no series yet)",
@@ -324,6 +375,10 @@ def make_handler(dash: Dashboard):
                         json.dumps(dash.cluster_health()),
                         "application/json",
                     )
+                elif self.path == "/api/top":
+                    # cluster-wide per-session workload rollup (the
+                    # `lizardfs-admin top` document)
+                    self._send(json.dumps(dash.top()), "application/json")
                 elif self.path == "/api/rebuild":
                     # RebuildEngine progress/ETA (rebuild-status verb)
                     self._send(

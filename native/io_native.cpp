@@ -188,17 +188,24 @@ bool recv_all(int fd, uint8_t* buf, size_t len) {
 // 0 (the default) keeps request frames byte-identical to pre-trace
 // builds.
 thread_local uint64_t g_trace_id = 0;
+// the originating cluster session, same pattern (per-session op
+// accounting on the chunkserver): appended AFTER the trace id — the
+// server parses it positionally past the trace slot, so a session
+// only rides frames that also carry a (nonzero) trace
+thread_local uint64_t g_session_id = 0;
 
 extern "C" {
 
 void lz_trace_set(uint64_t trace_id) { g_trace_id = trace_id; }
 
+void lz_session_set(uint64_t session_id) { g_session_id = session_id; }
+
 // Read [offset, offset+size) of one part into out. Whole exchange.
 int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
                  uint32_t part_id, uint32_t offset, uint32_t size,
                  uint8_t* out) {
-    // request (+8 reserved for the optional trailing trace id)
-    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
+    // request (+16 reserved for the optional trailing trace/session ids)
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8 + 8];
     size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
     req[8] = kProtoVersion;
     put32(req + 9, 1);            // req_id
@@ -210,6 +217,10 @@ int lz_read_part(int fd, uint64_t chunk_id, uint32_t version,
     if (g_trace_id != 0) {
         put64(req + 37, g_trace_id);
         body += 8;
+        if (g_session_id != 0) {
+            put64(req + 45, g_session_id);
+            body += 8;
+        }
     }
     put32(req, kTypeRead);
     put32(req + 4, static_cast<uint32_t>(body));
@@ -265,7 +276,7 @@ int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
                       uint8_t* out) {
     constexpr uint32_t kTypeReadBulk = 1206;
     constexpr uint32_t kTypeReadBulkData = 1207;
-    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
+    uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8 + 8];
     size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
     req[8] = kProtoVersion;
     put32(req + 9, 1);
@@ -274,9 +285,13 @@ int lz_read_part_bulk(int fd, uint64_t chunk_id, uint32_t version,
     put32(req + 25, part_id);
     put32(req + 29, offset);
     put32(req + 33, size);
-    if (g_trace_id != 0) {  // optional trailing trace id (wire.h)
+    if (g_trace_id != 0) {  // optional trailing trace + session (wire.h)
         put64(req + 37, g_trace_id);
         body += 8;
+        if (g_session_id != 0) {
+            put64(req + 45, g_session_id);
+            body += 8;
+        }
     }
     put32(req, kTypeReadBulk);
     put32(req + 4, static_cast<uint32_t>(body));
@@ -457,7 +472,7 @@ int lz_read_parts_gather(lz_part_req* parts, uint32_t d, uint32_t offset,
             parts[i].rc = 0;
             continue;
         }
-        uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8];
+        uint8_t req[8 + 1 + 4 + 8 + 4 + 4 + 4 + 4 + 8 + 8];
         size_t body = 1 + 4 + 8 + 4 + 4 + 4 + 4;
         req[8] = kProtoVersion;
         put32(req + 9, 1);
@@ -466,9 +481,13 @@ int lz_read_parts_gather(lz_part_req* parts, uint32_t d, uint32_t offset,
         put32(req + 25, parts[i].part_id);
         put32(req + 29, offset);
         put32(req + 33, part_blocks[i] * kBlockSize);
-        if (g_trace_id != 0) {  // optional trailing trace id (wire.h)
+        if (g_trace_id != 0) {  // optional trailing trace + session (wire.h)
             put64(req + 37, g_trace_id);
             body += 8;
+            if (g_session_id != 0) {
+                put64(req + 45, g_session_id);
+                body += 8;
+            }
         }
         put32(req, kTypeReadBulk);
         put32(req + 4, static_cast<uint32_t>(body));

@@ -303,6 +303,7 @@ struct WriteSession {
     uint32_t version = 0;
     uint32_t part_id = 0;
     uint64_t trace_id = 0;  // from WriteInit's optional trailing field
+    uint64_t session_id = 0;  // ditto (per-session op accounting)
     int fd = -1;           // owned by the session (closed at teardown)
     int max_blocks = 0;
     int down_fd = -1;      // owned here
@@ -315,7 +316,8 @@ struct WriteSession {
 
 // one finished data-plane op for the trace ring (runtime/tracing.py):
 // absolute CLOCK_REALTIME bounds + accumulated disk/net time inside.
-// Flattened to 8 u64 slots by lz_serve_trace; keep in sync with
+// Flattened to 9 u64 slots by lz_serve_trace2 (8 by the legacy
+// lz_serve_trace, which elides session_id); keep in sync with
 // chunkserver/native_serve.py TRACE_OP_SLOTS.
 struct TraceOp {
     uint64_t kind;      // 1=read 2=read_bulk 4=write_bulk
@@ -326,6 +328,7 @@ struct TraceOp {
     uint64_t t_end_us;
     uint64_t disk_us;   // time in flock..unlock block IO (+ CRC pass)
     uint64_t net_us;    // send time (reads) / recv time (writes)
+    uint64_t session_id;  // originating client session (0 = legacy peer)
 };
 
 constexpr uint64_t kTraceRead = 1;
@@ -416,7 +419,8 @@ struct Server {
 
 void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
               uint64_t chunk_id, uint64_t bytes, uint64_t t_start_us,
-              uint64_t t_end_us, uint64_t disk_us, uint64_t net_us) {
+              uint64_t t_end_us, uint64_t disk_us, uint64_t net_us,
+              uint64_t session_id = 0) {
     if (kind == kTraceWriteBulk || kind == kTraceWriteShm) {
         srv.write_disk_us.fetch_add(disk_us, std::memory_order_relaxed);
         srv.write_net_us.fetch_add(net_us, std::memory_order_relaxed);
@@ -432,7 +436,8 @@ void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
                              srv.trace_ring.begin() + kTraceRingCap / 2);
     }
     srv.trace_ring.push_back(TraceOp{kind, trace_id, chunk_id, bytes,
-                                     t_start_us, t_end_us, disk_us, net_us});
+                                     t_start_us, t_end_us, disk_us, net_us,
+                                     session_id});
 }
 
 std::mutex g_servers_mu;
@@ -473,8 +478,10 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t part_id = get32(body + 16);
     uint32_t offset = get32(body + 20);
     uint32_t size = get32(body + 24);
-    // optional trailing trace id (wire.h trace contract)
+    // optional trailing trace id (wire.h trace contract) + session id
+    // (per-session op accounting; same additive-tail convention)
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
+    uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
 
     uint8_t code = stOK;
     std::string path;
@@ -631,7 +638,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
         trace_op(srv, kTraceRead, trace_id, chunk_id, size, t_start, t_end,
-                 disk_us, t_end - net0);
+                 disk_us, t_end - net0, session_id);
     }
 }
 
@@ -668,6 +675,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t offset = get32(body + 20);
     uint32_t size = get32(body + 24);
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
+    uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
 
     uint8_t code = stOK;
     std::string path;
@@ -800,7 +808,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
         trace_op(srv, kTraceReadBulk, trace_id, chunk_id, size, t_start,
-                 t_end, disk_us, t_end - net0);
+                 t_end, disk_us, t_end - net0, session_id);
     }
 }
 
@@ -1046,12 +1054,15 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
     }
     bool create = body[pos] != 0;
     // optional trailing trace id (wire.h trace contract): tags every op
-    // of this write session in the trace ring
+    // of this write session in the trace ring; the session id follows
+    // it (same additive-tail convention, 0 = legacy peer)
     uint64_t trace_id = pos + 1 + 8 <= blen ? get64(body + pos + 1) : 0;
+    uint64_t session_id = pos + 1 + 16 <= blen ? get64(body + pos + 9) : 0;
 
     uint8_t code = stOK;
     std::unique_ptr<WriteSession> s(make_local_session(
         srv, chunk_id, version, part_id, create, trace_id, &code));
+    if (s != nullptr) s->session_id = session_id;
     if (s == nullptr) {
         send_status(cfd, send_mu, kTypeWriteStatus, req_id, chunk_id, 0,
                     code);
@@ -1084,10 +1095,13 @@ void serve_write_init(Server& srv, int cfd, std::mutex* send_mu,
                       chain[i].part_id);
             }
             f.push_back(create ? 1 : 0);
-            if (trace_id != 0) {  // propagate down the relay chain
+            if (trace_id != 0 || session_id != 0) {
+                // propagate down the relay chain (session rides after
+                // trace, so a bare session still needs the trace slot)
                 size_t base = f.size();
-                f.resize(base + 8);
+                f.resize(base + (session_id != 0 ? 16 : 8));
                 put64(f.data() + base, trace_id);
+                if (session_id != 0) put64(f.data() + base + 8, session_id);
             }
             put32(f.data(), kTypeWriteInit);
             put32(f.data() + 4, static_cast<uint32_t>(f.size() - 8));
@@ -1345,7 +1359,8 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
     }
     *conn_ok = true;  // frame fully consumed; socket still in sync
     trace_op(srv, kTraceWriteBulk, s != nullptr ? s->trace_id : 0, chunk_id,
-             dlen, t_start, lzwire::now_us(), disk_us, recv_us);
+             dlen, t_start, lzwire::now_us(), disk_us, recv_us,
+             s != nullptr ? s->session_id : 0);
 
     bool down_was_dead = false;
     if (s != nullptr && s->down_fd >= 0) {
@@ -1592,7 +1607,8 @@ bool shm_handle_frame(Server& srv, ShmConn* c, uint32_t type,
         }
         srv.shm_desc_ops.fetch_add(1, std::memory_order_relaxed);
         trace_op(srv, kTraceWriteShm, s != nullptr ? s->trace_id : 0,
-                 chunk_id, len, t_start, lzwire::now_us(), disk_us, 0);
+                 chunk_id, len, t_start, lzwire::now_us(), disk_us, 0,
+                 s != nullptr ? s->session_id : 0);
         shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, write_id,
                          code);
         return true;
@@ -1642,7 +1658,8 @@ bool shm_handle_frame(Server& srv, ShmConn* c, uint32_t type,
             srv.write_ops.fetch_add(1, std::memory_order_relaxed);
         }
         trace_op(srv, kTraceWriteBulk, s != nullptr ? s->trace_id : 0,
-                 chunk_id, dlen, t_start, lzwire::now_us(), disk_us, 0);
+                 chunk_id, dlen, t_start, lzwire::now_us(), disk_us, 0,
+                 s != nullptr ? s->session_id : 0);
         shm_queue_status(c, kTypeWriteStatus, req_id, chunk_id, write_id,
                          code);
         return true;
@@ -1665,9 +1682,12 @@ bool shm_handle_frame(Server& srv, ShmConn* c, uint32_t type,
             const bool create = body[pos] != 0;
             const uint64_t trace_id =
                 pos + 1 + 8 <= blen ? get64(body + pos + 1) : 0;
+            const uint64_t session_id =
+                pos + 1 + 16 <= blen ? get64(body + pos + 9) : 0;
             WriteSession* s = make_local_session(
                 srv, chunk_id, version, part_id, create, trace_id, &code);
             if (s != nullptr) {
+                s->session_id = session_id;
                 auto it = c->sessions.find(SessionKey(chunk_id, part_id));
                 if (it != c->sessions.end()) teardown_session(it->second);
                 c->sessions[SessionKey(chunk_id, part_id)] = s;
@@ -2320,11 +2340,11 @@ void lz_serve_shm_stats(int handle, uint64_t* out) {
     out[3] = active > 0 ? static_cast<uint64_t>(active) : 0;
 }
 
-// Drain up to max_ops finished traced ops, oldest first, 8 u64 slots
-// each: kind, trace_id, chunk_id, bytes, t_start_us, t_end_us, disk_us,
-// net_us. Returns the op count. Draining keeps the Python fold free of
-// dedupe bookkeeping.
-int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
+// Drain up to max_ops finished traced ops, oldest first, ``slots`` u64
+// per op: kind, trace_id, chunk_id, bytes, t_start_us, t_end_us,
+// disk_us, net_us[, session_id]. Returns the op count. Draining keeps
+// the Python fold free of dedupe bookkeeping.
+static int drain_trace(int handle, uint64_t* out, int max_ops, int slots) {
     Server* srv = nullptr;
     {
         std::lock_guard<std::mutex> g(g_servers_mu);
@@ -2339,7 +2359,7 @@ int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
                          max_ops > 0 ? static_cast<size_t>(max_ops) : 0));
     for (int i = 0; i < n; ++i) {
         const TraceOp& op = srv->trace_ring[static_cast<size_t>(i)];
-        uint64_t* slot = out + 8 * i;
+        uint64_t* slot = out + slots * i;
         slot[0] = op.kind;
         slot[1] = op.trace_id;
         slot[2] = op.chunk_id;
@@ -2348,10 +2368,24 @@ int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
         slot[5] = op.t_end_us;
         slot[6] = op.disk_us;
         slot[7] = op.net_us;
+        if (slots > 8) slot[8] = op.session_id;
     }
     srv->trace_ring.erase(srv->trace_ring.begin(),
                           srv->trace_ring.begin() + n);
     return n;
+}
+
+// legacy 8-slot drain (pre-session Pythons keep working against a new
+// .so; session_id is simply elided)
+int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
+    return drain_trace(handle, out, max_ops, 8);
+}
+
+// 9-slot drain: the 8 legacy slots + the originating session id
+// (per-session op accounting; chunkserver/native_serve.py prefers this
+// and falls back to lz_serve_trace on a stale .so)
+int lz_serve_trace2(int handle, uint64_t* out, int max_ops) {
+    return drain_trace(handle, out, max_ops, 9);
 }
 
 }  // extern "C"

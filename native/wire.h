@@ -8,7 +8,11 @@
 // trailing region of the frame. Receivers that predate it ignore the
 // extra bytes (body parsers bound-check ">= fixed size", not "=="); new
 // receivers read it when the body is long enough. Trace id 0 = untraced.
-// The python codec mirrors this as a SKEW_TOLERANT trailing field.
+// Session propagation (runtime/accounting.py): a second trailing u64 —
+// the originating client session — follows the trace id under the same
+// contract (per-session op accounting; it is positional, so a session
+// only rides frames that also carry the trace slot). 0 = unattributed.
+// The python codec mirrors both as SKEW_TOLERANT trailing fields.
 #pragma once
 
 #include <cctype>

@@ -11,6 +11,8 @@ import json
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
@@ -117,6 +119,18 @@ def _fat_row() -> dict:
         "cs_ingest": {"real_cs": 128, "parts_each": 2000, "ingest_s": 1.9},
         "loop_stalls": 0, "shadow_lag": 0,
     }
+    # bench-trajectory regression guard (this round): worst-case-ish —
+    # a round where several fiducials regressed past tolerance
+    row["bench_prev_round"] = 11
+    row["bench_deltas_pct"] = {
+        f"cluster_{g}_write_MBps": -31.5
+        for g in ("ec8_4", "ec3_2", "xor3", "goal_2_2_copies")
+    }
+    row["bench_regressions"] = [
+        "cluster_ec8_4_write_MBps", "cluster_ec3_2_write_MBps",
+        "cluster_goal_2_2_copies_write_MBps", "cluster_xor3_write_MBps",
+        "cluster_dbench8_ops_per_s",
+    ]
     return row
 
 
@@ -180,6 +194,83 @@ def test_summary_line_fits_driver_tail():
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail
     assert not any("C_client" in k for k in parsed)
+    # the regression guard's verdict rides the tail (or its drop is
+    # recorded); the full per-key delta map is full-file-only
+    assert (
+        parsed.get("bench_regressions") == _fat_row()["bench_regressions"]
+        or "bench_regressions" in parsed.get("dropped", [])
+    )
+    assert parsed.get("bench_prev_round") == 11
+    assert "bench_deltas_pct" not in parsed
+
+
+def test_bench_delta_guard():
+    """Round-over-round fiducial comparison: direction-aware deltas,
+    tolerance-gated regressions, metric-mismatch guard on `value`."""
+    prev = {
+        "metric": "kernelA", "value": 1000.0,
+        "cluster_ec8_4_write_MBps": 400.0,
+        "cluster_dbench8_ops_per_s": 900.0,
+        "reconstruct_1shard_cpu_ms": 100.0,
+        "cluster_4k_read_native_us": 200.0,
+        "box_memcpy_GBps": 10.0,
+        "cluster_ec8_4_write_phases": {"send_ms": 1.0},  # non-scalar: skip
+    }
+    row = {
+        "metric": "kernelA", "value": 990.0,          # -1%: fine
+        "cluster_ec8_4_write_MBps": 250.0,            # -37.5%: regression
+        "cluster_dbench8_ops_per_s": 1200.0,          # +33%: improvement
+        "reconstruct_1shard_cpu_ms": 140.0,           # +40% latency: regression
+        "cluster_4k_read_native_us": 190.0,           # faster: fine
+        "box_memcpy_GBps": 9.5,
+        "cluster_ec8_4_write_phases": {"send_ms": 2.0},
+        "cluster_error": "oops",                      # non-numeric: skip
+    }
+    deltas, regs = bench.bench_deltas(row, prev)
+    assert regs == [
+        "cluster_ec8_4_write_MBps", "reconstruct_1shard_cpu_ms",
+    ]
+    assert deltas["cluster_ec8_4_write_MBps"] == -37.5
+    assert deltas["cluster_dbench8_ops_per_s"] == pytest.approx(33.3, 0.1)
+    assert "cluster_ec8_4_write_phases" not in deltas
+    # a changed kernel metric makes `value` incomparable
+    d2, _ = bench.bench_deltas({**row, "metric": "kernelB"}, prev)
+    assert "value" not in d2
+
+
+def test_bench_round_self_record_and_reload(tmp_path):
+    """bench self-records its round file (numbered past any existing
+    file, parseable or not) and the next run loads it back as the
+    comparison base; a driver-captured tail cut mid-JSON contributes
+    nothing (the pre-guard trajectory)."""
+    # a truncated driver capture like the real BENCH_r05.json
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({
+        "n": 5, "tail": 'y_write_reps_MBps": [721.7, 773.6], "clus',
+    }))
+    assert bench._load_prev_round(str(tmp_path)) is None
+    row = {"metric": "kernelA", "value": 100.0,
+           "cluster_ec8_4_write_MBps": 400.0}
+    bench._bench_guard(row, str(tmp_path))
+    assert "bench_guard_error" not in row
+    assert (tmp_path / "BENCH_r06.json").exists()
+    n, prev_row = bench._load_prev_round(str(tmp_path))
+    assert n == 6 and prev_row["cluster_ec8_4_write_MBps"] == 400.0
+    # the next round compares against it and flags the regression
+    row2 = {"metric": "kernelA", "value": 99.0,
+            "cluster_ec8_4_write_MBps": 100.0}
+    bench._bench_guard(row2, str(tmp_path))
+    assert row2["bench_prev_round"] == 6
+    assert row2["bench_regressions"] == ["cluster_ec8_4_write_MBps"]
+    assert (tmp_path / "BENCH_r07.json").exists()
+    # a driver tail whose LAST line is whole JSON is minable
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps({
+        "n": 8,
+        "tail": 'garbage {"cut": \n'
+                + json.dumps({"summary": 1, "value": 50.0,
+                              "metric": "kernelA"}) + "\n",
+    }))
+    n, mined = bench._load_prev_round(str(tmp_path))
+    assert n == 8 and mined["value"] == 50.0
 
 
 def test_summary_budget_guard_drops_not_truncates():

@@ -26,11 +26,31 @@ from lizardfs_tpu.runtime.metrics import Metrics
 from tests.test_cluster import Cluster
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# optional OpenMetrics exemplar suffix (` # {labels} value [ts]`) — the
+# labeled-histogram families attach the slowest recent op's trace id to
+# their +Inf bucket; legal ONLY on histogram bucket samples
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?P<labels>\{[^}]*\})? (?P<value>\S+)$"
+    r"(?P<labels>\{[^}]*\})? (?P<value>\S+)"
+    r"(?P<exemplar> # (?P<elabels>\{[^}]*\}) (?P<evalue>\S+)( \S+)?)?$"
 )
 _LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"$')
+
+
+def _split_variant(labels: str | None) -> tuple[tuple, str | None]:
+    """(non-le label pairs sorted, le pair) of one sample's label set —
+    labeled histograms carry per-variant bucket series, so every
+    structural histogram check groups by the variant first."""
+    if not labels:
+        return (), None
+    le = None
+    rest = []
+    for pair in labels[1:-1].split(","):
+        if pair.startswith('le="'):
+            le = pair
+        else:
+            rest.append(pair)
+    return tuple(sorted(rest)), le
 
 
 def lint_prometheus(text: str) -> dict:
@@ -87,6 +107,21 @@ def lint_prometheus(text: str) -> dict:
             if name.endswith(suffix) and name[: -len(suffix)] in typed:
                 family = name[: -len(suffix)]
         assert family in typed, f"line {lineno}: sample without TYPE: {name}"
+        if match.group("exemplar"):
+            # exemplars: bucket samples of histogram families only,
+            # well-formed label pairs, parseable value
+            assert typed.get(family) == "histogram" and name.endswith(
+                "_bucket"
+            ), f"line {lineno}: exemplar on a non-bucket sample"
+            for pair in match.group("elabels")[1:-1].split(","):
+                assert _LABEL_RE.match(pair), \
+                    f"line {lineno}: bad exemplar label {pair!r}"
+            try:
+                float(match.group("evalue"))
+            except ValueError:
+                raise AssertionError(
+                    f"line {lineno}: bad exemplar value"
+                ) from None
         sampled.add(family)
         if typed.get(family) == "histogram":
             histograms[family].append((name, labels, value))
@@ -97,16 +132,30 @@ def lint_prometheus(text: str) -> dict:
     for family, mtype in typed.items():
         assert family in sampled, f"TYPE {family} has no samples"
     for family, samples in histograms.items():
-        buckets = [s for s in samples if s[0] == family + "_bucket"]
+        # labeled histograms carry one bucket series PER VARIANT (the
+        # non-le label set); every structural check groups by variant
+        buckets: dict[tuple, list] = {}
+        counts_of: dict[tuple, float] = {}
+        sums_of: set[tuple] = set()
+        for name, labels, value in samples:
+            variant, le = _split_variant(labels)
+            if name == family + "_bucket":
+                assert le is not None, f"{family}: bucket without le"
+                buckets.setdefault(variant, []).append((le, float(value)))
+            elif name == family + "_count":
+                counts_of[variant] = float(value)
+            elif name == family + "_sum":
+                sums_of.add(variant)
         assert buckets, f"histogram {family} has no buckets"
-        counts = [float(v) for _, _, v in buckets]
-        assert counts == sorted(counts), f"{family} buckets not cumulative"
-        assert 'le="+Inf"' in buckets[-1][1], f"{family} missing +Inf"
-        count_rows = [s for s in samples if s[0] == family + "_count"]
-        assert count_rows and float(count_rows[0][2]) == counts[-1], \
-            f"{family}: +Inf bucket != _count"
-        assert any(s[0] == family + "_sum" for s in samples), \
-            f"{family} missing _sum"
+        for variant, rows in buckets.items():
+            counts = [v for _, v in rows]
+            assert counts == sorted(counts), \
+                f"{family}{variant}: buckets not cumulative"
+            assert rows[-1][0] == 'le="+Inf"', \
+                f"{family}{variant}: missing/misplaced +Inf"
+            assert counts_of.get(variant) == counts[-1], \
+                f"{family}{variant}: +Inf bucket != _count"
+            assert variant in sums_of, f"{family}{variant}: missing _sum"
     return typed
 
 
@@ -127,6 +176,15 @@ def test_lint_synthetic_registry_all_kinds():
     mt.sample_all(1.0)
     mt.define("total", "bytes_read 2 MUL", help="derived doubling")
     mt.timing("CltomaCreate", help="create latency").record(0.001)
+    # labeled-histogram family (session_ops{session,op} shape): one
+    # HELP/TYPE block, per-variant bucket/_sum/_count, exemplar syntax
+    mt.labeled_timing(
+        "session_ops", {"session": "s5", "op": "read"},
+        help="per-session op latency",
+    ).record(0.002, trace_id=0xABC)
+    mt.labeled_timing(
+        "session_ops", {"session": 's"hostile\\', "op": "write"},
+    ).record(0.001)  # hostile label value must sanitize, not break
     slomod.SloEngine(mt, role="test")  # the full SLO gauge family
     typed = lint_prometheus(mt.to_prometheus())
     assert typed["lizardfs_bytes_read_total"] == "counter"
@@ -134,12 +192,18 @@ def test_lint_synthetic_registry_all_kinds():
     assert typed["lizardfs_faults_injected_total"] == "counter"
     assert typed["lizardfs_total"] == "gauge"  # derived exports as gauge
     assert typed["lizardfs_timing_CltomaCreate_us"] == "histogram"
+    assert typed["lizardfs_session_ops_us"] == "histogram"
     assert typed["lizardfs_slo_read_burn_fast"] == "gauge"
     # the explicit help text made it to the page verbatim
     text = mt.to_prometheus()
     assert "# HELP lizardfs_bytes_read_total bytes served to clients" in text
     assert ('lizardfs_faults_injected_total'
             '{action="flip",site="disk_pread"} 1') in text
+    # ONE HELP/TYPE block per labeled family, and the exemplar rides
+    # the +Inf bucket in OpenMetrics syntax
+    assert text.count("# TYPE lizardfs_session_ops_us histogram") == 1
+    assert ('lizardfs_session_ops_us_bucket{op="read",session="s5",'
+            'le="+Inf"} 1 # {trace_id="0xabc"}') in text
 
 
 def test_lint_rejects_violations():
@@ -207,5 +271,10 @@ async def test_lint_live_daemon_registries(tmp_path):
         typed = lint_prometheus(text)
         assert "lizardfs_cluster_health_status" in typed
         assert "lizardfs_span_ring_dropped_total" in typed
+        # per-session accounting on the live page: the traffic above
+        # attributed to the client's session, exposed as the labeled
+        # histogram family (the `top` view's data source)
+        assert typed["lizardfs_session_ops_us"] == "histogram"
+        assert f'session="s{c.session_id}"' in text
     finally:
         await cluster.stop()

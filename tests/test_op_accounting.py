@@ -1,0 +1,202 @@
+"""Exactly-once op accounting across failure/retry paths (ISSUE 14
+satellite; the PR-7 double-count class).
+
+One LOGICAL read/write must count exactly once in the new per-session
+labeled counters no matter how many transient retries, replica
+fallbacks, or RMW retry loops the implementation burned underneath.
+Each scenario runs under the deterministic scheduler
+(runtime/detsched.py) across several seeds so callback/executor
+interleavings can't hide a double count: the seed that reorders the
+retry against the original attempt is exactly the one a wall-clock test
+never explores.
+"""
+
+import pytest
+
+from lizardfs_tpu.runtime import detsched, faults
+from lizardfs_tpu.utils import data_generator
+
+# seed 1 rides tier-1; the rest of the seed matrix is slow-marked (each
+# scenario boots a real in-process cluster under the deterministic
+# loop, ~40 s apiece — the full matrix belongs to `make racehunt` /
+# chaos-cadence runs, not the fast gate)
+SEEDS = (
+    1,
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+)
+
+
+def _ops(client, op_class: str) -> int:
+    """Count of the client's labeled session_ops cell for one class."""
+    t = client.metrics.labeled_timings.get("session_ops", {}).get(
+        (("op", op_class), ("session", f"s{client.session_id}"))
+    )
+    return t.count if t is not None else 0
+
+
+def _bytes(client, op_class: str) -> float:
+    s = client.metrics.labeled.get("session_bytes", {}).get(
+        (("op", op_class), ("session", f"s{client.session_id}"))
+    )
+    return s.total if s is not None else 0.0
+
+
+async def _read_retry_scenario(tmp_path, seed: int):
+    """A degraded ec(3,2) read whose first part serve errors: the read
+    recovers (decode or re-locate retry) and the logical read counts
+    ONCE."""
+    from tests.test_cluster import Cluster, EC_GOAL
+
+    cluster = Cluster(tmp_path, n_cs=5, native_data_plane=False)
+    await cluster.start()
+    try:
+        # armed BEFORE any data IO: while rules are armed the client's
+        # native fast paths stand down, which the deterministic loop
+        # REQUIRES — detsched runs executor jobs inline, so a blocking
+        # native socket call against the in-process CS would deadlock.
+        # The rule itself only matches serve_read, so the write below
+        # is unaffected; the first read after the invalidate errors
+        # once and must recover.
+        faults.install(
+            "seed=%d; chunkserver:serve_read error,limit=1" % seed
+        )
+        c = await cluster.client()
+        f = await c.create(1, "ret.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        payload = data_generator.generate(3, 5 * 65536 + 17).tobytes()
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        before_ops = _ops(c, "read")
+        before_bytes = _bytes(c, "read")
+        data = await c.read_file(f.inode, 0, len(payload))
+        assert data == payload
+        return (_ops(c, "read") - before_ops,
+                _bytes(c, "read") - before_bytes, len(payload))
+    finally:
+        faults.clear()
+        await cluster.stop()
+
+
+async def _rmw_retry_scenario(tmp_path, seed: int):
+    """A partial-stripe pwrite whose first attempt tears on an injected
+    disk error: the RMW retry loop reruns the attempt, the logical
+    write counts ONCE."""
+    from tests.test_cluster import Cluster, EC_GOAL
+
+    cluster = Cluster(tmp_path, n_cs=5, native_data_plane=False)
+    await cluster.start()
+    try:
+        # keep SOME rule armed for the whole scenario (native paths
+        # stand down — see _read_retry_scenario); the never-firing
+        # placeholder covers the base write, then the real one-shot
+        # disk error replaces it for the pwrite under test
+        faults.install(
+            "seed=%d; chunkserver:disk_pwrite error,after=1000000" % seed
+        )
+        c = await cluster.client()
+        f = await c.create(1, "rmw.bin")
+        await c.setgoal(f.inode, EC_GOAL)
+        base = data_generator.generate(5, 6 * 65536).tobytes()
+        await c.write_file(f.inode, base)
+        patch = b"P" * 4096
+        before = _ops(c, "write")
+        faults.install(
+            "seed=%d; chunkserver:disk_pwrite error,limit=1" % seed
+        )
+        await c.pwrite(f.inode, 100, patch)
+        c.cache.invalidate(f.inode)
+        c._locate_cache.clear()
+        # the one-shot rule already fired: reads pass through it armed
+        got = await c.read_file(f.inode, 100, len(patch))
+        assert got == patch
+        return _ops(c, "write") - before
+    finally:
+        faults.clear()
+        await cluster.stop()
+
+
+async def _replica_fallback_scenario(tmp_path):
+    """A getattr whose replica leg refuses (follow link down) falls
+    back to the primary: the logical op counts once on the client AND
+    once in the PRIMARY's per-session accounting — the refusing shadow
+    records nothing."""
+    import asyncio
+
+    from lizardfs_tpu.chunkserver.server import ChunkServer
+    from lizardfs_tpu.client.client import Client
+    from lizardfs_tpu.master.server import MasterServer
+    from tests.test_cluster import make_goals
+
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    addrs = [("127.0.0.1", active.port), ("127.0.0.1", shadow.port)]
+    cs = ChunkServer(str(tmp_path / "cs0"), master_addr=addrs,
+                     heartbeat_interval=0.2)
+    await cs.start()
+    c = Client("", 0, master_addrs=addrs)
+    await c.connect()
+    try:
+        f = await c.create(1, "fb.bin")
+        deadline = asyncio.get_running_loop().time() + 10
+        while (shadow.changelog.version != active.changelog.version
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        # prime the replica link, then break the follow stream so the
+        # next replica-routed read is REFUSED -> primary fallback
+        assert (await c.getattr(f.inode)).inode == f.inode
+        shadow._shadow_task.cancel()
+        await asyncio.sleep(0.2)
+        assert not shadow._replica_ready()
+
+        def master_meta_reads(master):
+            t = master.session_ops.metrics.labeled_timings.get(
+                "session_ops", {}
+            ).get((("op", "meta_read"), ("session", f"s{c.session_id}")))
+            return t.count if t is not None else 0
+
+        before_cli = c.op_counters.get("CltomaGetattr", 0)
+        before_active = master_meta_reads(active)
+        before_shadow = master_meta_reads(shadow)
+        assert (await c.getattr(f.inode)).inode == f.inode
+        return (
+            c.op_counters.get("CltomaGetattr", 0) - before_cli,
+            master_meta_reads(active) - before_active,
+            master_meta_reads(shadow) - before_shadow,
+        )
+    finally:
+        await c.close()
+        await cs.stop()
+        await shadow.stop()
+        await active.stop()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_read_counts_once_across_transient_retry(tmp_path, seed):
+    ops, nbytes, size = detsched.run(
+        _read_retry_scenario(tmp_path, seed), seed=seed
+    )
+    assert ops == 1, f"seed {seed}: logical read counted {ops} times"
+    assert nbytes == size, f"seed {seed}: bytes double-counted"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_rmw_write_counts_once_across_retry(tmp_path, seed):
+    ops = detsched.run(_rmw_retry_scenario(tmp_path, seed), seed=seed)
+    assert ops == 1, f"seed {seed}: logical pwrite counted {ops} times"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_replica_fallback_counts_once(tmp_path, seed):
+    cli, active_n, shadow_n = detsched.run(
+        _replica_fallback_scenario(tmp_path), seed=seed
+    )
+    assert cli == 1, f"seed {seed}: client double-counted the fallback"
+    assert active_n == 1, f"seed {seed}: primary counted {active_n}"
+    assert shadow_n == 0, f"seed {seed}: refusing shadow recorded the op"

@@ -1,0 +1,153 @@
+"""Always-on sampling profiler (runtime/profiler.py): collapsed-stack
+output, overhead self-throttling, bounded memory, the LZ_PROF kill
+switch, and the FlightRecorder incident auto-arm + stack capture.
+"""
+
+import json
+import re
+import threading
+import time
+
+from lizardfs_tpu.runtime import profiler as profmod
+from lizardfs_tpu.runtime import slo as slomod
+from lizardfs_tpu.runtime.metrics import Metrics
+from lizardfs_tpu.runtime.profiler import SamplingProfiler
+
+_COLLAPSED_LINE = re.compile(r"^[^ ]+( [0-9]+)$")
+
+
+def _burn_named_stack(stop_evt):
+    """A thread parked in a recognizably-named frame."""
+    def inner_hot_loop():
+        while not stop_evt.wait(0.001):
+            pass
+    inner_hot_loop()
+
+
+def test_collapsed_stacks_and_stats():
+    p = SamplingProfiler(role="t", interval_s=0.004)
+    stop_evt = threading.Event()
+    t = threading.Thread(target=_burn_named_stack, args=(stop_evt,),
+                         daemon=True)
+    t.start()
+    p.start()
+    time.sleep(0.4)
+    p.stop()
+    stop_evt.set()
+    t.join(1.0)
+    snap = p.snapshot()
+    assert snap["samples"] > 10
+    assert snap["stacks"] >= 1
+    text = p.collapsed()
+    assert text
+    for line in text.splitlines():
+        # flamegraph.pl collapsed format: "frame;frame;... count"
+        assert _COLLAPSED_LINE.match(line), line
+    # the named thread's frames were captured root-first
+    assert "inner_hot_loop" in text
+    assert "_burn_named_stack;" in text.replace(
+        "test_profiler._burn_named_stack", "_burn_named_stack"
+    ) or "_burn_named_stack" in text
+    # top=N truncates
+    assert len(p.collapsed(top=1).splitlines()) == 1
+
+
+def test_overhead_throttle_keeps_budget():
+    """The adaptive interval keeps sample cost under the overhead
+    budget (the <2% acceptance bound, enforced structurally: interval
+    is re-derived from the measured cost every sample)."""
+    p = SamplingProfiler(role="t", interval_s=0.002,
+                         overhead_budget=0.02)
+    p.start()
+    time.sleep(0.5)
+    p.stop()
+    snap = p.snapshot()
+    assert snap["samples"] > 5
+    cost_s = snap["sample_cost_us"] / 1e6
+    interval_s = snap["interval_ms"] / 1e3
+    # cost per interval stays at/under the budget (some slack for the
+    # EWMA catching up on a noisy box)
+    assert cost_s / interval_s <= p.overhead_budget * 1.5, snap
+
+
+def test_bounded_stack_table():
+    p = SamplingProfiler(role="t", interval_s=0.002, max_stacks=1)
+    stop_evt = threading.Event()
+    t = threading.Thread(target=_burn_named_stack, args=(stop_evt,),
+                         daemon=True)
+    t.start()
+    p.start()
+    time.sleep(0.3)
+    p.stop()
+    stop_evt.set()
+    t.join(1.0)
+    # at most max_stacks distinct keys + the (truncated) overflow row
+    assert len(p.collapsed().splitlines()) <= 2
+    assert p.dropped > 0
+    assert "(truncated)" in p.collapsed()
+
+
+def test_process_profiler_is_shared_and_refcounted():
+    """Daemons share ONE process-wide sampler: N start()s keep a
+    single thread alive until the last stop() (in-process clusters
+    must not pay N GIL-contending samplers for N daemons)."""
+    p = profmod.process_profiler(role="a")
+    assert profmod.process_profiler(role="b") is p
+    p.start()
+    p.start()
+    assert p.running
+    p.stop()
+    assert p.running  # one registrant still up
+    p.stop()
+    assert not p.running
+    p.stop()  # underflow is a no-op
+    assert not p.running
+
+
+def test_lz_prof_off_never_starts():
+    """LZ_PROF=0 equivalence: start() is a no-op — no thread, no
+    samples, empty dump (there are no hot-path hooks to disable)."""
+    assert profmod.enabled()  # default on
+    profmod.set_enabled(False)
+    try:
+        p = SamplingProfiler(role="t")
+        p.start()
+        assert not p.running
+        time.sleep(0.05)
+        assert p.samples == 0
+        assert p.collapsed() == ""
+        assert p.snapshot()["enabled"] is False
+    finally:
+        profmod.set_enabled(True)
+
+
+def test_incident_arms_profiler_and_captures_stacks(tmp_path):
+    """An SLO breach arms the profiler's incident boost and the
+    incident file embeds the collapsed profile next to the spans."""
+    mt = Metrics()
+    eng = slomod.SloEngine(
+        mt, role="t",
+        span_source=lambda tid: [
+            {"trace_id": tid, "span_id": 1, "parent_id": 0, "role": "t",
+             "name": "slow", "t0": 0.0, "t1": 9.9}
+        ],
+        incident_dir=str(tmp_path / "incidents"),
+    )
+    p = SamplingProfiler(role="t", interval_s=0.004)
+    eng.profiler = p
+    eng.recorder.profile_source = p.collapsed
+    p.start()
+    time.sleep(0.1)  # collect some stacks first
+    breached = eng.observe("read", 99.0, trace_id=0xBEEF, name="slow_read")
+    p.stop()
+    assert breached
+    assert p.snapshot()["incident_armed"] is True
+    incidents = list((tmp_path / "incidents").glob("inc_*.json"))
+    assert len(incidents) == 1
+    doc = json.loads(incidents[0].read_text())
+    assert doc["trace_id"] == 0xBEEF
+    assert doc["spans"]
+    assert "profile" in doc and doc["profile"], doc.keys()
+    # the embedded profile is collapsed-stack text
+    for line in doc["profile"].splitlines():
+        assert _COLLAPSED_LINE.match(line), line

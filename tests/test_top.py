@@ -1,0 +1,367 @@
+"""Workload observatory: per-session op accounting + the cluster `top`
+rollup (ISSUE 14 tentpole).
+
+Pins: the labeled-timing family (exemplars, quantiles, cardinality
+bound), SessionOps top-K summaries, LZ_TOP=0 byte-equivalence of the
+scrape page, and — in the `smoke`-named e2e (`make top-smoke`) — a full
+in-process observatory cluster (master + chunkservers + NFS + S3
+gateways) whose `lizardfs-admin top` attributes traffic to the correct
+originating sessions with a trace-dump-renderable exemplar.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from lizardfs_tpu.proto import framing, messages as m
+from lizardfs_tpu.runtime import accounting, tracing
+from lizardfs_tpu.runtime.metrics import LABEL_VARIANT_CAP, Metrics
+
+from tests.test_cluster import Cluster
+
+
+# --- labeled timing family --------------------------------------------------
+
+
+def test_labeled_timing_exemplar_and_quantile():
+    mt = Metrics()
+    t = mt.labeled_timing("session_ops", {"session": "s1", "op": "read"})
+    t.record(0.001)
+    t.record(0.004, trace_id=0x77)
+    # same variant object on re-lookup, single family block on the page
+    assert mt.labeled_timing(
+        "session_ops", {"op": "read", "session": "s1"}
+    ) is t
+    assert t.exemplar_trace_id == 0x77
+    # slower op replaces the exemplar; a faster one inside the TTL does not
+    t.record(0.008, trace_id=0x88)
+    assert t.exemplar_trace_id == 0x88
+    t.record(0.0001, trace_id=0x99)
+    assert t.exemplar_trace_id == 0x88
+    # p99 upper bound lands within one log2 bucket of the max
+    assert 8000 <= t.quantile_us(0.99) <= 16384
+    page = mt.to_prometheus()
+    assert page.count("# TYPE lizardfs_session_ops_us histogram") == 1
+    assert '# {trace_id="0x88"}' in page
+
+
+def test_labeled_variant_cap_folds_to_other():
+    mt = Metrics()
+    for i in range(LABEL_VARIANT_CAP + 10):
+        mt.labeled_timing("f", {"session": f"s{i}"}).record(0.001)
+    variants = mt.labeled_timings["f"]
+    assert len(variants) == LABEL_VARIANT_CAP + 1  # + the "other" bucket
+    other = variants[(("session", "other"),)]
+    assert other.count == 10  # cap hit at 256; the next 10 folded here
+
+
+# --- SessionOps -------------------------------------------------------------
+
+
+def test_session_ops_top_and_rates():
+    mt = Metrics()
+    so = accounting.SessionOps(mt, "master", max_sessions=4)
+    for _ in range(5):
+        so.record(7, "read", 0.002, nbytes=1000, trace_id=0xA)
+    so.record(8, "write", 0.004, nbytes=500, trace_id=0xB)
+    top = so.top(8)
+    assert top[0]["session"] == "s7"
+    assert top[0]["classes"]["read"]["ops"] == 5
+    assert top[0]["classes"]["read"]["bytes"] == 5000
+    assert top[0]["exemplar"] == "0xa"
+    assert top[0]["rate_ops"] > 0
+    assert so.total_rate() > 0
+    assert so.active_sessions() == 2
+    so.retire(7)
+    assert so.active_sessions() == 1
+    # retirement drops the labeled variants too: session churn must
+    # not fill LABEL_VARIANT_CAP with dead cells (which would fold
+    # every FUTURE session into "other" — no p99, no exemplar)
+    assert (("op", "read"), ("session", "s7")) not in mt.labeled_timings[
+        "session_ops"
+    ]
+    assert all(
+        ("session", "s7") not in key
+        for key in mt.labeled.get("session_bytes", {})
+    )
+    # overflow sessions fold into the "other" row, totals stay truthful
+    for sid in range(100, 110):
+        so.record(sid, "read", 0.001)
+    labels = {row["session"] for row in so.top(16)}
+    assert "other" in labels
+    # s8(1) + three fresh slots (1 each) + 7 folded into "other"; the
+    # retired s7's aggregates are gone
+    assert sum(r["ops"] for r in so.top(16)) == 11
+
+
+def test_lz_top_off_page_byte_equivalent():
+    """LZ_TOP=0: record() is one attribute check, no labeled series are
+    created, and the Prometheus page is byte-identical to one that
+    never saw accounting traffic."""
+    assert accounting.enabled()  # default-on (LZ_TOP unset in CI)
+    mt = Metrics()
+    baseline = mt.to_prometheus()
+    accounting.set_enabled(False)
+    try:
+        so = accounting.SessionOps(mt, "cs")
+        so.record(5, "read", 0.001, nbytes=10, trace_id=0x1)
+        assert so.top(4) == []
+        assert so.total_rate() == 0.0
+        assert mt.to_prometheus() == baseline
+    finally:
+        accounting.set_enabled(True)
+
+
+# --- the observatory e2e (make top-smoke) -----------------------------------
+
+
+async def _admin(port: int, command: str, payload: str = "{}"):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        await framing.send_message(
+            writer, m.AdminCommand(req_id=1, command=command, json=payload)
+        )
+        return await framing.read_message(reader)
+    finally:
+        writer.close()
+
+
+async def _http_get(port: int, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        head = await reader.readline()
+        code = int(head.split()[1])
+        clen = 0
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":")[1])
+        body = await reader.readexactly(clen) if clen else b""
+        return code, body
+    finally:
+        writer.close()
+
+
+async def _wait(predicate, timeout=15.0, interval=0.1):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_top_smoke_cluster_wide_attribution(tmp_path):
+    """The acceptance shape in-process: master + 2 CS + NFS + S3 under
+    load; `top` renders per-session rates/bytes/p99 attributed to the
+    correct sessions, with an exemplar trace `trace-dump` renders."""
+    from lizardfs_tpu.chunkserver.server import ChunkServer
+    from lizardfs_tpu.nfs.server import NfsGateway
+    from lizardfs_tpu.s3.server import S3Gateway
+
+    cluster = Cluster(tmp_path, n_cs=0)
+    await cluster.start()
+    # fast heartbeats so the CS session summaries fold into cs_health
+    # within the test's patience (the timer interval binds at __init__)
+    for i in range(2):
+        cs = ChunkServer(
+            str(tmp_path / f"topcs{i}"),
+            master_addr=("127.0.0.1", cluster.master.port),
+            wave_timeout=0.2, native_data_plane=False,
+            heartbeat_interval=0.3,
+        )
+        await cs.start()
+        cluster.chunkservers.append(cs)
+    nfs_gw = NfsGateway("127.0.0.1", cluster.master.port)
+    s3_gw = S3Gateway("127.0.0.1", cluster.master.port)
+    nfs_gw.stats_push_interval_s = 0.2
+    s3_gw.stats_push_interval_s = 0.2
+    await nfs_gw.start()
+    await s3_gw.start()
+    try:
+        # client traffic: a write + a cold read, all attributed to the
+        # client's master-issued session
+        c = await cluster.client()
+        f = await c.create(1, "hot.bin")
+        payload = b"z" * 300_000
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode, 0, len(payload)) == payload
+        # s3 traffic through the gateway's own session
+        code, _ = await _http_get(s3_gw.port, "/healthz")
+        assert code == 200
+
+        async def s3_put(path: str, body: bytes) -> int:
+            r, w = await asyncio.open_connection("127.0.0.1", s3_gw.port)
+            try:
+                w.write(
+                    (
+                        f"PUT {path} HTTP/1.1\r\nHost: x\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n"
+                    ).encode() + body
+                )
+                await w.drain()
+                head = await r.readline()
+                return int(head.split()[1])
+            finally:
+                w.close()
+
+        assert await s3_put("/tbkt", b"") == 200
+        assert await s3_put("/tbkt/k1", b"obj-bytes" * 100) == 200
+
+        # heartbeats fold CS session summaries; gateways push stats
+        def ready():
+            rep = cluster.master.top_report()
+            label = f"s{c.session_id}"
+            s3_label = f"s{s3_gw.client.session_id}"
+            sess = rep["sessions"]
+            return (
+                label in sess
+                and "read" in sess[label].get("master", {}).get(
+                    "classes", {}
+                )
+                and sess.get(s3_label, {}).get("gateway") is not None
+                and rep["chunkservers"]
+            )
+
+        assert await _wait(ready), cluster.master.top_report()
+
+        # over the admin wire, like `lizardfs-admin top`
+        reply = await _admin(cluster.master.port, "top")
+        assert reply.status == 0
+        doc = json.loads(reply.json)
+        assert doc["enabled"] is True
+        label = f"s{c.session_id}"
+        row = doc["sessions"][label]["master"]
+        assert row["classes"]["read"]["ops"] >= 1
+        assert row["classes"]["write"]["ops"] >= 1
+        assert row["rate_ops"] >= 0
+        # the chunkserver leg attributes the data-plane BYTES to the
+        # same session (asyncio plane carries the trailing session_id)
+        cs_rows = [
+            r for rows in doc["chunkservers"].values() for r in rows
+        ]
+        assert any(
+            r["session"] == label and r.get("bytes", 0) > 0
+            for r in cs_rows
+        ), cs_rows
+        # the s3 gateway's push names its protocol-op mix
+        gw = doc["sessions"][f"s{s3_gw.client.session_id}"]["gateway"]
+        assert gw["role"] == "s3"
+        proto_classes = gw["protocol"][0]["classes"]
+        assert any(k.startswith("s3_") for k in proto_classes)
+        # history rings present (metrics-history retention for trends)
+        assert "session_ops_rate" in doc["history"]
+        # at least one exemplar links to a trace the span rings render
+        exemplar = row.get("exemplar") or next(
+            (v["exemplar"] for v in row["classes"].values()
+             if "exemplar" in v), None,
+        )
+        assert exemplar, row
+        tid = int(exemplar, 16)
+        spans = cluster.master.trace_spans(tid)
+        for cs in cluster.chunkservers:
+            spans += cs.trace_spans(tid)
+        spans += c.trace_ring.dump(tid)
+        merged = tracing.merge_timeline(spans, tid)
+        assert merged["segments"], "exemplar trace renders no timeline"
+
+        # the NFS gateway's HTTP observability endpoint (satellite):
+        # /metrics lints as a scrape page, /healthz names the role
+        code, page = await _http_get(nfs_gw.http_port, "/metrics")
+        assert code == 200
+        from tests.test_metrics_lint import lint_prometheus
+
+        lint_prometheus(page.decode())
+        code, hz = await _http_get(nfs_gw.http_port, "/healthz")
+        assert code == 200 and json.loads(hz)["role"] == "nfs"
+        code, prof = await _http_get(nfs_gw.http_port, "/profile")
+        assert code == 200
+        prof_doc = json.loads(prof)
+        assert "collapsed" in prof_doc and prof_doc["role"] == "nfs"
+
+        # the daemon-side profiler dump over the admin wire (the
+        # `lizardfs-admin profile` verb; the CLI pipes `collapsed` to
+        # flamegraph.pl). In-process daemons share one interpreter, so
+        # the profiler thread is running and sampling this very test.
+        reply = await _admin(cluster.master.port, "profile")
+        assert reply.status == 0
+        prof = json.loads(reply.json)
+        assert prof["enabled"] and "collapsed" in prof
+        assert prof["overhead_budget_pct"] == 2.0
+
+        # the admin CLI renderer digests the live document
+        from lizardfs_tpu.tools import admin_cli
+
+        rc = await admin_cli._amain(
+            [f"127.0.0.1:{cluster.master.port}", "top"]
+        )
+        assert rc == 0
+    finally:
+        await s3_gw.stop()
+        await nfs_gw.stop()
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_native_plane_attributes_sessions(tmp_path):
+    """The C++ data plane parses the trailing session_id (wire.h
+    session contract, lz_serve_trace2 drain): ops served natively
+    attribute to the originating session, not the 'native' aggregate
+    row — pinned here so the real-cluster `top` story can't rot."""
+    from lizardfs_tpu.core import native_io
+
+    if not native_io.available():
+        pytest.skip("native library not built")
+    cluster = Cluster(tmp_path, n_cs=1, native_data_plane=True)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "nat.bin")
+        payload = b"n" * 600_000
+        await c.write_file(f.inode, payload)
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode, 0, len(payload)) == payload
+        cs = cluster.chunkservers[0]
+        cs._fold_native_trace()
+        rows = {r["session"]: r for r in cs.session_ops.top(8)}
+        label = f"s{c.session_id}"
+        assert label in rows, rows
+        assert rows[label]["bytes"] > 0
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_top_session_retirement_sweeps_accounting(tmp_path):
+    """A retired session leaves the top view (its rate window and any
+    pushed gateway stats go with the registry entry)."""
+    cluster = Cluster(tmp_path, n_cs=1, native_data_plane=False)
+    await cluster.start()
+    try:
+        c = await cluster.client()
+        f = await c.create(1, "bye.bin")
+        await c.write_file(f.inode, b"x" * 1000)
+        sid = c.session_id
+        label = f"s{sid}"
+        assert label in cluster.master.top_report()["sessions"]
+        await c.close()
+        cluster.clients.clear()
+        # the maintenance sweep retires the disconnected session
+        await _wait(lambda: sid not in cluster.master.sessions, timeout=5)
+        cluster.master.session_ops.retire(sid)
+        cluster.master.session_stats.pop(sid, None)
+        rep = cluster.master.top_report()
+        assert label not in {
+            row["session"] for row in cluster.master.session_ops.top(32)
+        }
+    finally:
+        await cluster.stop()
