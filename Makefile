@@ -94,8 +94,19 @@ top-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_top.py -q -k smoke \
 	  -p no:cacheprovider
 
+# qos-smoke: in-process master + chunkservers, an abuser tenant
+# flooding locates next to a paced victim tenant — asserts sheds land
+# ONLY on the abuser, the victim's p99 bound holds, and per-session
+# accounting counts each logical op exactly once (the `smoke`-named
+# subset of tests/test_qos.py; the non-slow file rides tier-1 too).
+# The real-process variant is the `noisy-neighbor` schedule in
+# `make chaos`.
+qos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_qos.py -q -k smoke \
+	  -p no:cacheprovider
+
 native:
 	$(MAKE) -C native
 
 .PHONY: test lint metrics-lint racehunt check sanitize chaos chaos-slow \
-	s3-smoke top-smoke native
+	s3-smoke top-smoke qos-smoke native

@@ -485,6 +485,19 @@ def cluster_throughput() -> dict:
                     "loop_stalls": r.get("loop_stalls", 0),
                     "shadow_lag": r.get("shadow_lag", 0),
                 }
+            elif "qos_ab" in r:
+                # per-tenant QoS A/B (ISSUE 15): the victim's p99 with
+                # an abuser flooding, LZ_QOS off vs on, plus whether
+                # sheds landed only on the abuser (full per-arm worker
+                # stats live in BENCH_FULL.json)
+                q = r["qos_ab"]
+                out["cluster_qos_victim_p99_ms"] = {
+                    "off": q.get("victim_p99_off_ms", 0),
+                    "on": q.get("victim_p99_on_ms", 0),
+                    "bound_ms": q.get("bound_ms", 0),
+                    "abuser_sheds": q.get("abuser_busy_waits_on", 0),
+                    "target_met": q.get("target_met", False),
+                }
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]
@@ -898,6 +911,10 @@ def _summary_row(row: dict) -> dict:
         }
     if "cluster_locate_p99_ms" in row:
         s["cluster_locate_p99_ms"] = row["cluster_locate_p99_ms"]
+    if "cluster_qos_victim_p99_ms" in row:
+        # per-tenant QoS verdict (ISSUE 15): victim p99 off->on under
+        # an abuser flood + its bound + shed placement
+        s["cluster_qos_victim_p99_ms"] = row["cluster_qos_victim_p99_ms"]
     targeted = {
         key[: -len("_target_met")]
         for key in row
@@ -966,6 +983,7 @@ SUMMARY_BUDGET_BYTES = 1900
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "cluster_locate_p99_ms",
+    "cluster_qos_victim_p99_ms",
     "bench_regressions",
     "kernel_ladder",
     "cluster_ec3_2_write_phases", "cluster_ec8_4_write_window",

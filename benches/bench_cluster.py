@@ -806,6 +806,21 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             import logging
 
             logging.getLogger("bench").exception("locate storm row failed")
+
+        # per-tenant QoS A/B (ISSUE 15): an abuser tenant floods the
+        # locate plane next to a paced victim, LZ_QOS off vs on — the
+        # verdict is the victim's p99 under the flood with fair-share
+        # admission shedding the abuser
+        try:
+            from benches.bench_master_storm import run_qos_ab
+
+            rows.append(await run_qos_ab(
+                files=2_000, abuser_ops=400, victim_ops=120,
+            ))
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("qos A/B row failed")
     finally:
         await client.close()
         for cs in servers:
@@ -853,6 +868,14 @@ def main(argv=None) -> int:
                   f"   ({r.get('locate_qps_x', 0)}x, "
                   f"p99 {a['locate_p99_ms']}/"
                   f"{b.get('locate_p99_ms', 0)} ms)")
+        elif "qos_ab" in r:
+            q = r["qos_ab"]
+            print(f"{r['goal']:>18s}:  victim p99 "
+                  f"{q['victim_p99_off_ms']:.1f} -> "
+                  f"{q['victim_p99_on_ms']:.1f} ms (bound "
+                  f"{q['bound_ms']:.0f}); abuser "
+                  f"{q['abuser_qps_off']:.0f} -> {q['abuser_qps_on']:.0f} "
+                  f"q/s; target_met={q['target_met']}")
         elif "put_MBps" in r:
             print(f"{r['goal']:>18s}:  put {r['put_MBps']:8.1f} MB/s"
                   f"   get {r['get_MBps']:8.1f} MB/s"

@@ -81,7 +81,9 @@ async def _wait_port(port: int, timeout: float = 30.0) -> None:
 
 
 def _spawn_master(tmp: str, name: str, port: int,
-                  active_port: int | None = None) -> subprocess.Popen:
+                  active_port: int | None = None,
+                  extra_lines: list[str] | None = None,
+                  env_extra: dict | None = None) -> subprocess.Popen:
     cfg = os.path.join(tmp, f"{name}.cfg")
     lines = [
         f"DATA_PATH = {tmp}/{name}",
@@ -95,10 +97,11 @@ def _spawn_master(tmp: str, name: str, port: int,
             "PERSONALITY = shadow",
             f"ACTIVE_MASTER = 127.0.0.1:{active_port}",
         ]
+    lines += list(extra_lines or [])
     with open(cfg, "w") as f:
         f.write("\n".join(lines) + "\n")
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-               PALLAS_AXON_POOL_IPS="")
+               PALLAS_AXON_POOL_IPS="", **(env_extra or {}))
     return subprocess.Popen(
         [sys.executable, "-m", "lizardfs_tpu.master", cfg],
         stdout=open(os.path.join(tmp, f"{name}.log"), "wb"),
@@ -286,6 +289,152 @@ def _collect(procs: list[subprocess.Popen]) -> dict:
 
 
 # --------------------------------------------------------------------------
+# per-tenant QoS A/B: abuser vs victim under fair-share admission
+# --------------------------------------------------------------------------
+
+# the bench's tenant policy: the victim holds 3x the abuser's weight
+# over a 300 locate/s class budget, so a flooding abuser is shed while
+# the victim's paced load sits far inside its contended share
+QOS_BENCH_CFG = json.dumps({
+    "tenants": {
+        "victim": {"weight": 3, "match": ["qos-victim*"]},
+        "abuser": {"weight": 1, "match": ["qos-abuser*"]},
+    },
+    "rates": {"locate": 300},
+})
+QOS_VICTIM_P99_BOUND_MS = 250.0
+
+
+async def _qos_worker_main(args) -> None:
+    """Tenant worker: ``abuser`` floods locates as fast as the client
+    admits them (sheds retried inside the client); ``victim`` paces at
+    ``--rate`` and records per-op latency."""
+    from lizardfs_tpu.client.client import Client
+
+    host, _, port = args.addrs.rpartition(":")
+    client = Client(host, int(port))
+    await client.connect(info=args.info)
+    inode = args.base_inode + (args.index % max(args.files, 1))
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(args.count):
+        op0 = time.perf_counter()
+        await client.chunk_info(inode, 0)
+        lat.append(time.perf_counter() - op0)
+        if args.rate > 0:
+            # paced arrivals: sleep out the remainder of this op's slot
+            slot = (i + 1) / args.rate
+            behind = slot - (time.perf_counter() - t0)
+            if behind > 0:
+                await asyncio.sleep(behind)
+    wall = time.perf_counter() - t0
+    lat.sort()
+    step = max(len(lat) // 500, 1)
+    out = {
+        "ops": args.count, "wall_s": wall,
+        "qps": round(args.count / wall, 1) if wall else 0.0,
+        "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 2) if lat else 0.0,
+        "lat_sample_ms": [round(v * 1e3, 3) for v in lat[::step]],
+        "busy_waits": client.metrics.counter("qos_busy_waits").total,
+    }
+    await client.close()
+    print(json.dumps(out), flush=True)
+
+
+def _spawn_qos_worker(index: int, port: int, info: str, count: int,
+                      rate: float, base_inode: int, files: int,
+                      tmp: str) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__), "--qos-worker",
+            "--index", str(index), "--addrs", f"127.0.0.1:{port}",
+            "--info", info, "--count", str(count), "--rate", str(rate),
+            "--base-inode", str(base_inode), "--files", str(files),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=open(os.path.join(tmp, f"qosworker{index}.log"), "wb"),
+        env=env,
+    )
+
+
+async def run_qos_ab(
+    files: int = 2_000,
+    abuser_ops: int = 600,
+    victim_ops: int = 200,
+    victim_rate: float = 25.0,
+) -> dict:
+    """The per-tenant split: the SAME abuser-flood + paced-victim storm
+    runs twice — LZ_QOS=0 (pre-QoS behavior) and LZ_QOS=1 with the
+    bench tenant policy — and the verdict is the victim's p99 with the
+    abuser flooding, QoS on vs off. Returns one bench row dict."""
+    row: dict = {
+        "goal": "qos noisy neighbor", "files": files,
+        "abuser_ops": abuser_ops, "victim_ops": victim_ops,
+        "victim_rate": victim_rate,
+    }
+    for arm, qos_env in (("off", "0"), ("on", "1")):
+        tmp = tempfile.mkdtemp(prefix=f"lizqos{arm}")
+        port = _free_port()
+        proc = None
+        try:
+            with open(os.path.join(tmp, "qos.cfg"), "w") as f:
+                f.write(QOS_BENCH_CFG)
+            proc = _spawn_master(
+                tmp, "primary", port,
+                extra_lines=[f"QOS_CFG = {tmp}/qos.cfg"],
+                env_extra={"LZ_QOS": qos_env},
+            )
+            await _wait_port(port)
+            reply = await _admin(port, "synth-populate", json.dumps({
+                "files": files, "servers": 0, "copies": 1,
+            }))
+            assert reply.status == st.OK, reply.json
+            pop = json.loads(reply.json)
+            base_inode = pop["dir_inode"] + 1
+            workers = [
+                _spawn_qos_worker(0, port, "qos-abuser", abuser_ops,
+                                  0.0, base_inode, files, tmp),
+                _spawn_qos_worker(1, port, "qos-victim", victim_ops,
+                                  victim_rate, base_inode, files, tmp),
+            ]
+            outs = []
+            for p in workers:
+                raw, _ = await asyncio.to_thread(p.communicate, None, 600)
+                outs.append(json.loads(raw.decode().strip().splitlines()[-1]))
+            row[arm] = {
+                "abuser": outs[0], "victim": outs[1],
+            }
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            shutil.rmtree(tmp, ignore_errors=True)
+    on_v = row["on"]["victim"]
+    off_v = row["off"]["victim"]
+    row["qos_ab"] = {
+        "victim_p99_off_ms": off_v["p99_ms"],
+        "victim_p99_on_ms": on_v["p99_ms"],
+        "victim_qps_on": on_v["qps"],
+        "abuser_qps_off": row["off"]["abuser"]["qps"],
+        "abuser_qps_on": row["on"]["abuser"]["qps"],
+        "abuser_busy_waits_on": row["on"]["abuser"]["busy_waits"],
+        "victim_busy_waits_on": on_v["busy_waits"],
+        "bound_ms": QOS_VICTIM_P99_BOUND_MS,
+        "target_met": bool(
+            on_v["p99_ms"] <= QOS_VICTIM_P99_BOUND_MS
+            and on_v["busy_waits"] == 0
+            and row["on"]["abuser"]["busy_waits"] > 0
+        ),
+    }
+    return row
+
+
+# --------------------------------------------------------------------------
 # the orchestrated storm
 # --------------------------------------------------------------------------
 
@@ -429,15 +578,37 @@ def main(argv=None) -> int:
     p.add_argument("--real-cs", type=int, default=128)
     p.add_argument("--no-replica-arm", action="store_true")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--qos", action="store_true",
+                   help="run the per-tenant QoS A/B instead of the "
+                        "locate storm")
     # worker mode (internal)
     p.add_argument("--worker", action="store_true")
+    p.add_argument("--qos-worker", action="store_true")
     p.add_argument("--index", type=int, default=0)
     p.add_argument("--addrs", default="")
     p.add_argument("--base-inode", type=int, default=0)
     p.add_argument("--dir-inode", type=int, default=0)
+    p.add_argument("--info", default="qos-abuser")
+    p.add_argument("--count", type=int, default=100)
+    p.add_argument("--rate", type=float, default=0.0)
     args = p.parse_args(argv)
     if args.worker:
         asyncio.run(_worker_main(args))
+        return 0
+    if args.qos_worker:
+        asyncio.run(_qos_worker_main(args))
+        return 0
+    if args.qos:
+        row = asyncio.run(run_qos_ab())
+        q = row["qos_ab"]
+        if args.json:
+            print(json.dumps(row, indent=2))
+        else:
+            print(f"victim p99: off {q['victim_p99_off_ms']} ms -> on "
+                  f"{q['victim_p99_on_ms']} ms (bound {q['bound_ms']}); "
+                  f"abuser {q['abuser_qps_off']} -> {q['abuser_qps_on']} "
+                  f"q/s, {q['abuser_busy_waits_on']:.0f} busy waits; "
+                  f"target_met={q['target_met']}")
         return 0
     row = asyncio.run(run_storm(
         files=args.files, servers=args.servers, secs=args.secs,

@@ -63,6 +63,16 @@ if _lib is not None:
             _lib.lz_serve_shm_stats.restype = None
         except AttributeError:
             pass  # stale .so: shm ring counters stay off
+        try:
+            _lib.lz_serve_qos_set.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ]
+            _lib.lz_serve_qos_set.restype = ctypes.c_int
+            _lib.lz_serve_qos_deferrals.argtypes = [ctypes.c_int]
+            _lib.lz_serve_qos_deferrals.restype = ctypes.c_uint64
+        except AttributeError:
+            pass  # stale .so: native plane stays unpaced (QoS fails open)
     except AttributeError:
         _lib = None
 
@@ -166,6 +176,26 @@ class DataPlaneServer:
                 "session_id": int(s[8]) if slots > 8 else 0,
             })
         return ops
+
+    def qos_set(self, budgets: dict[int, int]) -> bool:
+        """Replace the native plane's per-session byte-rate budget
+        table (multi-tenant QoS; master-pushed via heartbeat acks).
+        The epoll proactor defers over-budget descriptor drains and
+        the threaded read/write paths pace with bounded sleeps. Returns
+        False on a stale .so — the native plane then simply stays
+        unpaced (QoS fails open, never into a lockout)."""
+        if not hasattr(_lib, "lz_serve_qos_set") or self._handle < 0:
+            return False
+        n = len(budgets)
+        sids = (ctypes.c_uint64 * max(n, 1))(*budgets.keys())
+        bps = (ctypes.c_uint64 * max(n, 1))(*budgets.values())
+        return _lib.lz_serve_qos_set(self._handle, sids, bps, n) == 0
+
+    def qos_deferrals(self) -> int:
+        """Data-plane ops paced/deferred by the QoS budgets."""
+        if not hasattr(_lib, "lz_serve_qos_deferrals") or self._handle < 0:
+            return 0
+        return int(_lib.lz_serve_qos_deferrals(self._handle))
 
     def stop(self) -> None:
         if self._handle >= 0:

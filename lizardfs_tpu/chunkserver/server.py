@@ -43,8 +43,10 @@ from lizardfs_tpu.core.encoder import get_encoder
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
+from lizardfs_tpu import constants as constants_mod
 from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as faultsmod
+from lizardfs_tpu.runtime import qos as qosmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
@@ -161,6 +163,16 @@ class ChunkServer(Daemon):
 
         self._repl_bps = self.tweaks.register("replication_bps", 0)
         self._repl_bucket = TokenBucket(0.0)
+        # multi-tenant QoS data plane (runtime/qos.py): per-tenant
+        # in-flight byte budgets under weighted deficit-round-robin.
+        # Config arrives on heartbeat acks (MatocsRegisterReply.
+        # qos_json: session->tenant map, weights, budget); unarmed
+        # (or LZ_QOS=0) every data path pays two checks and nothing
+        # else. Rebuild traffic enters as the "_rebuild" pseudo-tenant
+        # so rebuilds and tenants cannot starve each other.
+        self.qos_queue = qosmod.DrrByteQueue()
+        self._qos_tenants: dict[int, str] = {}
+        self._qos_raw = ""  # last applied qos_json (change detection)
         # fault injection for the SLO/flight-recorder e2e path: delays
         # every asyncio-plane read by this many ms (0 = off). The tweak
         # name survives as an ALIAS onto the general fault framework —
@@ -349,6 +361,12 @@ class ChunkServer(Daemon):
             # shm ring plane (native/shm_ring.h proactor): how many
             # same-host segments are mapped and how many bytes skipped
             # the socket copy — the same view Prometheus scrapes
+            self.metrics.gauge(
+                "native_qos_deferrals",
+                help="native data-plane ops paced/deferred by the "
+                     "per-session QoS byte budgets (proactor drains + "
+                     "threaded read/write paths)",
+            ).set(float(self.data_server.qos_deferrals()))
             shm = self.data_server.shm_stats()
             for key, help_txt in (
                 ("segments_mapped", "shm ring segments negotiated on "
@@ -367,7 +385,7 @@ class ChunkServer(Daemon):
         try:
             import json as _json
 
-            await self.master.call(
+            reply = await self.master.call(
                 m.CstomaHeartbeat,
                 cs_id=self.cs_id,
                 total_space=total,
@@ -378,6 +396,9 @@ class ChunkServer(Daemon):
                 health_json=_json.dumps(self.health_snapshot()),
                 timeout=5.0,
             )
+            # QoS data-plane config refresh (skew-tolerant trailing
+            # qos_json; old masters send "" = stay unthrottled)
+            self._qos_apply(getattr(reply, "qos_json", ""))
         except (ConnectionError, asyncio.TimeoutError):
             pass
 
@@ -554,7 +575,99 @@ class ChunkServer(Daemon):
         sessions = self.session_ops.top(8)
         if sessions:
             extra["sessions"] = sessions
+        # QoS data plane: which tenants are queued behind the byte
+        # budget right now (health/`top` name throttled tenants)
+        if self.qos_queue.armed:
+            q = self.qos_queue.snapshot()
+            extra["qos"] = {
+                "waiting": q["waiting"],
+                "throttle_waits": q["throttle_waits"],
+            }
         return extra
+
+    # --- multi-tenant QoS data plane ---------------------------------------
+
+    def _qos_apply(self, text: str) -> None:
+        """Install the master-pushed QoS config (heartbeat ack). Empty
+        text disarms (master off/unconfigured: behavior reverts to the
+        pre-QoS data plane). Idempotent per payload."""
+        if text == self._qos_raw:
+            return
+        if not text:
+            self._qos_raw = ""
+            self._qos_tenants = {}
+            self.qos_queue.configure({}, 0.0)
+            self._qos_native_apply({})
+            return
+        import json as _json
+
+        try:
+            doc = _json.loads(text)
+            tenants = {
+                int(sid): str(t)
+                for sid, t in (doc.get("tenants") or {}).items()
+            }
+            weights = {
+                str(t): float(w)
+                for t, w in (doc.get("weights") or {}).items()
+            }
+            weights[qosmod.REBUILD_TENANT] = float(
+                doc.get("rebuild_weight", 1.0)
+            )
+            capacity = float(doc.get("inflight_mb", 0) or 0) * 2**20
+        except (ValueError, TypeError):
+            self.log.warning("bad qos_json from master; keeping previous")
+            return
+        self._qos_raw = text
+        self._qos_tenants = tenants
+        self.qos_queue.configure(weights, capacity)
+        self._qos_native_apply(doc.get("session_bps") or {})
+
+    def _qos_native_apply(self, session_bps: dict) -> None:
+        """Per-session byte-rate budgets for the C++ data plane (epoll
+        proactor descriptor drain + threaded reads). Best effort: a
+        stale .so without the API simply stays unpaced — QoS fails
+        open, never into a lockout."""
+        if self.data_server is None:
+            return
+        try:
+            self.data_server.qos_set({
+                int(sid): int(bps) for sid, bps in session_bps.items()
+            })
+        except (AttributeError, ValueError, TypeError):
+            pass
+
+    def _qos_tenant(self, session_id) -> str:
+        try:
+            return self._qos_tenants.get(
+                int(session_id or 0), qosmod.DEFAULT_TENANT
+            )
+        except (TypeError, ValueError):
+            return qosmod.DEFAULT_TENANT
+
+    async def _qos_admit(self, session_id, nbytes: int) -> "str | None":
+        """Admit ``nbytes`` of data-plane work for the session's
+        tenant. Returns the tenant token for :meth:`_qos_done`, or
+        None when QoS is off/unarmed (the zero-cost path: these two
+        checks and nothing else)."""
+        if not constants_mod.qos_enabled() or not self.qos_queue.armed:
+            return None
+        tenant = (
+            session_id if session_id == qosmod.REBUILD_TENANT
+            else self._qos_tenant(session_id)
+        )
+        waited = await self.qos_queue.admit(tenant, nbytes)
+        if waited:
+            self.metrics.labeled_counter(
+                "qos_throttle", {"tenant": tenant},
+                help="data-plane ops that had to queue behind the "
+                     "per-tenant in-flight byte budget (weighted DRR)",
+            ).inc()
+        return tenant
+
+    def _qos_done(self, tenant: "str | None", nbytes: int) -> None:
+        if tenant is not None:
+            self.qos_queue.done(tenant, nbytes)
 
     async def _test_chunks(self) -> None:
         """Chunk tester (hdd_test_chunk analog): rotate through every
@@ -783,13 +896,21 @@ class ChunkServer(Daemon):
         self._repl_bucket.rate = float(self._repl_bps.value)
         self._repl_bucket.burst = max(self._repl_bucket.rate, 1.0)
         await self._repl_bucket.acquire(nbytes_needed)
-        data = await read_executor.execute_plan(
-            plan,
-            msg.chunk_id,
-            msg.version,
-            locations,
-            wave_timeout=self.wave_timeout,
-        )
+        # rebuild traffic rides the SAME weighted data-plane queue as
+        # client IO, as the "_rebuild" pseudo-tenant: a rebuild storm
+        # is capped at its weight share, and a tenant flood cannot
+        # starve rebuilds either (ROADMAP 4 both ways)
+        qt = await self._qos_admit(qosmod.REBUILD_TENANT, nbytes_needed)
+        try:
+            data = await read_executor.execute_plan(
+                plan,
+                msg.chunk_id,
+                msg.version,
+                locations,
+                wave_timeout=self.wave_timeout,
+            )
+        finally:
+            self._qos_done(qt, nbytes_needed)
         self.metrics.counter("replications").inc()
         self.metrics.counter("replication_bytes").inc(float(len(data)))
 
@@ -1052,6 +1173,7 @@ class ChunkServer(Daemon):
                 pos += len(piece)
 
         code = st.OK
+        qt = await self._qos_admit(session.session_id, msg.length)
         try:
             await asyncio.to_thread(apply_all)
         except ChunkStoreError as e:
@@ -1059,6 +1181,8 @@ class ChunkServer(Daemon):
         except Exception:
             self.log.exception("shm write failed")
             code = st.EIO
+        finally:
+            self._qos_done(qt, msg.length)
         self.metrics.counter("bytes_written").inc(float(msg.length))
         self.metrics.counter(
             "shm_desc_writes",
@@ -1149,6 +1273,10 @@ class ChunkServer(Daemon):
             served = await self._serve_read_native(writer, msg)
             if served:
                 return
+        # QoS: the disk phase holds per-tenant in-flight credits (the
+        # send phase must not — a wedged consumer would pin the shared
+        # pool; its connection already self-backpressures)
+        qt = await self._qos_admit(msg.session_id, msg.size)
         try:
             pieces = await asyncio.to_thread(
                 self.store.read,
@@ -1166,6 +1294,8 @@ class ChunkServer(Daemon):
                 ),
             )
             return
+        finally:
+            self._qos_done(qt, msg.size)
         for off, data, crc in pieces:
             self.metrics.counter("bytes_read").inc(float(len(data)))
             await framing.send_message(
@@ -1201,6 +1331,7 @@ class ChunkServer(Daemon):
         if msg.offset % MFSBLOCKSIZE != 0 or msg.size == 0:
             await reply_err(st.EINVAL)
             return
+        qt = await self._qos_admit(msg.session_id, msg.size)
         try:
             pieces = await asyncio.to_thread(
                 self.store.read,
@@ -1209,6 +1340,8 @@ class ChunkServer(Daemon):
         except ChunkStoreError as e:
             await reply_err(e.code)
             return
+        finally:
+            self._qos_done(qt, msg.size)
         self.metrics.counter("bytes_read").inc(float(msg.size))
         await framing.send_message(
             writer,
@@ -1267,12 +1400,17 @@ class ChunkServer(Daemon):
     async def _serve_read_native_inner(
         self, writer, msg, cf, sock, load
     ) -> bool:
+        # QoS in-flight credits cover the disk load (same contract as
+        # the asyncio path; the stream phase self-backpressures)
+        qt = await self._qos_admit(msg.session_id, msg.size)
         try:
             rc, buf, crcs = await native_io.run_serve(load)
         except FileNotFoundError:
             rc = st.NO_CHUNK  # file vanished between require() and open
         except OSError:
             rc = st.EIO  # transient local error (EMFILE, EACCES, ...)
+        finally:
+            self._qos_done(qt, msg.size)
         if rc != st.OK:
             self.log.warning(
                 "native read of %016X:%d failed: %s",
@@ -1484,6 +1622,7 @@ class ChunkServer(Daemon):
 
     async def _finish_write(self, writer, session, msg, down_ev) -> None:
         code = st.OK
+        qt = await self._qos_admit(session.session_id, len(msg.data))
         try:
             await asyncio.to_thread(self._local_write, session, msg)
         except ChunkStoreError as e:
@@ -1491,6 +1630,8 @@ class ChunkServer(Daemon):
         except Exception:
             self.log.exception("local write failed")
             code = st.EIO
+        finally:
+            self._qos_done(qt, len(msg.data))
         if down_ev is not None:
             # bounded like the bulk path: a next-hop that accepted the
             # dial but never acks must fail this write with TIMEOUT,
@@ -1574,6 +1715,7 @@ class ChunkServer(Daemon):
                 pos += len(piece)
 
         code = st.OK
+        qt = await self._qos_admit(session.session_id, len(msg.data))
         try:
             await asyncio.to_thread(apply_all)
         except ChunkStoreError as e:
@@ -1581,6 +1723,8 @@ class ChunkServer(Daemon):
         except Exception:
             self.log.exception("bulk write failed")
             code = st.EIO
+        finally:
+            self._qos_done(qt, len(msg.data))
         self.metrics.counter("bytes_written").inc(float(len(msg.data)))
         if down_ev is not None:
             if code == st.OK and down_ok == st.OK:

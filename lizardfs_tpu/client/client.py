@@ -43,6 +43,7 @@ from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.client.cache import BlockCache, ReadaheadAdviser
 from lizardfs_tpu.runtime import accounting
 from lizardfs_tpu.runtime import faults as _faults
+from lizardfs_tpu.runtime import qos as qosmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.metrics import PhaseBreakdown
@@ -62,10 +63,12 @@ IO_CALLER_PID: contextvars.ContextVar[int | None] = contextvars.ContextVar(
 )
 
 # status codes worth retrying a write for (infrastructure trouble);
-# everything else (quota, permissions, invalid args) is permanent
+# everything else (quota, permissions, invalid args) is permanent.
+# BUSY is the QoS fair-share shed — transient BY CONTRACT (the master
+# asks this tenant to back off and retry, never to error).
 _TRANSIENT = {
     st.EIO, st.NO_CHUNK_SERVERS, st.CHUNK_BUSY, st.DISCONNECTED,
-    st.TIMEOUT, st.WRONG_VERSION, st.CHUNK_LOST, st.NO_CHUNK,
+    st.TIMEOUT, st.WRONG_VERSION, st.CHUNK_LOST, st.NO_CHUNK, st.BUSY,
 }
 
 
@@ -101,6 +104,9 @@ class Client:
         self.encoder = encoder or get_encoder(None)
         self.wave_timeout = wave_timeout
         self.retries = retries
+        # QoS shed handling: how many BUSY backoff-retries one logical
+        # master RPC gets before the shed surfaces to the caller
+        self.busy_retries = 8
         self._info = "pyclient"
         self.cache = BlockCache()
         # reads at least this large bypass the block cache (bulk path)
@@ -508,12 +514,56 @@ class Client:
             role="client",
         )
 
+    async def _busy_retry(self, fn, what: str):
+        """Honor QoS fair-share sheds: a BUSY status is retried here
+        with a jittered backoff seeded by the server's retry-after
+        hint, clamped by the ambient RetryPolicy deadline so stacked
+        layers never amplify the wait. Exhausted attempts (or a budget
+        too small for even one backoff) surface the BUSY StatusError —
+        gateways map it (S3: 503 SlowDown, NFS: JUKEBOX delay)."""
+        attempt = 0
+        while True:
+            try:
+                return await fn()
+            except st.StatusError as e:
+                if e.code != st.BUSY:
+                    raise
+                if getattr(e, "_busy_exhausted", False):
+                    # an INNER busy-retry layer (e.g. _call inside a
+                    # _call_read fallback) already burned its attempts:
+                    # retrying here would amplify to attempts^2 and
+                    # re-record the op on each re-entry
+                    raise
+                delay = qosmod.busy_backoff_s(e.retry_after_ms, attempt)
+                rem = retrymod.budget()
+                if attempt >= self.busy_retries or (
+                    rem is not None and rem <= delay
+                ):
+                    e._busy_exhausted = True
+                    raise
+                self.metrics.counter(
+                    "qos_busy_waits",
+                    help="master RPCs shed with BUSY by fair-share "
+                         "admission and retried after backoff",
+                ).inc()
+                log.debug("%s shed (BUSY), retry %d in %.3fs",
+                          what, attempt + 1, delay)
+                await asyncio.sleep(delay)
+                attempt += 1
+
     async def _call(self, msg_cls, **fields):
         """Master RPC with transparent reconnect+retry on a lost or
-        demoted master (failover support). RPCs whose schema carries the
-        trailing ``trace_id`` field get the current request trace
-        attached automatically."""
+        demoted master (failover support) and backoff+retry on QoS
+        sheds. RPCs whose schema carries the trailing ``trace_id``
+        field get the current request trace attached automatically."""
+        # record ONCE, outside the busy-retry loop: a shed-and-retried
+        # op is one logical op in op_counters/oplog
         self._record(msg_cls.__name__)
+        return await self._busy_retry(
+            lambda: self._call_once(msg_cls, **fields), msg_cls.__name__
+        )
+
+    async def _call_once(self, msg_cls, **fields):
         if msg_cls.FIELDS and msg_cls.FIELDS[-1][0] == "trace_id":
             tid = tracing.current_trace_id()
             if tid:
@@ -607,9 +657,20 @@ class Client:
         count a stale retry and re-issue through the primary. Replica
         connection failures and refusals (NOT_POSSIBLE — promoted
         shadow, server-side kill switch, non-servable op) fall through
-        to the primary too."""
+        to the primary too. QoS BUSY sheds (either leg) back off and
+        retry via _busy_retry — a shed is never an error and never a
+        spurious stale-retry count."""
         if not self.shadow_reads:
             return await self._call(msg_cls, **fields)
+        return await self._busy_retry(
+            lambda: self._call_read_once(msg_cls, **fields),
+            msg_cls.__name__,
+        )
+
+    async def _call_read_once(self, msg_cls, **fields):
+        # ONE busy-retry layer: every fallback below re-enters
+        # _call (whose own busy loop handles primary sheds); a replica
+        # BUSY raises out to _call_read's wrapper instead of nesting
         conn = await self._replica_conn()
         if conn is None:
             return await self._call(msg_cls, **fields)
@@ -636,6 +697,16 @@ class Client:
             self._replica_retry_at = _time.monotonic() + 5.0
             self.metrics.counter("shadow_fallbacks").inc()
             return await self._call(msg_cls, **fields)
+        if status == st.BUSY:
+            # fair-share shed on the replica leg: checked BEFORE the
+            # token floor (the tokenless BUSY reply is a shed, not
+            # staleness — it must not count a spurious stale retry).
+            # The link stays up; _call_read's wrapper backs off and
+            # retries through whichever leg serves then.
+            raise st.StatusError(
+                st.BUSY, msg_cls.__name__,
+                retry_after_ms=getattr(r, "retry_after_ms", 0),
+            )
         if self._token_of(r) < self._meta_floor:
             self.metrics.counter("shadow_stale_retries").inc()
             return await self._call(msg_cls, **fields)

@@ -72,6 +72,17 @@ def shadow_reads_enabled() -> bool:
     return env_flag("LZ_SHADOW_READS")
 
 
+def qos_enabled() -> bool:
+    """LZ_QOS kill switch (default ON) for the multi-tenant QoS plane:
+    master fair-share admission (BUSY sheds), chunkserver data-plane
+    weighted queueing, and the native per-session byte budgets. Off,
+    every enforcement site is this one check and behavior is
+    byte-identical to the pre-QoS tree (an UNCONFIGURED engine admits
+    everything too, so the switch matters only on clusters that armed
+    limits). Read per call: operators flip it live."""
+    return env_flag("LZ_QOS")
+
+
 def s3_enabled() -> bool:
     """LZ_S3 kill switch (default ON) for the S3 object gateway: off,
     the gateway refuses to start (a booted gateway keeps serving —

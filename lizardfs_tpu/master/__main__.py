@@ -3,7 +3,10 @@
 Config keys (KEY = VALUE, mfsmaster.cfg analog): DATA_PATH, LISTEN_HOST,
 LISTEN_PORT, GOALS_CFG (path to mfsgoals.cfg-style file), IO_LIMIT_BPS
 (global bytes/s budget), IO_LIMITS_CFG (mfsiolimits.cfg-style per-cgroup
-budgets: `subsystem X` + `limit <group> <bps>` lines), LOG_LEVEL,
+budgets: `subsystem X` + `limit <group> <bps>` lines), QOS_CFG
+(multi-tenant fair-share config: tenant match rules/weights, per-class
+admission rates, data-plane budgets — doc/operations.md QoS runbook),
+LOG_LEVEL,
 HEALTH_INTERVAL, IMAGE_INTERVAL, LIFECYCLE_INTERVAL (s3 lifecycle
 tiering scan period), PERSONALITY (master|shadow),
 ACTIVE_MASTER (host:port, required for shadow), and optional election:
@@ -35,6 +38,7 @@ async def _run(cfg: Config) -> None:
             ("exports", cfg.get_str("EXPORTS_CFG", "")),
             ("topology", cfg.get_str("TOPOLOGY_CFG", "")),
             ("iolimits", cfg.get_str("IO_LIMITS_CFG", "")),
+            ("qos", cfg.get_str("QOS_CFG", "")),
         ) if path
     }
     server = MasterServer(

@@ -40,6 +40,7 @@ from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
 from lizardfs_tpu.proto import status as st
 from lizardfs_tpu.runtime import accounting
+from lizardfs_tpu.runtime import qos as qosmod
 from lizardfs_tpu.runtime import retry as retrymod
 from lizardfs_tpu.runtime import tracing
 from lizardfs_tpu.runtime.daemon import Daemon
@@ -232,6 +233,31 @@ class MasterServer(Daemon):
         # revocation doesn't wait out META_TTL_S.
         # inode -> {sid -> last watch refresh}
         self._read_watchers: dict[int, dict[int, float]] = {}
+        # multi-tenant QoS (runtime/qos.py): sessions map to tenants at
+        # registration (config-driven, QOS_CFG), the RPC loop sheds
+        # over-budget tenants with transient BUSY replies, and the
+        # data-plane config rides every heartbeat ack to chunkservers.
+        # An unconfigured engine admits everything — QoS only bites on
+        # clusters that armed rates/budgets (LZ_QOS=0 kills even that).
+        self.qos_tenants = qosmod.TenantMap()
+        self.qos = qosmod.FairShare()
+        self.qos_doc: dict = {}  # the parsed QOS_CFG (admin-mutable)
+        self._qos_cs_cache: tuple = ()  # (key, json) heartbeat-ack cache
+        # per-class admission rates double as live tweaks (admin
+        # `tweaks-set qos_locate_rate 2000` == admin `qos set`): the
+        # hook writes through to the engine
+        self._qos_rate_tweaks = {
+            cls: self.tweaks.register(
+                f"qos_{cls}_rate", 0.0,
+                on_set=lambda v, c=cls: self.qos.set_rate(c, v),
+            )
+            for cls in qosmod.MASTER_RATE_CLASSES
+        }
+        # bumped whenever the session population (or a session's
+        # tenant) changes: the heartbeat-ack qos push keys its cache on
+        # (engine generation, this) instead of fingerprinting every
+        # session per ack
+        self._session_epoch = 0
         from lizardfs_tpu.master.exports import Exports, Topology
 
         self.exports = exports if exports is not None else Exports()
@@ -337,10 +363,14 @@ class MasterServer(Daemon):
 
             self.io_limit_subsystem, self.io_limits = parse_limits_cfg(text)
 
+        def qos_cfg(text):
+            self._qos_apply_config(qosmod.parse_config(text))
+
         attempt("goals", goals)
         attempt("exports", exports)
         attempt("topology", topology)
         attempt("iolimits", iolimits)
+        attempt("qos", qos_cfg)
         self._last_reload = {"reloaded": reloaded, "failed": failed}
         if reloaded or failed:
             self.log.info("config reload: ok=%s failed=%s", reloaded, failed)
@@ -599,6 +629,8 @@ class MasterServer(Daemon):
             # the session (labeled counters keep their totals)
             self.session_ops.retire(sid)
             self.session_stats.pop(sid, None)
+        if dead:
+            self._session_epoch += 1
         # release locks AND open handles whose owning session has no
         # live connection and never reconnected (orphans from a
         # promotion or client crash)
@@ -734,7 +766,13 @@ class MasterServer(Daemon):
             "info": first.info, "connected": True, "ip": peer[0],
             "readonly": rule.readonly, "maproot": rule.maproot,
             "root": root_inode,
+            # tenant identity is decided at registration (and
+            # re-resolved when the QoS config reloads): admission, the
+            # data-plane push, health, and `top` all read this label
+            "tenant": self.qos_tenants.tenant_of(first.info, rule.path),
+            "export": rule.path,
         }
+        self._session_epoch += 1
         self._session_writers[session_id] = writer
         # reconnect within the grace window: the session keeps its locks
         self._lock_grace.pop(session_id, None)
@@ -753,6 +791,14 @@ class MasterServer(Daemon):
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                # fair-share admission: an over-budget tenant's op is
+                # shed with transient BUSY + retry hint BEFORE it costs
+                # handler work. Off/unconfigured = these two checks.
+                if constants_mod.qos_enabled() and self.qos.armed:
+                    busy = self._qos_shed(session_id, msg)
+                    if busy is not None:
+                        await framing.send_message(writer, busy)
+                        continue
                 t0 = time.perf_counter()
                 tw0 = time.time()
                 try:
@@ -796,6 +842,7 @@ class MasterServer(Daemon):
             # release locks the reconnected client still holds)
             if self._session_writers.get(session_id) is writer:
                 self.sessions.get(session_id, {})["connected"] = False
+                self._session_epoch += 1
                 self._session_writers.pop(session_id, None)
                 if self._stopping.is_set():
                     # master shutdown, not client departure: locks must
@@ -864,6 +911,131 @@ class MasterServer(Daemon):
         except fsmod.FsError:
             return None
 
+    # --- multi-tenant QoS (fair-share admission) ---------------------------
+
+    # completion/session verbs are never shed: WriteChunkEnd[Batch]
+    # releases the chunk lock a granted write holds (shedding it would
+    # convert admission pressure into lock pressure), and session
+    # control fires once per mount, not on the request path
+    _QOS_NEVER_SHED = frozenset({
+        "CltomaWriteChunkEnd", "CltomaWriteChunkEndBatch", "CltomaGoodbye",
+        "CltomaRegister", "CltomaIoLimitRequest", "CltomaSessionStats",
+        "CltomaOpen", "CltomaRelease",
+    })
+
+    def _qos_admission_class(self, msg) -> "str | None":
+        """Admission op class of a client RPC (one vocabulary with the
+        chunkserver data plane), or None for ops QoS never sheds."""
+        name = type(msg).__name__
+        if name in self._QOS_NEVER_SHED:
+            return None
+        if name == "CltomaReadChunk":
+            return "locate"
+        if name == "CltomaWriteChunk":
+            return "write"
+        if name == "CltomaLockOp" and getattr(msg, "ltype", -1) == \
+                LOCK_UNLOCK:
+            # lock RELEASES are never shed (same reason as
+            # WriteChunkEnd: shedding a release converts admission
+            # pressure into lock pressure for every waiter, including
+            # other tenants — cross-tenant priority inversion)
+            return None
+        if name in _OP_CLASS_READ:
+            return "meta_read"
+        return "meta_write"
+
+    def _qos_apply_config(self, doc: dict) -> None:
+        """Install a parsed QoS config (startup, SIGHUP, admin `qos`):
+        tenant mapping + admission engine + the doc the heartbeat-ack
+        push to chunkservers is built from. Tweak mirrors stay in sync
+        so `tweaks` output never lies about a live rate."""
+        self.qos_doc = doc
+        self.qos_tenants = qosmod.TenantMap.from_config(doc)
+        self.qos.configure(doc)
+        self._qos_cs_cache = ()
+        for cls, tweak in self._qos_rate_tweaks.items():
+            tweak.value = self.qos.rates.get(cls, 0.0)
+        # re-resolve live sessions against the NEW match rules: a
+        # SIGHUP that moves a client between tenants must bite without
+        # waiting for that client to reconnect
+        for sess in self.sessions.values():
+            sess["tenant"] = self.qos_tenants.tenant_of(
+                str(sess.get("info", "")), str(sess.get("export", ""))
+            )
+        self._session_epoch += 1
+
+    def _qos_shed(self, session_id: int, msg) -> "m.MatoclStatusReply | None":
+        """Admission check for one client RPC: None = admitted, else
+        the BUSY reply to send (shed, with the backoff hint). The
+        LZ_QOS=0 / unconfigured path is the caller's two checks and
+        nothing else."""
+        cls = self._qos_admission_class(msg)
+        if cls is None:
+            return None
+        tenant = self.sessions.get(session_id, {}).get(
+            "tenant", qosmod.DEFAULT_TENANT
+        )
+        retry_ms = self.qos.admit(tenant, cls)
+        if retry_ms is None:
+            return None
+        self.metrics.labeled_counter(
+            "qos_shed", {"tenant": tenant, "op": cls},
+            help="client RPCs shed with BUSY by fair-share admission, "
+                 "by tenant and op class",
+        ).inc()
+        return m.MatoclStatusReply(
+            req_id=getattr(msg, "req_id", 0), status=st.BUSY,
+            retry_after_ms=retry_ms,
+        )
+
+    def _qos_cs_json(self) -> str:
+        """The QoS data-plane config chunkservers apply, refreshed on
+        every heartbeat ack: session->tenant map, tenant weights, the
+        in-flight byte budget, and optional per-session native-plane
+        pacing. Empty string when QoS is off/unconfigured (the ack is
+        byte-identical to the pre-QoS one). Cached until the engine
+        generation or session population changes."""
+        if not constants_mod.qos_enabled():
+            return ""
+        doc = self.qos_doc
+        inflight_mb = float(doc.get("data_inflight_mb", 0) or 0)
+        data_bps = float(doc.get("data_bps", 0) or 0)
+        if inflight_mb <= 0 and data_bps <= 0:
+            return ""
+        key = (self.qos.generation, self._session_epoch)
+        if self._qos_cs_cache and self._qos_cs_cache[0] == key:
+            return self._qos_cs_cache[1]
+        tenants = {
+            sid: s.get("tenant", qosmod.DEFAULT_TENANT)
+            for sid, s in self.sessions.items() if s.get("connected")
+        }
+        weights = dict(self.qos.weights)
+        out = {
+            "gen": self.qos.generation,
+            "tenants": {str(sid): t for sid, t in tenants.items()},
+            "weights": weights,
+            "inflight_mb": inflight_mb,
+            "rebuild_weight": float(doc.get("rebuild_weight", 1.0)),
+        }
+        if data_bps > 0:
+            # approximate native-plane pacing: the total data rate
+            # split by tenant weight across connected tenants, each
+            # session paced at its tenant's share (the asyncio DRR is
+            # the precise enforcement; this bounds the C++ fast path)
+            active = {tenants[sid] for sid in tenants}
+            total_w = sum(
+                weights.get(t, 1.0) for t in active
+            ) or 1.0
+            out["session_bps"] = {
+                str(sid): int(
+                    data_bps * weights.get(t, 1.0) / total_w
+                )
+                for sid, t in tenants.items()
+            }
+        text = json.dumps(out, sort_keys=True)
+        self._qos_cs_cache = (key, text)
+        return text
+
     def _replica_ready(self) -> bool:
         """A shadow serves replica reads only while its changelog follow
         link is live — a partitioned shadow would otherwise serve
@@ -914,8 +1086,12 @@ class MasterServer(Daemon):
             "info": first.info, "connected": True, "ip": peer[0],
             "readonly": True, "maproot": rule.maproot, "root": root_inode,
             "replica": True,
+            # the client appends "/replica" to its info; prefix rules
+            # still match, so both legs land on the same tenant
+            "tenant": self.qos_tenants.tenant_of(first.info, rule.path),
         }
         self.sessions[session_id] = entry
+        self._session_epoch += 1
         await framing.send_message(
             writer,
             m.MatoclRegister(
@@ -946,6 +1122,15 @@ class MasterServer(Daemon):
                     # the primary (its own conn fails over if WE are
                     # the new primary)
                     reply = self._error_reply(msg, st.NOT_POSSIBLE)
+                elif constants_mod.qos_enabled() and self.qos.armed and (
+                    (busy := self._qos_shed(session_id, msg)) is not None
+                ):
+                    # locate storms shed per-tenant on replicas too —
+                    # one scanner must not starve the fleet's locates
+                    # through the shadow either. BUSY (not
+                    # NOT_POSSIBLE) so the client backs off and retries
+                    # instead of dropping the replica link.
+                    reply = busy
                 else:
                     t0 = time.perf_counter()
                     try:
@@ -980,6 +1165,7 @@ class MasterServer(Daemon):
             # the export-subtree remap entirely
             if self.sessions.get(session_id) is entry:
                 del self.sessions[session_id]
+                self._session_epoch += 1
 
     def _error_reply(self, msg, code: int):
         if isinstance(msg, (m.CltomaReadChunk,)):
@@ -2544,7 +2730,11 @@ class MasterServer(Daemon):
                             pass
                     await framing.send_message(
                         writer, m.MatocsRegisterReply(
-                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id,
+                            # QoS data-plane config refresh: weights /
+                            # budgets changed live propagate within one
+                            # heartbeat ("" when off/unconfigured)
+                            qos_json=self._qos_cs_json(),
                         )
                     )
                 elif isinstance(msg, (m.CstomaChunkDamaged, m.CstomaChunkLost)):
@@ -3868,12 +4058,30 @@ class MasterServer(Daemon):
                 gateways["nfs"] += 1
             elif info.startswith("s3-gateway"):
                 gateways["s3"] += 1
+        # QoS: NAME currently-throttled tenants so "who is being shed"
+        # is answerable from `lizardfs-admin health` during an incident
+        qos_doc: dict = {}
+        if constants_mod.qos_enabled() and (
+            self.qos.armed or self.qos.sheds
+        ):
+            snap = self.qos.snapshot()
+            qos_doc = {
+                "armed": snap["armed"],
+                "throttled": self.qos.throttled_tenants(),
+                "sheds": snap["sheds"],
+            }
+            # per-tenant SLO objectives (QOS_CFG p99_ms): evaluate each
+            # configured tenant's worst observed master-leg p99 across
+            # its connected sessions against its objective
+            if self.qos.objectives:
+                qos_doc["objectives"] = self._qos_objective_report()
         return {
             "status": status,
             "master": master_snap,
             "chunkservers": servers,
             "shadows": shadows,
             "gateways": gateways,
+            "qos": qos_doc,
             "tape": {
                 "servers": len(self.ts_links),
                 "pending": len(self.tape_pending),
@@ -3892,6 +4100,34 @@ class MasterServer(Daemon):
                 ),
             },
         }
+
+    def _qos_objective_report(self) -> dict:
+        """Per-tenant SLO check: worst session_ops p99 (ms) across a
+        tenant's connected sessions vs. its configured ``p99_ms``
+        objective. Cold path (health/admin only)."""
+        out: dict[str, dict] = {}
+        by_tenant: dict[str, list[int]] = {}
+        for sid, sess in self.sessions.items():
+            if sess.get("connected"):
+                by_tenant.setdefault(
+                    sess.get("tenant", qosmod.DEFAULT_TENANT), []
+                ).append(sid)
+        variants = self.metrics.labeled_timings.get("session_ops", {})
+        for tenant, objective in self.qos.objectives.items():
+            worst = 0.0
+            for key, timing in variants.items():
+                labels = dict(key)
+                for sid in by_tenant.get(tenant, ()):
+                    if labels.get("session") == f"s{sid}":
+                        worst = max(
+                            worst, timing.quantile_us(0.99) / 1e3
+                        )
+            out[tenant] = {
+                "p99_ms": round(worst, 3),
+                "objective_ms": objective,
+                "breached": bool(worst > objective),
+            }
+        return out
 
     def top_report(self, k: int = 16, resolution: str = "sec") -> dict:
         """The cluster-wide workload rollup `lizardfs-admin top` and
@@ -3915,6 +4151,7 @@ class MasterServer(Daemon):
             entry["info"] = str(sess.get("info", ""))
             entry["ip"] = sess.get("ip", "")
             entry["connected"] = bool(sess.get("connected"))
+            entry["tenant"] = sess.get("tenant", qosmod.DEFAULT_TENANT)
             stats = self.session_stats.get(sid)
             if stats is not None:
                 entry["gateway"] = dict(stats)
@@ -3941,12 +4178,37 @@ class MasterServer(Daemon):
                 "slo_locate_burn_fast",
             )
         }
+        # per-tenant rollup: aggregate the master-leg rates of each
+        # tenant's sessions + whether admission is currently shedding
+        # it (the `top` tenant column's source)
+        tenants_doc: dict[str, dict] = {}
+        throttled = set(
+            self.qos.throttled_tenants()
+            if constants_mod.qos_enabled() else ()
+        )
+        for label, entry in sessions_doc.items():
+            tenant = entry.get("tenant")
+            if tenant is None:
+                continue
+            row = tenants_doc.setdefault(
+                tenant, {"sessions": 0, "rate_ops": 0.0, "throttled": False}
+            )
+            row["sessions"] += 1
+            row["rate_ops"] = round(
+                row["rate_ops"]
+                + entry.get("master", {}).get("rate_ops", 0.0), 2
+            )
+        for tenant in throttled:
+            tenants_doc.setdefault(
+                tenant, {"sessions": 0, "rate_ops": 0.0}
+            )["throttled"] = True
         return {
             "ts": now,
             "enabled": accounting.enabled(),
             "resolution": resolution,
             "sessions": sessions_doc,
             "chunkservers": chunkservers,
+            "tenants": tenants_doc,
             "totals": {
                 "rate_ops": self.session_ops.total_rate(),
                 "sessions_tracked": self.session_ops.active_sessions(),
@@ -3979,6 +4241,56 @@ class MasterServer(Daemon):
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps(self.cluster_health()),
+            )
+        if msg.command == "qos":
+            # show/set fair-share weights and limits LIVE (the tweaks
+            # plane is the other write path for the per-class rates;
+            # SIGHUP re-reads QOS_CFG wholesale). Payload keys:
+            #   {"weight": {tenant: w}}, {"rate": {class: ops_s}},
+            #   {"data_inflight_mb": v}, {"data_bps": v},
+            #   {"rebuild_weight": v}  — empty payload = show
+            try:
+                payload = json.loads(msg.json) if msg.json else {}
+                for tenant, w in (payload.get("weight") or {}).items():
+                    self.qos.set_weight(str(tenant), float(w))
+                    self.qos_doc.setdefault("tenants", {}).setdefault(
+                        str(tenant), {}
+                    )["weight"] = float(w)
+                for cls, rate in (payload.get("rate") or {}).items():
+                    self.qos.set_rate(str(cls), float(rate))
+                    self._qos_rate_tweaks[str(cls)].value = float(rate)
+                    self.qos_doc.setdefault("rates", {})[str(cls)] = (
+                        float(rate)
+                    )
+                for key in ("data_inflight_mb", "data_bps",
+                            "rebuild_weight"):
+                    if key in payload:
+                        self.qos_doc[key] = float(payload[key])
+                        self.qos.generation += 1
+                if payload:
+                    self._qos_cs_cache = ()
+            except (ValueError, TypeError) as e:
+                return m.AdminReply(
+                    req_id=msg.req_id, status=st.EINVAL,
+                    json=json.dumps({"error": str(e)[:200]}),
+                )
+            doc = self.qos.snapshot()
+            doc["enabled"] = constants_mod.qos_enabled()
+            doc["data"] = {
+                "inflight_mb": float(
+                    self.qos_doc.get("data_inflight_mb", 0) or 0
+                ),
+                "data_bps": float(self.qos_doc.get("data_bps", 0) or 0),
+                "rebuild_weight": float(
+                    self.qos_doc.get("rebuild_weight", 1.0)
+                ),
+            }
+            doc["default_tenant"] = self.qos_tenants.default
+            doc["match_rules"] = list(self.qos_tenants.rules)
+            if self.qos.objectives:
+                doc["objectives"] = self._qos_objective_report()
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         basic = self.handle_admin_basics(msg)
         if basic is not None:

@@ -68,6 +68,11 @@ NFS3ERR_BAD_COOKIE = 10003
 NFS3ERR_NOTSUPP = 10004
 NFS3ERR_TOOSMALL = 10005
 NFS3ERR_SERVERFAULT = 10006
+# RFC 1813 §2.6: "the server initiated the request, but was not able
+# to complete it in a timely fashion ... retry later" — the jukebox
+# (near-line media) delay code every NFS client honors with backoff.
+# QoS fair-share sheds (st.BUSY) map here: back off, retry, never fail.
+NFS3ERR_JUKEBOX = 10008
 
 _STATUS_MAP = {
     st.OK: NFS3_OK,
@@ -85,6 +90,7 @@ _STATUS_MAP = {
     st.NAME_TOO_LONG: NFS3ERR_NAMETOOLONG,
     st.EROFS: NFS3ERR_ROFS,
     st.NO_CHUNK: NFS3ERR_STALE,
+    st.BUSY: NFS3ERR_JUKEBOX,
 }
 
 # ftype (proto) -> NF3 type

@@ -217,11 +217,20 @@ class MatoclStatusReply(Message):
 
     ``meta_version`` (trailing, skew-tolerant): consistency token, see
     MatoclAttrReply — carried on mutation acks too so a client's
-    monotonic-reads floor covers read-your-writes through replicas."""
+    monotonic-reads floor covers read-your-writes through replicas.
+
+    ``retry_after_ms`` (trailing, skew-tolerant): the fair-share
+    admission controller's backoff hint on BUSY sheds — QoS sheds
+    answer ANY request type with this reply (the RPC pump resolves by
+    req_id and call_ok raises before typed-field access), so the hint
+    needs exactly one carrier. 0 / absent = no hint."""
 
     MSG_TYPE = 1013
     SKEW_TOLERANT_FROM = 2
-    FIELDS = (("req_id", "u32"), ("status", "u8"), ("meta_version", "u64"))
+    FIELDS = (
+        ("req_id", "u32"), ("status", "u8"), ("meta_version", "u64"),
+        ("retry_after_ms", "u32"),
+    )
 
 
 class CltomaRmdir(Message):
@@ -906,8 +915,22 @@ class CstomaRegister(Message):
 
 
 class MatocsRegisterReply(Message):
+    """Registration / heartbeat ack to a chunkserver.
+
+    ``qos_json`` (trailing, skew-tolerant): the master's current QoS
+    data-plane config for this chunkserver — session->tenant map,
+    tenant weights, in-flight byte budget, optional per-session native
+    pacing — refreshed on every heartbeat ack so weights/limits changed
+    live (admin `qos` / SIGHUP) propagate within one heartbeat. Old
+    peers send/receive "" and stay unthrottled (fail-open: QoS degrades
+    to the pre-QoS behavior, never to a lockout)."""
+
     MSG_TYPE = 1101
-    FIELDS = (("req_id", "u32"), ("status", "u8"), ("cs_id", "u32"))
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (
+        ("req_id", "u32"), ("status", "u8"), ("cs_id", "u32"),
+        ("qos_json", "str"),
+    )
 
 
 class CstomaHeartbeat(Message):

@@ -38,6 +38,11 @@ NOT_POSSIBLE = 29
 # writes must recall it first (CltomaTapeRecall); transient by design —
 # a client that waits out the recall and retries succeeds
 TAPE_RECALL = 30
+# fair-share admission shed the op for THIS tenant (multi-tenant QoS):
+# transient by design — clients back off (the reply's trailing
+# retry_after_ms is the server's hint) and retry through the unified
+# RetryPolicy; S3 maps it to 503 SlowDown, NFS to JUKEBOX delay
+BUSY = 31
 
 _NAMES = {v: k for k, v in list(globals().items()) if isinstance(v, int)}
 
@@ -47,8 +52,14 @@ def name(code: int) -> str:
 
 
 class StatusError(Exception):
-    """Raised by clients when an RPC returns a non-OK status."""
+    """Raised by clients when an RPC returns a non-OK status.
 
-    def __init__(self, code: int, context: str = ""):
+    ``retry_after_ms``: the server's backoff hint on BUSY sheds (0 =
+    none given); carried so the client's busy-retry loop can honor it
+    without re-parsing the reply."""
+
+    def __init__(self, code: int, context: str = "",
+                 retry_after_ms: int = 0):
         self.code = code
+        self.retry_after_ms = retry_after_ms
         super().__init__(f"{name(code)}{(': ' + context) if context else ''}")

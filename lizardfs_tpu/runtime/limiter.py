@@ -19,15 +19,19 @@ import time
 
 
 class TokenBucket:
-    def __init__(self, rate: float, burst: float | None = None):
-        """rate: tokens (bytes) per second; burst: bucket size."""
+    def __init__(self, rate: float, burst: float | None = None,
+                 now_fn=time.monotonic):
+        """rate: tokens (bytes) per second; burst: bucket size.
+        ``now_fn`` injects a clock for deterministic tests (the QoS
+        fair-share suite drives refills on virtual time)."""
         self.rate = rate
         self.burst = burst if burst is not None else max(rate, 1.0)
+        self._now = now_fn
         self._tokens = self.burst
-        self._last = time.monotonic()
+        self._last = now_fn()
 
     def _refill(self) -> None:
-        now = time.monotonic()
+        now = self._now()
         self._tokens = min(
             self.burst, self._tokens + (now - self._last) * self.rate
         )

@@ -113,7 +113,13 @@ class RpcConnection:
         reply = await self.call(msg_cls, timeout=timeout, **fields)
         st = getattr(reply, "status", 0)
         if st != 0:
-            raise StatusError(st, msg_cls.__name__)
+            # BUSY sheds carry the admission controller's backoff hint
+            # (MatoclStatusReply.retry_after_ms); surface it on the
+            # exception so the client's busy-retry loop can honor it
+            raise StatusError(
+                st, msg_cls.__name__,
+                retry_after_ms=getattr(reply, "retry_after_ms", 0),
+            )
         return reply
 
     async def send(self, msg: Message) -> None:

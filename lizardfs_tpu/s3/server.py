@@ -111,6 +111,9 @@ def _status_error(e: st.StatusError, resource: str) -> _HttpError:
             "object is on the tape tier; restore in progress — retry",
         ),
         st.CHUNK_BUSY: (503, "SlowDown", "busy; retry"),
+        # QoS fair-share shed: this bucket's tenant is over budget —
+        # S3 semantics are exactly SlowDown (client backs off)
+        st.BUSY: (503, "SlowDown", "tenant over fair share; slow down"),
         st.NO_CHUNK_SERVERS: (503, "SlowDown", "no chunkservers"),
         # recall-path failures are transient by contract (tape server
         # restarting / restore outliving one RPC budget): retryable,

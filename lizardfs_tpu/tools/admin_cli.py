@@ -3,7 +3,15 @@
     python -m lizardfs_tpu.tools.admin_cli <host:port> <command>
 
 Commands: info, list-chunkservers, list-sessions, chunks-health,
-save-metadata, metadata-checksum, promote-shadow, faults.
+save-metadata, metadata-checksum, promote-shadow, faults, qos.
+
+``qos`` shows the master's multi-tenant fair-share state (weights,
+per-class rates, sheds, per-tenant objectives) and sets it live::
+
+    lizardfs-admin HOST:PORT qos                   # show
+    lizardfs-admin HOST:PORT qos weight bulk 2     # tenant weight
+    lizardfs-admin HOST:PORT qos rate locate 3000  # class ops/s
+    lizardfs-admin HOST:PORT qos data-inflight-mb 32
 
 ``faults`` steers the live fault-injection rule set of any daemon
 (runtime/faults.py) over the tweaks/admin channel::
@@ -87,14 +95,17 @@ async def _amain(argv) -> int:
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
             "trace-dump", "health", "slowops", "rebuild-status", "faults",
-            "top", "profile",
+            "top", "profile", "qos",
         ],
     )
     p.add_argument("extra", nargs="*",
                    help="tweaks-set: NAME VALUE; metrics: [resolution]; "
                         "trace-dump: [trace_id]; "
                         "faults: [arm RULE | clear]; "
-                        "top: [watch]; profile: [top_n]")
+                        "top: [watch]; profile: [top_n]; "
+                        "qos: [weight TENANT W | rate CLASS OPS | "
+                        "data-inflight-mb MB | data-bps BPS | "
+                        "rebuild-weight W]")
     p.add_argument("--password", default=None,
                    help="admin password (challenge-response)")
     args = p.parse_args(argv)
@@ -195,6 +206,33 @@ async def _amain(argv) -> int:
             if doc.get("collapsed"):
                 print(doc["collapsed"])
             return 0
+    elif cmd == "qos":
+        payload: dict = {}
+        if args.extra:
+            sub = args.extra[0]
+            try:
+                if sub == "weight" and len(args.extra) == 3:
+                    payload = {"weight": {args.extra[1]:
+                                          float(args.extra[2])}}
+                elif sub == "rate" and len(args.extra) == 3:
+                    payload = {"rate": {args.extra[1]:
+                                        float(args.extra[2])}}
+                elif sub in ("data-inflight-mb", "data-bps",
+                             "rebuild-weight") and len(args.extra) == 2:
+                    payload = {sub.replace("-", "_"):
+                               float(args.extra[1])}
+                else:
+                    raise ValueError(sub)
+            except ValueError:
+                print("usage: qos [weight TENANT W | rate CLASS OPS | "
+                      "data-inflight-mb MB | data-bps BPS | "
+                      "rebuild-weight W]", file=sys.stderr)
+                return 2
+        reply = await _admin(addr, "qos", json.dumps(payload),
+                             password=args.password)
+        if getattr(reply, "status", 1) == st.OK:
+            _print_qos(json.loads(reply.json))
+            return 0
     elif cmd == "tweaks-set":
         if len(args.extra) != 2:
             print("usage: tweaks-set NAME VALUE", file=sys.stderr)
@@ -274,13 +312,22 @@ def _print_top(doc: dict) -> None:
         if pts:
             print(f"  {name:<22s} [{_spark(pts):<24s}] now "
                   f"{pts[-1]:.1f}")
+    # per-tenant rollup: aggregate rates + the admission verdict per
+    # tenant (the multi-tenant QoS view; absent pre-QoS masters)
+    tenants = doc.get("tenants", {})
+    for tenant, row in sorted(
+        tenants.items(), key=lambda kv: -kv[1].get("rate_ops", 0.0)
+    ):
+        flag = "  THROTTLED" if row.get("throttled") else ""
+        print(f"  tenant {tenant:<12s} {row.get('sessions', 0)} sessions  "
+              f"{row.get('rate_ops', 0.0):8.1f} ops/s{flag}")
     rows = sorted(
         doc.get("sessions", {}).items(),
         key=lambda kv: -kv[1].get("master", {}).get("rate_ops", 0.0),
     )
     print(
-        f"  {'session':<10s} {'who':<22s} {'ops/s':>8s} {'MB/s':>8s} "
-        f"{'p99 ms':>8s}  hot (class: ops/s, p99) / exemplar"
+        f"  {'session':<10s} {'who':<22s} {'tenant':<10s} {'ops/s':>8s} "
+        f"{'MB/s':>8s} {'p99 ms':>8s}  hot (class: ops/s, p99) / exemplar"
     )
     for label, entry in rows:
         mrow = entry.get("master", {})
@@ -302,6 +349,7 @@ def _print_top(doc: dict) -> None:
         exemplar = mrow.get("exemplar", entry.get("exemplar", ""))
         print(
             f"  {label:<10s} {who:<22s} "
+            f"{(entry.get('tenant', '') or '-')[:10]:<10s} "
             f"{mrow.get('rate_ops', 0.0):>8.1f} "
             f"{cs_bytes / 1e6:>8.2f} "
             f"{mrow.get('p99_ms', 0.0):>8.1f}  "
@@ -324,6 +372,43 @@ def _print_top(doc: dict) -> None:
             )
     if not rows:
         print("  (no sessions tracked yet)")
+
+
+def _print_qos(doc: dict) -> None:
+    """Render the master's multi-tenant QoS state."""
+    state = "armed" if doc.get("armed") else "unconfigured (admits all)"
+    if not doc.get("enabled", True):
+        state = "DISABLED (LZ_QOS off)"
+    print(f"qos: {state}  generation {doc.get('generation', 0)}")
+    rates = doc.get("rates", {})
+    if rates:
+        print("  rates   " + "  ".join(
+            f"{cls}={int(r)}/s" for cls, r in sorted(rates.items())
+        ))
+    data = doc.get("data", {})
+    if data:
+        print(f"  data    inflight {data.get('inflight_mb', 0):.0f} MiB"
+              f"  bps {int(data.get('data_bps', 0)) or 'off'}"
+              f"  rebuild-weight {data.get('rebuild_weight', 1.0):g}")
+    weights = doc.get("weights", {})
+    sheds = doc.get("sheds", {})
+    objectives = doc.get("objectives", {})
+    active = set(doc.get("active_tenants", []))
+    for tenant in sorted(set(weights) | set(sheds) | active):
+        shed = sheds.get(tenant, {})
+        obj = objectives.get(tenant)
+        obj_s = ""
+        if obj:
+            flag = "BREACHED" if obj.get("breached") else "ok"
+            obj_s = (f"  p99 {obj.get('p99_ms', 0):.1f}/"
+                     f"{obj.get('objective_ms', 0):.0f}ms {flag}")
+        print(f"  tenant {tenant:<12s} weight {weights.get(tenant, 1.0):g}"
+              f"  {'active ' if tenant in active else '       '}"
+              f"sheds {shed.get('count', 0)}"
+              + (f" ({shed.get('age_s', 0)}s ago)" if shed else "")
+              + obj_s)
+    if not weights and not active:
+        print("  (no tenants configured or active)")
 
 
 def _print_faults(doc: dict) -> None:
@@ -412,6 +497,16 @@ def _print_health(doc: dict) -> None:
         f"stalls {master.get('loop_stalls', 0)}  "
         f"span-drops {master.get('span_ring_dropped', 0)}"
     )
+    # multi-tenant QoS: NAME currently-throttled tenants + breached
+    # per-tenant objectives right in the health render
+    qos = doc.get("qos") or {}
+    if qos.get("throttled"):
+        print("  qos throttled: " + ", ".join(qos["throttled"]))
+    for tenant, obj in sorted((qos.get("objectives") or {}).items()):
+        if obj.get("breached"):
+            print(f"  qos objective BREACHED: {tenant} p99 "
+                  f"{obj.get('p99_ms', 0):.1f}ms > "
+                  f"{obj.get('objective_ms', 0):.0f}ms")
     # shadow read replicas: applied-position lag per connected shadow
     # (the incident metric for the replica plane — staleness retries
     # climb when lag does)

@@ -33,6 +33,11 @@ Schedules:
   s3-multipart   SIGKILL a chunkserver mid-multipart-upload; the S3
                  gateway completes byte-identically or fails cleanly
                  (no torn object visible to GET)
+  noisy-neighbor one tenant floods the master's locate plane while a
+                 victim tenant keeps reading: fair-share admission
+                 sheds ONLY the abuser (BUSY, retried — never errored),
+                 the victim's p99 and goodput hold within bounds, and
+                 health/metrics NAME the throttled tenant
 """
 
 from __future__ import annotations
@@ -91,10 +96,14 @@ class ChaosCluster:
     instrumentable (the same stand-down the servers apply themselves
     when rules are armed at startup)."""
 
-    def __init__(self, tmp: str, n_cs: int = 4, shadow: bool = False):
+    def __init__(self, tmp: str, n_cs: int = 4, shadow: bool = False,
+                 qos_cfg: str | None = None):
         self.tmp = tmp
         self.n_cs = n_cs
         self.want_shadow = shadow
+        # JSON QoS config (runtime/qos.py parse_config schema): written
+        # to disk and wired as the master's QOS_CFG
+        self.qos_cfg = qos_cfg
         self.master_port = _free_port()
         self.shadow_port = _free_port() if shadow else None
         self.cs_ports: list[int] = []
@@ -116,12 +125,17 @@ class ChaosCluster:
     async def start(self) -> None:
         with open(os.path.join(self.tmp, "goals.cfg"), "w") as f:
             f.write("1 one : _\n5 ec32 : $ec(3,2)\n")
+        qos_line = ""
+        if self.qos_cfg is not None:
+            with open(os.path.join(self.tmp, "qos.cfg"), "w") as f:
+                f.write(self.qos_cfg)
+            qos_line = f"QOS_CFG = {self.tmp}/qos.cfg\n"
         self._spawn(
             "master", "lizardfs_tpu.master",
             f"DATA_PATH = {self.tmp}/master\n"
             f"LISTEN_PORT = {self.master_port}\n"
             f"GOALS_CFG = {self.tmp}/goals.cfg\n"
-            "HEALTH_INTERVAL = 0.3\n",
+            "HEALTH_INTERVAL = 0.3\n" + qos_line,
         )
         await self._wait_port(self.master_port)
         if self.want_shadow:
@@ -202,7 +216,8 @@ class ChaosCluster:
                 p.kill()
 
 
-async def _client(cluster: ChaosCluster, shadow: bool = False):
+async def _client(cluster: ChaosCluster, shadow: bool = False,
+                  info: str = "chaos"):
     from lizardfs_tpu.client.client import Client
 
     addrs = [("127.0.0.1", cluster.master_port)]
@@ -210,7 +225,7 @@ async def _client(cluster: ChaosCluster, shadow: bool = False):
         addrs.append(("127.0.0.1", cluster.shadow_port))
     c = Client(*addrs[0], wave_timeout=0.3, master_addrs=addrs)
     # lint: waive(unbounded-await): delegates to Client.connect — dials via the 5 s-bounded RpcConnection.connect and a 30 s-capped register RPC
-    await c.connect(info="chaos")
+    await c.connect(info=info)
     return c
 
 
@@ -479,12 +494,110 @@ async def run_s3_multipart(cluster: ChaosCluster, rng: random.Random,
         await c.close()
 
 
+# QoS config the noisy-neighbor drill arms on its master: the victim
+# tenant holds 3x the abuser's weight; 150 locates/s total means the
+# flood is shed hard while the victim's paced 20/s sits far under its
+# ~112/s contended share
+NOISY_QOS_CFG = json.dumps({
+    "tenants": {
+        "victim": {"weight": 3, "match": ["nn-victim*"], "p99_ms": 1000},
+        "abuser": {"weight": 1, "match": ["nn-abuser*"]},
+    },
+    "rates": {"locate": 150},
+    "data_inflight_mb": 32,
+})
+
+# the drill's victim-side bounds (asserted, not hoped): paced-locate
+# p99 and total wall clock vs the unconstrained ideal
+NOISY_VICTIM_P99_MS = 250.0
+NOISY_VICTIM_OPS = 120
+NOISY_VICTIM_PACE_S = 0.05
+NOISY_ABUSER_OPS = 250
+
+
+async def run_noisy_neighbor(cluster: ChaosCluster, rng: random.Random,
+                             log) -> None:
+    """One tenant floods the master's locate plane; fair-share
+    admission sheds ONLY the abuser (as transient BUSY the client
+    retries — never an error), the victim's p99 and goodput hold
+    within the configured bounds, and health + Prometheus NAME the
+    throttled tenant."""
+    victim = await _client(cluster, info="nn-victim")
+    abuser = await _client(cluster, info="nn-abuser")
+    try:
+        fv = await victim.create(1, "victim.bin")
+        fa = await abuser.create(1, "abuser.bin")
+        pay = _payload(rng.randrange(1 << 20), 128 * 1024 + 7)
+        await victim.write_file(fv.inode, pay)
+        await abuser.write_file(fa.inode, pay)
+        # seed-steered start skew: the flood may lead or trail the
+        # victim's first paced op
+        skew = rng.uniform(0.0, 0.3)
+        lat: list[float] = []
+
+        async def flood():
+            await asyncio.sleep(skew)
+            for _ in range(NOISY_ABUSER_OPS):
+                # every shed is retried inside the client (BUSY
+                # backoff); an exception here fails the drill
+                await abuser.chunk_info(fa.inode, 0)
+
+        async def paced():
+            for _ in range(NOISY_VICTIM_OPS):
+                t0 = time.monotonic()
+                await victim.chunk_info(fv.inode, 0)
+                lat.append(time.monotonic() - t0)
+                await asyncio.sleep(NOISY_VICTIM_PACE_S)
+
+        t0 = time.monotonic()
+        await asyncio.gather(flood(), paced())
+        victim_wall = time.monotonic() - t0
+        lat.sort()
+        p99_ms = lat[int(len(lat) * 0.99)] * 1e3
+        ideal = NOISY_VICTIM_OPS * NOISY_VICTIM_PACE_S
+        log(f"  victim p99 {p99_ms:.1f} ms, wall {victim_wall:.1f}s "
+            f"(ideal {ideal:.1f}s); abuser busy-waits "
+            f"{abuser.metrics.counter('qos_busy_waits').total:.0f}")
+        # victim p99 holds within the configured bound
+        assert p99_ms <= NOISY_VICTIM_P99_MS, f"victim p99 {p99_ms:.1f}ms"
+        # victim goodput within 2x of its unconstrained fair share
+        assert victim_wall <= 2.0 * ideal + 2.0, victim_wall
+        # the abuser WAS shed and retried through it
+        assert abuser.metrics.counter("qos_busy_waits").total > 0, \
+            "flood was never shed"
+        assert victim.metrics.counter("qos_busy_waits").total == 0, \
+            "victim was shed"
+        # master side: sheds labeled abuser only; health + prom NAME it
+        prom = json.loads(
+            (await admin(cluster.master_port, "metrics-prom")).json
+        )["text"]
+        shed_lines = [
+            line for line in prom.splitlines()
+            if "lizardfs_qos_shed_total{" in line
+        ]
+        assert any('tenant="abuser"' in line for line in shed_lines), \
+            "shed counter family missing from /metrics"
+        assert all('tenant="victim"' not in line for line in shed_lines), \
+            f"victim shed on the master: {shed_lines}"
+        health = json.loads((await admin(cluster.master_port, "health")).json)
+        assert "abuser" in health.get("qos", {}).get("throttled", []), health
+        qos_doc = json.loads(
+            (await admin(cluster.master_port, "qos")).json
+        )
+        assert qos_doc["sheds"].get("abuser", {}).get("count", 0) > 0
+    finally:
+        await victim.close()
+        await abuser.close()
+
+
 SCHEDULES = {
     "kill-write": (run_kill_write, dict(n_cs=4)),
     "bitflip-read": (run_bitflip_read, dict(n_cs=3)),
     "stall-acks": (run_stall_acks, dict(n_cs=3)),
     "shadow-stale": (run_shadow_stale, dict(n_cs=3, shadow=True)),
     "s3-multipart": (run_s3_multipart, dict(n_cs=4)),
+    "noisy-neighbor": (run_noisy_neighbor,
+                       dict(n_cs=2, qos_cfg=NOISY_QOS_CFG)),
 }
 
 

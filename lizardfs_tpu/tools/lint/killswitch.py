@@ -57,6 +57,7 @@ SWITCHES = {
     "LZ_S3_LIFECYCLE",     # master lifecycle tiering scanner (on)
     "LZ_TOP",              # per-session op accounting / `top` view (on)
     "LZ_PROF",             # always-on sampling profiler (on)
+    "LZ_QOS",              # multi-tenant fair-share QoS plane (on)
 }
 
 # Value vars: one read site each; documented; spelling rules N/A.

@@ -155,6 +155,7 @@ DEFAULT_SITE = "frame_recv"
 
 # generic per-surface instruments: (file, regex, what broke if absent)
 DAEMON = "lizardfs_tpu/runtime/daemon.py"
+CLIENT = "lizardfs_tpu/client/client.py"
 ANCHORS = (
     (MASTER, r"metrics\.timing\(type\(msg\)\.__name__\)",
      "master per-op latency histograms (request_log analog)"),
@@ -187,6 +188,20 @@ ANCHORS = (
     # the always-on sampling profiler's dump path (admin `profile`)
     (DAEMON, r"profiler\.collapsed\(",
      "daemon profiler collapsed-stack dump (admin `profile`)"),
+    # multi-tenant QoS (ISSUE 15): the shed/throttle labeled counter
+    # families and the BUSY handling chain must stand on every surface
+    # — deleting any of them silently un-instruments load shedding
+    (MASTER, r"labeled_counter\(\s*\n?\s*[\"']qos_shed[\"']",
+     "master per-tenant shed counter (qos_shed{tenant,op})"),
+    (CS, r"labeled_counter\(\s*\n?\s*[\"']qos_throttle[\"']",
+     "chunkserver per-tenant throttle counter (qos_throttle{tenant})"),
+    (CLIENT, r"st\.BUSY",
+     "client BUSY (QoS shed) backoff-retry handling"),
+    (CLIENT, r"qos_busy_waits",
+     "client shed-retry counter (qos_busy_waits)"),
+    (S3, r"st\.BUSY", "S3 gateway BUSY -> 503 SlowDown mapping"),
+    (NFS, r"NFS3ERR_JUKEBOX",
+     "NFS gateway BUSY -> JUKEBOX delay mapping"),
 )
 
 # files searched for OP_CLASSES coverage (who feeds each objective)

@@ -415,7 +415,70 @@ struct Server {
     std::atomic<uint64_t> shm_desc_ops{0};         // descriptors landed
     std::atomic<uint64_t> shm_bytes{0};            // payload bytes via ring
     std::atomic<int64_t> shm_active_segments{0};   // currently mapped
+    // multi-tenant QoS: master-pushed per-session byte-rate budgets
+    // (lz_serve_qos_set; the chunkserver heartbeat relays the master's
+    // qos_json). Unlisted sessions are unbudgeted. Threaded reads pace
+    // with a bounded sleep; the proactor's descriptor drain DEFERS the
+    // connection (frames stay buffered) and retries on a short epoll
+    // timeout — pacing, never a lockout.
+    struct QosBudget {
+        double bps = 0.0;
+        double tokens = 0.0;
+        uint64_t last_us = 0;
+    };
+    std::mutex qos_mu;
+    std::map<uint64_t, QosBudget> qos_budgets;
+    // mirror of qos_budgets.size(): the unbudgeted hot path must be
+    // one relaxed load, never a mutex, per frame
+    std::atomic<int> qos_n{0};
+    std::atomic<uint64_t> qos_deferrals{0};
 };
+
+// Charge `len` bytes against the session's budget. Returns 0 when
+// admitted (or the session is unbudgeted — only then are tokens
+// consumed), else a suggested retry delay in microseconds. Debt model
+// mirrors runtime/limiter.py TokenBucket: a request is admitted while
+// tokens are positive and may drive them negative, so jumbo ops pace
+// instead of deadlocking.
+uint64_t qos_charge(Server& srv, uint64_t session_id, uint64_t len) {
+    if (srv.qos_n.load(std::memory_order_relaxed) == 0) return 0;
+    if (session_id == 0) return 0;  // legacy peer / unattributed
+    std::lock_guard<std::mutex> g(srv.qos_mu);
+    auto it = srv.qos_budgets.find(session_id);
+    if (it == srv.qos_budgets.end()) return 0;
+    Server::QosBudget& b = it->second;
+    if (b.bps <= 0.0) return 0;
+    const uint64_t now = lzwire::now_us();
+    if (b.last_us == 0 || now < b.last_us) b.last_us = now;
+    b.tokens = std::min(b.bps,  // burst = one second of the budget
+                        b.tokens + (now - b.last_us) * 1e-6 * b.bps);
+    b.last_us = now;
+    if (b.tokens > 0.0) {
+        b.tokens -= static_cast<double>(len);
+        return 0;
+    }
+    uint64_t delay = static_cast<uint64_t>((-b.tokens + 1.0) / b.bps * 1e6);
+    if (delay < 1000) delay = 1000;
+    if (delay > 100000) delay = 100000;  // re-check at least every 100 ms
+    return delay;
+}
+
+// Bounded blocking pace for the thread-per-connection read path (the
+// proactor never blocks — it defers instead). Caps total wait at 2 s:
+// QoS shapes traffic, it must never wedge a reader against a
+// misconfigured budget.
+void qos_pace_blocking(Server& srv, uint64_t session_id, uint64_t len) {
+    uint64_t waited = 0, delay = 0;
+    while ((delay = qos_charge(srv, session_id, len)) != 0 &&
+           !srv.stopping.load(std::memory_order_relaxed) &&
+           waited < 2000000) {
+        const uint64_t step = std::min<uint64_t>(delay, 50000);
+        ::usleep(static_cast<useconds_t>(step));
+        waited += step;
+    }
+    if (waited != 0)
+        srv.qos_deferrals.fetch_add(1, std::memory_order_relaxed);
+}
 
 void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
               uint64_t chunk_id, uint64_t bytes, uint64_t t_start_us,
@@ -482,6 +545,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     // (per-session op accounting; same additive-tail convention)
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
     uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
+    qos_pace_blocking(srv, session_id, size);
 
     uint8_t code = stOK;
     std::string path;
@@ -676,6 +740,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t size = get32(body + 24);
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
     uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
+    qos_pace_blocking(srv, session_id, size);
 
     uint8_t code = stOK;
     std::string path;
@@ -1251,6 +1316,10 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
                ncrcs != (dlen + kBlockSize - 1) / kBlockSize) {
         code = stEINVAL;
     }
+    // QoS pacing before the stream lands: the sender blocks on the
+    // socket while this thread sleeps, which IS the backpressure
+    if (s != nullptr && code == stOK)
+        qos_pace_blocking(srv, s->session_id, dlen);
     bool chained = s != nullptr && s->down_fd >= 0;
     if (chained) {
         // forward header + fixed + crcs + dlen downstream before data
@@ -1485,6 +1554,10 @@ struct ShmConn {
     bool want_out = false;     // EPOLLOUT currently armed
     int pending_fd = -1;       // SCM_RIGHTS fd awaiting its ShmInit frame
     bool dead = false;
+    // QoS deferral: the drain stopped at a frame whose session is over
+    // its byte budget; frames stay buffered and the proactor retries
+    // once this stamp passes (pacing without blocking the loop thread)
+    uint64_t defer_until_us = 0;
 };
 
 struct Proactor {
@@ -1826,6 +1899,42 @@ void shm_handle_in(Server& srv, Proactor* p, ShmConn* c) {
             break;
         }
         if (c->in_len - pos < 8 + length) break;
+        // QoS gate on write-bearing frames: peek the session and the
+        // byte cost; over budget -> stop draining HERE (the frame and
+        // everything behind it stays buffered, acks stay FIFO) and let
+        // the proactor retry after the suggested delay
+        if (srv.qos_n.load(std::memory_order_relaxed) != 0 &&
+            length >= 1 + 36 &&
+            (type == lzshm::kTypeShmWritePart || type == kTypeWriteBulk ||
+             type == kTypeWriteBulkPart)) {
+            const uint8_t* b = c->in.data() + pos + 8 + 1;
+            const uint64_t chunk_id = get64(b + 4);
+            uint64_t sid = 0;
+            uint64_t charge = length;
+            if (type == lzshm::kTypeShmWritePart) {
+                auto it = c->sessions.find(
+                    SessionKey(chunk_id, get32(b + 16)));
+                if (it != c->sessions.end()) sid = it->second->session_id;
+                charge = get32(b + 32);  // descriptor's payload length
+            } else {
+                WriteSession* s =
+                    type == kTypeWriteBulkPart
+                        ? [&]() -> WriteSession* {
+                              auto it2 = c->sessions.find(
+                                  SessionKey(chunk_id, get32(b + 16)));
+                              return it2 == c->sessions.end() ? nullptr
+                                                              : it2->second;
+                          }()
+                        : find_chunk_session(&c->sessions, chunk_id);
+                if (s != nullptr) sid = s->session_id;
+            }
+            const uint64_t delay = qos_charge(srv, sid, charge);
+            if (delay != 0) {
+                c->defer_until_us = lzwire::now_us() + delay;
+                srv.qos_deferrals.fetch_add(1, std::memory_order_relaxed);
+                break;
+            }
+        }
         if (!shm_handle_frame(srv, c, type, c->in.data() + pos + 8,
                               length)) {
             c->dead = true;
@@ -1862,7 +1971,19 @@ void proactor_remove(Proactor* p, ShmConn* c) {
 void proactor_loop(Proactor* p) {
     struct epoll_event events[64];
     while (!p->stopping.load(std::memory_order_acquire)) {
-        int n = ::epoll_wait(p->epfd, events, 64, 1000);
+        // QoS-deferred connections hold buffered frames no epoll event
+        // will re-announce (the socket was already drained): wake on a
+        // short timeout while any exist
+        int timeout = 1000;
+        {
+            std::lock_guard<std::mutex> g(p->mu);
+            for (ShmConn* c : p->conns)
+                if (c->defer_until_us != 0) {
+                    timeout = 10;
+                    break;
+                }
+        }
+        int n = ::epoll_wait(p->epfd, events, 64, timeout);
         if (n < 0) {
             if (errno == EINTR) continue;
             break;
@@ -1880,6 +2001,21 @@ void proactor_loop(Proactor* p) {
                 shm_flush_out(p, c);
             if (!c->dead && (events[i].events & EPOLLIN))
                 shm_handle_in(*p->srv, p, c);
+            if (c->dead) proactor_remove(p, c);
+        }
+        // retry deferred drains whose delay passed (collected AFTER the
+        // event pass: a connection removed above is gone from conns)
+        const uint64_t now = lzwire::now_us();
+        std::vector<ShmConn*> retry;
+        {
+            std::lock_guard<std::mutex> g(p->mu);
+            for (ShmConn* c : p->conns)
+                if (c->defer_until_us != 0 && now >= c->defer_until_us)
+                    retry.push_back(c);
+        }
+        for (ShmConn* c : retry) {
+            c->defer_until_us = 0;
+            if (!c->dead) shm_handle_in(*p->srv, p, c);
             if (c->dead) proactor_remove(p, c);
         }
     }
@@ -2386,6 +2522,53 @@ int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
 // and falls back to lz_serve_trace on a stale .so)
 int lz_serve_trace2(int handle, uint64_t* out, int max_ops) {
     return drain_trace(handle, out, max_ops, 9);
+}
+
+// Multi-tenant QoS: replace the per-session byte-rate budget table
+// (pairs of session id + bytes/sec; the chunkserver heartbeat relays
+// the master's qos_json). Sessions keep their accumulated token debt
+// across refreshes so a budget update cannot grant a free burst.
+// Returns 0 on success, -1 on a bad handle.
+int lz_serve_qos_set(int handle, const uint64_t* sids,
+                     const uint64_t* bps, int n) {
+    Server* srv = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_servers_mu);
+        if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+            g_servers[handle] == nullptr)
+            return -1;
+        srv = g_servers[handle];
+    }
+    std::lock_guard<std::mutex> g(srv->qos_mu);
+    std::map<uint64_t, Server::QosBudget> next;
+    for (int i = 0; i < n; ++i) {
+        Server::QosBudget b;
+        auto it = srv->qos_budgets.find(sids[i]);
+        if (it != srv->qos_budgets.end()) {
+            b = it->second;  // keep accumulated debt across refreshes
+        } else {
+            // a NEW budget starts with a full one-second burst (the
+            // TokenBucket contract) — zero tokens would defer the
+            // session's very first op
+            b.tokens = static_cast<double>(bps[i]);
+        }
+        b.bps = static_cast<double>(bps[i]);
+        next[sids[i]] = b;
+    }
+    srv->qos_budgets.swap(next);
+    srv->qos_n.store(static_cast<int>(srv->qos_budgets.size()),
+                     std::memory_order_relaxed);
+    return 0;
+}
+
+// How many data-plane ops were paced/deferred by the QoS budgets
+// (threaded reads/writes + proactor drains combined).
+uint64_t lz_serve_qos_deferrals(int handle) {
+    std::lock_guard<std::mutex> g(g_servers_mu);
+    if (handle < 0 || handle >= static_cast<int>(g_servers.size()) ||
+        g_servers[handle] == nullptr)
+        return 0;
+    return g_servers[handle]->qos_deferrals.load();
 }
 
 }  // extern "C"

@@ -114,6 +114,12 @@ def _fat_row() -> dict:
         "shadow_served": 123456, "stale_retries": 12,
     }
     row["cluster_locate_p99_ms"] = {"primary": 12.34, "replica_topo": 10.56}
+    # per-tenant QoS A/B fiducial (this round: fair-share admission) —
+    # victim p99 off->on under an abuser flood with its bound verdict
+    row["cluster_qos_victim_p99_ms"] = {
+        "off": 187.5, "on": 6.2, "bound_ms": 250.0,
+        "abuser_sheds": 312, "target_met": True,
+    }
     row["cluster_locate_storm_detail"] = {
         "files": 100000, "servers": 1000, "populate_s": 4.2,
         "cs_ingest": {"real_cs": 128, "parts_each": 2000, "ingest_s": 1.9},
@@ -191,6 +197,12 @@ def test_summary_line_fits_driver_tail():
         or "cluster_locate_qps" in parsed.get("dropped", [])
     )
     assert "cluster_locate_storm_detail" not in parsed
+    # the QoS A/B verdict rides the tail (or its drop is recorded)
+    assert (
+        parsed.get("cluster_qos_victim_p99_ms", {}).get("target_met")
+        is True
+        or "cluster_qos_victim_p99_ms" in parsed.get("dropped", [])
+    )
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail
     assert not any("C_client" in k for k in parsed)
