@@ -498,6 +498,19 @@ def cluster_throughput() -> dict:
                     "abuser_sheds": q.get("abuser_busy_waits_on", 0),
                     "target_met": q.get("target_met", False),
                 }
+            elif "hotspot" in r:
+                # hot-spot A/B (ISSUE 17): aggregate read MB/s on one
+                # 1-copy chunk with the heat loop off vs on — verdict
+                # is the adaptive goal boost landing (copies, time to
+                # boost) without costing read throughput
+                h = r["hotspot"]
+                out["cluster_hotspot_read_MBps"] = {
+                    "off": h.get("read_off_MBps", 0),
+                    "on": h.get("read_on_MBps", 0),
+                    "copies": h.get("copies", 1),
+                    "boost_s": h.get("boost_s", 0),
+                    "target_met": h.get("target_met", False),
+                }
             elif "native_read_us" in r:
                 out["cluster_4k_read_native_us"] = r["native_read_us"]
                 out["cluster_4k_read_loop_us"] = r["loop_read_us"]
@@ -915,6 +928,10 @@ def _summary_row(row: dict) -> dict:
         # per-tenant QoS verdict (ISSUE 15): victim p99 off->on under
         # an abuser flood + its bound + shed placement
         s["cluster_qos_victim_p99_ms"] = row["cluster_qos_victim_p99_ms"]
+    if "cluster_hotspot_read_MBps" in row:
+        # hot-spot verdict (ISSUE 17): did the heat loop boost the
+        # viral chunk, how fast, and did read throughput hold
+        s["cluster_hotspot_read_MBps"] = row["cluster_hotspot_read_MBps"]
     targeted = {
         key[: -len("_target_met")]
         for key in row
@@ -976,13 +993,18 @@ def _summary_row(row: dict) -> dict:
 # the driver records only a ~2000-byte stdout tail; leave margin for
 # the trailing newline + any stderr interleaving. Structural guard:
 # tests/test_bench_summary.py pins that a worst-case row set fits.
-SUMMARY_BUDGET_BYTES = 1900
+# (1900 -> 1925 when the hot-spot A/B fiducial joined: a worst-case
+# round now carries one more drop record, and the ladder must still
+# stop before the ec(8,4) phases rung; 1925 keeps ~75 bytes of slack
+# under the hard window.)
+SUMMARY_BUDGET_BYTES = 1925
 
 # dropped (in order) when a fat round outgrows the budget — ordered
 # least-verdict-bearing first; each drop is recorded so the tail shows
 # WHAT was cut instead of cutting mid-JSON like r05
 _SUMMARY_DROP_ORDER = (
     "cluster_slo_breaches_by_class", "cluster_locate_p99_ms",
+    "cluster_hotspot_read_MBps",
     "cluster_qos_victim_p99_ms",
     "bench_regressions",
     "kernel_ladder",

@@ -89,6 +89,111 @@ def _bench_dir() -> Path:
     return Path(tempfile.mkdtemp(prefix="lizbench"))
 
 
+async def run_hotspot_ab(n_cs: int = 3, size_kb: int = 256,
+                         readers: int = 4, secs: float = 2.0) -> dict:
+    """Hot-spot A/B (ISSUE 17): `readers` clients hammer one 1-copy
+    chunk, LZ_HEAT=0 vs on. Off, every read funnels through the single
+    copy's server; on, the heat loop goal-boosts the chunk (extra
+    copies through the changelog + RebuildEngine) and load-ranked
+    locate replies drain readers onto the new copies. The verdict:
+    the boost actually landed, and aggregate read MB/s held or
+    improved. Runs on its own small cluster — the arms flip the
+    process-wide kill switch, so nothing else may be mid-measurement."""
+    saved = os.environ.get("LZ_HEAT")
+    payload = data_generator.generate(17, size_kb * 1024).tobytes()
+    out: dict = {"readers": readers, "secs": secs}
+
+    async def one_arm(on: bool) -> float:
+        os.environ["LZ_HEAT"] = "1" if on else "0"
+        tmp = _bench_dir()
+        master = MasterServer(str(tmp / "master"), goals=bench_goals(),
+                              health_interval=0.2)
+        await master.start()
+        servers = []
+        for i in range(n_cs):
+            cs = ChunkServer(str(tmp / f"cs{i}"),
+                             master_addr=("127.0.0.1", master.port),
+                             heartbeat_interval=0.3)
+            await cs.start()
+            servers.append(cs)
+        clients = []
+        try:
+            writer = Client("127.0.0.1", master.port)
+            await writer.connect()
+            clients.append(writer)
+            f = await writer.create(1, "viral.bin")
+            await writer.write_file(f.inode, payload)
+            loc = await writer.chunk_info(f.inode, 0)
+            chunk = master.meta.registry.chunk(loc.chunk_id)
+            if on:
+                # drill-sized thresholds: boost after ~2 heartbeat
+                # folds of the storm, never demote mid-measurement
+                master.tweaks.set("heat_boost_bytes",
+                                  str(2 * size_kb * 1024))
+                master.tweaks.set("heat_demote_bytes", "1024")
+            for _ in range(readers):
+                rc = Client("127.0.0.1", master.port)
+                await rc.connect()
+                clients.append(rc)
+            stop = asyncio.Event()
+            nbytes = [0]
+
+            async def hammer(rc: Client) -> None:
+                while not stop.is_set():
+                    rc.cache.invalidate(f.inode)
+                    got = await rc.read_file(f.inode)
+                    assert len(got) == len(payload)
+                    nbytes[0] += len(got)
+
+            tasks = [asyncio.create_task(hammer(rc))
+                     for rc in clients[1:]]
+            try:
+                if on:
+                    # warm-up: storm until the boost lands AND a second
+                    # copy is serving (bounded; a miss is the verdict)
+                    t0 = time.monotonic()
+                    deadline = t0 + 12.0
+                    while time.monotonic() < deadline:
+                        if chunk.boost > 0 and len(
+                                {cs_id for cs_id, _ in chunk.parts}) >= 2:
+                            break
+                        await asyncio.sleep(0.1)
+                    out["boost_s"] = round(time.monotonic() - t0, 2)
+                    out["copies"] = len({cs_id for cs_id, _ in chunk.parts})
+                    out["boosted"] = chunk.boost > 0
+                nbytes[0] = 0
+                t0 = time.monotonic()
+                await asyncio.sleep(secs)
+                elapsed = time.monotonic() - t0
+                return round(nbytes[0] / elapsed / 2**20, 1)
+            finally:
+                stop.set()
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+        finally:
+            for rc in clients:
+                await rc.close()
+            for cs in servers:
+                await cs.stop()
+            await master.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    try:
+        out["read_off_MBps"] = await one_arm(False)
+        out["read_on_MBps"] = await one_arm(True)
+    finally:
+        if saved is None:
+            os.environ.pop("LZ_HEAT", None)
+        else:
+            os.environ["LZ_HEAT"] = saved
+    out["target_met"] = bool(
+        out.get("boosted")
+        and out["read_on_MBps"] >= 0.8 * out["read_off_MBps"]
+    )
+    return {"goal": "hot-spot A/B", "hotspot": out}
+
+
 async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
     tmp = _bench_dir()
     master = MasterServer(str(tmp / "master"), goals=bench_goals(),
@@ -821,6 +926,17 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             import logging
 
             logging.getLogger("bench").exception("qos A/B row failed")
+
+        # hot-spot A/B (ISSUE 17): readers hammer one 1-copy chunk,
+        # LZ_HEAT off vs on — the verdict is the adaptive goal boost
+        # landing (extra copies, load-ranked locates) without costing
+        # aggregate read throughput
+        try:
+            rows.append(await run_hotspot_ab())
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("hot-spot A/B row failed")
     finally:
         await client.close()
         for cs in servers:
@@ -876,6 +992,13 @@ def main(argv=None) -> int:
                   f"{q['bound_ms']:.0f}); abuser "
                   f"{q['abuser_qps_off']:.0f} -> {q['abuser_qps_on']:.0f} "
                   f"q/s; target_met={q['target_met']}")
+        elif "hotspot" in r:
+            h = r["hotspot"]
+            print(f"{r['goal']:>18s}:  off {h['read_off_MBps']:8.1f} MB/s"
+                  f"   on {h['read_on_MBps']:8.1f} MB/s"
+                  f"   copies {h.get('copies', 1)}"
+                  f" (boost in {h.get('boost_s', 0):.1f}s)"
+                  f"   target_met={h['target_met']}")
         elif "put_MBps" in r:
             print(f"{r['goal']:>18s}:  put {r['put_MBps']:8.1f} MB/s"
                   f"   get {r['get_MBps']:8.1f} MB/s"

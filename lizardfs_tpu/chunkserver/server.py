@@ -120,6 +120,12 @@ class ChunkServer(Daemon):
         self.session_ops = accounting.SessionOps(
             self.metrics, "chunkserver", max_sessions=16
         )
+        # per-chunk heat accumulator between heartbeats: chunk_id ->
+        # [ops, bytes]. The top slice folds into heartbeat heat_json
+        # (master/heat.py heavy-hitter sketch); bounded so a scan over
+        # millions of chunks can't balloon the daemon — once full, new
+        # (cold) chunks are dropped and the hot set keeps charging
+        self._heat: dict[int, list[float]] = {}
         # (total, used) from the last heartbeat's store.space() so the
         # health snapshot doesn't re-stat the folders
         self._last_space: tuple[int, int] | None = None
@@ -394,6 +400,10 @@ class ChunkServer(Daemon):
                 # span-drop / disk-error snapshot rides the heartbeat
                 # (skew-tolerant trailing field; old masters ignore it)
                 health_json=_json.dumps(self.health_snapshot()),
+                # per-chunk heat fold for the master's cluster heat map
+                # (skew-tolerant trailing field; "" when LZ_HEAT is off
+                # so the wire stays byte-identical to the pre-heat tree)
+                heat_json=self._heat_fold_json(),
                 timeout=5.0,
             )
             # QoS data-plane config refresh (skew-tolerant trailing
@@ -497,6 +507,9 @@ class ChunkServer(Daemon):
                         total_space=total,
                         used_space=used,
                         health_json="",
+                        # heat folds go to the ACTIVE only (shadows
+                        # don't run the heat loop)
+                        heat_json="",
                         timeout=5.0,
                     )
             except (OSError, ConnectionError, asyncio.TimeoutError,
@@ -549,6 +562,10 @@ class ChunkServer(Daemon):
                 max(op["t1"] - op["t0"], 0.0),
                 nbytes=op["bytes"], trace_id=op["trace_id"],
             )
+            # native-plane ops heat the same per-chunk accumulator the
+            # asyncio handlers charge — the master's heat map must not
+            # go blind when the C++ data plane serves the bytes
+            self._heat_charge(op["chunk_id"], op["bytes"])
 
     def trace_spans(self, trace_id: int | None = None) -> list[dict]:
         # pull whatever the native plane recorded since the last
@@ -584,6 +601,43 @@ class ChunkServer(Daemon):
                 "throttle_waits": q["throttle_waits"],
             }
         return extra
+
+    # --- per-chunk heat fold (master/heat.py input) -------------------------
+
+    def _heat_charge(self, chunk_id: int, nbytes: int) -> None:
+        """Charge one data-plane op against the chunk's heat row. Cheap
+        enough for every read/write; gated so LZ_HEAT=off costs one
+        env read and nothing else."""
+        if not constants_mod.heat_enabled():
+            return
+        cell = self._heat.get(chunk_id)
+        if cell is None:
+            if len(self._heat) >= 1024:
+                # full: keep charging known-hot chunks, drop newcomers
+                # (the master's sketch only wants the heavy hitters)
+                return
+            cell = self._heat[chunk_id] = [0.0, 0.0]
+        cell[0] += 1.0
+        cell[1] += float(nbytes)
+
+    def _heat_fold_json(self) -> str:
+        """Top-K of the accumulator as heartbeat heat_json, then reset.
+        Returns "" when LZ_HEAT is off or nothing charged — the
+        heartbeat stays byte-identical to the pre-heat wire."""
+        if not constants_mod.heat_enabled():
+            self._heat.clear()
+            return ""
+        if not self._heat:
+            return ""
+        import json as _json
+
+        top = sorted(
+            self._heat.items(), key=lambda kv: kv[1][1], reverse=True
+        )[:16]
+        self._heat.clear()
+        return _json.dumps({
+            "chunks": [[cid, int(ops), int(nb)] for cid, (ops, nb) in top]
+        })
 
     # --- multi-tenant QoS data plane ---------------------------------------
 
@@ -994,6 +1048,7 @@ class ChunkServer(Daemon):
                         msg.session_id or "unattributed", "read", dt,
                         nbytes=msg.size, trace_id=msg.trace_id,
                     )
+                    self._heat_charge(msg.chunk_id, msg.size)
                 elif isinstance(msg, m.CltocsReadBulk):
                     t0 = time.perf_counter()
                     tw0 = time.time()
@@ -1013,6 +1068,7 @@ class ChunkServer(Daemon):
                         msg.session_id or "unattributed", "read", dt,
                         nbytes=msg.size, trace_id=msg.trace_id,
                     )
+                    self._heat_charge(msg.chunk_id, msg.size)
                 elif isinstance(msg, m.CltocsWriteInit):
                     await self._serve_write_init(writer, msg, sessions)
                 elif isinstance(msg, m.CltocsWriteData):
@@ -1201,6 +1257,7 @@ class ChunkServer(Daemon):
             session.session_id or "unattributed", "write", dt,
             nbytes=msg.length, trace_id=session.trace_id,
         )
+        self._heat_charge(msg.chunk_id, msg.length)
         await ack(code)
 
     @staticmethod
@@ -1753,6 +1810,7 @@ class ChunkServer(Daemon):
             session.session_id or "unattributed", "write", dt,
             nbytes=len(msg.data), trace_id=session.trace_id,
         )
+        self._heat_charge(msg.chunk_id, len(msg.data))
         await ack(code)
 
     def _local_write(self, session: _WriteSession, msg: m.CltocsWriteData) -> None:

@@ -83,6 +83,17 @@ def qos_enabled() -> bool:
     return env_flag("LZ_QOS")
 
 
+def heat_enabled() -> bool:
+    """LZ_HEAT kill switch (default ON) for the cluster heat loop:
+    master heat tracking + `lizardfs_heat_*` families, chunkserver
+    per-chunk heartbeat folds (off sends heat_json="" — heartbeats stay
+    byte-identical to the pre-heat wire), adaptive goal boosts, load-
+    weighted placement, and the SLO→QoS auto-arm. Off, no goal_boost /
+    goal_demote op is ever committed and placement falls back to pure
+    free-space weighting. Read per call: operators flip it live."""
+    return env_flag("LZ_HEAT")
+
+
 def s3_enabled() -> bool:
     """LZ_S3 kill switch (default ON) for the S3 object gateway: off,
     the gateway refuses to start (a booted gateway keeps serving —

@@ -76,12 +76,17 @@ def assign_slots(
     servers: list,
     jitter,
     wildcard: str = "_",
+    load=None,
 ) -> list[int]:
     """Optimal distinct-server choice for one slice's slots.
 
     ``servers`` expose ``.label`` and ``.free_space``; ``jitter(i, j)``
-    -> small int noise. Requires len(servers) >= len(slot_labels); the
-    caller handles the fewer-servers-than-slots case (repeats allowed)
+    -> small int noise. ``load(j)`` (optional) -> observed load score
+    for column j in [0, 1+] (heartbeat health + queue depth + heat
+    share); a loaded server costs as much extra as full fullness would,
+    so placement leans away from hot servers without ever violating a
+    label. Requires len(servers) >= len(slot_labels); the caller
+    handles the fewer-servers-than-slots case (repeats allowed)
     separately. Returns server indices per slot; mismatched labels are
     only used when no matching assignment exists (placed beats
     unplaced).
@@ -94,6 +99,10 @@ def assign_slots(
             c = 0 if (want == wildcard or s.label == want) else MISMATCH
             # fuller servers cost more: scale fullness into [0, 1000]
             c += 1000 - (s.free_space * 1000) // max_free
+            if load is not None:
+                # observed load scales into the same [0, 1000] band as
+                # fullness (load 0 — the heat-off state — adds nothing)
+                c += min(int(load(j) * 1000), 1000)
             c += int(jitter(i, j))
             row.append(c)
         cost.append(row)

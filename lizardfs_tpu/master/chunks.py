@@ -60,6 +60,11 @@ class ChunkInfo:
     goal_id: int = 0  # goal that created this chunk (label-aware repair)
     refcount: int = 1  # files referencing this chunk (snapshots share; COW
     #                    on write — chunk_goal_counters analog)
+    # temporary heat-driven goal boost: extra wanted copies on top of
+    # ``copies`` while the chunk is hot (master/heat.py adaptive
+    # replication). Applied/cleared ONLY through the goal_boost /
+    # goal_demote changelog ops so shadows and the image agree.
+    boost: int = 0
     locked_until: float = 0.0
     # live locations: (cs_id, slice part index) set; volatile
     parts: set[tuple[int, int]] = field(default_factory=set)
@@ -76,7 +81,8 @@ class RedundancyState:
 
     def __init__(self, missing: list[int], redundant: list[tuple[int, int]],
                  safe: bool, readable: bool,
-                 crowded: list[tuple[int, int]] | None = None):
+                 crowded: list[tuple[int, int]] | None = None,
+                 boost_only: bool = False):
         self.missing_parts = missing  # slice part indices with no copy
         self.redundant = redundant  # (cs_id, part) copies beyond 1
         self.is_safe = safe  # can lose any single server w/o data loss
@@ -85,6 +91,10 @@ class RedundancyState:
         # another part of this chunk — emergency placement that should
         # migrate off once a distinct server is available
         self.crowded = crowded or []
+        # True when every missing copy is owed only to a heat-driven
+        # goal boost (base goal satisfied): replication work, yes, but
+        # never "endangered" on health surfaces or in priority queues
+        self.boost_only = boost_only
 
     @property
     def is_endangered(self) -> bool:
@@ -159,6 +169,16 @@ class ChunkRegistry:
         self.pending_deletes: list[ChunkInfo] = []
         self._rebalance_cursor = 0
         self._rng = random.Random(0xEC)
+        # chunks currently carrying a heat-driven goal boost (mirrors
+        # ChunkInfo.boost > 0; maintained by set_boost so the heat tick
+        # never scans the whole table to find its own boosts)
+        self.boosted: set[int] = set()
+        # observatory-driven placement (master/heat.py): cs_id -> load
+        # score in [0, 1+] (heartbeat health + DRR queue depth + heat
+        # share, maintained by the master's heat tick). Empty — the
+        # LZ_HEAT-off state — means pure free-space weighting, the
+        # pre-heat behavior, byte for byte.
+        self.server_load: dict[int, float] = {}
 
     # --- chunkserver db -------------------------------------------------------
 
@@ -320,6 +340,7 @@ class ChunkRegistry:
 
     def delete_chunk(self, chunk_id: int) -> ChunkInfo | None:
         self.stale_versions.pop(chunk_id, None)
+        self.boosted.discard(chunk_id)
         chunk = self.chunks.pop(chunk_id, None)
         if chunk is not None and chunk.parts:
             for cs_id, part in chunk.parts:
@@ -330,6 +351,19 @@ class ChunkRegistry:
             if len(self.pending_deletes) > 100_000:
                 del self.pending_deletes[:-100_000]
         return chunk
+
+    def set_boost(self, chunk_id: int, boost: int) -> None:
+        """The one write path for heat goal boosts: keeps ChunkInfo.boost
+        and the ``boosted`` set in lockstep (goal_boost / goal_demote op
+        application and image load both come through here)."""
+        chunk = self.chunks.get(chunk_id)
+        if chunk is None:
+            return
+        chunk.boost = max(int(boost), 0)
+        if chunk.boost:
+            self.boosted.add(chunk_id)
+        else:
+            self.boosted.discard(chunk_id)
 
     def release_chunk(self, chunk_id: int) -> None:
         """Drop one file reference; physical deletion only at zero."""
@@ -353,14 +387,23 @@ class ChunkRegistry:
         live = {p: cs for p, cs in live.items() if cs}
         if t.is_standard:
             ncopies = len(live.get(0, []))
-            # under goal: each missing copy is a 'missing part 0' work item
-            missing = [0] * max(chunk.copies - ncopies, 0)
+            # under goal: each missing copy is a 'missing part 0' work
+            # item; a heat boost raises the wanted count temporarily
+            # (extra copies shed again through the redundant path once
+            # the boost demotes)
+            wanted = chunk.copies + max(chunk.boost, 0)
+            missing = [0] * max(wanted - ncopies, 0)
             redundant = [
-                (c, 0) for c in live.get(0, [])[chunk.copies :]
+                (c, 0) for c in live.get(0, [])[wanted:]
             ]
             readable = ncopies >= 1
+            # safety is judged against the BASE goal: a boost adds read
+            # fan-out, it never redefines what counts as endangered
             safe = ncopies >= min(2, chunk.copies)
-            return RedundancyState(missing, redundant, safe, readable)
+            return RedundancyState(
+                missing, redundant, safe, readable,
+                boost_only=bool(missing) and ncopies >= chunk.copies,
+            )
         missing = [p for p in range(expected) if p not in live]
         redundant = []
         for p, cs_list in live.items():
@@ -417,10 +460,18 @@ class ChunkRegistry:
         if len(slot_labels) < count:
             slot_labels += ["_"] * (count - len(slot_labels))
 
+        def load_of(s: ChunkServerInfo) -> float:
+            return max(self.server_load.get(s.cs_id, 0.0), 0.0)
+
         def pick_from(pool: list[ChunkServerInfo]) -> ChunkServerInfo | None:
             if not pool:
                 return None
-            weights = [max(s.free_space, 1) for s in pool]
+            # observed load scales the free-space weight down: a server
+            # at load 1.0 competes with half its free space (load 0 —
+            # the heat-off state — leaves the weight untouched)
+            weights = [
+                max(s.free_space, 1) / (1.0 + load_of(s)) for s in pool
+            ]
             return pool[self._rng.choices(range(len(pool)), weights=weights)[0]]
 
         if count <= len(candidates):
@@ -432,6 +483,7 @@ class ChunkRegistry:
             idx = assignment.assign_slots(
                 slot_labels[:count], candidates,
                 jitter=lambda i, j: self._rng.randrange(100),
+                load=lambda j: load_of(candidates[j]),
             )
             return [candidates[j] for j in idx]
 
@@ -518,7 +570,9 @@ class ChunkRegistry:
             self.danger_scanned_total += 1
             if not state.is_readable:
                 self._boot_lost += 1
-            elif state.is_endangered or state.missing_parts:
+            elif state.is_endangered or (
+                state.missing_parts and not state.boost_only
+            ):
                 self._boot_endangered += 1
         self._boot_idx = end
         if end >= len(self._boot_ids):
@@ -533,7 +587,9 @@ class ChunkRegistry:
         self.danger_scanned_total += 1
         if not state.is_readable:
             self._cycle_lost += 1
-        elif state.is_endangered or state.missing_parts:
+        elif state.is_endangered or (
+            state.missing_parts and not state.boost_only
+        ):
             self._cycle_endangered += 1
 
     def _chunk_work(self, chunk: ChunkInfo, out: list,

@@ -170,6 +170,21 @@ class MetadataStore:
     def _op_delete_chunk(self, op):
         self.registry.delete_chunk(op["chunk_id"])
 
+    def _op_goal_boost(self, op):
+        """Heat-driven temporary goal boost: raise the chunk's wanted
+        copy count by ``boost`` extra copies (master/heat.py adaptive
+        replication). The live master decides thresholds/hysteresis
+        OUTSIDE the op; apply is unconditional on a missing chunk being
+        a no-op (the chunk may have been released between the heat
+        decision and a shadow's replay)."""
+        self.registry.set_boost(op["chunk_id"], op["boost"])
+
+    def _op_goal_demote(self, op):
+        """Heat decayed back under the demote threshold: drop the
+        temporary boost (the redundant-copy path then sheds the extra
+        replicas). No-op on a missing chunk, same as goal_boost."""
+        self.registry.set_boost(op["chunk_id"], 0)
+
     def _op_purge_trash(self, op):
         node = self.fs.nodes.get(op["inode"])
         will_sustain = bool(self.fs.open_refs.get(op["inode"]))
@@ -311,7 +326,8 @@ class MetadataStore:
                 "table": [
                     {"id": c.chunk_id, "version": c.version,
                      "slice_type": c.slice_type, "copies": c.copies,
-                     "refcount": c.refcount, "goal_id": c.goal_id}
+                     "refcount": c.refcount, "goal_id": c.goal_id,
+                     "boost": c.boost}
                     for c in self.registry.chunks.values()
                 ],
             },
@@ -347,6 +363,7 @@ class MetadataStore:
                 copies=row.get("copies", 1), goal_id=row.get("goal_id", 0),
             )
             c.refcount = row.get("refcount", 1)
+            self.registry.set_boost(c.chunk_id, row.get("boost", 0))
         self.registry.next_chunk_id = ch["next_chunk_id"]
         self.quotas = QuotaDatabase.from_dict(doc.get("quotas", {}))
         self.locks = LockManager()
@@ -432,7 +449,7 @@ class MetadataStore:
                 return 0
             return self._h(
                 "chunk", c.chunk_id, c.version, c.slice_type, c.copies,
-                c.refcount, c.goal_id,
+                c.refcount, c.goal_id, c.boost,
             )
         if kind == "quota":
             e = self.quotas.entries.get((key[1], key[2]))
@@ -658,7 +675,8 @@ class MetadataStore:
             out.add(("node", op["inode"]))
             node_quota(op["inode"])
             node_chunks(op["inode"])
-        elif t in ("create_chunk", "bump_chunk_version", "delete_chunk"):
+        elif t in ("create_chunk", "bump_chunk_version", "delete_chunk",
+                   "goal_boost", "goal_demote"):
             out.add(("chunk", op["chunk_id"]))
         elif t in ("acquire", "release"):
             out |= {("open", op["inode"]), ("sustained", op["inode"]),

@@ -87,7 +87,9 @@ def classify(chunk, state) -> int:
     'endangered'."""
     if not state.is_readable:
         return PRIORITY_LOST  # only stale-version/filerepair can help
-    if not state.missing_parts:
+    if not state.missing_parts or state.boost_only:
+        # heat-boost copies (base goal already satisfied) are placement
+        # work: they must never outrank real repairs in the queue
         return PRIORITY_REBALANCE
     from lizardfs_tpu.core import geometry
 

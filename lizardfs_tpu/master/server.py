@@ -35,6 +35,7 @@ from lizardfs_tpu.master.metadata import MetadataStore
 from lizardfs_tpu.master.quotas import KIND_DIR, KIND_GROUP, KIND_USER
 from lizardfs_tpu import constants as constants_mod
 from lizardfs_tpu.constants import MFSBLOCKSIZE, MFSCHUNKSIZE
+from lizardfs_tpu.master import heat as heatmod
 from lizardfs_tpu.master import rebuild as rebuild_mod
 from lizardfs_tpu.proto import framing
 from lizardfs_tpu.proto import messages as m
@@ -275,6 +276,20 @@ class MasterServer(Daemon):
         # throttle, progress/ETA) — the endangered FIFO feeds it, the
         # health tick launches what it admits (master/rebuild.py)
         self.rebuild = rebuild_mod.RebuildEngine(self.metrics, self.tweaks)
+        # cluster heat map (master/heat.py): decayed per-chunk / inode /
+        # server heavy-hitter sketch fed by client RPC charges, CS
+        # heartbeat heat folds, and gateway stats pushes. The health
+        # tick closes the loop: adaptive goal boosts (changelog ops),
+        # load-weighted placement, and the SLO→QoS auto-arm below.
+        self.heat = heatmod.HeatTracker(self.metrics, self.tweaks)
+        # heat-armed QoS pressure: tenant -> (restore_weight, expiry).
+        # The SLO breach hook halves an offender's fair-share weight;
+        # the health tick restores it when the window expires.
+        self._heat_qos_pressure: dict[str, tuple[float, float]] = {}
+        self._slo_qos_last = 0.0  # rate limit on the auto-arm action
+        # second auto-arm action beside the profiler (runtime/slo.py):
+        # an SLO burn breach also squeezes the top-offending tenant
+        self.slo.qos_arm = self._slo_qos_arm
         # repair-failure backoff: chunk_id -> monotonic deadline before
         # the next replicate attempt (a source at a stale version fails
         # fast, and retrying it at tick rate floods the log and the net)
@@ -832,6 +847,15 @@ class MasterServer(Daemon):
                         "locate", dt, trace_id=tid,
                         name=type(msg).__name__,
                     )
+                    # heat map, inode kind: the master-leg RPC charge
+                    # carries latency + trace id so the hottest cell's
+                    # heat_hot_ops histogram gets a drill-down exemplar
+                    if constants_mod.heat_enabled():
+                        inode = getattr(msg, "inode", 0)
+                        if inode:
+                            self.heat.charge(
+                                "inode", inode, seconds=dt, trace_id=tid,
+                            )
                 if reply is not None:
                     self._stamp_token(reply)
                     await framing.send_message(writer, reply)
@@ -1035,6 +1059,116 @@ class MasterServer(Daemon):
         text = json.dumps(out, sort_keys=True)
         self._qos_cs_cache = (key, text)
         return text
+
+    # --- cluster heat loop (master/heat.py) --------------------------------
+
+    def _heat_tick(self) -> None:
+        """The heat loop's control leg, riding the health tick: decay
+        the sketch, commit goal boosts/demotes for chunks crossing the
+        thresholds (hysteresis lives in heat.boost_decisions), refresh
+        the load-weighted placement inputs, and expire heat-armed QoS
+        pressure."""
+        registry = self.meta.registry
+        now = time.monotonic()
+        enabled = constants_mod.heat_enabled()
+        # expire armed QoS pressure even when the switch just went off:
+        # LZ_HEAT=0 must never leave a tenant squeezed forever
+        for tenant, (restore, until) in list(
+            self._heat_qos_pressure.items()
+        ):
+            if now >= until or not enabled:
+                del self._heat_qos_pressure[tenant]
+                self.qos.set_weight(tenant, restore)
+        if not enabled:
+            if registry.server_load:
+                # revert placement to pure free-space weighting
+                registry.server_load = {}
+            return
+        self.heat.tick(now)
+        # observatory-driven placement: new-chunk server selection
+        # weighs observed load — per-server heat share + heartbeat
+        # health status + DRR queue depth (queued data-plane bytes)
+        waiting: dict[int, float] = {}
+        for cs_id, snap in self.cs_health.items():
+            q = (snap or {}).get("qos") or {}
+            w = q.get("waiting")
+            if isinstance(w, dict):
+                waiting[cs_id] = float(sum(w.values()))
+            elif w:
+                try:
+                    waiting[cs_id] = float(w)
+                except (TypeError, ValueError):
+                    pass
+        registry.server_load = self.heat.server_loads(
+            self.cs_health, waiting
+        )
+        # adaptive replication: boost chunks whose decayed heat crossed
+        # heat_boost_bytes, demote once it falls below heat_demote_bytes
+        # — via digest-covered changelog ops so shadows and the image
+        # agree; the extra copies are made/shed by the ordinary
+        # RebuildEngine machinery under its token-bucket budget
+        boosted = {
+            cid: registry.chunks[cid].boost
+            for cid in registry.boosted if cid in registry.chunks
+        }
+        to_boost, to_demote = self.heat.boost_decisions(boosted)
+        for cid in to_demote:
+            self.commit({"op": "goal_demote", "chunk_id": cid})
+            self.log.info("heat: goal demote chunk %d", cid)
+        for cid, copies in to_boost:
+            if cid not in registry.chunks:
+                continue
+            self.commit({
+                "op": "goal_boost", "chunk_id": cid, "boost": copies,
+            })
+            # wake the health walk on it now, not a cursor cycle later
+            registry.mark_endangered(cid)
+            self.log.info(
+                "heat: goal boost chunk %d (+%d copies)", cid, copies
+            )
+
+    def _slo_qos_arm(self, op_class: str, trace_id: int) -> None:
+        """Second SLO auto-arm action (beside the profiler): burn-rate
+        breach → squeeze the top-offending tenant's fair-share weight
+        for a window. Rate-limited, reversible (the health tick
+        restores the weight), and inert unless both LZ_HEAT and LZ_QOS
+        are on and QoS is actually armed."""
+        if not constants_mod.heat_enabled():
+            return
+        if not constants_mod.qos_enabled() or not self.qos.armed:
+            return
+        now = time.monotonic()
+        if now - self._slo_qos_last < 30.0:
+            return
+        # top offender: the highest-rate session's tenant right now
+        tenant = ""
+        for row in self.session_ops.top(4):
+            label = row["session"]
+            if not label.startswith("s"):
+                continue  # "other"/aggregate rows have no tenant
+            try:
+                sid = int(label[1:])
+            except ValueError:
+                continue
+            tenant = self.sessions.get(sid, {}).get("tenant", "")
+            if tenant:
+                break
+        if not tenant or tenant in self._heat_qos_pressure:
+            return
+        self._slo_qos_last = now
+        current = self.qos.weights.get(tenant, 1.0)
+        self._heat_qos_pressure[tenant] = (current, now + 30.0)
+        self.qos.set_weight(tenant, current / 2.0)
+        self.metrics.labeled_counter(
+            "slo_qos_armed", {"tenant": tenant, "op": op_class},
+            help="SLO burn-rate breaches that auto-armed QoS pressure "
+                 "(halved fair-share weight for a window), by offending "
+                 "tenant and breaching op class",
+        ).inc()
+        self.log.warning(
+            "slo breach (%s, trace 0x%x): qos pressure armed on tenant "
+            "%s for 30s", op_class, trace_id, tenant,
+        )
 
     def _replica_ready(self) -> bool:
         """A shadow serves replica reads only while its changelog follow
@@ -1422,6 +1556,19 @@ class MasterServer(Daemon):
                 )
             doc["ts"] = time.time()
             self.session_stats[session_id] = doc
+            # gateway heat leg: pushes may carry a "hot" table of
+            # [inode, ops, bytes] rows (protocol gateways serve data
+            # without per-inode master RPCs, so this is the only way
+            # their traffic reaches the heat map)
+            if constants_mod.heat_enabled():
+                for row in doc.get("hot") or ():
+                    try:
+                        ino, ops, nbytes = (
+                            int(row[0]), float(row[1]), float(row[2])
+                        )
+                    except (TypeError, ValueError, IndexError):
+                        continue
+                    self.heat.charge("inode", ino, ops=ops, nbytes=nbytes)
             return m.MatoclStatusReply(req_id=msg.req_id, status=st.OK)
         if isinstance(msg, m.CltomaLookup):
             self._check_perm(fs.dir_node(msg.parent), msg.uid, list(msg.gids), 1)
@@ -2199,14 +2346,20 @@ class MasterServer(Daemon):
                 self.topology.distance(client_ip, srv.host)
                 if client_ip else 0
             )
-            rows.append((part, dist, srv))
-        rows.sort(key=lambda r: (r[0], r[1]))
+            # equal part+distance replicas rank by observed load (heat
+            # share + queue depth + health): readers drain toward the
+            # cold copy a goal boost just created instead of piling
+            # onto the server that made the chunk hot. server_load is
+            # empty with LZ_HEAT off, keeping the pre-heat ordering.
+            load = self.meta.registry.server_load.get(cs_id, 0.0)
+            rows.append((part, dist, load, srv))
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
         return [
             m.PartLocation(
                 addr=m.Addr(host=srv.host, port=srv.data_addr_port),
                 part_id=geometry.ChunkPartType(t, part).id,
             )
-            for part, _, srv in rows
+            for part, _, _, srv in rows
         ]
 
     # how long a locate keeps a session subscribed to invalidations;
@@ -2307,6 +2460,11 @@ class MasterServer(Daemon):
                 file_length=node.length, locations=[],
             )
         chunk = self.meta.registry.chunk(chunk_id)
+        # heat map, chunk kind, ops only: the real byte weight arrives
+        # via chunkserver heartbeat folds — this keeps a hot chunk
+        # tracked even between folds
+        if constants_mod.heat_enabled():
+            self.heat.charge("chunk", chunk_id)
         return m.MatoclReadChunk(
             req_id=msg.req_id, status=st.OK, chunk_id=chunk_id,
             version=chunk.version, file_length=node.length,
@@ -2331,6 +2489,9 @@ class MasterServer(Daemon):
         if chunk_id == 0:
             return await self._create_new_chunk(msg, node)
         chunk = self.meta.registry.chunk(chunk_id)
+        if constants_mod.heat_enabled():
+            # chunk-kind heat, ops only (bytes ride the CS folds)
+            self.heat.charge("chunk", chunk_id)
         if chunk.locked_until > time.monotonic():
             return m.MatoclWriteChunk(
                 req_id=msg.req_id, status=st.CHUNK_BUSY, chunk_id=0, version=0,
@@ -2726,6 +2887,14 @@ class MasterServer(Daemon):
                             self.cs_health[srv.cs_id] = json.loads(
                                 msg.health_json
                             )
+                        except ValueError:
+                            pass
+                    hj = getattr(msg, "heat_json", "")
+                    if hj and constants_mod.heat_enabled():
+                        # per-chunk heat fold: the byte-weight input of
+                        # the cluster heat map (old peers send "")
+                        try:
+                            self.heat.fold_cs(srv.cs_id, json.loads(hj))
                         except ValueError:
                             pass
                     await framing.send_message(
@@ -3366,6 +3535,10 @@ class MasterServer(Daemon):
         # bootstrap counter so /health's lost/endangered become exact
         # within minutes of a restart, not after a full cursor cycle
         self.meta.registry.danger_bootstrap()
+        # heat loop: decay, goal boosts/demotes, placement loads, QoS
+        # pressure expiry — before health_work so a fresh boost's
+        # missing copies are scheduled in this same tick
+        self._heat_tick()
         work = self.meta.registry.health_work(limit=16)
         for item in work:
             if item[0] == "replicate":
@@ -4075,6 +4248,21 @@ class MasterServer(Daemon):
             # its connected sessions against its objective
             if self.qos.objectives:
                 qos_doc["objectives"] = self._qos_objective_report()
+        # heat: the hottest chunks and any standing goal boosts, so an
+        # operator reading a degraded rollup sees the hot spot (and the
+        # adaptive-replication response) without a second probe
+        heat_doc: dict = {}
+        if constants_mod.heat_enabled():
+            boosted = {
+                cid: self.meta.registry.chunks[cid].boost
+                for cid in self.meta.registry.boosted
+                if cid in self.meta.registry.chunks
+            }
+            heat_doc = {
+                "chunks": self.heat.top("chunk", 4),
+                "boosted": {str(c): b for c, b in boosted.items()},
+                "qos_pressure": sorted(self._heat_qos_pressure),
+            }
         return {
             "status": status,
             "master": master_snap,
@@ -4082,6 +4270,7 @@ class MasterServer(Daemon):
             "shadows": shadows,
             "gateways": gateways,
             "qos": qos_doc,
+            "heat": heat_doc,
             "tape": {
                 "servers": len(self.ts_links),
                 "pending": len(self.tape_pending),
@@ -4307,6 +4496,24 @@ class MasterServer(Daemon):
                 # a partial reload is a failure, details in the JSON
                 status=st.OK if not result.get("failed") else st.EINVAL,
                 json=json.dumps(result),
+            )
+        if msg.command == "heat":
+            # the cluster heat map: hottest chunks/inodes/servers with
+            # decayed scores, thresholds, standing goal boosts, and any
+            # heat-armed QoS pressure (lizardfs-admin heat / webui)
+            registry = self.meta.registry
+            doc = self.heat.snapshot({
+                cid: registry.chunks[cid].boost
+                for cid in registry.boosted if cid in registry.chunks
+            })
+            doc["enabled"] = constants_mod.heat_enabled()
+            doc["server_load"] = {
+                str(cs): round(v, 3)
+                for cs, v in sorted(registry.server_load.items())
+            }
+            doc["qos_pressure"] = sorted(self._heat_qos_pressure)
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK, json=json.dumps(doc)
             )
         if msg.command == "rebuild-status":
             # RebuildEngine progress: queue depths by priority class,

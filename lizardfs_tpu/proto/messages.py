@@ -938,7 +938,14 @@ class CstomaHeartbeat(Message):
     health snapshot (runtime/slo.py health_from — SLO burn, stall
     hits, span drops, disk errors) folded into the heartbeat so the
     master's cluster `health` rollup needs no extra link; an old peer
-    sends/receives "" and reads as health-unknown."""
+    sends/receives "" and reads as health-unknown.
+
+    ``heat_json`` (trailing, skew-tolerant): the chunkserver's top-K
+    per-chunk heat fold — ``{"chunks": [[chunk_id, ops, bytes], ...]}``
+    accumulated since the last heartbeat — feeding the master's heat
+    tracker (master/heat.py). "" when LZ_HEAT is off (heartbeats stay
+    byte-identical to the pre-heat wire) or from an old peer, which
+    reads as no data-plane heat observed."""
 
     MSG_TYPE = 1102
     SKEW_TOLERANT_FROM = 4
@@ -948,6 +955,7 @@ class CstomaHeartbeat(Message):
         ("total_space", "u64"),
         ("used_space", "u64"),
         ("health_json", "str"),
+        ("heat_json", "str"),
     )
 
 

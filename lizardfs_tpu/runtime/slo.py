@@ -309,6 +309,13 @@ class SloEngine:
         # arms its incident boost window so slowops captures come with
         # stacks, and incident files embed the collapsed profile
         self.profiler = None
+        # optional second auto-arm action (the heat loop's SLO→QoS
+        # chain): ``qos_arm(op_class, trace_id)`` is called on every
+        # breach — the master wires a rate-limited hook that arms QoS
+        # pressure on the top-offender tenant (master/server.py
+        # _slo_qos_arm). None (the default, and the LZ_HEAT-off state)
+        # keeps breach handling exactly as before.
+        self.qos_arm = None
         self.objectives: dict[str, Objective] = {}
         for op_class, (thresh_ms, target) in {
             **DEFAULT_OBJECTIVES, **(objectives or {})
@@ -387,6 +394,11 @@ class SloEngine:
                 # sample rate for the capture window so the incident's
                 # collapsed stacks have useful resolution
                 self.profiler.arm_incident()
+            if self.qos_arm is not None:
+                try:
+                    self.qos_arm(op_class, trace_id)
+                except Exception:  # noqa: BLE001 — auto-arm is best effort
+                    pass
             spans: list[dict] = []
             if self.span_source is not None and trace_id:
                 try:

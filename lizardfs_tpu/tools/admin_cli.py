@@ -95,7 +95,7 @@ async def _amain(argv) -> int:
             "save-metadata", "metadata-checksum", "promote-shadow",
             "metrics", "metrics-csv", "metrics-prom", "tweaks", "tweaks-set",
             "trace-dump", "health", "slowops", "rebuild-status", "faults",
-            "top", "profile", "qos",
+            "top", "profile", "qos", "heat",
         ],
     )
     p.add_argument("extra", nargs="*",
@@ -253,6 +253,8 @@ async def _amain(argv) -> int:
         _print_health(doc)
     elif cmd == "rebuild-status":
         _print_rebuild(doc)
+    elif cmd == "heat":
+        _print_heat(doc)
     elif cmd == "slowops":
         for e in doc.get("slowops", []):
             cap = "captured" if e.get("captured") else "uncaptured"
@@ -525,6 +527,52 @@ def _print_health(doc: dict) -> None:
             f"stalls {snap.get('loop_stalls', 0)}  "
             f"disk-errors {snap.get('disk_errors', 0)}"
         )
+
+
+def _print_heat(doc: dict) -> None:
+    """Render the cluster heat map: hottest chunks / inodes / servers
+    (decayed scores), standing goal boosts, placement loads, and any
+    heat-armed QoS pressure."""
+    if not doc.get("enabled", True):
+        print("cluster heat loop is DISABLED (LZ_HEAT=0)")
+    th = doc.get("thresholds", {})
+    print(
+        f"heat map — half-life {doc.get('half_life_s', 0):.0f}s, "
+        f"boost at {th.get('heat_boost_bytes', 0) / 2**20:.0f} MiB, "
+        f"demote under {th.get('heat_demote_bytes', 0) / 2**20:.0f} MiB, "
+        f"+{th.get('heat_boost_copies', 0)} copies, "
+        f"max {th.get('heat_max_boosted', 0)} boosted"
+    )
+    boosted = doc.get("boosted") or {}
+    if boosted:
+        print("  boosted: " + ", ".join(
+            f"chunk {cid} (+{b})" for cid, b in sorted(
+                boosted.items(), key=lambda kv: int(kv[0])
+            )
+        ))
+    if doc.get("qos_pressure"):
+        print("  qos pressure armed on: " + ", ".join(doc["qos_pressure"]))
+    for kind in ("chunks", "inodes", "servers"):
+        rows = doc.get(kind) or []
+        if not rows:
+            continue
+        print(f"  hottest {kind}:")
+        for r in rows[:8]:
+            trace = f"  trace {r['trace_id']}" if r.get("trace_id") else ""
+            print(
+                f"    {kind[:-1]:>6s} {r['key']:<12d} "
+                f"{r['heat_bytes'] / 2**20:>8.1f} MiB-heat "
+                f"{r['heat_ops']:>8.1f} ops-heat  "
+                f"(lifetime {r['total_bytes'] / 2**20:.1f} MiB / "
+                f"{r['total_ops']} ops){trace}"
+            )
+    load = doc.get("server_load") or {}
+    if load:
+        print("  placement load: " + ", ".join(
+            f"cs{cs}={v:.2f}" for cs, v in sorted(
+                load.items(), key=lambda kv: int(kv[0])
+            )
+        ))
 
 
 def main(argv=None) -> int:

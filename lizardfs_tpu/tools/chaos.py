@@ -38,6 +38,10 @@ Schedules:
                  sheds ONLY the abuser (BUSY, retried — never errored),
                  the victim's p99 and goodput hold within bounds, and
                  health/metrics NAME the throttled tenant
+  hot-spot       one file goes viral: the heat loop goal-boosts the hot
+                 chunk (real extra replicas via the RebuildEngine),
+                 read p99 holds through the storm with byte identity,
+                 and demotion lands once the heat decays
 """
 
 from __future__ import annotations
@@ -590,6 +594,127 @@ async def run_noisy_neighbor(cluster: ChaosCluster, rng: random.Random,
         await abuser.close()
 
 
+# hot-spot drill bounds: the viral file's read p99 must hold through
+# the storm (generous — a shared CI box still has to clear it), and the
+# boost must land within the storm window
+HOTSPOT_READ_P99_MS = 2000.0
+HOTSPOT_READERS = 3
+HOTSPOT_STORM_S = 30.0
+HOTSPOT_DEMOTE_S = 60.0
+
+
+async def run_hot_spot(cluster: ChaosCluster, rng: random.Random,
+                       log) -> None:
+    """One file goes viral: a read storm hammers a single goal-1 chunk.
+    The heat loop must goal-boost it (extra replicas appear through the
+    RebuildEngine), fleet read p99 must hold through the storm with
+    every read byte-identical (zero acknowledged-op loss), and once the
+    storm ends and heat decays, the demotion must land and shed the
+    extra copies."""
+    c = await _client(cluster, info="hotspot-writer")
+    try:
+        f = await c.create(1, "viral.bin")
+        payload = _payload(
+            rng.randrange(1 << 20), 2 * 2**20 + rng.randrange(4096)
+        )
+        await c.write_file(f.inode, payload)
+        # drill-sized thresholds via the operator path (admin
+        # tweaks-set): boost after ~4 MiB of decayed heat, demote
+        # under 1 MiB
+        for name, value in (("heat_boost_bytes", 4 * 2**20),
+                            ("heat_demote_bytes", 1 * 2**20)):
+            reply = await admin(
+                cluster.master_port, "tweaks-set",
+                json.dumps({"name": name, "value": value}),
+            )
+            assert getattr(reply, "status", 1) == 0, f"tweaks-set {name}"
+        lat: list[float] = []
+        boosted: dict = {}
+        stop = asyncio.Event()
+
+        async def reader(idx: int) -> None:
+            rdr = await _client(cluster, info=f"hotspot-r{idx}")
+            try:
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    rdr.cache.invalidate(f.inode)
+                    got = await rdr.read_file(f.inode)
+                    lat.append(time.monotonic() - t0)
+                    # zero acknowledged-op loss: every read returns the
+                    # acknowledged bytes, boost/demote never tears one
+                    assert got == payload, "viral read byte identity"
+            finally:
+                await rdr.close()
+
+        async def watch_boost() -> None:
+            deadline = time.monotonic() + HOTSPOT_STORM_S
+            while time.monotonic() < deadline:
+                doc = json.loads(
+                    (await admin(cluster.master_port, "heat")).json
+                )
+                if doc.get("boosted"):
+                    boosted.update(doc["boosted"])
+                    return
+                await asyncio.sleep(0.3)
+
+        readers = [
+            asyncio.ensure_future(reader(i))
+            for i in range(HOTSPOT_READERS)
+        ]
+        try:
+            await watch_boost()
+        finally:
+            stop.set()
+            await asyncio.gather(*readers)
+        assert boosted, "viral chunk never goal-boosted under the storm"
+        lat.sort()
+        p99_ms = lat[int(len(lat) * 0.99)] * 1e3
+        log(f"  boosted {boosted}; {len(lat)} storm reads, "
+            f"p99 {p99_ms:.1f} ms")
+        assert p99_ms <= HOTSPOT_READ_P99_MS, f"storm read p99 {p99_ms:.1f}ms"
+        # the boost is real replication, not bookkeeping: extra copies
+        # of the viral chunk appear through the RebuildEngine
+        loc = await c.chunk_info(f.inode, 0)
+        deadline = time.monotonic() + HOTSPOT_DEMOTE_S
+        copies = 1
+        while time.monotonic() < deadline:
+            loc = await c.chunk_info(f.inode, 0)
+            copies = len({(l.addr.host, l.addr.port) for l in loc.locations})
+            if copies >= 2:
+                break
+            await asyncio.sleep(0.3)
+        assert copies >= 2, f"boost never materialized ({copies} copies)"
+        log(f"  {copies} live copies of the viral chunk")
+        # the health rollup NAMES the hot spot while boosted
+        health = json.loads(
+            (await admin(cluster.master_port, "health")).json
+        )
+        assert health.get("heat", {}).get("boosted"), health.get("heat")
+        # storm over: collapse the decay half-life (operator knob) and
+        # the demotion must follow the heat down
+        reply = await admin(
+            cluster.master_port, "tweaks-set",
+            json.dumps({"name": "heat_half_life_s", "value": 1.0}),
+        )
+        assert getattr(reply, "status", 1) == 0
+        deadline = time.monotonic() + HOTSPOT_DEMOTE_S
+        while time.monotonic() < deadline:
+            doc = json.loads(
+                (await admin(cluster.master_port, "heat")).json
+            )
+            if not doc.get("boosted"):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            raise AssertionError("goal demote never landed after the storm")
+        log("  demotion landed after the storm")
+        # the file is still byte-identical after boost + demote
+        c.cache.invalidate(f.inode)
+        assert await c.read_file(f.inode) == payload, "post-storm identity"
+    finally:
+        await c.close()
+
+
 SCHEDULES = {
     "kill-write": (run_kill_write, dict(n_cs=4)),
     "bitflip-read": (run_bitflip_read, dict(n_cs=3)),
@@ -598,6 +723,7 @@ SCHEDULES = {
     "s3-multipart": (run_s3_multipart, dict(n_cs=4)),
     "noisy-neighbor": (run_noisy_neighbor,
                        dict(n_cs=2, qos_cfg=NOISY_QOS_CFG)),
+    "hot-spot": (run_hot_spot, dict(n_cs=3)),
 }
 
 

@@ -58,6 +58,7 @@ SWITCHES = {
     "LZ_TOP",              # per-session op accounting / `top` view (on)
     "LZ_PROF",             # always-on sampling profiler (on)
     "LZ_QOS",              # multi-tenant fair-share QoS plane (on)
+    "LZ_HEAT",             # cluster heat map + adaptive replication (on)
 }
 
 # Value vars: one read site each; documented; spelling rules N/A.

@@ -156,6 +156,8 @@ DEFAULT_SITE = "frame_recv"
 # generic per-surface instruments: (file, regex, what broke if absent)
 DAEMON = "lizardfs_tpu/runtime/daemon.py"
 CLIENT = "lizardfs_tpu/client/client.py"
+HEAT = "lizardfs_tpu/master/heat.py"
+SLO = "lizardfs_tpu/runtime/slo.py"
 ANCHORS = (
     (MASTER, r"metrics\.timing\(type\(msg\)\.__name__\)",
      "master per-op latency histograms (request_log analog)"),
@@ -202,6 +204,26 @@ ANCHORS = (
     (S3, r"st\.BUSY", "S3 gateway BUSY -> 503 SlowDown mapping"),
     (NFS, r"NFS3ERR_JUKEBOX",
      "NFS gateway BUSY -> JUKEBOX delay mapping"),
+    # cluster heat loop (ISSUE 17): the lizardfs_heat_* families, the
+    # heat section of `health`, and the SLO→QoS auto-arm chain are
+    # standing surfaces — deleting any of them silently blinds the
+    # heat map or disarms the second auto-arm action
+    (HEAT, r"labeled_counter\(\s*\n?\s*[\"']heat_ops[\"']",
+     "heat sketch per-key op counter (heat_ops{kind,key})"),
+    (HEAT, r"labeled_counter\(\s*\n?\s*[\"']heat_bytes[\"']",
+     "heat sketch per-key byte counter (heat_bytes{kind,key})"),
+    (HEAT, r"labeled_timing\(\s*\n?\s*[\"']heat_hot_ops[\"']",
+     "hot-key latency histogram with trace-id exemplars (heat_hot_ops)"),
+    (MASTER, r"[\"']heat[\"']:\s*heat_doc",
+     "heat section of the cluster `health` rollup"),
+    (MASTER, r"def _slo_qos_arm\(",
+     "SLO burn-rate breach -> QoS pressure auto-arm action"),
+    (MASTER, r"labeled_counter\(\s*\n?\s*[\"']slo_qos_armed[\"']",
+     "auto-armed QoS pressure counter (slo_qos_armed{tenant,op})"),
+    (SLO, r"qos_arm\(",
+     "SLO engine second auto-arm hook (breach -> qos_arm call)"),
+    (CS, r"_heat_fold_json\(",
+     "chunkserver per-chunk heat heartbeat fold (heat map input)"),
 )
 
 # files searched for OP_CLASSES coverage (who feeds each objective)

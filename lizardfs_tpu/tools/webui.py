@@ -161,6 +161,15 @@ class Dashboard:
             ).json
         )
 
+    def heat(self) -> dict:
+        """The cluster heat map: hottest chunks/inodes/servers, goal
+        boosts, placement loads (`lizardfs-admin heat`)."""
+        return json.loads(
+            self._call(
+                m.AdminCommand(req_id=1, command="heat", json="{}")
+            ).json
+        )
+
     def metrics(self, resolution: str = "sec") -> dict:
         return json.loads(
             self._call(
@@ -379,6 +388,9 @@ def make_handler(dash: Dashboard):
                     # cluster-wide per-session workload rollup (the
                     # `lizardfs-admin top` document)
                     self._send(json.dumps(dash.top()), "application/json")
+                elif self.path == "/api/heat":
+                    # cluster heat map (the `lizardfs-admin heat` doc)
+                    self._send(json.dumps(dash.heat()), "application/json")
                 elif self.path == "/api/rebuild":
                     # RebuildEngine progress/ETA (rebuild-status verb)
                     self._send(

@@ -120,6 +120,12 @@ def _fat_row() -> dict:
         "off": 187.5, "on": 6.2, "bound_ms": 250.0,
         "abuser_sheds": 312, "target_met": True,
     }
+    # hot-spot A/B fiducial (this round: the heat loop's adaptive
+    # replication) — one viral 1-copy chunk, LZ_HEAT off vs on
+    row["cluster_hotspot_read_MBps"] = {
+        "off": 812.4, "on": 934.7, "copies": 3, "boost_s": 1.85,
+        "target_met": True,
+    }
     row["cluster_locate_storm_detail"] = {
         "files": 100000, "servers": 1000, "populate_s": 4.2,
         "cs_ingest": {"real_cs": 128, "parts_each": 2000, "ingest_s": 1.9},
@@ -202,6 +208,12 @@ def test_summary_line_fits_driver_tail():
         parsed.get("cluster_qos_victim_p99_ms", {}).get("target_met")
         is True
         or "cluster_qos_victim_p99_ms" in parsed.get("dropped", [])
+    )
+    # the hot-spot A/B verdict rides the tail (or its drop is recorded)
+    assert (
+        parsed.get("cluster_hotspot_read_MBps", {}).get("target_met")
+        is True
+        or "cluster_hotspot_read_MBps" in parsed.get("dropped", [])
     )
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail

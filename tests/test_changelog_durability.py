@@ -115,6 +115,12 @@ def _scenario() -> list[dict]:
         {"op": "create_chunk", "slice_type": 0, "chunk_id": 15,
          "version": 1, "copies": 1},
         {"op": "delete_chunk", "chunk_id": 15},
+        # heat loop: boost the COW'd chunk, demote it, then leave a
+        # boost standing on chunk 12 so the image round trip below
+        # proves ChunkInfo.boost persists across a restore
+        {"op": "goal_boost", "chunk_id": 14, "boost": 2},
+        {"op": "goal_demote", "chunk_id": 14},
+        {"op": "goal_boost", "chunk_id": 12, "boost": 1},
         {"op": "snapshot", "src_inode": 6, "dst_parent": 2,
          "dst_name": "snap", "inode_map": {"6": 7}, "ts": TS + 23},
         # tape tier: archive, demote, recall, re-archive, drop

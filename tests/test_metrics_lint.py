@@ -247,6 +247,9 @@ async def test_lint_live_daemon_registries(tmp_path):
             for cs in cluster.chunkservers
         )
         await cluster.master._health_tick()
+        # second tick: the heat sketch's first tick only stamps its
+        # decay clock; the gauge exports from the second onward
+        await cluster.master._health_tick()
         for daemon in [cluster.master, *cluster.chunkservers]:
             lint_prometheus(daemon.metrics.to_prometheus())
         # the client-side registry (write-window depth/credit/coalesce
@@ -271,6 +274,14 @@ async def test_lint_live_daemon_registries(tmp_path):
         typed = lint_prometheus(text)
         assert "lizardfs_cluster_health_status" in typed
         assert "lizardfs_span_ring_dropped_total" in typed
+        # the heat observatory families ride the same page: master-leg
+        # charges feed the labeled counters + the trace-exemplar
+        # histogram, the health tick exports the sketch-size gauge
+        assert typed["lizardfs_heat_ops_total"] == "counter"
+        assert typed["lizardfs_heat_bytes_total"] == "counter"
+        assert typed["lizardfs_heat_hot_ops_us"] == "histogram"
+        assert "lizardfs_heat_tracked_cells" in typed
+        assert 'kind="inode"' in text and 'kind="chunk"' in text
         # per-session accounting on the live page: the traffic above
         # attributed to the client's session, exposed as the labeled
         # histogram family (the `top` view's data source)

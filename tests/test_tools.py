@@ -226,6 +226,9 @@ async def test_webui_endpoints(tmp_path):
             "lost": 0, "endangered": 0, "rebalance": 0,
         }
         assert "eta_s" in rebuild and "throttle" in rebuild
+        heat = json.loads(await asyncio.to_thread(fetch, "/api/heat"))
+        assert heat["enabled"] is True
+        assert "thresholds" in heat and "boosted" in heat
         httpd.shutdown()
     finally:
         await cluster.stop()
