@@ -37,9 +37,10 @@ racehunt:
 	  --seeds $(RACEHUNT_SEEDS) $(RACEHUNT_TARGETS)
 
 # check: the one-command gate — invariant lint, metrics exposition
-# lint, tier-1, then a racehunt smoke (seeds printed for replay)
-check: lint metrics-lint test racehunt
-	@echo "check: lint + metrics-lint + tier-1 + racehunt all green"
+# lint, tier-1, the read-path microscope smoke, then a racehunt smoke
+# (seeds printed for replay)
+check: lint metrics-lint test read-smoke racehunt
+	@echo "check: lint + metrics-lint + tier-1 + read-smoke + racehunt all green"
 
 # sanitizer matrix over the FULL native surface (native/Makefile
 # `sanitize`: ASan+UBSan and TSan over ec/io/serve + the shm plane),
@@ -105,8 +106,18 @@ qos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_qos.py -q -k smoke \
 	  -p no:cacheprovider
 
+# read-smoke: in-process cluster, one TRACED degraded ec(8,4) read on
+# the instrumented wave path — asserts the phase breakdown lands in
+# `top`, the merged timeline's attribution buckets sum to the wall,
+# slowops rows embed the attribution, and the dial queue-wait gate
+# charged (the `smoke`-named subset of tests/test_read_phases.py; the
+# non-slow file rides tier-1 too)
+read-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_read_phases.py -q \
+	  -k smoke -p no:cacheprovider
+
 native:
 	$(MAKE) -C native
 
 .PHONY: test lint metrics-lint racehunt check sanitize chaos chaos-slow \
-	s3-smoke top-smoke qos-smoke native
+	s3-smoke top-smoke qos-smoke read-smoke native

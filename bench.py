@@ -398,10 +398,27 @@ def cluster_throughput() -> dict:
                     # over the row's write reps — the instrument the
                     # 4-round ec(8,4) miss has been waiting for
                     out[f"cluster_{key}_write_phases"] = r["write_phases_ms"]
+                if "read_phases_ms" in r:
+                    # the read-side twin (locate/dial/wait/net/decode/
+                    # gather busy-time; `dominant` names the roofline)
+                    out[f"cluster_{key}_read_phases"] = r["read_phases_ms"]
                 if "write_window" in r:
                     # adaptive write-window fiducials (depth settled,
                     # segments sent, credit stalls, coalesced commits)
                     out[f"cluster_{key}_write_window"] = r["write_window"]
+            elif "read_MBps" in r:
+                # read-only rows (the ec(8,4) degraded-read fiducial):
+                # parity-recovery throughput + its phase breakdown
+                out[f"cluster_{key}_read_MBps"] = r["read_MBps"]
+                out[f"cluster_{key}_spread_pct"] = r.get(
+                    "read_spread_pct", 0
+                )
+                if "read_reps_MBps" in r:
+                    out[f"cluster_{key}_read_reps_MBps"] = (
+                        r["read_reps_MBps"]
+                    )
+                if "read_phases_ms" in r:
+                    out[f"cluster_{key}_read_phases"] = r["read_phases_ms"]
             elif "coverage_pct" in r:
                 # cross-role trace attribution of one ec(8,4) write rep
                 # (benches/bench_cluster.py traced rep): wall, how much
@@ -780,6 +797,14 @@ def _bench_guard(row: dict, bench_dir: str) -> None:
                     f"DELTA vs r{prev_n:02d}: {key} "
                     f"{deltas[key]:+.1f}%{flag}"
                 )
+        else:
+            # empty/unloadable trajectory: this run is the fresh
+            # baseline — say so explicitly (and mark the row) instead
+            # of silently printing no DELTA lines at all, which reads
+            # as "guard never ran" in the driver tail
+            row["bench_prev_round"] = 0
+            print("DELTA: no loadable prior round -- recording fresh "
+                  "baseline")
         files = _round_files(bench_dir)
         n_next = (files[-1][0] + 1) if files else 1
         path = os.path.join(bench_dir, f"BENCH_r{n_next:02d}.json")
@@ -968,6 +993,15 @@ def _summary_row(row: dict) -> dict:
                     else v)
                 for k, v in value.items()
             }
+        elif key.endswith("_read_phases") and "_ec8_4" in key:
+            # the read-side twin (ISSUE 18): cluster_ec8_4_read_phases
+            # + its degraded-read variant, integer ms with the named
+            # dominant phase (the roofline verdict) — xor3/ec3_2 read
+            # phases stay in BENCH_FULL.json
+            s[key] = {
+                k: (int(round(v)) if isinstance(v, float) else v)
+                for k, v in value.items()
+            }
         elif key == "cluster_ec8_4_write_shm" and isinstance(value, dict):
             # the shm on/off A/B delta: THE instrument of this round's
             # send-phase attack
@@ -993,11 +1027,13 @@ def _summary_row(row: dict) -> dict:
 # the driver records only a ~2000-byte stdout tail; leave margin for
 # the trailing newline + any stderr interleaving. Structural guard:
 # tests/test_bench_summary.py pins that a worst-case row set fits.
-# (1900 -> 1925 when the hot-spot A/B fiducial joined: a worst-case
-# round now carries one more drop record, and the ladder must still
-# stop before the ec(8,4) phases rung; 1925 keeps ~75 bytes of slack
-# under the hard window.)
-SUMMARY_BUDGET_BYTES = 1925
+# (1900 -> 1925 when the hot-spot A/B fiducial joined; 1925 -> 1950
+# when the read-phase fiducials joined: a worst-case round carries two
+# more phase dicts + their drop records, and the ladder must still
+# stop before the ec(8,4) write-phases rung — drop records now strip
+# the cluster_ prefix to pay for most of it; 1950 keeps ~50 bytes of
+# slack under the hard window.)
+SUMMARY_BUDGET_BYTES = 1950
 
 # dropped (in order) when a fat round outgrows the budget — ordered
 # least-verdict-bearing first; each drop is recorded so the tail shows
@@ -1015,8 +1051,13 @@ _SUMMARY_DROP_ORDER = (
     # the s3 row drops as ONE unit (prefix entry, one drop record)
     # before the ec(8,4) instruments the standing write target depends on
     "cluster_s3_*",
+    # the degraded-read phase dict drops before the healthy-read one:
+    # parity-recovery cost is diagnosis, the healthy roofline is the
+    # standing fiducial (ISSUE 18)
+    "cluster_ec8_4_degraded_read_read_phases",
     "cluster_ec8_4_write_trace", "tpu_error", "cluster_error",
     "cluster_ec8_4_write_shm", "cluster_locate_qps",
+    "cluster_ec8_4_read_phases",
     "cluster_ec8_4_write_phases",
 )
 
@@ -1042,7 +1083,12 @@ def _fit_summary(s: dict) -> dict:
             del s[key]
         else:
             continue
-        dropped.append(key)
+        # records strip the redundant cluster_ prefix: on a worst-case
+        # round a dozen-plus drop records ride the tail, and the prefix
+        # alone would cost ~100 bytes of the budget they exist to save
+        dropped.append(
+            key[len("cluster_"):] if key.startswith("cluster_") else key
+        )
         s["dropped"] = dropped  # idempotent re-assign, stays last
     return s
 

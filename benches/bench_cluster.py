@@ -265,6 +265,7 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             # a median with recorded spread separates signal from noise
             wts, rts = [], []
             phases_before = client.write_phases.snapshot()
+            read_before = client.read_phases.snapshot()
             window_before = {
                 name: client.metrics.series[name].total
                 for name in ("write_window_segments",
@@ -337,6 +338,20 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                                   "commit")}
                 phases["dominant"] = max(busy, key=busy.get)
                 row["write_phases_ms"] = phases
+                # the read-side twin over the same reps (client_read
+                # phases: locate/dial/wait/net/decode/gather) — the
+                # instrument ROADMAP 1/2 (zero-copy reads, small-op
+                # war) will be driven by; `dominant` names the read
+                # roofline the same way `send_over_encode` named the
+                # write one
+                rphases = phase_delta(
+                    client.read_phases.snapshot(), read_before
+                )
+                rbusy = {p: rphases.get(f"{p}_ms", 0.0)
+                         for p in ("locate", "dial", "wait", "net",
+                                   "decode", "gather")}
+                rphases["dominant"] = max(rbusy, key=rbusy.get)
+                row["read_phases_ms"] = rphases
                 if client.write_window is not None:
                     # write-window fiducials: the depth the controller
                     # settled on plus this row's segment/credit-wait/
@@ -839,6 +854,76 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
                 })
             finally:
                 await asyncio.to_thread(pool.close)
+
+        # degraded-read fiducial: one holder of an ec(8,4) chunk down,
+        # every read recovers through parity — the decode leg joins the
+        # critical path, and the read-phase breakdown names whether
+        # recovery is decode- or net-bound (the arbitration the
+        # efficient-decoding codec papers in PAPERS.md need). The
+        # victim restarts on its data dir afterwards so the rebuild
+        # row below still starts from a full cluster.
+        try:
+            deg_mb = min(size_mb, 32)
+            dpayload = payload_arr[: deg_mb * 2**20]
+            dback = np.empty(deg_mb * 2**20, dtype=np.uint8)
+            f = await client.create(1, "degraded_ec84.bin")
+            await client.setgoal(f.inode, 12)  # ec(8,4)
+            await client.write_file(f.inode, payload[: deg_mb * 2**20])
+            loc = await client.chunk_info(f.inode, 0)
+            victim = next(
+                cs for cs in servers
+                if any(l.addr.port in (cs.port, getattr(
+                    cs.data_server, "port", -1)) for l in loc.locations)
+            )
+            vidx = servers.index(victim)
+            await victim.stop()
+            dts = []
+            deg_before = client.read_phases.snapshot()
+            for rep in range(REPS):
+                client.cache.invalidate(f.inode)
+                await asyncio.to_thread(dback.fill, 0)
+                t0 = time.perf_counter()
+                n = await client.read_file_into(f.inode, 0, dback)
+                dts.append(time.perf_counter() - t0)
+                assert n == dback.size
+                equal = await asyncio.to_thread(
+                    np.array_equal, dback, dpayload
+                )
+                assert equal, "corruption in degraded ec(8,4) read"
+            rphases = phase_delta(
+                client.read_phases.snapshot(), deg_before
+            )
+            rbusy = {p: rphases.get(f"{p}_ms", 0.0)
+                     for p in ("locate", "dial", "wait", "net",
+                               "decode", "gather")}
+            rphases["dominant"] = max(rbusy, key=rbusy.get)
+            d_reps = [round(deg_mb / t, 1) for t in dts]
+            d_med, d_spread = _median_spread(d_reps)
+            rows.append({
+                "goal": "ec(8,4) degraded read",
+                "read_MBps": d_med,
+                "read_spread_pct": d_spread,
+                "read_reps_MBps": d_reps,
+                "read_phases_ms": rphases,
+            })
+            await drop_bench_files(["degraded_ec84.bin"])
+            revived = ChunkServer(
+                str(tmp / f"cs{vidx}"),
+                master_addr=("127.0.0.1", master.port),
+            )
+            await revived.start()
+            servers[vidx] = revived
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if len(master.meta.registry.connected_servers()) >= n_cs:
+                    break
+                await asyncio.sleep(0.1)
+        except AssertionError:
+            raise  # corruption fails the bench like the goal rows
+        except Exception:  # noqa: BLE001 — infra failure must not kill it
+            import logging
+
+            logging.getLogger("bench").exception("degraded-read row failed")
 
         # RebuildEngine throughput: kill one chunkserver under an
         # ec(8,4) data set and time the engine restoring full

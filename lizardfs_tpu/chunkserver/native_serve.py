@@ -57,6 +57,13 @@ if _lib is not None:
         except AttributeError:
             pass  # stale .so: session attribution rides trace as 0
         try:
+            _lib.lz_serve_trace3.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
+            ]
+            _lib.lz_serve_trace3.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: queue-wait slot drains as 0
+        try:
             _lib.lz_serve_shm_stats.argtypes = [
                 ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
             ]
@@ -77,10 +84,10 @@ if _lib is not None:
         _lib = None
 
 
-# lz_serve_trace2 flattens one op to 9 u64 slots (the legacy
-# lz_serve_trace serves 8, eliding session_id) — keep in sync with
-# serve_native.cpp TraceOp
-TRACE_OP_SLOTS = 9
+# lz_serve_trace3 flattens one op to 10 u64 slots (lz_serve_trace2
+# serves 9, eliding queue_us; the legacy lz_serve_trace serves 8, also
+# eliding session_id) — keep in sync with serve_native.cpp TraceOp
+TRACE_OP_SLOTS = 10
 _TRACE_KINDS = {1: "cs_read", 2: "cs_read_bulk", 4: "cs_write_bulk",
                 5: "cs_write_shm"}
 
@@ -147,14 +154,18 @@ class DataPlaneServer:
     def trace_ops(self, max_ops: int = 1024) -> list[dict]:
         """Drain the native per-op trace ring: one dict per traced op
         with CLOCK_REALTIME second bounds (t0/t1), accumulated disk/net
-        microseconds, and (new .so) the originating session id, ready
-        to fold into a SpanRing + per-session accounting."""
+        microseconds, the originating session id, and (trace3 .so) the
+        op's QoS queue-wait microseconds, ready to fold into a
+        SpanRing + per-session accounting."""
         if self._handle < 0:
             return []
-        # version-skew tolerant drain: prefer the 9-slot channel (adds
-        # session_id), fall back to the legacy 8-slot one on a stale .so
-        if hasattr(_lib, "lz_serve_trace2"):
-            slots, fn = TRACE_OP_SLOTS, _lib.lz_serve_trace2
+        # version-skew tolerant drain: prefer the 10-slot channel (adds
+        # queue_us), then the 9-slot one (session_id), then the legacy
+        # 8-slot one on a stale .so
+        if hasattr(_lib, "lz_serve_trace3"):
+            slots, fn = TRACE_OP_SLOTS, _lib.lz_serve_trace3
+        elif hasattr(_lib, "lz_serve_trace2"):
+            slots, fn = 9, _lib.lz_serve_trace2
         elif hasattr(_lib, "lz_serve_trace"):
             slots, fn = 8, _lib.lz_serve_trace
         else:
@@ -174,6 +185,7 @@ class DataPlaneServer:
                 "disk_us": int(s[6]),
                 "net_us": int(s[7]),
                 "session_id": int(s[8]) if slots > 8 else 0,
+                "queue_us": int(s[9]) if slots > 9 else 0,
             })
         return ops
 

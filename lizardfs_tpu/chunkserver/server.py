@@ -540,10 +540,14 @@ class ChunkServer(Daemon):
             self.log.debug("native trace drain failed", exc_info=True)
             return
         for op in ops:
+            # queue_us (lz_serve_trace3): QoS pacing wait inside the op
+            # — the attribution engine splits the span's head into a
+            # "queue" sub-interval so native backpressure is visible
             self.trace_ring.record(
                 op["trace_id"], op["name"], op["t0"], op["t1"],
                 role="chunkserver", bytes=op["bytes"],
                 disk_us=op["disk_us"], net_us=op["net_us"],
+                queue_us=op.get("queue_us", 0),
                 chunk_id=op["chunk_id"],
             )
             # SLO accounting for the native plane rides the fold (the
@@ -710,6 +714,7 @@ class ChunkServer(Daemon):
             session_id if session_id == qosmod.REBUILD_TENANT
             else self._qos_tenant(session_id)
         )
+        w0 = tracing.phase_t0()
         waited = await self.qos_queue.admit(tenant, nbytes)
         if waited:
             self.metrics.labeled_counter(
@@ -717,6 +722,12 @@ class ChunkServer(Daemon):
                 help="data-plane ops that had to queue behind the "
                      "per-tenant in-flight byte budget (weighted DRR)",
             ).inc()
+            # the wait itself is a labeled queue_wait timing + an
+            # ambient-trace span, so DRR backpressure is attributable
+            tracing.charge_queue_wait(
+                self.metrics, self.trace_ring, "drr_disk", tenant, w0,
+                role="chunkserver",
+            )
         return tenant
 
     def _qos_done(self, tenant: "str | None", nbytes: int) -> None:

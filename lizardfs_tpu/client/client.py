@@ -214,6 +214,17 @@ class Client:
         self.write_phases = PhaseBreakdown(
             "client_write", ("encode", "stage", "send", "ack", "commit")
         )
+        # the read-side twin: busy-time per logical read decomposed as
+        # locate (master RPC), dial (pool-miss connects), wait (QoS
+        # throttle + retry backoff + shed waits), net (socket transfer,
+        # incl. the native gather call), decode (plan postprocess /
+        # EC recovery), gather (stripe de-interleave). Deep layers that
+        # can't see the client (conn pool, read executor) charge via
+        # tracing.PHASE_SINK, activated around each logical read.
+        self.read_phases = PhaseBreakdown(
+            "client_read",
+            ("locate", "dial", "wait", "net", "decode", "gather"),
+        )
         # request-scoped span ring (runtime/tracing.py): phase charges
         # double as client-role spans when the op runs under a trace;
         # merge with daemon `trace-dump` output via tracing.merge_timeline
@@ -514,6 +525,32 @@ class Client:
             role="client",
         )
 
+    def _read_phase(self, name: str, t0: tuple[float, float]) -> None:
+        """Charge a read phase (+ client-role span under a trace)."""
+        self.read_phases.add(name, _time.perf_counter() - t0[0])
+        self.trace_ring.record(
+            tracing.current_trace_id(), f"read:{name}", t0[1], _time.time(),
+            role="client",
+        )
+
+    def _read_sink(self, phase: str, t0, t1) -> None:
+        """tracing.PHASE_SINK target: layers below the client (connection
+        pool dials, read-executor socket waits and plan postprocess)
+        charge the ambient logical read's phases here. Pool-miss dials
+        double as the ``dial`` queue-wait gate."""
+        self.read_phases.add(phase, max(t1[0] - t0[0], 0.0))
+        tid = tracing.current_trace_id()
+        if tid:
+            self.trace_ring.record(
+                tid, f"read:{phase}", t0[1], t1[1], role="client"
+            )
+        if phase == "dial":
+            # ring=None: the read:dial span above already lands in the
+            # attribution queue bucket; a twin span would be noise
+            tracing.charge_queue_wait(
+                self.metrics, None, "dial", "default", t0, role="client"
+            )
+
     async def _busy_retry(self, fn, what: str):
         """Honor QoS fair-share sheds: a BUSY status is retried here
         with a jittered backoff seeded by the server's retry-after
@@ -548,7 +585,15 @@ class Client:
                 ).inc()
                 log.debug("%s shed (BUSY), retry %d in %.3fs",
                           what, attempt + 1, delay)
+                # shed-retry waits are a queue-wait gate: the op did no
+                # work, it queued behind fair-share admission
+                w0 = tracing.phase_t0()
                 await asyncio.sleep(delay)
+                tracing.charge_queue_wait(
+                    self.metrics, self.trace_ring, "busy_retry", "default",
+                    w0, role="client",
+                )
+                tracing.charge_phase("wait", w0)
                 attempt += 1
 
     async def _call(self, msg_cls, **fields):
@@ -835,6 +880,10 @@ class Client:
             self._limits_probe_task = None
         await self._drop_replica()
         if self.master is not None:
+            if self.read_phases.reps or self.write_phases.reps:
+                # parting stats push: the session's phase breakdowns
+                # stay visible in `top` past disconnect (best effort)
+                await self.push_session_stats()
             try:
                 # clean goodbye: the master releases our locks now
                 # instead of holding them for the crash-grace window
@@ -2256,6 +2305,7 @@ class Client:
                 # blocking with nothing outstanding is safe, since any
                 # credit holder then has acks of its own to reap.
                 waited = False
+                w0 = tracing.phase_t0()
                 while not win.try_acquire(session.unique_addrs, seg_bytes):
                     waited = True
                     if outstanding:
@@ -2264,6 +2314,13 @@ class Client:
                         await win.acquire(session.unique_addrs, seg_bytes)
                         break
                 win.note_segment(waited)
+                if waited:
+                    # credit-gate queue wait (reap-or-block included):
+                    # the segment did no work while the window was full
+                    tracing.charge_queue_wait(
+                        self.metrics, self.trace_ring, "write_credit",
+                        "default", w0, role="client",
+                    )
                 try:
                     t0 = self._t0()
                     await native_io.run(
@@ -2491,19 +2548,58 @@ class Client:
 
     async def read_file(self, inode: int, offset: int = 0, size: int | None = None) -> bytes:
         t0 = _time.perf_counter()
+        tw0 = _time.time()
         tid, fresh_trace = tracing.begin()
+        # the read-phase sink is scoped to THIS logical read: every
+        # locate/dial/wait/net/decode/gather charge below — including
+        # ones from the conn pool and read executor — lands on this
+        # client's read_phases exactly once (retries/fallbacks re-enter
+        # phases, never the wall/rep accounting)
+        sink_tok = tracing.PHASE_SINK.set(self._read_sink)
         try:
             with accounting.task_session(self.session_id):
                 data = await self._read_file_inner(inode, offset, size)
         finally:
+            tracing.PHASE_SINK.reset(sink_tok)
             tracing.end(fresh_trace)
         # ONE logical read == ONE accounting record: replica fallbacks
         # and dead-holder retries below this line never double-count
+        dt = _time.perf_counter() - t0
+        self.read_phases.add_wall(dt)
+        # root span: the attribution wall anchor (`trace-dump --attribute`)
+        self.trace_ring.record(
+            tid, "read_file", tw0, _time.time(), role="client",
+            bytes=len(data),
+        )
         self.session_ops.record(
-            self.session_id, "read", _time.perf_counter() - t0,
-            nbytes=len(data), trace_id=tid,
+            self.session_id, "read", dt, nbytes=len(data), trace_id=tid,
         )
         return data
+
+    def session_stats_doc(self) -> dict:
+        """Workload summary for the master's `top` rollup: the client's
+        read/write phase breakdowns ride the same CltomaSessionStats
+        push the protocol gateways use, so `lizardfs-admin top` (and
+        the webui) name each session's read roofline."""
+        return {
+            "role": "client",
+            "read_phases": self.read_phases.snapshot(),
+            "write_phases": self.write_phases.snapshot(),
+        }
+
+    async def push_session_stats(self) -> None:
+        """Push :meth:`session_stats_doc` to the master (best effort —
+        telemetry must never fail the caller)."""
+        import json as _json
+
+        try:
+            await self._call(
+                m.CltomaSessionStats,
+                stats_json=_json.dumps(self.session_stats_doc()),
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                st.StatusError):
+            log.debug("session-stats push failed", exc_info=True)
 
     async def _read_file_inner(
         self, inode: int, offset: int, size: int | None
@@ -2540,6 +2636,8 @@ class Client:
         C-contiguous uint8."""
         tid, fresh_trace = tracing.begin()
         tw0 = _time.time()
+        tp0 = _time.perf_counter()
+        sink_tok = tracing.PHASE_SINK.set(self._read_sink)
         try:
             attr = await self.getattr(inode)
             length = attr.length
@@ -2552,12 +2650,14 @@ class Client:
             self.trace_ring.record(
                 tid, "read_file", tw0, _time.time(), role="client", bytes=n
             )
+            self.read_phases.add_wall(_time.perf_counter() - tp0)
             self.session_ops.record(
                 self.session_id, "read", _time.time() - tw0, nbytes=n,
                 trace_id=tid,
             )
             return n
         finally:
+            tracing.PHASE_SINK.reset(sink_tok)
             tracing.end(fresh_trace)
 
     async def _read_into(
@@ -2665,12 +2765,16 @@ class Client:
 
         throttled = file_length is not None
         if throttled:
+            t0 = self._t0()
             await self._throttle(read_size)  # QoS: charge once, not per retry
+            self._read_phase("wait", t0)
         last_error: Exception | None = None
         bad_addrs: set[tuple[str, int]] = set()  # replicas that failed us
         for attempt in range(self.retries):
             if attempt:
+                t0 = self._t0()
                 await asyncio.sleep(min(0.1 * 2 ** attempt, 2.0))  # backoff
+                self._read_phase("wait", t0)
             loc = None
             fresh = False
             if attempt == 0:
@@ -2682,6 +2786,7 @@ class Client:
                         self.op_counters.get("locate_cache_hit", 0) + 1
                     )
             if loc is None:
+                t0 = self._t0()
                 token = self._locate_token(inode)
                 # first attempt may serve the locate from a replica;
                 # RETRY locates go to the primary — a failed read may
@@ -2709,6 +2814,9 @@ class Client:
                         m.CltomaReadChunk, inode=inode,
                         chunk_index=chunk_index, **self._ident(None, None),
                     )
+                # locate phase: the master round trip(s), replica
+                # fallback included; cache hits charge nothing
+                self._read_phase("locate", t0)
                 if self._locate_token(inode) == token:
                     # refuse stores that raced an invalidation: the
                     # reply may predate the mutation that bumped epoch
@@ -2749,7 +2857,9 @@ class Client:
                 # provisional geometry would bill EOF reads for bytes
                 # never transferred
                 throttled = True
+                t0 = self._t0()
                 await self._throttle(read_size)
+                self._read_phase("wait", t0)
             if loc.chunk_id == 0:
                 if into is not None:
                     into[into_offset : into_offset + size] = 0
@@ -3025,8 +3135,14 @@ class Client:
                     cell,
                 ),
             )
+            # run_in_executor does not propagate the phase-sink context;
+            # the whole native gather (sockets + C de-interleave) is
+            # timed at the await and charged as net — the chunkserver's
+            # queue/disk/net attrs refine it in the attribution view
+            t0 = self._t0()
             try:
                 await asyncio.shield(fut)
+                self._read_phase("net", t0)
                 for p in wanted:
                     GLOBAL_STATS.record_success(by_part[p][0])
                 # counted so tests/operators can see the fast path is
@@ -3078,13 +3194,17 @@ class Client:
             and into.flags.c_contiguous and into.dtype == np.uint8
         ):
             # zero-copy: de-interleave straight into the caller's buffer
+            t0 = self._t0()
             await asyncio.to_thread(
                 striping.assemble_chunk, data_parts, slice_type, size,
                 into[into_offset : into_offset + size],
             )
+            self._read_phase("gather", t0)
             return None
+        t0 = self._t0()
         region = await asyncio.to_thread(
             striping.assemble_chunk, data_parts, slice_type,
             d * bps,  # bytes covered by these stripes
         )
+        self._read_phase("gather", t0)
         return np.asarray(region[rel : rel + size])

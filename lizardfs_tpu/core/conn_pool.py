@@ -15,6 +15,7 @@ import time
 
 from lizardfs_tpu.runtime import faults as _faults
 from lizardfs_tpu.runtime import retry as _retry
+from lizardfs_tpu.runtime import tracing as _tracing
 
 # dial bound: a blackholed chunkserver (SYN dropped) must cost a read
 # attempt seconds, not the OS connect timeout; tighter ambient
@@ -61,9 +62,14 @@ class ConnectionPool:
             return conn
         if _faults.ACTIVE:
             await _faults.dial_point("cs", f"{addr[0]}:{addr[1]}")
+        # pool miss: the dial is read-phase "dial" busy-time (and the
+        # `dial` queue-wait gate) on whatever logical read is ambient;
+        # free when no read-phase sink is active
+        t0 = _tracing.phase_t0()
         reader, writer = await _retry.bounded_wait(
             asyncio.open_connection(*addr), DIAL_TIMEOUT
         )
+        _tracing.charge_phase("dial", t0)
         return PooledConnection(reader, writer)
 
     def release(self, addr: tuple[str, int], conn: PooledConnection) -> None:
@@ -89,7 +95,12 @@ class ConnectionPool:
     def close_all(self) -> None:
         for bucket in self._idle.values():
             for conn in bucket:
-                conn.writer.close()
+                try:
+                    conn.writer.close()
+                except RuntimeError:
+                    # stream bound to a dead loop (see acquire): the
+                    # socket died with its loop, nothing left to close
+                    pass
         self._idle.clear()
 
 

@@ -104,8 +104,14 @@ async def read_part_range(
                 cell if scatter_direct else None,
             ),
         )
+        # run_in_executor drops the phase-sink context too: the native
+        # exchange is timed here and charged as read-phase net (parallel
+        # part reads overlap, so net busy-time may exceed wall — the
+        # PhaseBreakdown pipelining contract)
+        t0 = tracing.phase_t0()
         try:
             await asyncio.shield(fut)
+            tracing.charge_phase("net", t0)
             GLOBAL_STATS.record_success(addr)
             if not scatter_direct:
                 out[into_offset : into_offset + size] = tmp
@@ -128,6 +134,9 @@ async def read_part_range(
     conn = await GLOBAL_POOL.acquire(addr)
     clean = False
     cancelled = False
+    # the whole framed exchange (request send + piece recv/CRC loop) is
+    # read-phase net busy-time on the ambient logical read
+    t0 = tracing.phase_t0()
     try:
         await framing.send_message(
             conn.writer,
@@ -173,6 +182,7 @@ async def read_part_range(
                         f"short read: {received} of {size} bytes"
                     )
                 GLOBAL_STATS.record_success(addr)
+                tracing.charge_phase("net", t0)
                 return out
             else:
                 raise ReadError(f"unexpected message {type(msg).__name__}")
@@ -306,4 +316,9 @@ async def execute_plan(
         if pending:
             await asyncio.gather(*pending.keys(), return_exceptions=True)
 
-    return plan.postprocess(buffer, available)
+    # postprocess is the decode leg: parity recovery / block CRC checks
+    # for striped plans (a plain pass-through for healthy std reads)
+    t0 = tracing.phase_t0()
+    result = plan.postprocess(buffer, available)
+    tracing.charge_phase("decode", t0)
+    return result

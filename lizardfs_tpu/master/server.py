@@ -4347,6 +4347,13 @@ class MasterServer(Daemon):
                 entry["gateway"]["age_s"] = round(
                     now - stats.get("ts", now), 1
                 )
+                # client-pushed phase breakdowns ride the same stats
+                # doc (Client.push_session_stats); lift them to the
+                # entry so `top` renders each session's read/write
+                # roofline without digging into the gateway sub-doc
+                for key in ("read_phases", "write_phases"):
+                    if stats.get(key):
+                        entry[key] = stats[key]
         # chunkserver legs: per-session data-plane summaries folded
         # into heartbeats (health_json "sessions"); merged per session
         chunkservers: dict[str, list] = {}

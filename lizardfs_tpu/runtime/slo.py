@@ -45,6 +45,7 @@ import time
 from collections import deque
 
 from lizardfs_tpu.constants import env_flag
+from lizardfs_tpu.runtime import tracing
 
 _ENABLED = env_flag("LZ_SLO")
 
@@ -226,6 +227,17 @@ class FlightRecorder:
             "ts": time.time(),
             "captured": bool(spans),
         }
+        if spans:
+            # auto-attribution: every captured breach names where its
+            # milliseconds went (queue/disk/net/compute/unattributed) —
+            # slowops rows and incident files carry it without anyone
+            # having to re-run trace-dump --attribute by hand
+            try:
+                entry["attribution"] = tracing.attribute_timeline(
+                    tracing.merge_timeline(spans, trace_id, wall_name=name)
+                )
+            except Exception:  # noqa: BLE001 — capture is best effort
+                pass
         self._slow.append(entry)
         self._slow.sort(key=lambda e: -e["ms"])
         del self._slow[self.top_n:]
@@ -316,6 +328,11 @@ class SloEngine:
         # _slo_qos_arm). None (the default, and the LZ_HEAT-off state)
         # keeps breach handling exactly as before.
         self.qos_arm = None
+        # per-op-class attribution rollup: breached ops' bucketed
+        # milliseconds (tracing.attribute_timeline) accumulated across
+        # captures, so an SLO breach names WHERE the time went, not
+        # just that a threshold was crossed
+        self.attribution_ms: dict[str, dict[str, float]] = {}
         self.objectives: dict[str, Objective] = {}
         for op_class, (thresh_ms, target) in {
             **DEFAULT_OBJECTIVES, **(objectives or {})
@@ -405,9 +422,17 @@ class SloEngine:
                     spans = self.span_source(trace_id)
                 except Exception:  # noqa: BLE001 — capture is best effort
                     spans = []
-            self.recorder.record(
+            entry = self.recorder.record(
                 op_class, name or op_class, seconds, trace_id, spans
             )
+            attr = entry.get("attribution")
+            if attr:
+                roll = self.attribution_ms.setdefault(
+                    op_class,
+                    {b: 0.0 for b in tracing.ATTRIBUTION_BUCKETS},
+                )
+                for b, v in attr.get("buckets_ms", {}).items():
+                    roll[b] = roll.get(b, 0.0) + v
         return breached
 
     def snapshot(self) -> dict:
@@ -425,6 +450,14 @@ class SloEngine:
                 "burn_slow": round(slow, 3),
                 "status": obj.status(now),
             }
+            roll = self.attribution_ms.get(op_class)
+            if roll:
+                out[op_class]["attribution_ms"] = {
+                    b: round(v, 3) for b, v in roll.items()
+                }
+                out[op_class]["attribution_dominant"] = max(
+                    roll, key=lambda b: roll[b]
+                )
         return out
 
     def status(self) -> str:

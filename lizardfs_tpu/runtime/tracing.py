@@ -296,3 +296,224 @@ def format_timeline(timeline: dict) -> str:
             f"{seg['role']:<12s} {seg['name']}"
         )
     return "\n".join(lines)
+
+
+# --- read-phase sink ---------------------------------------------------------
+#
+# The client activates a sink around each LOGICAL read (read_file /
+# read_file_into); deep layers that have no client reference — the
+# connection pool's dial, the read executor's socket waits and plan
+# postprocess — charge busy-time into whatever sink is ambient. A
+# contextvar (not a global) keeps concurrent clients in one process
+# (in-process test clusters, gateways) from cross-charging; asyncio
+# tasks and to_thread propagate it, run_in_executor does not (native
+# executor hops are therefore timed at the await site instead).
+
+PHASE_SINK: contextvars.ContextVar = contextvars.ContextVar(
+    "lz_read_phase_sink", default=None
+)
+
+
+def phase_t0() -> tuple[float, float]:
+    """(perf_counter, wall) anchor for :func:`charge_phase` — durations
+    stay monotonic-accurate while span endpoints stay epoch-aligned."""
+    return (time.perf_counter(), time.time())
+
+
+def charge_phase(phase: str, t0: tuple[float, float]) -> None:
+    """Charge [t0, now] to ``phase`` on the ambient read-phase sink;
+    free (one contextvar get) when no logical read is in flight."""
+    sink = PHASE_SINK.get()
+    if sink is not None:
+        sink(phase, t0, (time.perf_counter(), time.time()))
+
+
+def charge_queue_wait(
+    metrics, ring, gate: str, tenant: str, t0: tuple[float, float],
+    *, role: str = "", trace_id: int | None = None,
+) -> float:
+    """Charge one finished queue wait: a ``queue_wait{gate,tenant}``
+    labeled timing on the owning component's registry plus a
+    ``queue_wait:<gate>`` span on its ring (attribution's queue
+    bucket). Explicit registry/ring arguments — in-process clusters run
+    master + chunkservers + clients in one interpreter, so a
+    process-global sink would misattribute the wait. Returns the
+    seconds charged."""
+    seconds = max(time.perf_counter() - t0[0], 0.0)
+    tid = current_trace_id() if trace_id is None else trace_id
+    if metrics is not None:
+        metrics.labeled_timing(
+            "queue_wait", {"gate": gate, "tenant": tenant or "default"},
+            help="time ops spent waiting at an admission/credit gate "
+                 "(DRR disk gate, write-window credits, shed retries, "
+                 "connection dials) before doing any work",
+        ).record(seconds, trace_id=tid)
+    if ring is not None and tid:
+        ring.record(
+            tid, f"queue_wait:{gate}", t0[1], t0[1] + seconds,
+            role=role, gate=gate,
+        )
+    return seconds
+
+
+# --- latency attribution -----------------------------------------------------
+
+ATTRIBUTION_BUCKETS = ("queue", "disk", "net", "compute", "unattributed")
+
+# substring -> bucket, FIRST match wins (specific names before generic
+# ones: "read:wait" must hit queue before "read" hits net). Unknown
+# names classify to None and their time surfaces as unattributed-gap —
+# honest, and exactly what flags a span this table should learn.
+_BUCKET_RULES = (
+    ("queue_wait", "queue"),
+    ("dial", "queue"),
+    ("throttle", "queue"),
+    ("backoff", "queue"),
+    ("read:wait", "queue"),
+    ("qos", "queue"),
+    ("locate", "net"),
+    ("decode", "compute"),
+    ("gather", "compute"),
+    ("assemble", "compute"),
+    ("encode", "compute"),
+    ("stage", "compute"),
+    ("crc", "compute"),
+    ("disk", "disk"),
+    ("net", "net"),
+    ("send", "net"),
+    ("recv", "net"),
+    ("ack", "net"),
+    ("commit", "net"),
+    ("read", "net"),
+    ("write", "net"),
+)
+
+
+def classify_segment(name: str) -> "str | None":
+    label = str(name).lower()
+    for pat, bucket in _BUCKET_RULES:
+        if pat in label:
+            return bucket
+    return None
+
+
+def _merge_intervals(ivs: list) -> list:
+    """Sorted disjoint union of [a, b) intervals."""
+    out: list = []
+    for a, b in sorted(ivs):
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def _subtract_intervals(ivs: list, claimed: list) -> list:
+    """``ivs`` minus ``claimed`` (both sorted disjoint unions)."""
+    out = []
+    for a, b in ivs:
+        cur = a
+        for ca, cb in claimed:
+            if cb <= cur or ca >= b:
+                continue
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def attribute_timeline(timeline: dict) -> dict:
+    """Decompose a :func:`merge_timeline` result into queue / disk /
+    net / compute / unattributed-gap milliseconds that sum EXACTLY to
+    the op's wall time.
+
+    Every wall instant lands in at most one bucket: per-bucket span
+    unions are resolved in priority order (queue > disk > net >
+    compute), each later bucket only claiming instants no
+    higher-priority bucket covered — overlapping spans can never push
+    the sum past 100%. Segments are clamped to the wall window, so a
+    clock-skewed ring (a chunkserver span leaking past the client
+    wall) cannot produce negative gaps; zero/negative-duration
+    segments are skipped. Chunkserver spans carrying the native
+    plane's ``queue_us``/``disk_us``/``net_us`` attrs are split into
+    synthetic sub-intervals in that order instead of classifying the
+    envelope, so one ``cs_read`` op feeds three buckets."""
+    wall_ms = float(timeline.get("wall_ms", 0.0) or 0.0)
+    buckets = {b: 0.0 for b in ATTRIBUTION_BUCKETS}
+    out = {
+        "trace_id": timeline.get("trace_id", 0),
+        "wall_ms": round(wall_ms, 3),
+        "buckets_ms": buckets,
+        "pct": {b: 0.0 for b in ATTRIBUTION_BUCKETS},
+        "dominant": "unattributed",
+    }
+    if wall_ms <= 0.0:
+        return out
+    per_bucket: dict[str, list] = {}
+    for seg in timeline.get("segments", ()):
+        try:
+            s = float(seg.get("start_ms", 0.0))
+            e = s + float(seg.get("dur_ms", 0.0))
+        except (TypeError, ValueError):
+            continue
+        s = min(max(s, 0.0), wall_ms)
+        e = min(max(e, 0.0), wall_ms)
+        if e <= s:
+            continue
+        attrs = seg.get("attrs") or {}
+        if any(k in attrs for k in ("queue_us", "disk_us", "net_us")):
+            cursor = s
+            for key, bucket in (
+                ("queue_us", "queue"), ("disk_us", "disk"),
+                ("net_us", "net"),
+            ):
+                dur = min(
+                    max(float(attrs.get(key, 0) or 0), 0.0) / 1e3,
+                    e - cursor,
+                )
+                if dur > 0.0:
+                    per_bucket.setdefault(bucket, []).append(
+                        (cursor, cursor + dur)
+                    )
+                    cursor += dur
+            continue
+        bucket = classify_segment(seg.get("name", ""))
+        if bucket is not None:
+            per_bucket.setdefault(bucket, []).append((s, e))
+    claimed: list = []
+    covered = 0.0
+    for bucket in ("queue", "disk", "net", "compute"):
+        ivs = _merge_intervals(per_bucket.get(bucket, []))
+        own = _subtract_intervals(ivs, claimed)
+        got = sum(b - a for a, b in own)
+        buckets[bucket] = round(got, 3)
+        covered += got
+        claimed = _merge_intervals(claimed + ivs)
+    buckets["unattributed"] = round(max(wall_ms - covered, 0.0), 3)
+    out["pct"] = {
+        b: round(100.0 * v / wall_ms, 1) for b, v in buckets.items()
+    }
+    out["dominant"] = max(buckets, key=lambda b: buckets[b])
+    return out
+
+
+def format_attribution(attr: dict) -> str:
+    """One-block rendering (`trace-dump --attribute`, slowops)."""
+    lines = [
+        f"attribution 0x{attr.get('trace_id', 0):x}  "
+        f"wall {attr.get('wall_ms', 0.0):.2f} ms  "
+        f"dominant {attr.get('dominant', '?')}"
+    ]
+    buckets = attr.get("buckets_ms", {})
+    pct = attr.get("pct", {})
+    for b in ATTRIBUTION_BUCKETS:
+        lines.append(
+            f"  {b:<14s} {buckets.get(b, 0.0):>10.3f} ms "
+            f"{pct.get(b, 0.0):>6.1f}%"
+        )
+    return "\n".join(lines)

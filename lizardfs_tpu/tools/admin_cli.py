@@ -106,6 +106,9 @@ async def _amain(argv) -> int:
                         "qos: [weight TENANT W | rate CLASS OPS | "
                         "data-inflight-mb MB | data-bps BPS | "
                         "rebuild-weight W]")
+    p.add_argument("--attribute", action="store_true",
+                   help="trace-dump: append the latency attribution "
+                        "(queue/disk/net/compute/unattributed buckets)")
     p.add_argument("--password", default=None,
                    help="admin password (challenge-response)")
     args = p.parse_args(argv)
@@ -139,9 +142,14 @@ async def _amain(argv) -> int:
             spans = json.loads(reply.json).get("spans", [])
             if trace_id:
                 # merged per-request timeline for one trace
-                print(tracing.format_timeline(
-                    tracing.merge_timeline(spans, trace_id)
-                ))
+                timeline = tracing.merge_timeline(spans, trace_id)
+                print(tracing.format_timeline(timeline))
+                if args.attribute:
+                    # where the milliseconds went: bucket decomposition
+                    # of the same timeline (sums exactly to wall)
+                    print(tracing.format_attribution(
+                        tracing.attribute_timeline(timeline)
+                    ))
             else:
                 print(json.dumps(spans, indent=2))
             return 0
@@ -258,9 +266,16 @@ async def _amain(argv) -> int:
     elif cmd == "slowops":
         for e in doc.get("slowops", []):
             cap = "captured" if e.get("captured") else "uncaptured"
+            attr = e.get("attribution") or {}
+            dom = attr.get("dominant", "")
+            dom_s = (
+                f"  {dom} {attr.get('pct', {}).get(dom, 0.0):.0f}%"
+                if dom else ""
+            )
             print(
                 f"{e['ms']:>10.1f} ms  {e['op_class']:<10s} "
                 f"{e['name']:<20s} trace 0x{e['trace_id']:x}  ({cap})"
+                f"{dom_s}"
             )
         if not doc.get("slowops"):
             print("(no SLO breaches recorded)")
@@ -357,8 +372,25 @@ def _print_top(doc: dict) -> None:
             f"{mrow.get('p99_ms', 0.0):>8.1f}  "
             f"{hot_s}{('  trace ' + exemplar) if exemplar else ''}"
         )
+        phases = entry.get("read_phases")
+        if phases and phases.get("reps"):
+            busy = {
+                k[:-3]: v for k, v in phases.items()
+                if k.endswith("_ms") and k != "wall_ms"
+            }
+            dom = max(busy, key=lambda k: busy[k]) if busy else "?"
+            busy_s = " ".join(
+                f"{k}={v:.0f}ms" for k, v in sorted(
+                    busy.items(), key=lambda kv: -kv[1]
+                ) if v > 0
+            )
+            print(
+                f"             `- read phases ({phases.get('reps', 0)} "
+                f"reads, wall {phases.get('wall_ms', 0.0):.0f}ms) "
+                f"dominant {dom}  {busy_s}"
+            )
         gw = entry.get("gateway")
-        if gw:
+        if gw and gw.get("protocol"):
             proto = gw.get("protocol") or []
             mix = proto[0].get("classes", {}) if proto else {}
             top3 = sorted(

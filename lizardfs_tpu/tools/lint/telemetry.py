@@ -158,6 +158,8 @@ DAEMON = "lizardfs_tpu/runtime/daemon.py"
 CLIENT = "lizardfs_tpu/client/client.py"
 HEAT = "lizardfs_tpu/master/heat.py"
 SLO = "lizardfs_tpu/runtime/slo.py"
+TRACING = "lizardfs_tpu/runtime/tracing.py"
+NATIVE_SERVE = "lizardfs_tpu/chunkserver/native_serve.py"
 ANCHORS = (
     (MASTER, r"metrics\.timing\(type\(msg\)\.__name__\)",
      "master per-op latency histograms (request_log analog)"),
@@ -224,6 +226,30 @@ ANCHORS = (
      "SLO engine second auto-arm hook (breach -> qos_arm call)"),
     (CS, r"_heat_fold_json\(",
      "chunkserver per-chunk heat heartbeat fold (heat map input)"),
+    # read-path microscope (ISSUE 18): phase-instrumented reads, the
+    # queue-wait gates, and the attribution engine are standing
+    # surfaces — losing any leg silently blanks a `top` column, a
+    # queue_wait family, or the slowops/incident attribution embed
+    (CLIENT, r"PHASE_SINK\.set\(",
+     "client read-phase sink activation at the read_file boundary"),
+    (CLIENT, r"read_phases\.add_wall\(",
+     "client exactly-once read wall/rep accounting (PhaseBreakdown)"),
+    (CLIENT, r"charge_queue_wait\(",
+     "client queue-wait gates (dial / busy_retry / write_credit)"),
+    (CS, r"charge_queue_wait\(",
+     "chunkserver DRR disk-gate queue-wait charge (drr_disk gate)"),
+    (CS, r"queue_us",
+     "chunkserver native trace-slot queue-wait fold (queue_us slot)"),
+    (TRACING, r"def attribute_timeline\(",
+     "latency attribution engine (queue/disk/net/compute buckets)"),
+    (TRACING, r"def charge_queue_wait\(",
+     "shared queue-wait charge helper (metric + ambient trace span)"),
+    (SLO, r"attribute_timeline\(",
+     "slowops/incident latency-attribution embed"),
+    (MASTER, r"read_phases",
+     "per-session read-phase lift into the `top` rollup"),
+    (NATIVE_SERVE, r"lz_serve_trace3",
+     "native 10-slot trace drain (queue_us-bearing slot contract)"),
 )
 
 # files searched for OP_CLASSES coverage (who feeds each objective)

@@ -63,7 +63,8 @@ PAGE = """<!doctype html>
 </table>
 <h2>workload top &mdash; per-session (ops/s over the accounting window)</h2>
 <table><tr><th>session</th><th>who</th><th>ops/s</th><th>p99 ms</th>
-<th>hot classes</th><th>exemplar trace</th></tr>{top_rows}</table>
+<th>hot classes</th><th>read roofline</th>
+<th>exemplar trace</th></tr>{top_rows}</table>
 <h2>metadata ops (last 120 s)</h2>
 <pre>{ops}</pre>
 <h2>charts &mdash; range: {range_links} (showing {span})</h2>
@@ -250,11 +251,25 @@ class Dashboard:
             if gw:
                 who += f" ({gw.get('role', '?')} gateway)"
             exemplar = str(mrow.get("exemplar", entry.get("exemplar", "")))
+            # client-pushed read PhaseBreakdown (top_report lifts it
+            # from the session-stats doc): name the dominant phase so
+            # the table answers "what bounds this session's reads"
+            phases = entry.get("read_phases") or {}
+            roofline = ""
+            if phases.get("reps"):
+                busy = {
+                    k[:-3]: v for k, v in phases.items()
+                    if k.endswith("_ms") and k != "wall_ms"
+                }
+                if busy:
+                    dom = max(busy, key=lambda k: busy[k])
+                    roofline = f"{dom} {busy[dom]:.0f}ms"
             top_rows.append(
                 f"<tr><td>{_esc(str(label))}</td><td>{_esc(who)}</td>"
                 f"<td>{mrow.get('rate_ops', 0.0):.1f}</td>"
                 f"<td>{mrow.get('p99_ms', 0.0):.1f}</td>"
-                f"<td>{_esc(hot)}</td><td>{_esc(exemplar)}</td></tr>"
+                f"<td>{_esc(hot)}</td><td>{_esc(roofline)}</td>"
+                f"<td>{_esc(exemplar)}</td></tr>"
             )
         rows = []
         for s in info.get("chunkservers", []):

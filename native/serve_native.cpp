@@ -316,9 +316,10 @@ struct WriteSession {
 
 // one finished data-plane op for the trace ring (runtime/tracing.py):
 // absolute CLOCK_REALTIME bounds + accumulated disk/net time inside.
-// Flattened to 9 u64 slots by lz_serve_trace2 (8 by the legacy
-// lz_serve_trace, which elides session_id); keep in sync with
-// chunkserver/native_serve.py TRACE_OP_SLOTS.
+// Flattened to 10 u64 slots by lz_serve_trace3 (9 by lz_serve_trace2,
+// which elides queue_us; 8 by the legacy lz_serve_trace, which also
+// elides session_id); keep in sync with chunkserver/native_serve.py
+// TRACE_OP_SLOTS.
 struct TraceOp {
     uint64_t kind;      // 1=read 2=read_bulk 4=write_bulk
     uint64_t trace_id;
@@ -329,6 +330,8 @@ struct TraceOp {
     uint64_t disk_us;   // time in flock..unlock block IO (+ CRC pass)
     uint64_t net_us;    // send time (reads) / recv time (writes)
     uint64_t session_id;  // originating client session (0 = legacy peer)
+    uint64_t queue_us;  // QoS pacing wait before any work (read-phase
+                        // "wait"; attribution bucket "queue")
 };
 
 constexpr uint64_t kTraceRead = 1;
@@ -466,8 +469,9 @@ uint64_t qos_charge(Server& srv, uint64_t session_id, uint64_t len) {
 // Bounded blocking pace for the thread-per-connection read path (the
 // proactor never blocks — it defers instead). Caps total wait at 2 s:
 // QoS shapes traffic, it must never wedge a reader against a
-// misconfigured budget.
-void qos_pace_blocking(Server& srv, uint64_t session_id, uint64_t len) {
+// misconfigured budget. Returns the microseconds spent waiting so the
+// op's TraceOp can carry its queue time (attribution bucket "queue").
+uint64_t qos_pace_blocking(Server& srv, uint64_t session_id, uint64_t len) {
     uint64_t waited = 0, delay = 0;
     while ((delay = qos_charge(srv, session_id, len)) != 0 &&
            !srv.stopping.load(std::memory_order_relaxed) &&
@@ -478,12 +482,13 @@ void qos_pace_blocking(Server& srv, uint64_t session_id, uint64_t len) {
     }
     if (waited != 0)
         srv.qos_deferrals.fetch_add(1, std::memory_order_relaxed);
+    return waited;
 }
 
 void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
               uint64_t chunk_id, uint64_t bytes, uint64_t t_start_us,
               uint64_t t_end_us, uint64_t disk_us, uint64_t net_us,
-              uint64_t session_id = 0) {
+              uint64_t session_id = 0, uint64_t queue_us = 0) {
     if (kind == kTraceWriteBulk || kind == kTraceWriteShm) {
         srv.write_disk_us.fetch_add(disk_us, std::memory_order_relaxed);
         srv.write_net_us.fetch_add(net_us, std::memory_order_relaxed);
@@ -500,7 +505,7 @@ void trace_op(Server& srv, uint64_t kind, uint64_t trace_id,
     }
     srv.trace_ring.push_back(TraceOp{kind, trace_id, chunk_id, bytes,
                                      t_start_us, t_end_us, disk_us, net_us,
-                                     session_id});
+                                     session_id, queue_us});
 }
 
 std::mutex g_servers_mu;
@@ -545,7 +550,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
     // (per-session op accounting; same additive-tail convention)
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
     uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
-    qos_pace_blocking(srv, session_id, size);
+    uint64_t queue_us = qos_pace_blocking(srv, session_id, size);
 
     uint8_t code = stOK;
     std::string path;
@@ -702,7 +707,7 @@ void serve_read(Server& srv, int cfd, std::mutex* send_mu,
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
         trace_op(srv, kTraceRead, trace_id, chunk_id, size, t_start, t_end,
-                 disk_us, t_end - net0, session_id);
+                 disk_us, t_end - net0, session_id, queue_us);
     }
 }
 
@@ -740,7 +745,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
     uint32_t size = get32(body + 24);
     uint64_t trace_id = blen >= 36 ? get64(body + 28) : 0;
     uint64_t session_id = blen >= 44 ? get64(body + 36) : 0;
-    qos_pace_blocking(srv, session_id, size);
+    uint64_t queue_us = qos_pace_blocking(srv, session_id, size);
 
     uint8_t code = stOK;
     std::string path;
@@ -873,7 +878,7 @@ void serve_read_bulk(Server& srv, int cfd, std::mutex* send_mu,
         srv.bytes_read.fetch_add(size, std::memory_order_relaxed);
         srv.read_ops.fetch_add(1, std::memory_order_relaxed);
         trace_op(srv, kTraceReadBulk, trace_id, chunk_id, size, t_start,
-                 t_end, disk_us, t_end - net0, session_id);
+                 t_end, disk_us, t_end - net0, session_id, queue_us);
     }
 }
 
@@ -1318,8 +1323,9 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
     }
     // QoS pacing before the stream lands: the sender blocks on the
     // socket while this thread sleeps, which IS the backpressure
+    uint64_t queue_us = 0;
     if (s != nullptr && code == stOK)
-        qos_pace_blocking(srv, s->session_id, dlen);
+        queue_us = qos_pace_blocking(srv, s->session_id, dlen);
     bool chained = s != nullptr && s->down_fd >= 0;
     if (chained) {
         // forward header + fixed + crcs + dlen downstream before data
@@ -1429,7 +1435,7 @@ void serve_write_bulk(Server& srv, int cfd, std::mutex* send_mu,
     *conn_ok = true;  // frame fully consumed; socket still in sync
     trace_op(srv, kTraceWriteBulk, s != nullptr ? s->trace_id : 0, chunk_id,
              dlen, t_start, lzwire::now_us(), disk_us, recv_us,
-             s != nullptr ? s->session_id : 0);
+             s != nullptr ? s->session_id : 0, queue_us);
 
     bool down_was_dead = false;
     if (s != nullptr && s->down_fd >= 0) {
@@ -2478,8 +2484,8 @@ void lz_serve_shm_stats(int handle, uint64_t* out) {
 
 // Drain up to max_ops finished traced ops, oldest first, ``slots`` u64
 // per op: kind, trace_id, chunk_id, bytes, t_start_us, t_end_us,
-// disk_us, net_us[, session_id]. Returns the op count. Draining keeps
-// the Python fold free of dedupe bookkeeping.
+// disk_us, net_us[, session_id[, queue_us]]. Returns the op count.
+// Draining keeps the Python fold free of dedupe bookkeeping.
 static int drain_trace(int handle, uint64_t* out, int max_ops, int slots) {
     Server* srv = nullptr;
     {
@@ -2505,6 +2511,7 @@ static int drain_trace(int handle, uint64_t* out, int max_ops, int slots) {
         slot[6] = op.disk_us;
         slot[7] = op.net_us;
         if (slots > 8) slot[8] = op.session_id;
+        if (slots > 9) slot[9] = op.queue_us;
     }
     srv->trace_ring.erase(srv->trace_ring.begin(),
                           srv->trace_ring.begin() + n);
@@ -2522,6 +2529,13 @@ int lz_serve_trace(int handle, uint64_t* out, int max_ops) {
 // and falls back to lz_serve_trace on a stale .so)
 int lz_serve_trace2(int handle, uint64_t* out, int max_ops) {
     return drain_trace(handle, out, max_ops, 9);
+}
+
+// 10-slot drain: the 9 trace2 slots + QoS queue-wait microseconds
+// (read-phase "wait" / attribution bucket "queue"; native_serve.py
+// prefers this and falls back down the chain on a stale .so)
+int lz_serve_trace3(int handle, uint64_t* out, int max_ops) {
+    return drain_trace(handle, out, max_ops, 10);
 }
 
 // Multi-tenant QoS: replace the per-session byte-rate budget table

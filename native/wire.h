@@ -13,6 +13,13 @@
 // contract (per-session op accounting; it is positional, so a session
 // only rides frames that also carry the trace slot). 0 = unattributed.
 // The python codec mirrors both as SKEW_TOLERANT trailing fields.
+// Trace DRAIN contract (serve_native.cpp TraceOp): finished ops flatten
+// to u64 slots {kind, trace_id, chunk_id, bytes, t_start_us, t_end_us,
+// disk_us, net_us, session_id, queue_us}. lz_serve_trace drains 8
+// slots, lz_serve_trace2 adds session_id (9), lz_serve_trace3 adds
+// queue_us (10) — the op's QoS pacing wait, folded into the "queue"
+// attribution bucket. Additive only: python drains prefer the widest
+// export present and fall back down the chain on a stale .so.
 #pragma once
 
 #include <cctype>

@@ -68,6 +68,25 @@ def _fat_row() -> dict:
             "depth": 8, "max_depth": 8, "segments": 1234,
             "credit_waits": 56, "commits_coalesced": 12,
         }
+    # read-path microscope fiducials (this round: ISSUE 18) — healthy
+    # striped read phase breakdowns + the ec(8,4) degraded-read
+    # (parity recovery) variant row
+    for g in ("xor3", "ec3_2", "ec8_4"):
+        row[f"cluster_{g}_read_phases"] = {
+            "locate_ms": 123.45, "dial_ms": 23.45, "wait_ms": 345.67,
+            "net_ms": 2345.67, "decode_ms": 1234.56,
+            "gather_ms": 456.78, "wall_ms": 3456.78, "reps": 5,
+            "dominant": "net",
+        }
+    row["cluster_ec8_4_degraded_read_read_MBps"] = 987.6
+    row["cluster_ec8_4_degraded_read_spread_pct"] = 24.3
+    row["cluster_ec8_4_degraded_read_read_reps_MBps"] = [980.1, 987.6,
+                                                         995.2]
+    row["cluster_ec8_4_degraded_read_read_phases"] = {
+        "locate_ms": 234.56, "dial_ms": 34.56, "wait_ms": 456.78,
+        "net_ms": 1456.78, "decode_ms": 2345.67, "gather_ms": 345.67,
+        "wall_ms": 4567.89, "reps": 5, "dominant": "decode",
+    }
     row["cluster_ec8_4_write_trace"] = {
         "rep_MBps": 431.2, "wall_ms": 297.123, "coverage_pct": 94.7,
         "by_role_ms": {"client": 401.2, "chunkserver": 233.4,
@@ -160,26 +179,49 @@ def test_summary_line_fits_driver_tail():
     assert (
         parsed.get("cluster_ec8_4_write_trace", {}).get("coverage_pct")
         == 94.7
-        or "cluster_ec8_4_write_trace" in parsed.get("dropped", [])
+        or "ec8_4_write_trace" in parsed.get("dropped", [])
     )
     # write-window fiducials ride the tail for the target row only
     # (xor3/ec3_2 window dicts stay in BENCH_FULL.json); under budget
     # pressure the dict may drop, but then the drop is RECORDED
     assert (
         parsed.get("cluster_ec8_4_write_window", {}).get("depth") == 8
-        or "cluster_ec8_4_write_window" in parsed.get("dropped", [])
+        or "ec8_4_write_window" in parsed.get("dropped", [])
     )
     assert not any("xor3_write_window" in k for k in parsed)
     # the shm on/off A/B delta rides the tail (or its drop is recorded),
     # and the send/encode ratio survives int compaction with decimals
     assert (
         parsed.get("cluster_ec8_4_write_shm", {}).get("delta_pct") == 18.8
-        or "cluster_ec8_4_write_shm" in parsed.get("dropped", [])
+        or "ec8_4_write_shm" in parsed.get("dropped", [])
     )
     if "cluster_ec8_4_write_phases" in parsed:
         assert parsed["cluster_ec8_4_write_phases"][
             "send_over_encode"] == 0.87
         assert parsed["cluster_ec8_4_write_phases"]["dominant"] == "encode"
+    # the read-phase fiducials (ISSUE 18): the ec(8,4) roofline rides
+    # the tail (or its drop is recorded); xor3/ec3_2 read phases are
+    # full-file-only, per-rep arrays likewise
+    assert (
+        parsed.get("cluster_ec8_4_read_phases", {}).get("dominant")
+        == "net"
+        or "ec8_4_read_phases" in parsed.get("dropped", [])
+    )
+    if "cluster_ec8_4_read_phases" in parsed:
+        # integer-ms compaction, dominant preserved
+        assert parsed["cluster_ec8_4_read_phases"]["net_ms"] == 2346
+    assert (
+        parsed.get("cluster_ec8_4_degraded_read_read_phases", {})
+        .get("dominant") == "decode"
+        or "ec8_4_degraded_read_read_phases"
+        in parsed.get("dropped", [])
+    )
+    assert not any("xor3_read_phases" in k for k in parsed)
+    assert not any("ec3_2_read_phases" in k for k in parsed)
+    # the degraded-read throughput scalar always rides (it is a
+    # _read_MBps key, never on the drop ladder)
+    assert parsed["cluster_ec8_4_degraded_read_read_MBps"] == 987.6
+    assert "cluster_ec8_4_degraded_read_read_reps_MBps" not in parsed
     # slo fiducials ride the tail: noise attribution from the artifact
     assert parsed["cluster_health_status"] == "degraded"
     assert parsed["cluster_slo_breaches"] == 1234
@@ -194,26 +236,26 @@ def test_summary_line_fits_driver_tail():
                        ("cluster_s3_get_MBps", 234.5),
                        ("cluster_s3_list_ops", 45.6)):
         assert (parsed.get(skey) == sval
-                or "cluster_s3_*" in parsed.get("dropped", []))
+                or "s3_*" in parsed.get("dropped", []))
     assert "cluster_s3_put_reps_MBps" not in parsed
     # the locate-storm A/B verdict rides the tail (or its drop is
     # recorded); the detail dict is full-file-only
     assert (
         parsed.get("cluster_locate_qps", {}).get("target_met") is True
-        or "cluster_locate_qps" in parsed.get("dropped", [])
+        or "locate_qps" in parsed.get("dropped", [])
     )
     assert "cluster_locate_storm_detail" not in parsed
     # the QoS A/B verdict rides the tail (or its drop is recorded)
     assert (
         parsed.get("cluster_qos_victim_p99_ms", {}).get("target_met")
         is True
-        or "cluster_qos_victim_p99_ms" in parsed.get("dropped", [])
+        or "qos_victim_p99_ms" in parsed.get("dropped", [])
     )
     # the hot-spot A/B verdict rides the tail (or its drop is recorded)
     assert (
         parsed.get("cluster_hotspot_read_MBps", {}).get("target_met")
         is True
-        or "cluster_hotspot_read_MBps" in parsed.get("dropped", [])
+        or "hotspot_read_MBps" in parsed.get("dropped", [])
     )
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail
@@ -295,6 +337,28 @@ def test_bench_round_self_record_and_reload(tmp_path):
     }))
     n, mined = bench._load_prev_round(str(tmp_path))
     assert n == 8 and mined["value"] == 50.0
+
+
+def test_bench_guard_fresh_baseline(tmp_path, capsys):
+    """An empty BENCH trajectory must record a fresh round cleanly and
+    SAY so — an explicit first-round DELTA line + bench_prev_round=0 in
+    the row — instead of silently printing no DELTA output (which reads
+    as 'guard never ran' in the driver tail)."""
+    row = {"metric": "kernelA", "value": 100.0}
+    bench._bench_guard(row, str(tmp_path))
+    out = capsys.readouterr().out
+    assert "DELTA" in out and "fresh baseline" in out
+    assert row["bench_prev_round"] == 0
+    assert "bench_guard_error" not in row
+    assert (tmp_path / "BENCH_r01.json").exists()
+    # a recorded-but-empty round is skipped as a compare base (nothing
+    # to diff against), but numbering still advances past it
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"n": 2, "self_recorded": True, "row": {}}))
+    row2 = {"metric": "kernelA", "value": 99.0}
+    bench._bench_guard(row2, str(tmp_path))
+    assert row2["bench_prev_round"] == 1  # compared against r01, not r02
+    assert (tmp_path / "BENCH_r03.json").exists()
 
 
 def test_summary_budget_guard_drops_not_truncates():

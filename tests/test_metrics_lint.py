@@ -235,7 +235,12 @@ async def test_lint_live_daemon_registries(tmp_path):
         c.cache.invalidate(f.inode)
         await c.read_file(f.inode, 0, 300_000)
         # fire one injected fault so the labeled faults_injected family
-        # is present on a LIVE page (new-series lint coverage)
+        # is present on a LIVE page (new-series lint coverage); drain
+        # the shared dial pool first so the faulted read (forced onto
+        # the instrumented wave path by the armed rule) must pool-miss
+        # and charge the `dial` queue-wait gate (ISSUE 18)
+        from lizardfs_tpu.core.conn_pool import GLOBAL_POOL
+        GLOBAL_POOL.close_all()
         faults.install("seed=1; chunkserver:serve_read delay=1,limit=1")
         try:
             c.cache.invalidate(f.inode)
@@ -254,10 +259,17 @@ async def test_lint_live_daemon_registries(tmp_path):
             lint_prometheus(daemon.metrics.to_prometheus())
         # the client-side registry (write-window depth/credit/coalesce
         # series ride whatever exporter embeds the client) lints too
-        typed_client = lint_prometheus(c.metrics.to_prometheus())
+        client_text = c.metrics.to_prometheus()
+        typed_client = lint_prometheus(client_text)
         assert "lizardfs_write_window_depth" in typed_client
         assert "lizardfs_write_window_credit_waits_total" in typed_client
         assert "lizardfs_write_commits_coalesced_total" in typed_client
+        # queue-wait gate family (ISSUE 18): the pool-miss dial during
+        # the faulted read charged the labeled timing, so the family is
+        # live, typed, and carries the gate/tenant labels
+        assert typed_client["lizardfs_queue_wait_us"] == "histogram"
+        assert 'gate="dial"' in client_text
+        assert 'tenant="default"' in client_text
         # over the wire (metrics-prom relays the same render)
         r, w = await asyncio.open_connection(
             "127.0.0.1", cluster.master.port
