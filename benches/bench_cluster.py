@@ -194,6 +194,28 @@ async def run_hotspot_ab(n_cs: int = 3, size_kb: int = 256,
     return {"goal": "hot-spot A/B", "hotspot": out}
 
 
+async def run_failover_rto(seed: int = 1) -> dict:
+    """Failover RTO fiducial (ISSUE 19): the kill-primary chaos drill
+    on a real master+shadow+metalogger quorum — SIGKILL the elected
+    active under a windowed ec(8,4) write stream (plus a rebuild and a
+    multipart upload in flight) and measure detect -> elect -> promote
+    -> first-acked-write. The drill itself asserts zero acknowledged-
+    write loss and the fenced epoch; the row carries the measured RTO
+    against the drill's budget. Runs on its own multi-PROCESS cluster
+    (SIGKILL needs real processes), so nothing else is mid-measurement."""
+    from lizardfs_tpu.tools import chaos
+
+    tmp = _bench_dir()
+    try:
+        doc = await chaos.run_schedule(
+            "kill-primary", seed, workdir=str(tmp), log=lambda *_: None
+        )
+    finally:
+        shutil.rmtree(str(tmp), ignore_errors=True)
+    doc["target_met"] = bool(doc["rto_s"] <= doc["rto_budget_s"])
+    return {"goal": "failover RTO", "failover": doc}
+
+
 async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
     tmp = _bench_dir()
     master = MasterServer(str(tmp / "master"), goals=bench_goals(),
@@ -1022,6 +1044,17 @@ async def run_bench(size_mb: int, n_cs: int, encoder: str) -> list[dict]:
             import logging
 
             logging.getLogger("bench").exception("hot-spot A/B row failed")
+
+        # failover RTO (ISSUE 19): SIGKILL the elected active master
+        # under load on a real-process quorum — the verdict is the
+        # detect->elect->promote->first-acked-write outage, with zero
+        # acknowledged-write loss asserted inside the drill
+        try:
+            rows.append(await run_failover_rto())
+        except Exception:  # noqa: BLE001 — fiducials must not kill the bench
+            import logging
+
+            logging.getLogger("bench").exception("failover RTO row failed")
     finally:
         await client.close()
         for cs in servers:
@@ -1077,6 +1110,13 @@ def main(argv=None) -> int:
                   f"{q['bound_ms']:.0f}); abuser "
                   f"{q['abuser_qps_off']:.0f} -> {q['abuser_qps_on']:.0f} "
                   f"q/s; target_met={q['target_met']}")
+        elif "failover" in r:
+            fo = r["failover"]
+            print(f"{r['goal']:>18s}:  rto {fo['rto_s']:6.2f} s"
+                  f"   (promote {fo['promote_s']:.2f} s, epoch "
+                  f"{fo['epoch']}, {fo['acked_writes']} acked / "
+                  f"{fo['lost_writes']} lost)"
+                  f"   target_met={fo['target_met']}")
         elif "hotspot" in r:
             h = r["hotspot"]
             print(f"{r['goal']:>18s}:  off {h['read_off_MBps']:8.1f} MB/s"

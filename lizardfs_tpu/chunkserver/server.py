@@ -142,6 +142,13 @@ class ChunkServer(Daemon):
         self.label = label
         self.cs_id = 0
         self.master: RpcConnection | None = None
+        # highest cluster fencing epoch observed on any master link
+        # (register/heartbeat acks and mirror refusals carry it). Echoed
+        # on every registration and heartbeat so a deposed ex-primary
+        # hears about the election from its own chunkservers and steps
+        # down; an ack BELOW this fences the command link instead of
+        # obeying a zombie. 0 = pre-HA / LZ_HA off, fencing disengaged.
+        self.cluster_epoch = 0
         self.encoder = get_encoder(encoder_name)
         # replicator recovery backend, resolved lazily on first rebuild:
         # the auto ladder's mesh-sharded backend when real multichip
@@ -324,9 +331,18 @@ class ChunkServer(Daemon):
             total_space=total,
             used_space=used,
             data_port=self.data_server.port if self.data_server else 0,
+            # echo the highest epoch we have seen: a zombie ex-primary
+            # answering this addr fences itself on it and refuses us
+            epoch=self.cluster_epoch,
         )
         self.cs_id = reply.cs_id
-        self.log.info("registered with master as cs %d", self.cs_id)
+        self.cluster_epoch = max(
+            self.cluster_epoch, getattr(reply, "epoch", 0)
+        )
+        self.log.info(
+            "registered with master as cs %d (epoch %d)",
+            self.cs_id, self.cluster_epoch,
+        )
 
     async def stop(self) -> None:
         import socket as _socket
@@ -404,13 +420,51 @@ class ChunkServer(Daemon):
                 # (skew-tolerant trailing field; "" when LZ_HEAT is off
                 # so the wire stays byte-identical to the pre-heat tree)
                 heat_json=self._heat_fold_json(),
+                # max epoch observed on ANY link (incl. mirror refusals
+                # from a freshly promoted shadow): the deposed primary
+                # learns of the election from this echo and steps down
+                epoch=self.cluster_epoch,
                 timeout=5.0,
             )
+            reply_epoch = getattr(reply, "epoch", 0)
+            if reply_epoch and reply_epoch < self.cluster_epoch:
+                # the acking master never applied the epoch_bump we saw
+                # elsewhere — zombie ex-primary. Fence the command link:
+                # drop it and let the next tick re-cycle the address
+                # list to the elected active. Its commands after this
+                # point would mutate a forked history.
+                self.log.warning(
+                    "fencing command link to stale master (epoch %d < %d)",
+                    reply_epoch, self.cluster_epoch,
+                )
+                await self.master.close()
+                self.master = None
+                return
+            self.cluster_epoch = max(self.cluster_epoch, reply_epoch)
             # QoS data-plane config refresh (skew-tolerant trailing
             # qos_json; old masters send "" = stay unthrottled)
             self._qos_apply(getattr(reply, "qos_json", ""))
         except (ConnectionError, asyncio.TimeoutError):
             pass
+
+    async def _observe_mirror_epoch(self, epoch: int) -> None:
+        """Mirror->command flip: a mirror-plane reply (ack or refusal)
+        announcing a HIGHER cluster epoch means an election happened —
+        the peer at that address was promoted, and our command link
+        points at the deposed ex-primary. Adopt the epoch and fence the
+        command link; the next heartbeat re-dials the address list and
+        lands command-capable on the new active (the stale mirror entry
+        for its addr is popped by the next mirror tick)."""
+        if epoch <= self.cluster_epoch:
+            return
+        self.cluster_epoch = epoch
+        if self.master is not None and not self.master.closed:
+            self.log.warning(
+                "cluster epoch %d announced on the mirror plane — "
+                "fencing the command link and re-dialing", epoch,
+            )
+            await self.master.close()
+            self.master = None
 
     async def _mirror_maintain(self) -> None:
         """Own-timer wrapper for _mirror_tick (never inline in the
@@ -458,8 +512,13 @@ class ChunkServer(Daemon):
                 entry = None
             async def mirror_register(c):
                 # ONE field list for initial registration and the 60 s
-                # wholesale re-report — only the connection varies
-                return await c.call_ok(
+                # wholesale re-report — only the connection varies.
+                # Plain `call`, not call_ok: a REFUSAL from a freshly
+                # promoted master carries the NEW cluster epoch, and
+                # that refusal is exactly how this chunkserver learns
+                # to flip the address mirror->command (the flip itself
+                # is _observe_mirror_epoch fencing the command link).
+                reply = await c.call(
                     m.CstomaRegister,
                     addr=m.Addr(host=self.host, port=self.port),
                     label=self.label,
@@ -470,8 +529,15 @@ class ChunkServer(Daemon):
                         self.data_server.port if self.data_server else 0
                     ),
                     mirror=1,
+                    epoch=self.cluster_epoch,
                     timeout=30.0,
                 )
+                await self._observe_mirror_epoch(
+                    getattr(reply, "epoch", 0)
+                )
+                if getattr(reply, "status", 0) != 0:
+                    raise st.StatusError(reply.status, "CstomaRegister")
+                return reply
 
             conn = None  # a dial not yet handed to self._mirror
             try:

@@ -94,6 +94,19 @@ def heat_enabled() -> bool:
     return env_flag("LZ_HEAT")
 
 
+def ha_enabled() -> bool:
+    """LZ_HA kill switch (default ON) for the autopilot-failover
+    subsystem: quorum leader election among masters + metaloggers
+    (metaloggers vote, never lead), automatic fenced promotion of the
+    winning shadow (the `epoch_bump` changelog op), and epoch fencing
+    of zombie ex-primaries on every register/heartbeat link. Off, no
+    election sockets are opened, promotion never commits an epoch bump,
+    and every epoch wire field stays 0 — byte-identical to the
+    manual-promotion (PR-7) tree; `promote-shadow` still works. Read
+    per call: operators flip it live."""
+    return env_flag("LZ_HA")
+
+
 def s3_enabled() -> bool:
     """LZ_S3 kill switch (default ON) for the S3 object gateway: off,
     the gateway refuses to start (a booted gateway keeps serving —

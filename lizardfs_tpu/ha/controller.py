@@ -46,6 +46,11 @@ class FailoverController:
         # serialize hooks: during flapping, a stale demote finishing
         # after a fresh promote would strip the new leader's service IP
         self._hook_lock = asyncio.Lock()
+        self.promotions = 0
+        self.demotions = 0
+        # monotonic stamp of the last self-promotion this controller
+        # performed (RTO attribution: detect->elect->promote)
+        self.last_promotion_at: float | None = None
         self.log = logging.getLogger(f"failover[{node_id}]")
         self.node = ElectionNode(
             node_id,
@@ -84,10 +89,25 @@ class FailoverController:
     async def stop(self) -> None:
         await self.node.stop()
 
+    def status(self) -> dict:
+        doc = self.node.status()
+        doc.update({
+            "personality": self.master.personality,
+            "epoch": self.master.meta.epoch,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+        })
+        return doc
+
     async def _on_leader(self) -> None:
         if self.master.personality != "master":
             self.log.info("election won — promoting shadow")
             self.master.promote()
+            self.promotions += 1
+            self.last_promotion_at = asyncio.get_running_loop().time()
+            mx = getattr(self.master, "metrics", None)
+            if mx is not None:
+                mx.counter("ha_promotions").inc()
             await self._run_hook(self.promote_exec, "master")
 
     async def _on_follower(self, leader_id: str) -> None:
@@ -107,4 +127,8 @@ class FailoverController:
             # no service map configured: read-only until restarted
             self.master.personality = "shadow"
         if was_active:
+            self.demotions += 1
+            mx = getattr(self.master, "metrics", None)
+            if mx is not None:
+                mx.counter("ha_demotions").inc()
             await self._run_hook(self.demote_exec, "shadow")

@@ -25,6 +25,17 @@ FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
 
+# A vote-only arbiter's archived version is only a PROXY for data
+# freshness: its archive can momentarily lead the surviving shadow's
+# replay (each followed the dead active over its own socket), and with
+# the active gone the shadow can never catch up past it — a strict
+# up-to-date rule would then deadlock the election forever. After this
+# many max election timeouts without ANY leader, an arbiter stops
+# refusing behind candidates (availability over the proxy). Real
+# masters (can_lead=True) never relax: their version IS the data, and
+# relaxing it could elect a stale master and lose acknowledged writes.
+ARBITER_RELAX_TIMEOUTS = 10.0
+
 
 class _Proto(asyncio.DatagramProtocol):
     def __init__(self, node: "ElectionNode"):
@@ -50,6 +61,7 @@ class ElectionNode:
         on_follower=None,  # async (leader_id) -> None
         election_timeout: tuple[float, float] = (0.15, 0.30),
         heartbeat_interval: float = 0.05,
+        can_lead: bool = True,
     ):
         self.node_id = node_id
         self.listen = listen
@@ -59,6 +71,17 @@ class ElectionNode:
         self.on_follower = on_follower
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        # metaloggers vote but never lead (uraft arbiter analog): a
+        # vote-only node never starts an election, so it contributes to
+        # quorum without ever being promoted to serve metadata
+        self.can_lead = can_lead
+        self.elections_started = 0
+        self.votes_granted = 0
+        self.depositions = 0
+        self.stale_votes_granted = 0
+        # last time a leader heartbeat arrived: drives the arbiter's
+        # leaderless-deadlock relaxation (never reset by vote grants)
+        self._leader_seen_at = 0.0
 
         self.state = FOLLOWER
         self.term = 0
@@ -82,6 +105,7 @@ class ElectionNode:
         )
         self.listen = self._transport.get_extra_info("sockname")[:2]
         self._last_heartbeat = loop.time()
+        self._leader_seen_at = loop.time()
         self._tasks.append(loop.create_task(self._ticker()))
 
     async def stop(self) -> None:
@@ -116,12 +140,32 @@ class ElectionNode:
                 continue
             timeout = self._rng.uniform(*self.election_timeout)
             await asyncio.sleep(0.02)
-            if loop.time() - self._last_heartbeat > timeout:
+            if (
+                self.can_lead
+                and loop.time() - self._last_heartbeat > timeout
+            ):
                 self._start_election()
+
+    def status(self) -> dict:
+        """Snapshot for the admin `ha` command / health section."""
+        return {
+            "node_id": self.node_id,
+            "state": self.state,
+            "term": self.term,
+            "leader": self.leader_id,
+            "can_lead": self.can_lead,
+            "peers": sorted(self.peers),
+            "quorum": self.quorum,
+            "elections_started": self.elections_started,
+            "votes_granted": self.votes_granted,
+            "stale_votes_granted": self.stale_votes_granted,
+            "depositions": self.depositions,
+        }
 
     def _start_election(self) -> None:
         self.term += 1
         self.state = CANDIDATE
+        self.elections_started += 1
         self.voted_for = self.node_id
         self._votes = {self.node_id}
         self.log.debug("starting election for term %d", self.term)
@@ -150,6 +194,7 @@ class ElectionNode:
             self.voted_for = None
             if self.state == LEADER:
                 self.log.warning("deposed by higher term %d", term)
+                self.depositions += 1
             self.state = FOLLOWER
         if mtype == "vote_req":
             self._on_vote_req(msg, term)
@@ -168,7 +213,9 @@ class ElectionNode:
                 new_leader = msg.get("leader")
                 leader_changed = new_leader != self.leader_id
                 self.leader_id = new_leader
-                self._last_heartbeat = asyncio.get_running_loop().time()
+                now = asyncio.get_running_loop().time()
+                self._last_heartbeat = now
+                self._leader_seen_at = now
                 if (leader_changed or was_leader) and self.on_follower is not None \
                         and new_leader != self.node_id:
                     asyncio.get_running_loop().create_task(
@@ -178,14 +225,29 @@ class ElectionNode:
     def _on_vote_req(self, msg: dict, term: int) -> None:
         candidate = msg.get("candidate", "")
         cand_version = int(msg.get("version", 0))
+        # uraft rule: never elect a master whose metadata is behind ours
+        up_to_date = cand_version >= int(self.get_version())
+        if not up_to_date and not self.can_lead:
+            leaderless_s = (
+                asyncio.get_running_loop().time() - self._leader_seen_at
+            )
+            if leaderless_s > ARBITER_RELAX_TIMEOUTS * self.election_timeout[1]:
+                self.stale_votes_granted += 1
+                self.log.warning(
+                    "arbiter granting vote to behind candidate %s "
+                    "(v%d < our v%d) after %.1fs without a leader",
+                    candidate, cand_version, int(self.get_version()),
+                    leaderless_s,
+                )
+                up_to_date = True
         granted = (
             term == self.term
             and self.voted_for in (None, candidate)
-            # uraft rule: never elect a master whose metadata is behind ours
-            and cand_version >= int(self.get_version())
+            and up_to_date
         )
         if granted:
             self.voted_for = candidate
+            self.votes_granted += 1
             self._last_heartbeat = asyncio.get_running_loop().time()
         self._send(candidate, {
             "type": "vote", "term": self.term, "granted": granted,

@@ -19,6 +19,7 @@ the floating-IP helper glue).
 import asyncio
 import sys
 
+from lizardfs_tpu import constants
 from lizardfs_tpu.master.server import MasterServer
 from lizardfs_tpu.runtime.config import Config
 from lizardfs_tpu.runtime.daemon import setup_logging
@@ -61,7 +62,11 @@ async def _run(cfg: Config) -> None:
     # fails loudly on a bad file instead of serving half a config
     server.reload(strict=True)
     controller = None
-    if cfg.get_str("ELECTION_ID", ""):
+    # LZ_HA kill switch: off = no election socket, no vote traffic, no
+    # automatic promotion — the daemon behaves exactly like the manual-
+    # promotion tree even with ELECTION_* configured (promote-shadow
+    # over the admin port still works)
+    if cfg.get_str("ELECTION_ID", "") and constants.ha_enabled():
         from lizardfs_tpu.ha.controller import FailoverController
 
         peers = {}
@@ -85,7 +90,18 @@ async def _run(cfg: Config) -> None:
             promote_exec=cfg.get_str("PROMOTE_EXEC", "") or None,
             demote_exec=cfg.get_str("DEMOTE_EXEC", "") or None,
             service_addrs=service_addrs,
+            # RTO tuning knobs (doc/operations.md failover runbook):
+            # detect time is bounded by the randomized election timeout,
+            # steady-state traffic by the heartbeat interval
+            election_timeout=(
+                cfg.get_float("ELECTION_TIMEOUT_MIN", 0.15, min_value=0.01),
+                cfg.get_float("ELECTION_TIMEOUT_MAX", 0.30, min_value=0.02),
+            ),
+            heartbeat_interval=cfg.get_float(
+                "HEARTBEAT_INTERVAL", 0.05, min_value=0.005
+            ),
         )
+        server.ha_controller = controller
     if controller is not None:
         await controller.start()
     try:

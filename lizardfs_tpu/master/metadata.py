@@ -29,6 +29,13 @@ class MetadataStore:
         # re-issues an id whose locks are still held (sessions.mfs
         # analog for the id space; live connection state stays local)
         self.next_session = 1
+        # cluster fencing epoch (uraft term analog): bumped by the
+        # epoch_bump op a freshly elected master commits as its FIRST
+        # write. Every register/heartbeat link carries it, so a zombie
+        # ex-primary (deposed but still running) is refused by its own
+        # former peers instead of having late writes merged. Replicated
+        # through the changelog and persisted in the image.
+        self.epoch = 0
         # tape-copy records (matotsserv analog): inode -> list of
         # {"label","length","mtime","gen","ts"} archival copies;
         # replicated through the changelog and persisted in the image
@@ -316,6 +323,12 @@ class MetadataStore:
     def _op_session_new(self, op):
         self.next_session = max(self.next_session, op["sid"] + 1)
 
+    def _op_epoch_bump(self, op):
+        """Fenced promotion (HA tentpole): a freshly elected master's
+        first committed write claims the new cluster epoch. max() keeps
+        replay monotone even if an old line is re-applied."""
+        self.epoch = max(self.epoch, op["epoch"])
+
     # --- persistence sections --------------------------------------------------
 
     def to_sections(self) -> dict:
@@ -333,6 +346,7 @@ class MetadataStore:
             },
             "quotas": self.quotas.to_dict(),
             "next_session": self.next_session,
+            "epoch": self.epoch,
             "tape": {str(i): c for i, c in self.tape_copies.items() if c},
             "tape_gen": {str(i): g for i, g in self.content_gen.items()},
             "demoted": {str(i): d for i, d in self.demoted.items()},
@@ -368,6 +382,7 @@ class MetadataStore:
         self.quotas = QuotaDatabase.from_dict(doc.get("quotas", {}))
         self.locks = LockManager()
         self.next_session = int(doc.get("next_session", 1))
+        self.epoch = int(doc.get("epoch", 0))
         self.tape_copies = {
             int(i): list(c) for i, c in doc.get("tape", {}).items()
         }
@@ -499,7 +514,7 @@ class MetadataStore:
             # pre-reserves them outside apply() (alloc_inode, chunk-id
             # reservation), and apply maintains them monotonically via
             # max(), so shadows converge on them from the ops alone
-            return self._h("misc", self.next_session)
+            return self._h("misc", self.next_session, self.epoch)
         raise ValueError(f"unknown entity kind {kind!r}")
 
     def _op_synth_populate(self, op):
@@ -756,6 +771,8 @@ class MetadataStore:
                         out.add(("locks", kind, inode))
         elif t == "session_new":
             pass  # misc only
+        elif t == "epoch_bump":
+            pass  # misc only (the epoch rides the misc hash)
         return out
 
     def full_digest(self) -> int:

@@ -330,6 +330,9 @@ class MasterServer(Daemon):
         # cs_id -> the writer whose mirror loop currently owns that
         # server's registration (supersession guard for teardown)
         self._mirror_cs_owner: dict[int, asyncio.StreamWriter] = {}
+        # autopilot failover: set by __main__ when this daemon runs an
+        # ElectionNode (quorum membership); health/admin `ha` read it
+        self.ha_controller = None
         # config file paths for SIGHUP / admin `reload` (cfg_reload
         # analog): keys "goals", "exports", "topology", "iolimits"
         self.config_paths = dict(config_paths or {})
@@ -753,6 +756,16 @@ class MasterServer(Daemon):
                 ),
             )
             return
+        if self.observe_peer_epoch(getattr(first, "epoch", 0)):
+            # the client has seen a newer master than us — we just
+            # stepped down; refuse so it redials the address list
+            await framing.send_message(
+                writer,
+                m.MatoclRegister(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, session_id=0
+                ),
+            )
+            return
         peer = writer.get_extra_info("peername") or ("127.0.0.1", 0)
         rule = self.exports.match(peer[0], getattr(first, "password", ""))
         if rule is None:
@@ -798,6 +811,10 @@ class MasterServer(Daemon):
                 # seeds the client's monotonic-reads floor: a replica
                 # must be at least this caught up to serve this client
                 meta_version=self.changelog.version,
+                # cluster fencing epoch: the client echoes its highest
+                # observed value on every redial, so a zombie ex-primary
+                # it lands on learns of the election and steps down
+                epoch=self.meta.epoch,
             ),
         )
         try:
@@ -805,6 +822,12 @@ class MasterServer(Daemon):
                 try:
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if not self.is_active:
+                    # fenced/demoted mid-session (observe_peer_epoch or
+                    # a lost election): stop serving writes NOW and
+                    # close, so the client's redial loop finds the new
+                    # active instead of a zombie merging late mutations
                     break
                 # fair-share admission: an over-budget tenant's op is
                 # shed with transient BUSY + retry hint BEFORE it costs
@@ -1231,6 +1254,11 @@ class MasterServer(Daemon):
             m.MatoclRegister(
                 req_id=first.req_id, status=st.OK, session_id=session_id,
                 meta_version=self.changelog.version,
+                # shadow's replayed fencing epoch: the client adopts it
+                # and presents it on its next primary (re)dial, so a
+                # zombie ex-primary is fenced even by clients that only
+                # ever reached this replica after the election
+                epoch=self.meta.epoch,
             ),
         )
         served = self.metrics.counter(
@@ -2816,18 +2844,35 @@ class MasterServer(Daemon):
             await framing.send_message(
                 writer,
                 m.MatocsRegisterReply(
-                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0,
+                    epoch=self.meta.epoch,
                 ),
             )
             return
         if getattr(first, "mirror", 0):
             # a mirror link never carries commands; the ACTIVE must not
             # adopt one as a command link (its pushes would be dropped
-            # by the peer's pump) — refuse so the chunkserver backs off
+            # by the peer's pump) — refuse so the chunkserver backs off.
+            # The refusal CARRIES our epoch: a chunkserver mirror-dialing
+            # a freshly promoted master learns of the election from this
+            # very reply and flips the address mirror->command (fencing
+            # its old command link to the deposed ex-primary).
             await framing.send_message(
                 writer,
                 m.MatocsRegisterReply(
-                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0,
+                    epoch=self.meta.epoch,
+                ),
+            )
+            return
+        if self.observe_peer_epoch(getattr(first, "epoch", 0)):
+            # this chunkserver has seen a newer master — we just fenced
+            # ourselves; refuse so its link cycles to the real active
+            await framing.send_message(
+                writer,
+                m.MatocsRegisterReply(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE, cs_id=0,
+                    epoch=self.meta.epoch,
                 ),
             )
             return
@@ -2846,7 +2891,10 @@ class MasterServer(Daemon):
         )
         await framing.send_message(
             writer,
-            m.MatocsRegisterReply(req_id=first.req_id, status=st.OK, cs_id=srv.cs_id),
+            m.MatocsRegisterReply(
+                req_id=first.req_id, status=st.OK, cs_id=srv.cs_id,
+                epoch=self.meta.epoch,
+            ),
         )
         self.log.info(
             "chunkserver %d registered (%s:%d, %d parts, %d stale)",
@@ -2874,9 +2922,21 @@ class MasterServer(Daemon):
                     msg = await framing.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                if not self.is_active:
+                    # demoted mid-link (fenced, or lost an election):
+                    # a shadow must never hold a command link — close
+                    # so the chunkserver's heartbeat loop re-cycles the
+                    # address list and finds the new active
+                    break
                 if isinstance(msg, m.CstomaChunkOpStatus):
                     link.dispatch_ack(msg)
                 elif isinstance(msg, m.CstomaHeartbeat):
+                    if self.observe_peer_epoch(getattr(msg, "epoch", 0)):
+                        # the chunkserver heard of a newer election than
+                        # we did (its heartbeat echoes the max epoch it
+                        # has observed) — we just stepped down; drop the
+                        # command link instead of acking as active
+                        break
                     srv.total_space = msg.total_space
                     srv.used_space = msg.used_space
                     if getattr(msg, "health_json", ""):
@@ -2904,6 +2964,10 @@ class MasterServer(Daemon):
                             # budgets changed live propagate within one
                             # heartbeat ("" when off/unconfigured)
                             qos_json=self._qos_cs_json(),
+                            # fencing epoch refresh: every heartbeat ack
+                            # re-stamps the cluster epoch so the fleet
+                            # converges on it within one interval
+                            epoch=self.meta.epoch,
                         )
                     )
                 elif isinstance(msg, (m.CstomaChunkDamaged, m.CstomaChunkLost)):
@@ -2977,7 +3041,11 @@ class MasterServer(Daemon):
             await framing.send_message(
                 writer,
                 m.MatocsRegisterReply(
-                    req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                    req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id,
+                    # shadow's replayed epoch: keeps mirror-registered
+                    # chunkservers fencing-current even before this
+                    # node is ever promoted
+                    epoch=self.meta.epoch,
                 ),
             )
             return srv
@@ -3003,7 +3071,8 @@ class MasterServer(Daemon):
                     srv.used_space = msg.used_space
                     await framing.send_message(
                         writer, m.MatocsRegisterReply(
-                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id
+                            req_id=msg.req_id, status=st.OK, cs_id=srv.cs_id,
+                            epoch=self.meta.epoch,
                         )
                     )
                 elif isinstance(msg, (m.CstomaChunkDamaged, m.CstomaChunkLost)):
@@ -3457,6 +3526,18 @@ class MasterServer(Daemon):
     # --- health loop (ChunkWorker analog) ----------------------------------------------
 
     async def _health_tick(self) -> None:
+        # HA posture gauges are set on EVERY personality — during a
+        # failover the node an operator is watching is precisely the
+        # one that is NOT (yet) active
+        self.metrics.gauge(
+            "ha_epoch",
+            help="cluster fencing epoch this node has applied (bumped "
+                 "by every promotion; 0 = pre-HA / LZ_HA off)",
+        ).set(self.meta.epoch)
+        self.metrics.gauge(
+            "ha_is_active",
+            help="1 when this node serves as the active master",
+        ).set(int(self.is_active))
         if not self.is_active:
             return
         self.metrics.gauge("chunks").set(len(self.meta.registry.chunks))
@@ -3827,11 +3908,28 @@ class MasterServer(Daemon):
     # --- shadow / metalogger stream (matomlserv analog) ---------------------------------
 
     async def _shadow_loop(self, reader, writer, first: m.MltomaRegister) -> None:
+        if self.observe_peer_epoch(getattr(first, "epoch", 0)):
+            # the registering shadow/metalogger has replayed a NEWER
+            # epoch_bump than our own state — a later election happened
+            # without us. We just stepped down; refuse the stream (a
+            # zombie feeding changelog lines would fork its follower).
+            await framing.send_message(
+                writer,
+                m.MatomlRegisterReply(
+                    req_id=first.req_id, status=st.NOT_POSSIBLE,
+                    version=self.changelog.version, epoch=self.meta.epoch,
+                ),
+            )
+            return
         self.shadow_writers.append(writer)
         await framing.send_message(
             writer,
             m.MatomlRegisterReply(
-                req_id=first.req_id, status=st.OK, version=self.changelog.version
+                req_id=first.req_id, status=st.OK,
+                version=self.changelog.version,
+                # followers compare this against their replayed epoch:
+                # lower than theirs = we are the zombie, they refuse us
+                epoch=self.meta.epoch,
             ),
         )
         try:
@@ -3962,11 +4060,29 @@ class MasterServer(Daemon):
         try:
             await framing.send_message(
                 writer,
-                m.MltomaRegister(req_id=1, version_known=self.changelog.version),
+                m.MltomaRegister(
+                    req_id=1, version_known=self.changelog.version,
+                    # our replayed cluster epoch: a deposed ex-primary we
+                    # accidentally dial sees it is behind and steps down
+                    epoch=self.meta.epoch,
+                ),
             )
             hello = await framing.read_message(reader)
             if not isinstance(hello, m.MatomlRegisterReply) or hello.status != st.OK:
                 raise ConnectionError("active master rejected shadow registration")
+            if (
+                constants_mod.ha_enabled()
+                and getattr(hello, "epoch", 0)
+                and hello.epoch < self.meta.epoch
+            ):
+                # zombie active: it never applied the epoch_bump we
+                # replayed — following it would fork our history off the
+                # elected leader's. Drop the link; the follow loop (or
+                # the failover controller's next leader event) re-points.
+                raise ConnectionError(
+                    f"refusing stale active (epoch {hello.epoch} < "
+                    f"ours {self.meta.epoch})"
+                )
             if (
                 hello.version > self.changelog.version
                 or getattr(self, "_force_image_download", False)
@@ -4048,6 +4164,31 @@ class MasterServer(Daemon):
         self.meta.apply(op)
         self.changelog.append(op)  # assigns the same version, persists
 
+    def observe_peer_epoch(self, peer_epoch: int) -> bool:
+        """Zombie-fencing input: every register/heartbeat surface feeds
+        the peer's highest observed cluster epoch here. An ACTIVE master
+        seeing a HIGHER epoch than its own has been superseded by an
+        election it never heard (partitioned ex-primary): it steps down
+        to shadow on the spot — all mutating timers and loops guard on
+        ``is_active``, so demotion mid-run is safe — instead of merging
+        late writes into a forked history. Returns True when the caller
+        must refuse/close its link (we just fenced ourselves).
+
+        Epoch 0 is a pre-HA peer (or LZ_HA off end to end): fencing
+        disengaged, byte-for-byte the manual-promotion behavior."""
+        if not peer_epoch or not constants_mod.ha_enabled():
+            return False
+        if self.is_active and peer_epoch > self.meta.epoch:
+            self.log.error(
+                "FENCED: peer reports cluster epoch %d > our %d — a newer "
+                "master was elected; stepping down to shadow",
+                peer_epoch, self.meta.epoch,
+            )
+            self.metrics.counter("ha_fenced").inc()
+            self.personality = "shadow"
+            return True
+        return False
+
     def promote(self) -> None:
         """Shadow -> active master (promoteAutoToMaster analog,
         personality.h:69). Chunkservers and clients find us by cycling
@@ -4067,7 +4208,19 @@ class MasterServer(Daemon):
                 w.close()
             except Exception:  # noqa: BLE001 — already dead is fine
                 pass
-        self.log.info("promoted to active master at v%d", self.changelog.version)
+        if constants_mod.ha_enabled():
+            # fenced promotion: the new active's FIRST committed write
+            # claims the next cluster epoch. It rides the changelog
+            # (replayed by every shadow/metalogger) and is stamped on
+            # every register/heartbeat ack from here on, so a zombie
+            # ex-primary's links are refused by its own peers. With
+            # LZ_HA off no bump is committed and every epoch field
+            # stays 0 — manual promotion behaves exactly as before.
+            self.commit({"op": "epoch_bump", "epoch": self.meta.epoch + 1})
+        self.log.info(
+            "promoted to active master at v%d (epoch %d)",
+            self.changelog.version, self.meta.epoch,
+        )
 
     def follow(self, addr: tuple[str, int]) -> None:
         """(Re-)point this node at the CURRENT active master and stream
@@ -4136,6 +4289,22 @@ class MasterServer(Daemon):
         if isinstance(msg, m.AdminCommand):
             reply = await self._admin_command(msg)
             await framing.send_message(writer, reply)
+
+    def _ha_status(self) -> dict:
+        """The `ha` section of health / the admin `ha` command: this
+        node's failover posture. Always present (operators check it
+        FIRST during an incident); election fields appear only when a
+        FailoverController is wired (__main__ with ELECTION_ID)."""
+        doc: dict = {
+            "enabled": constants_mod.ha_enabled(),
+            "personality": self.personality,
+            "epoch": self.meta.epoch,
+            "fenced": int(self.metrics.counter("ha_fenced").total),
+        }
+        ctrl = self.ha_controller
+        if ctrl is not None:
+            doc.update(ctrl.status())
+        return doc
 
     def cluster_health(self, evaluate_chunks: bool = True) -> dict:
         """The cluster-wide health rollup: this master's own snapshot,
@@ -4271,6 +4440,7 @@ class MasterServer(Daemon):
             "gateways": gateways,
             "qos": qos_doc,
             "heat": heat_doc,
+            "ha": self._ha_status(),
             "tape": {
                 "servers": len(self.ts_links),
                 "pending": len(self.tape_pending),
@@ -4437,6 +4607,14 @@ class MasterServer(Daemon):
             return m.AdminReply(
                 req_id=msg.req_id, status=st.OK,
                 json=json.dumps(self.cluster_health()),
+            )
+        if msg.command == "ha":
+            # failover posture: personality, cluster epoch, election
+            # state (term/leader/quorum when a controller is wired),
+            # promotion/fencing counters — `lizardfs-admin ha`
+            return m.AdminReply(
+                req_id=msg.req_id, status=st.OK,
+                json=json.dumps(self._ha_status()),
             )
         if msg.command == "qos":
             # show/set fair-share weights and limits LIVE (the tweaks

@@ -34,6 +34,12 @@ class Metalogger:
         self.master_addrs = master_addrs
         self.image_interval = image_interval
         self.version = 0
+        # highest cluster fencing epoch seen in the archived stream
+        # (epoch_bump lines / image sections): a master whose reply
+        # epoch is BEHIND this is a deposed ex-primary — refuse to
+        # follow it, or the archive forks off the elected leader's
+        # history. 0 until the first promotion = fencing disengaged.
+        self.epoch = 0
         self._log_file = None
         self._task: asyncio.Task | None = None
         self._stopping = asyncio.Event()
@@ -49,6 +55,20 @@ class Metalogger:
                     parsed = Changelog.parse_line(line)
                     if parsed:
                         self.version = max(self.version, parsed[0])
+                        self._note_epoch(parsed[1])
+
+    def _note_epoch(self, line: str) -> None:
+        """Fold an archived changelog line into the known cluster epoch
+        (substring pre-filter: one json.loads per PROMOTION, not per
+        line)."""
+        if '"epoch_bump"' not in line:
+            return
+        try:
+            op = json.loads(line)
+        except ValueError:
+            return
+        if op.get("op") == "epoch_bump":
+            self.epoch = max(self.epoch, int(op.get("epoch", 0)))
 
     def _append(self, version: int, line: str) -> None:
         if self._log_file is None:
@@ -60,6 +80,16 @@ class Metalogger:
         self._log_file.write(f"{version}: {line}\n")
         self._log_file.flush()
         self.version = version
+        self._note_epoch(line)
+
+    def prefer(self, addr: tuple[str, int]) -> None:
+        """Move an address to the front of the follow cycle. The
+        election wiring calls this when a leader is named, so the next
+        (re)connect lands on the elected master first instead of
+        probing deposed peers in config order."""
+        if addr in self.master_addrs and self.master_addrs[0] != addr:
+            self.master_addrs.remove(addr)
+            self.master_addrs.insert(0, addr)
 
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -93,11 +123,25 @@ class Metalogger:
         )
         try:
             await framing.send_message(
-                writer, m.MltomaRegister(req_id=1, version_known=self.version)
+                writer, m.MltomaRegister(
+                    req_id=1, version_known=self.version,
+                    # our replayed epoch: a zombie we dial steps down
+                    epoch=self.epoch,
+                )
             )
             hello = await framing.read_message(reader)
             if not isinstance(hello, m.MatomlRegisterReply) or hello.status != st.OK:
                 raise ConnectionError("not the active master")
+            hello_epoch = getattr(hello, "epoch", 0)
+            if hello_epoch and hello_epoch < self.epoch:
+                # deposed ex-primary: it never applied the epoch_bump we
+                # already archived — its lines would fork our archive off
+                # the elected leader's history. Try the next address.
+                raise ConnectionError(
+                    f"refusing stale active (epoch {hello_epoch} < "
+                    f"ours {self.epoch})"
+                )
+            self.epoch = max(self.epoch, hello_epoch)
             self.log.info("following master at %s:%d (v%d)", *addr, hello.version)
             last_image = 0.0
             loop = asyncio.get_running_loop()
@@ -115,6 +159,9 @@ class Metalogger:
                     doc = json.loads(msg.image.decode())
                     doc.pop("format", None)  # save_image stamps its own
                     save_image(self.data_dir, msg.version, doc)
+                    # the image's epoch section covers promotions whose
+                    # epoch_bump line predates our archive window
+                    self.epoch = max(self.epoch, int(doc.get("epoch", 0)))
                     self.log.info("archived metadata image v%d", msg.version)
         finally:
             await retrymod.close_writer(writer, swallow_cancel=True)

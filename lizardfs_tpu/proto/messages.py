@@ -97,7 +97,13 @@ class CltomaRegister(Message):
     to be served by a shadow master in read-replica mode — the shadow
     accepts the (primary-issued) ``session_id`` without committing a
     session allocation and serves only the read-mostly RPC allowlist.
-    Old peers send 0 and are refused by shadows as before."""
+    Old peers send 0 and are refused by shadows as before.
+
+    ``epoch`` (trailing, skew-tolerant): the highest cluster fencing
+    epoch the client has observed (see MatoclRegister). A master whose
+    own epoch is LOWER refuses the registration — it is a zombie
+    ex-primary a later election superseded. 0 = pre-HA peer / no
+    election has ever run (fencing never engages)."""
 
     MSG_TYPE = 1000
     SKEW_TOLERANT_FROM = 4
@@ -107,13 +113,19 @@ class CltomaRegister(Message):
         ("info", "str"),
         ("password", "str"),
         ("replica_ok", "u8"),
+        ("epoch", "u64"),
     )
 
 
 class MatoclRegister(Message):
     # trailing ``meta_version``: the serving master's applied changelog
     # position — seeds the client's monotonic-reads floor (see
-    # MatoclAttrReply); old masters send 0 = no floor
+    # MatoclAttrReply); old masters send 0 = no floor.
+    # trailing ``epoch``: the serving master's cluster fencing epoch
+    # (epoch_bump changelog op, HA failover). The client keeps the max
+    # it has ever seen and presents it on every redial, so a zombie
+    # ex-primary can never re-adopt a client that outlived it. Old
+    # masters send 0.
     MSG_TYPE = 1001
     SKEW_TOLERANT_FROM = 3
     FIELDS = (
@@ -121,6 +133,7 @@ class MatoclRegister(Message):
         ("status", "u8"),
         ("session_id", "u64"),
         ("meta_version", "u64"),
+        ("epoch", "u64"),
     )
 
 
@@ -896,7 +909,12 @@ class CstomaRegister(Message):
     active master refuses mirror registrations (a command-less link
     must never be mistaken for a command link) and shadows refuse
     non-mirror ones (a chunkserver's main link must keep cycling to
-    the active). Old peers send 0 = normal registration."""
+    the active). Old peers send 0 = normal registration.
+
+    ``epoch`` (trailing, skew-tolerant): the highest cluster fencing
+    epoch the chunkserver has observed. An active master with a LOWER
+    epoch refuses the registration and steps down — the chunkserver is
+    telling it a later election happened. 0 = pre-HA peer."""
 
     MSG_TYPE = 1100
     SKEW_TOLERANT_FROM = 7
@@ -911,6 +929,7 @@ class CstomaRegister(Message):
         # go to the control port's asyncio server)
         ("data_port", "u16"),
         ("mirror", "u8"),
+        ("epoch", "u64"),
     )
 
 
@@ -923,13 +942,18 @@ class MatocsRegisterReply(Message):
     pacing — refreshed on every heartbeat ack so weights/limits changed
     live (admin `qos` / SIGHUP) propagate within one heartbeat. Old
     peers send/receive "" and stay unthrottled (fail-open: QoS degrades
-    to the pre-QoS behavior, never to a lockout)."""
+    to the pre-QoS behavior, never to a lockout).
+
+    ``epoch`` (trailing, skew-tolerant): the replying master's cluster
+    fencing epoch — stamped on registration AND heartbeat acks (mirror
+    acks included), so a chunkserver learns of a promotion within one
+    heartbeat and fences its stale command link. Old masters send 0."""
 
     MSG_TYPE = 1101
     SKEW_TOLERANT_FROM = 3
     FIELDS = (
         ("req_id", "u32"), ("status", "u8"), ("cs_id", "u32"),
-        ("qos_json", "str"),
+        ("qos_json", "str"), ("epoch", "u64"),
     )
 
 
@@ -945,7 +969,12 @@ class CstomaHeartbeat(Message):
     accumulated since the last heartbeat — feeding the master's heat
     tracker (master/heat.py). "" when LZ_HEAT is off (heartbeats stay
     byte-identical to the pre-heat wire) or from an old peer, which
-    reads as no data-plane heat observed."""
+    reads as no data-plane heat observed.
+
+    ``epoch`` (trailing, skew-tolerant): the chunkserver's highest
+    observed fencing epoch, echoed back at the master on every beat —
+    a deposed ex-primary hears about the election it lost from its own
+    chunkservers and steps down. 0 = pre-HA peer."""
 
     MSG_TYPE = 1102
     SKEW_TOLERANT_FROM = 4
@@ -956,6 +985,7 @@ class CstomaHeartbeat(Message):
         ("used_space", "u64"),
         ("health_json", "str"),
         ("heat_json", "str"),
+        ("epoch", "u64"),
     )
 
 
@@ -1305,13 +1335,23 @@ class CltocsWriteEnd(Message):
 
 
 class MltomaRegister(Message):
+    # trailing ``epoch``: the follower's highest observed fencing epoch
+    # (HA failover). An active master with a lower epoch refuses the
+    # follow link and steps down — it was superseded. 0 = pre-HA peer.
     MSG_TYPE = 1300
-    FIELDS = (("req_id", "u32"), ("version_known", "u64"))
+    SKEW_TOLERANT_FROM = 2
+    FIELDS = (("req_id", "u32"), ("version_known", "u64"),
+              ("epoch", "u64"))
 
 
 class MatomlRegisterReply(Message):
+    # trailing ``epoch``: the serving master's fencing epoch. A
+    # follower that already knows a HIGHER epoch treats this "active"
+    # as a zombie and keeps cycling its address list. 0 = pre-HA peer.
     MSG_TYPE = 1304
-    FIELDS = (("req_id", "u32"), ("status", "u8"), ("version", "u64"))
+    SKEW_TOLERANT_FROM = 3
+    FIELDS = (("req_id", "u32"), ("status", "u8"), ("version", "u64"),
+              ("epoch", "u64"))
 
 
 class MatomlChangelogLine(Message):

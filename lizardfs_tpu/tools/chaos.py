@@ -42,6 +42,14 @@ Schedules:
                  chunk (real extra replicas via the RebuildEngine),
                  read p99 holds through the storm with byte identity,
                  and demotion lands once the heat decays
+  kill-primary   SIGKILL the ACTIVE master of an elected master+shadow+
+                 metalogger quorum with a windowed ec(8,4) write stream,
+                 a rebuild, and a multipart upload all in flight: the
+                 survivor SELF-promotes (no operator), chunkservers and
+                 clients converge on it, zero acknowledged writes are
+                 lost, and the detect->elect->promote->first-acked-write
+                 outage is measured and bounded (the
+                 cluster_failover_rto_s bench fiducial shares this drill)
 """
 
 from __future__ import annotations
@@ -101,15 +109,20 @@ class ChaosCluster:
     when rules are armed at startup)."""
 
     def __init__(self, tmp: str, n_cs: int = 4, shadow: bool = False,
-                 qos_cfg: str | None = None):
+                 qos_cfg: str | None = None, ha: bool = False):
         self.tmp = tmp
         self.n_cs = n_cs
-        self.want_shadow = shadow
+        # ha: full autopilot quorum — master + shadow masters running
+        # FailoverControllers plus a vote-only metalogger, all wired
+        # through ELECTION_* config. Whoever wins the boot election is
+        # the active; use active_master_port() to find it.
+        self.ha = ha
+        self.want_shadow = shadow or ha
         # JSON QoS config (runtime/qos.py parse_config schema): written
         # to disk and wired as the master's QOS_CFG
         self.qos_cfg = qos_cfg
         self.master_port = _free_port()
-        self.shadow_port = _free_port() if shadow else None
+        self.shadow_port = _free_port() if self.want_shadow else None
         self.cs_ports: list[int] = []
         self.procs: dict[str, subprocess.Popen] = {}
 
@@ -126,20 +139,45 @@ class ChaosCluster:
             stderr=subprocess.STDOUT, env=env,
         )
 
+    def _ha_cfg(self, node_id: str) -> str:
+        """ELECTION_*/MASTER_PEERS lines for one quorum member (na =
+        the boot master, nb = the boot shadow, nw = the metalogger)."""
+        peers = ",".join(
+            f"{nid}=127.0.0.1:{port}"
+            for nid, port in self.election_ports.items() if nid != node_id
+        )
+        return (
+            f"ELECTION_ID = {node_id}\n"
+            f"ELECTION_LISTEN = 127.0.0.1:{self.election_ports[node_id]}\n"
+            f"ELECTION_PEERS = {peers}\n"
+            f"MASTER_PEERS = na=127.0.0.1:{self.master_port},"
+            f"nb=127.0.0.1:{self.shadow_port}\n"
+            # RTO knobs: roomy enough that a loaded CI box's scheduling
+            # hiccups don't trigger spurious elections mid-drill
+            "ELECTION_TIMEOUT_MIN = 0.3\n"
+            "ELECTION_TIMEOUT_MAX = 0.6\n"
+            "HEARTBEAT_INTERVAL = 0.1\n"
+        )
+
     async def start(self) -> None:
         with open(os.path.join(self.tmp, "goals.cfg"), "w") as f:
-            f.write("1 one : _\n5 ec32 : $ec(3,2)\n")
+            f.write("1 one : _\n5 ec32 : $ec(3,2)\n12 ec84 : $ec(8,4)\n")
         qos_line = ""
         if self.qos_cfg is not None:
             with open(os.path.join(self.tmp, "qos.cfg"), "w") as f:
                 f.write(self.qos_cfg)
             qos_line = f"QOS_CFG = {self.tmp}/qos.cfg\n"
+        if self.ha:
+            self.election_ports = {
+                nid: _free_port() for nid in ("na", "nb", "nw")
+            }
         self._spawn(
             "master", "lizardfs_tpu.master",
             f"DATA_PATH = {self.tmp}/master\n"
             f"LISTEN_PORT = {self.master_port}\n"
             f"GOALS_CFG = {self.tmp}/goals.cfg\n"
-            "HEALTH_INTERVAL = 0.3\n" + qos_line,
+            "HEALTH_INTERVAL = 0.3\n" + qos_line
+            + (self._ha_cfg("na") if self.ha else ""),
         )
         await self._wait_port(self.master_port)
         if self.want_shadow:
@@ -150,9 +188,27 @@ class ChaosCluster:
                 f"GOALS_CFG = {self.tmp}/goals.cfg\n"
                 "PERSONALITY = shadow\n"
                 f"ACTIVE_MASTER = 127.0.0.1:{self.master_port}\n"
-                "HEALTH_INTERVAL = 0.3\n",
+                "HEALTH_INTERVAL = 0.3\n"
+                + (self._ha_cfg("nb") if self.ha else ""),
             )
             await self._wait_port(self.shadow_port)
+        if self.ha:
+            self._spawn(
+                "metalogger", "lizardfs_tpu.metalogger",
+                f"DATA_PATH = {self.tmp}/metalogger\n"
+                f"MASTER_ADDRS = 127.0.0.1:{self.master_port},"
+                f"127.0.0.1:{self.shadow_port}\n"
+                "IMAGE_INTERVAL = 5.0\n" + self._ha_cfg("nw"),
+            )
+            # the boot election must settle before chunkservers spawn:
+            # they register with whichever master holds the leadership
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if await self.active_master_port() is not None:
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise AssertionError("boot election never settled")
         addrs = f"127.0.0.1:{self.master_port}"
         if self.want_shadow:
             addrs += f",127.0.0.1:{self.shadow_port}"
@@ -174,9 +230,31 @@ class ChaosCluster:
             await asyncio.sleep(0.1)
         raise AssertionError("chunkservers never registered")
 
+    async def active_master_port(self) -> int | None:
+        """The service port of whichever master currently holds the
+        leadership (HA topologies only; either may have won)."""
+        for port in (self.master_port, self.shadow_port):
+            if port is None:
+                continue
+            try:
+                doc = json.loads((await admin(port, "ha")).json)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                continue
+            # both conditions: a boot master that just LOST the first
+            # election still reports personality=master for a beat
+            if doc.get("personality") == "master" \
+                    and doc.get("state") == "leader":
+                return port
+        return None
+
     async def _cs_count(self) -> int:
+        port = self.master_port
+        if self.ha:
+            port = await self.active_master_port()
+            if port is None:
+                return 0
         try:
-            reply = await admin(self.master_port, "info")
+            reply = await admin(port, "info")
             return sum(
                 1 for s in json.loads(reply.json)["chunkservers"]
                 if s["connected"] and not s.get("mirror")
@@ -715,6 +793,222 @@ async def run_hot_spot(cluster: ChaosCluster, rng: random.Random,
         await c.close()
 
 
+# kill-primary bound: the whole detect -> elect -> promote -> first-
+# acked-write outage, wall clock, on a loaded CI box (the election
+# itself settles in ~1s with the drill's 0.3-0.6s timeouts; the rest is
+# client redial + re-register + the first windowed write completing)
+KILL_PRIMARY_RTO_S = 45.0
+
+
+async def run_kill_primary(cluster: ChaosCluster, rng: random.Random,
+                           log) -> dict:
+    """SIGKILL the ACTIVE master of an elected master+shadow+metalogger
+    quorum while a windowed ec(8,4) write stream, a rebuild, and a
+    multipart upload are ALL in flight. The survivor must SELF-promote
+    (no operator command anywhere), chunkservers and clients must
+    converge on it, ZERO acknowledged writes may be lost, the fenced
+    epoch must be claimed, and the detect->elect->promote->first-acked-
+    write outage must fit inside KILL_PRIMARY_RTO_S. Returns the RTO
+    doc (the cluster_failover_rto_s bench fiducial reuses this drill).
+    """
+    from lizardfs_tpu.proto import status as st
+    from lizardfs_tpu.s3.client import S3Client, S3Error
+    from lizardfs_tpu.s3.server import S3Gateway
+
+    active_port = await cluster.active_master_port()
+    assert active_port is not None, "no elected active master"
+    active_name = (
+        "master" if active_port == cluster.master_port else "shadow"
+    )
+    survivor_port = (
+        cluster.shadow_port if active_name == "master"
+        else cluster.master_port
+    )
+    log(f"  active is the '{active_name}' process (:{active_port})")
+
+    c = await _client(cluster, shadow=True)
+    # S3 gateway for the mid-multipart leg: its embedded client must
+    # know BOTH masters or it can never converge after the kill
+    gw = S3Gateway("127.0.0.1", cluster.master_port)
+    gw.client.master_addrs = [
+        ("127.0.0.1", cluster.master_port),
+        ("127.0.0.1", cluster.shadow_port),
+    ]
+    await gw.start()
+    s3 = S3Client("127.0.0.1", gw.port)
+    acked: list[tuple[str, bytes]] = []
+    stop_writes = asyncio.Event()
+    t_kill = [0.0]
+    t_first_ack = [0.0]
+    try:
+        # --- continuous windowed ec(8,4) write stream ------------------
+        async def writer() -> None:
+            seq = 0
+            while not stop_writes.is_set():
+                name = f"wr_{seq}.bin"
+                # payload derived from seq, not rng: draws inside a
+                # concurrent task would make the schedule's rng stream
+                # depend on kill timing and break seeded replay
+                payload = _payload(1000 + seq, 192 * 1024 + 7 * seq)
+                while not stop_writes.is_set():
+                    try:
+                        try:
+                            f = await c.create(1, name)
+                        except st.StatusError as e:
+                            # created on the old master before it died:
+                            # the name exists, the bytes may not
+                            if e.code != st.EEXIST:
+                                raise
+                            f = await c.lookup(1, name)
+                        await c.setgoal(f.inode, 12)  # ec(8,4), windowed
+                        await c.write_file(f.inode, payload)
+                    except (ConnectionError, OSError, st.StatusError,
+                            asyncio.TimeoutError):
+                        await asyncio.sleep(0.1)
+                        continue
+                    # ACKNOWLEDGED: from here on this write may never
+                    # be lost, whatever dies
+                    acked.append((name, payload))
+                    if t_kill[0] and not t_first_ack[0]:
+                        t_first_ack[0] = time.monotonic()
+                    break
+                seq += 1
+                await asyncio.sleep(0.05)
+
+        writer_task = asyncio.ensure_future(writer())
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(acked) < 3:
+            await asyncio.sleep(0.1)
+        assert len(acked) >= 3, "baseline write stream never flowed"
+
+        # --- mid-multipart leg: upload part 1 of 3, then the kill ------
+        await s3.create_bucket("chaos")
+        await s3.put_object("chaos", "warmup", b"x")
+        mpu_client_root = await c.resolve("/chaos")
+        await c.setgoal(mpu_client_root.inode, 12)
+        staging = await c.resolve("/.s3mpu")
+        await c.setgoal(staging.inode, 12)
+        parts = [
+            _payload(rng.randrange(1 << 20), 2 * 2**20 + rng.randrange(999))
+            for _ in range(3)
+        ]
+        upload = await s3.create_multipart("chaos", "obj")
+        etags = [(1, await s3.upload_part("chaos", "obj", upload, 1,
+                                          parts[0]))]
+
+        # --- mid-rebuild leg: lose a chunkserver just before the kill --
+        cs_victim = rng.randrange(cluster.n_cs)
+        cluster.kill9(f"cs{cs_victim}")
+        log(f"  SIGKILL cs{cs_victim} (rebuild in flight at the kill)")
+        await asyncio.sleep(0.3)
+
+        # --- THE KILL --------------------------------------------------
+        log(f"  SIGKILL the active '{active_name}' master")
+        t_kill[0] = time.monotonic()
+        cluster.kill9(active_name)
+
+        # the survivor must promote ITSELF: no admin command from here
+        promote_s = None
+        deadline = time.monotonic() + KILL_PRIMARY_RTO_S
+        while time.monotonic() < deadline:
+            try:
+                doc = json.loads((await admin(survivor_port, "ha")).json)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                doc = {}
+            if doc.get("personality") == "master" \
+                    and doc.get("state") == "leader":
+                promote_s = time.monotonic() - t_kill[0]
+                break
+            await asyncio.sleep(0.1)
+        assert promote_s is not None, "survivor never self-promoted"
+        assert doc.get("promotions", 0) >= 1, doc
+        assert doc.get("epoch", 0) >= 1, f"promotion not fenced: {doc}"
+        epoch = doc["epoch"]
+
+        # first acknowledged write AFTER the kill: the measured RTO
+        while time.monotonic() < deadline and not t_first_ack[0]:
+            await asyncio.sleep(0.05)
+        assert t_first_ack[0], "write stream never resumed"
+        rto_s = t_first_ack[0] - t_kill[0]
+        log(f"  promote {promote_s:.2f}s, first acked write {rto_s:.2f}s")
+        assert rto_s <= KILL_PRIMARY_RTO_S, f"RTO {rto_s:.1f}s"
+
+        # the in-flight multipart upload completes byte-identically
+        # through the promoted master (the gateway's client redials)
+        mpu_deadline = time.monotonic() + 60.0
+        for part_n in (2, 3):
+            while True:
+                try:
+                    etags.append((part_n, await s3.upload_part(
+                        "chaos", "obj", upload, part_n, parts[part_n - 1]
+                    )))
+                    break
+                except S3Error:
+                    assert time.monotonic() < mpu_deadline, \
+                        "multipart upload never recovered"
+                    await asyncio.sleep(0.3)
+        while True:
+            try:
+                await s3.complete_multipart("chaos", "obj", upload, etags)
+                break
+            except S3Error:
+                assert time.monotonic() < mpu_deadline, \
+                    "multipart complete never recovered"
+                await asyncio.sleep(0.3)
+        got = await s3.get_object("chaos", "obj")
+        assert got.body == b"".join(parts), \
+            "multipart byte identity across the failover"
+
+        # every surviving chunkserver re-registers with the new active
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if await cluster._cs_count() >= cluster.n_cs - 1:
+                break
+            await asyncio.sleep(0.2)
+        assert await cluster._cs_count() >= cluster.n_cs - 1, \
+            "chunkservers never converged on the new active"
+
+        # stop the stream; ZERO acknowledged-write loss: every acked
+        # file reads back byte-identical through the new active (the
+        # cs kill leg makes some of these degraded ec(8,4) reads)
+        stop_writes.set()
+        await writer_task
+        for name, payload in acked:
+            node = await c.lookup(1, name)
+            c.cache.invalidate(node.inode)
+            got = await c.read_file(node.inode)
+            assert got == payload, f"acked write {name} lost or torn"
+        log(f"  all {len(acked)} acknowledged writes intact")
+
+        # rebuild convergence on the NEW master: the first stream
+        # file's redundancy is restored to all 12 ec(8,4) parts
+        first = await c.lookup(1, acked[0][0])
+        await _wait_redundant(c, first.inode, expected_parts=12,
+                              timeout=90.0)
+
+        # observability: the promoted master's health names the HA
+        # standing, and the metrics page exports the epoch gauge
+        health = json.loads((await admin(survivor_port, "health")).json)
+        assert health.get("ha", {}).get("epoch") == epoch, health.get("ha")
+        prom = json.loads(
+            (await admin(survivor_port, "metrics-prom")).json
+        )["text"]
+        assert "lizardfs_ha_epoch" in prom, "ha gauges missing"
+        return {
+            "rto_s": round(rto_s, 2),
+            "promote_s": round(promote_s, 2),
+            "epoch": epoch,
+            "acked_writes": len(acked),
+            "lost_writes": 0,
+            "rto_budget_s": KILL_PRIMARY_RTO_S,
+        }
+    finally:
+        stop_writes.set()
+        await s3.close()
+        await gw.stop()
+        await c.close()
+
+
 SCHEDULES = {
     "kill-write": (run_kill_write, dict(n_cs=4)),
     "bitflip-read": (run_bitflip_read, dict(n_cs=3)),
@@ -724,13 +1018,16 @@ SCHEDULES = {
     "noisy-neighbor": (run_noisy_neighbor,
                        dict(n_cs=2, qos_cfg=NOISY_QOS_CFG)),
     "hot-spot": (run_hot_spot, dict(n_cs=3)),
+    "kill-primary": (run_kill_primary, dict(n_cs=5, ha=True)),
 }
 
 
 async def run_schedule(name: str, seed: int, workdir: str | None = None,
-                       log=print) -> None:
+                       log=print):
     """Run one schedule at one seed; raises on any invariant violation.
-    The whole run sits under the bounded-time budget."""
+    The whole run sits under the bounded-time budget. Returns whatever
+    the schedule returns (kill-primary's RTO doc feeds the
+    cluster_failover_rto_s bench fiducial; the rest return None)."""
     fn, topo = SCHEDULES[name]
     rng = random.Random(seed)
     tmp_ctx = (
@@ -740,16 +1037,18 @@ async def run_schedule(name: str, seed: int, workdir: str | None = None,
     tmp = workdir if workdir is not None else tmp_ctx.name
     cluster = ChaosCluster(tmp, **topo)
     try:
-        await asyncio.wait_for(_run_body(cluster, fn, rng, log), BUDGET_S)
+        return await asyncio.wait_for(
+            _run_body(cluster, fn, rng, log), BUDGET_S
+        )
     finally:
         cluster.stop()
         if tmp_ctx is not None:
             tmp_ctx.cleanup()
 
 
-async def _run_body(cluster, fn, rng, log) -> None:
+async def _run_body(cluster, fn, rng, log):
     await cluster.start()
-    await fn(cluster, rng, log)
+    return await fn(cluster, rng, log)
 
 
 def main(argv=None) -> int:

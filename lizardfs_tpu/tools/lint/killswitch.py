@@ -59,6 +59,7 @@ SWITCHES = {
     "LZ_PROF",             # always-on sampling profiler (on)
     "LZ_QOS",              # multi-tenant fair-share QoS plane (on)
     "LZ_HEAT",             # cluster heat map + adaptive replication (on)
+    "LZ_HA",               # autopilot failover: election + fencing (on)
 }
 
 # Value vars: one read site each; documented; spelling rules N/A.

@@ -157,6 +157,7 @@ DEFAULT_SITE = "frame_recv"
 DAEMON = "lizardfs_tpu/runtime/daemon.py"
 CLIENT = "lizardfs_tpu/client/client.py"
 HEAT = "lizardfs_tpu/master/heat.py"
+ELECTION = "lizardfs_tpu/ha/election.py"
 SLO = "lizardfs_tpu/runtime/slo.py"
 TRACING = "lizardfs_tpu/runtime/tracing.py"
 NATIVE_SERVE = "lizardfs_tpu/chunkserver/native_serve.py"
@@ -250,6 +251,22 @@ ANCHORS = (
      "per-session read-phase lift into the `top` rollup"),
     (NATIVE_SERVE, r"lz_serve_trace3",
      "native 10-slot trace drain (queue_us-bearing slot contract)"),
+    # autopilot failover (ISSUE 19): the lizardfs_ha_* families, the
+    # `ha` section of health/admin, and the epoch fence are standing
+    # surfaces — losing a gauge blinds the operator mid-incident, and
+    # losing the fence silently re-opens the split-brain window
+    (MASTER, r"gauge\(\s*\n?\s*[\"']ha_epoch[\"']",
+     "HA epoch gauge on every personality (lizardfs_ha_epoch)"),
+    (MASTER, r"gauge\(\s*\n?\s*[\"']ha_is_active[\"']",
+     "HA active-posture gauge (lizardfs_ha_is_active)"),
+    (MASTER, r"counter\([\"']ha_fenced[\"']\)\.inc\(",
+     "zombie ex-primary fence counter (lizardfs_ha_fenced_total)"),
+    (MASTER, r"def _ha_status\(",
+     "the `ha` admin command / health section (failover posture)"),
+    (MASTER, r"[\"']ha[\"']:\s*self\._ha_status\(\)",
+     "ha section of the cluster `health` rollup"),
+    (ELECTION, r"stale_votes_granted",
+     "arbiter leaderless-relaxation counter in election status"),
 )
 
 # files searched for OP_CLASSES coverage (who feeds each objective)

@@ -45,6 +45,9 @@ OPTIONAL_BY_CONVENTION = {
     "health_json",
     "replica_ok",
     "mirror",
+    # HA fencing epoch (ISSUE 19): rides every register/heartbeat
+    # surface as an additive tail; 0 = pre-HA peer, fencing disengaged
+    "epoch",
 }
 
 # (message, field) pairs that are additive-convention fields WITHIN one

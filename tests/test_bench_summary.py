@@ -145,6 +145,12 @@ def _fat_row() -> dict:
         "off": 812.4, "on": 934.7, "copies": 3, "boost_s": 1.85,
         "target_met": True,
     }
+    # failover RTO fiducial (this round: ISSUE 19) — the kill-primary
+    # drill's detect->elect->promote->first-acked-write outage
+    row["cluster_failover_rto_s"] = {
+        "rto_s": 3.77, "promote_s": 0.34, "epoch": 1,
+        "acked": 11, "lost": 0, "target_met": True,
+    }
     row["cluster_locate_storm_detail"] = {
         "files": 100000, "servers": 1000, "populate_s": 4.2,
         "cs_ingest": {"real_cs": 128, "parts_each": 2000, "ingest_s": 1.9},
@@ -256,6 +262,12 @@ def test_summary_line_fits_driver_tail():
         parsed.get("cluster_hotspot_read_MBps", {}).get("target_met")
         is True
         or "hotspot_read_MBps" in parsed.get("dropped", [])
+    )
+    # the failover RTO verdict rides the tail (or its drop is recorded);
+    # it sits LATE on the ladder — this round's headline fiducial
+    assert (
+        parsed.get("cluster_failover_rto_s", {}).get("lost") == 0
+        or "failover_rto_s" in parsed.get("dropped", [])
     )
     # the C-client NFS row is full-file-only (decision-note input):
     # it must never crowd verdict-bearing rows out of the tail

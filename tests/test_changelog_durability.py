@@ -32,6 +32,11 @@ def _scenario() -> list[dict]:
     xval = base64.b64encode(b"v1").decode()
     return [
         {"op": "session_new", "sid": 5},
+        # fenced promotion (HA): the epoch claim a freshly elected
+        # master commits first — twice, the second stale (replay must
+        # stay monotone via max())
+        {"op": "epoch_bump", "epoch": 1},
+        {"op": "epoch_bump", "epoch": 1},
         # namespace scaffolding
         {"op": "mknode", "parent": 1, "name": "d", "inode": 2, "ftype": 2,
          "mode": 0o755, "uid": 0, "gid": 0, "ts": TS, "goal": 1,

@@ -211,7 +211,7 @@ async def test_write_chain_init_reply_bounded(tmp_path):
 @pytest.mark.parametrize("seed", [1, 2, 3])
 @pytest.mark.parametrize("schedule", [
     "kill-write", "bitflip-read", "stall-acks", "shadow-stale",
-    "s3-multipart", "noisy-neighbor", "hot-spot",
+    "s3-multipart", "noisy-neighbor", "hot-spot", "kill-primary",
 ])
 async def test_chaos_schedules_full(tmp_path, schedule, seed):
     """The acceptance matrix: every schedule passes deterministically

@@ -8,6 +8,7 @@ import pytest
 
 from lizardfs_tpu.chunkserver.server import ChunkServer
 from lizardfs_tpu.client.client import Client
+from lizardfs_tpu.constants import OFF_SPELLINGS
 from lizardfs_tpu.ha.election import ElectionNode, LEADER
 from lizardfs_tpu.master.server import MasterServer
 from lizardfs_tpu.metalogger.server import Metalogger
@@ -371,6 +372,10 @@ async def test_failover_controller_exec_hooks(tmp_path):
         active, "na", addrs["na"], peers_of("na"),
         election_timeout=(0.2, 0.4),
     )
+    # what master/__main__ wires: the admin `ha` command and the health
+    # section report the election standing through this back-pointer
+    shadow.ha_controller = ctrl_shadow
+    active.ha_controller = ctrl_active
     # witness/arbiter node: quorum without a third master (uraft
     # deployments run an odd node count the same way)
     async def _noop():
@@ -399,6 +404,18 @@ async def test_failover_controller_exec_hooks(tmp_path):
                 break
         assert shadow.personality == "master"
         assert marker.read_text().strip() == "nb:master"
+        # autopilot promotion is FENCED: the winner's first committed
+        # write claimed the next cluster epoch, and the admin surface
+        # reports the election standing alongside it
+        assert shadow.meta.epoch == 1
+        ha = json.loads((await admin(shadow.port, "ha")).json)
+        assert ha["enabled"] is True
+        assert ha["epoch"] == 1
+        assert ha["personality"] == "master"
+        assert ha["state"] == LEADER
+        assert ha["promotions"] >= 1
+        health = json.loads((await admin(shadow.port, "health")).json)
+        assert health["ha"]["epoch"] == 1
     finally:
         await witness.stop()
         await ctrl_shadow.stop()
@@ -596,3 +613,267 @@ async def test_shadow_promotion_mid_replica_serving(tmp_path):
         for cs in servers:
             await cs.stop()
         await shadow.stop()
+
+
+@pytest.mark.parametrize("spelling", list(OFF_SPELLINGS))
+@pytest.mark.asyncio
+async def test_lz_ha_off_spelling_equivalence(tmp_path, monkeypatch, spelling):
+    """LZ_HA off (all four documented spellings) must reproduce the
+    manual-promotion tree byte for byte: promotion commits no
+    ``epoch_bump``, every epoch wire field stays 0 (fencing disengaged),
+    and the operator's ``promote-shadow`` command still works."""
+    monkeypatch.setenv("LZ_HA", spelling)
+    from lizardfs_tpu import constants
+
+    assert not constants.ha_enabled()
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    try:
+        c = Client("127.0.0.1", active.port)
+        await c.connect()
+        d = await c.mkdir(1, "dir")
+        # registration replies carried epoch 0 — nothing to adopt
+        assert c.cluster_epoch == 0
+        await c.close()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if shadow.changelog.version == active.changelog.version:
+                break
+        await active.stop()
+        reply = await admin(shadow.port, "promote-shadow")
+        assert reply.status == 0
+        # manual promotion committed NO epoch bump
+        assert shadow.meta.epoch == 0
+        assert not any(
+            op.get("op") == "epoch_bump"
+            for _, op in shadow.changelog.iter_entries(0)
+        )
+        c2 = Client("127.0.0.1", shadow.port)
+        await c2.connect()
+        assert (await c2.lookup(1, "dir")).inode == d.inode
+        assert c2.cluster_epoch == 0
+        await c2.close()
+        # the admin surface reports the subsystem off
+        ha = json.loads((await admin(shadow.port, "ha")).json)
+        assert ha["enabled"] is False
+        assert ha["epoch"] == 0
+    finally:
+        await shadow.stop()
+
+
+@pytest.mark.asyncio
+async def test_zombie_ex_primary_fenced_by_epoch(tmp_path):
+    """Split brain: the shadow is promoted while the old active still
+    runs. The epoch the promotion committed must fence the zombie — the
+    chunkserver hears the new epoch on its mirror plane (the promoted
+    master's refusal carries it), flips its command link, and the
+    zombie steps itself down the moment any peer presents the higher
+    epoch. Its late writes are refused, never merged."""
+    active = MasterServer(str(tmp_path / "m1"), goals=make_goals())
+    await active.start()
+    shadow = MasterServer(
+        str(tmp_path / "m2"), goals=make_goals(),
+        personality="shadow", active_addr=("127.0.0.1", active.port),
+    )
+    await shadow.start()
+    addrs = [("127.0.0.1", active.port), ("127.0.0.1", shadow.port)]
+    cs = ChunkServer(
+        str(tmp_path / "cs"), master_addr=addrs,
+        heartbeat_interval=0.2, wave_timeout=0.2,
+    )
+    cs.mirror_reregister_interval = 0.2
+    await cs.start()
+    c = Client("", 0, master_addrs=addrs, wave_timeout=0.2)
+    await c.connect()
+    try:
+        f = await c.create(1, "fence.bin")
+        payload = b"fenced" * 1000
+        await c.write_file(f.inode, payload)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if shadow.changelog.version == active.changelog.version:
+                break
+        assert shadow.changelog.version == active.changelog.version
+
+        # SPLIT BRAIN: promote the shadow while the active still serves
+        shadow.promote()
+        assert shadow.meta.epoch == 1
+
+        # convergence: keep poking the old primary's link so the fence
+        # propagates (cs mirror refusal -> command-link flip -> the
+        # zombie sees epoch 1 on a register/heartbeat and steps down;
+        # the client's severed link redials onto the new active)
+        async def poke():
+            try:
+                await c.getattr(f.inode)
+            except (ConnectionError, OSError):
+                pass
+
+        for _ in range(300):
+            await poke()
+            await asyncio.sleep(0.05)
+            if (
+                active.personality == "shadow"
+                and cs.cluster_epoch == 1
+                and len(shadow.cs_links) == 1
+                and c.cluster_epoch == 1
+            ):
+                break
+        assert active.personality == "shadow", "zombie never fenced itself"
+        assert active.metrics.counter("ha_fenced").total >= 1
+        assert cs.cluster_epoch == 1
+        assert len(shadow.cs_links) == 1
+        assert c.cluster_epoch == 1
+
+        # the surviving client reads through the new active; a client
+        # pinned to the fenced ex-primary is refused outright (the
+        # zombie's late-write path is closed)
+        assert await c.read_file(f.inode) == payload
+        zc = Client("127.0.0.1", active.port)
+        with pytest.raises(ConnectionError):
+            await zc.connect()
+        # and the new active's changelog is strictly ahead — nothing
+        # from the zombie was merged after the fence
+        assert shadow.changelog.version >= active.changelog.version
+    finally:
+        await c.close()
+        await cs.stop()
+        await shadow.stop()
+        await active.stop()
+
+
+@pytest.mark.asyncio
+async def test_arbiter_relaxes_version_rule_when_leaderless():
+    """Liveness: a vote-only arbiter whose archive momentarily leads
+    the surviving shadow's replay must not deadlock the election — the
+    dead active can never feed the shadow past it. After a long
+    leaderless window the arbiter grants the vote anyway; a real
+    master (can_lead=True) NEVER relaxes, since electing a behind
+    master would lose acknowledged writes."""
+
+    async def _noop():
+        pass
+
+    now = asyncio.get_running_loop().time()
+    arbiter = ElectionNode(
+        "w", ("127.0.0.1", 0), {"a": ("127.0.0.1", 1)},
+        get_version=lambda: 10, on_leader=_noop, can_lead=False,
+        election_timeout=(0.05, 0.1),
+    )
+    arbiter._leader_seen_at = now
+    arbiter._on_message(
+        {"type": "vote_req", "term": 1, "candidate": "a", "version": 5}
+    )
+    assert arbiter.voted_for is None, "behind candidate granted too early"
+    arbiter._leader_seen_at = now - 100.0  # long leaderless window
+    arbiter._on_message(
+        {"type": "vote_req", "term": 2, "candidate": "a", "version": 5}
+    )
+    assert arbiter.voted_for == "a"
+    assert arbiter.stale_votes_granted == 1
+
+    master_voter = ElectionNode(
+        "m", ("127.0.0.1", 0), {"a": ("127.0.0.1", 1)},
+        get_version=lambda: 10, on_leader=_noop, can_lead=True,
+        election_timeout=(0.05, 0.1),
+    )
+    master_voter._leader_seen_at = now - 100.0
+    master_voter._on_message(
+        {"type": "vote_req", "term": 1, "candidate": "a", "version": 5}
+    )
+    assert master_voter.voted_for is None, "a master relaxed the data rule"
+    assert master_voter.stale_votes_granted == 0
+
+
+def _election_race_trial():
+    """A 3-candidate + 1 vote-only-witness quorum under a permuted
+    scheduler: elect, kill the leader, re-elect. Pins the two Raft
+    safety properties the autopilot rests on: at most one leader per
+    term (ever), and a single leader eventually emerges — and the
+    witness (metalogger analog) never leads."""
+
+    async def trial():
+        ports = {f"n{i}": _free_udp_port() for i in range(3)}
+        ports["w"] = _free_udp_port()
+        all_addrs = {nid: ("127.0.0.1", p) for nid, p in ports.items()}
+        leaders_by_term: dict[int, set[str]] = {}
+        nodes: dict[str, ElectionNode] = {}
+
+        def make(nid):
+            async def on_leader():
+                n = nodes[nid]
+                leaders_by_term.setdefault(n.term, set()).add(nid)
+
+            async def on_follower(leader_id):
+                pass
+
+            peers = {k: v for k, v in all_addrs.items() if k != nid}
+            return ElectionNode(
+                nid, all_addrs[nid], peers,
+                get_version=lambda: 1,
+                on_leader=on_leader, on_follower=on_follower,
+                can_lead=(nid != "w"),
+                election_timeout=(0.1, 0.25), heartbeat_interval=0.03,
+            )
+
+        for nid in ports:
+            nodes[nid] = make(nid)
+            await nodes[nid].start()
+        try:
+            first = None
+            for _ in range(300):
+                await asyncio.sleep(0.02)
+                cur = [n for n, nd in nodes.items() if nd.state == LEADER]
+                if len(cur) == 1:
+                    first = cur[0]
+                    break
+            assert first is not None, "no leader elected"
+            assert first != "w", "vote-only witness won an election"
+
+            # the leader dies; the remaining 3-of-4 quorum re-elects
+            await nodes[first].stop()
+            second = None
+            for _ in range(400):
+                await asyncio.sleep(0.02)
+                cur = [
+                    n for n, nd in nodes.items()
+                    if n != first and nd.state == LEADER
+                ]
+                if len(cur) == 1:
+                    second = cur[0]
+                    break
+            assert second is not None, "no re-election after leader death"
+            assert second != "w"
+
+            # safety, across every interleaving this seed produced: no
+            # term ever crowned two leaders, and the witness never led
+            assert all(len(s) == 1 for s in leaders_by_term.values()), (
+                leaders_by_term
+            )
+            assert "w" not in {
+                n for s in leaders_by_term.values() for n in s
+            }
+        finally:
+            for n in nodes.values():
+                await n.stop()
+
+    return trial()
+
+
+@pytest.mark.parametrize(
+    "seed",
+    [1] + [pytest.param(s, marks=pytest.mark.slow) for s in (2, 3)],
+)
+def test_election_race_no_double_leader(seed):
+    """Race-hunt the election under detsched's permuted ready queue:
+    different seeds reorder vote/heartbeat/timeout callbacks; the
+    no-double-leader-per-term and eventual-single-leader invariants
+    must hold for every one of them."""
+    from lizardfs_tpu.runtime import detsched
+
+    detsched.run(_election_race_trial(), seed=seed)

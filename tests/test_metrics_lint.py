@@ -294,6 +294,11 @@ async def test_lint_live_daemon_registries(tmp_path):
         assert typed["lizardfs_heat_hot_ops_us"] == "histogram"
         assert "lizardfs_heat_tracked_cells" in typed
         assert 'kind="inode"' in text and 'kind="chunk"' in text
+        # HA posture gauges (ISSUE 19) ride every health tick on every
+        # personality — live here with epoch 0 (LZ_HA off in tier-1),
+        # so the family an operator watches mid-failover never vanishes
+        assert typed["lizardfs_ha_epoch"] == "gauge"
+        assert typed["lizardfs_ha_is_active"] == "gauge"
         # per-session accounting on the live page: the traffic above
         # attributed to the client's session, exposed as the labeled
         # histogram family (the `top` view's data source)
